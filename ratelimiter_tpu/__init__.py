@@ -1,0 +1,68 @@
+"""ratelimiter_tpu — a TPU-native distributed rate-limiting framework.
+
+A brand-new implementation of the capabilities of the reference
+``zahra-abedi/distributed-rate-limiter`` (Go + Redis; see /root/reference),
+re-designed TPU-first on JAX/XLA/Pallas:
+
+* Instead of one Redis round-trip per decision (reference
+  ``internal/ratelimiter/tokenbucket.go:172`` — ``client.Eval`` per call),
+  request keys are hashed and batched on the host and decided in a single
+  fused device call against HBM-resident state.
+* Instead of Redis Lua scripts as the atomic compute unit (reference
+  ``fixedwindow.go:21-27``), the atomic unit is a jitted batched kernel with
+  in-batch same-key sequencing (sort + segment scan).
+* Instead of Redis Cluster for horizontal scale (reference
+  ``docs/ARCHITECTURE.md:199-219``), multi-chip deployments shard traffic
+  over a ``jax.sharding.Mesh`` and merge per-chip sketches with ICI
+  collectives (``psum``).
+
+Public API (capability parity with reference ``internal/ratelimiter/interface.go``):
+
+    from ratelimiter_tpu import Algorithm, Config, Result, create_limiter
+
+    lim = create_limiter(Config(algorithm=Algorithm.SLIDING_WINDOW,
+                                limit=100, window=60.0), backend="exact")
+    res = lim.allow("user:1")          # -> Result
+    res = lim.allow_n("user:1", 10)    # atomic all-or-nothing batch of n
+    out = lim.allow_batch(["a","b"])   # first-class batched decision (TPU path)
+    lim.reset("user:1")
+    lim.close()
+"""
+
+from ratelimiter_tpu.core.types import Algorithm, Result, BatchResult
+from ratelimiter_tpu.core.config import Config, SketchParams, DenseParams, DEFAULT_PREFIX
+from ratelimiter_tpu.core.errors import (
+    RateLimiterError,
+    InvalidConfigError,
+    InvalidKeyError,
+    InvalidNError,
+    StorageUnavailableError,
+    ClosedError,
+)
+from ratelimiter_tpu.core.clock import Clock, SystemClock, ManualClock
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.algorithms.factory import create_limiter
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Result",
+    "BatchResult",
+    "Config",
+    "SketchParams",
+    "DenseParams",
+    "DEFAULT_PREFIX",
+    "RateLimiterError",
+    "InvalidConfigError",
+    "InvalidKeyError",
+    "InvalidNError",
+    "StorageUnavailableError",
+    "ClosedError",
+    "Clock",
+    "SystemClock",
+    "ManualClock",
+    "RateLimiter",
+    "create_limiter",
+    "__version__",
+]

@@ -1,0 +1,13 @@
+"""Algorithm implementations (L2 in SURVEY.md §1).
+
+Unlike the reference — where each algorithm file owns its Redis Lua script and
+there is no algorithm/storage seam (``tokenbucket.go:63-81`` injects a raw
+``*redis.Client``) — algorithms here are decision semantics over a Store
+abstraction (ratelimiter_tpu.storage), with exact (host) and device (dense /
+sketch) backends behind the same contract.
+"""
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.algorithms.factory import create_limiter
+
+__all__ = ["RateLimiter", "create_limiter"]

@@ -1,0 +1,182 @@
+"""Exact host-side (dict) backend — the conformance oracle.
+
+Plays the role miniredis plays for the reference (SURVEY.md §4.2.1): the same
+public code path, exact semantics, virtual time, no device. It is also the
+accuracy oracle the sketch backend's false-deny rate is measured against
+(BASELINE.json metric), standing in for the reference's Redis sliding-window
+oracle.
+
+Semantics follow the reference implementations (SURVEY.md §2.4) except where
+the documented contract wins over the code (deliberate divergences, pinned in
+tests/test_divergences.py):
+
+* allow_n is conditional-consume for ALL algorithms — denial consumes nothing
+  (the documented contract ``interface.go:104-105``; the reference's FW/SW
+  code INCRBYs before checking, §2.4.2).
+* remaining is uniformly "floor of free quota after this decision" — which is
+  exactly the reference token bucket's behavior (``tokenbucket.go:51``), and
+  for denied FW/SW is what the count would allow (the reference reports 0
+  there only because its denials consumed the quota).
+
+State GC: the reference leans on Redis TTLs (window for FW, 2x window for
+SW-prev and TB hashes — §2.4.9). Here idle entries are pruned lazily on access
+and by ``prune()`` using the same horizons.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional, Tuple
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.core.clock import Clock
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.types import (
+    Algorithm,
+    Result,
+    allowed_result,
+    denied_result,
+)
+
+
+class ExactLimiter(RateLimiter):
+    """Exact in-process limiter for all algorithms (TPU_SKETCH maps to exact
+    sliding-window semantics — it is the sketch's oracle)."""
+
+    def __init__(self, config: Config, clock: Optional[Clock] = None):
+        super().__init__(config, clock)
+        self._lock = threading.Lock()
+        # fixed window: formatted key -> (window_start, count)
+        self._fw: Dict[str, Tuple[float, int]] = {}
+        # sliding window: formatted key -> (curr_start, curr_count, prev_count)
+        self._sw: Dict[str, Tuple[float, int, int]] = {}
+        # token bucket: formatted key -> (tokens, last_refill)
+        self._tb: Dict[str, Tuple[float, float]] = {}
+
+    # ------------------------------------------------------------------ allow
+
+    def _allow_n(self, key: str, n: int, now: float) -> Result:
+        algo = self.config.algorithm
+        with self._lock:
+            if algo is Algorithm.FIXED_WINDOW:
+                return self._fixed_window(key, n, now)
+            if algo in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
+                return self._sliding_window(key, n, now)
+            return self._token_bucket(key, n, now)
+
+    def _fixed_window(self, key: str, n: int, now: float) -> Result:
+        """Reference ``fixedwindow.go:65-115``: counter per (key, window
+        start); windows wall-clock aligned via truncation (§2.4.14); allow iff
+        count + n <= limit (conditional consume, see module docstring)."""
+        cfg = self.config
+        window = float(cfg.window)
+        window_start = math.floor(now / window) * window
+        fkey = cfg.format_key(key)
+        start, count = self._fw.get(fkey, (window_start, 0))
+        if start != window_start:
+            count = 0  # lazy window roll — the analog of the FW key TTL
+        reset_at = window_start + window
+        if count + n <= cfg.limit:
+            count += n
+            self._fw[fkey] = (window_start, count)
+            return allowed_result(cfg.limit, cfg.limit - count, reset_at)
+        self._fw[fkey] = (window_start, count)
+        return denied_result(cfg.limit, cfg.limit - count, reset_at - now, reset_at)
+
+    def _sliding_window(self, key: str, n: int, now: float) -> Result:
+        """Reference ``slidingwindow.go:68-122``: weighted two-window count
+        ``prev*(1-progress) + curr`` (``slidingwindow.go:190-197``), windows
+        wall-clock aligned. Unlike the reference (which increments in Lua then
+        decides in Go — a check-act race it accepts, §2.4.4), the check and
+        the consume here are one atomic step."""
+        cfg = self.config
+        window = float(cfg.window)
+        curr_start = math.floor(now / window) * window
+        fkey = cfg.format_key(key)
+        start, curr, prev = self._sw.get(fkey, (curr_start, 0, 0))
+        if start != curr_start:
+            if start == curr_start - window:
+                prev, curr = curr, 0     # rolled exactly one window
+            else:
+                prev, curr = 0, 0        # idle > one window: both expired
+        progress = (now - curr_start) / window
+        weighted = prev * (1.0 - progress) + curr
+        reset_at = curr_start + window
+        if weighted + n <= cfg.limit:
+            curr += n
+            self._sw[fkey] = (curr_start, curr, prev)
+            remaining = cfg.limit - int(weighted + n)
+            return allowed_result(cfg.limit, remaining, reset_at)
+        self._sw[fkey] = (curr_start, curr, prev)
+        remaining = cfg.limit - int(weighted)
+        return denied_result(cfg.limit, remaining, reset_at - now, reset_at)
+
+    def _token_bucket(self, key: str, n: int, now: float) -> Result:
+        """Reference Lua ``tokenbucket.go:23-52``: lazy continuous refill
+        ``tokens = min(cap, tokens + elapsed*rate)``; new buckets start full;
+        consume only if sufficient (denial consumes nothing — the one
+        algorithm where the reference already honors the contract)."""
+        cfg = self.config
+        rate = cfg.refill_rate
+        fkey = cfg.format_key(key)
+        tokens, last = self._tb.get(fkey, (float(cfg.limit), now))
+        elapsed = max(0.0, now - last)
+        tokens = min(float(cfg.limit), tokens + elapsed * rate)
+        # Reference reset_at approximation: now + time to fill the whole
+        # bucket from empty, regardless of level (``tokenbucket.go:161-165``).
+        reset_at = now + cfg.limit / rate
+        if tokens >= n:
+            tokens -= n
+            self._tb[fkey] = (tokens, now)
+            return allowed_result(cfg.limit, math.floor(tokens), reset_at)
+        self._tb[fkey] = (tokens, now)
+        # Reference ``tokenbucket.go:122-130``: time until the deficit refills.
+        retry_after = (n - tokens) / rate
+        return denied_result(cfg.limit, math.floor(tokens), retry_after, reset_at)
+
+    # ------------------------------------------------------------------ reset
+
+    def _reset(self, key: str) -> None:
+        """Clears all state for key. For FW the reference deletes only the
+        current window's Redis key (``fixedwindow.go:118-128``, §2.4.12);
+        since expired windows can never influence a decision, deleting
+        everything is observationally equivalent — pinned in tests."""
+        fkey = self.config.format_key(key)
+        with self._lock:
+            self._fw.pop(fkey, None)
+            self._sw.pop(fkey, None)
+            self._tb.pop(fkey, None)
+
+    # ------------------------------------------------------------------ GC
+
+    def prune(self, now: Optional[float] = None) -> int:
+        """Drop entries the reference's TTLs would have expired (§2.4.9):
+        FW after 1 window, SW and TB after 2 windows of idleness. Returns the
+        number of entries dropped."""
+        t = self.clock.now() if now is None else float(now)
+        window = float(self.config.window)
+        dropped = 0
+        with self._lock:
+            for fkey, (start, _count) in list(self._fw.items()):
+                if t - start >= window:
+                    del self._fw[fkey]
+                    dropped += 1
+            for fkey, (start, _c, _p) in list(self._sw.items()):
+                if t - start >= 2 * window:
+                    del self._sw[fkey]
+                    dropped += 1
+            for fkey, (_tok, last) in list(self._tb.items()):
+                if t - last >= 2 * window:
+                    del self._tb[fkey]
+                    dropped += 1
+        return dropped
+
+    # ------------------------------------------------------------------ intro
+
+    def key_count(self) -> int:
+        """Number of live state entries (memory-footprint introspection; the
+        analog of the reference's ~100-200 B/user Redis accounting,
+        ``docs/ARCHITECTURE.md:458-469``)."""
+        with self._lock:
+            return len(self._fw) + len(self._sw) + len(self._tb)

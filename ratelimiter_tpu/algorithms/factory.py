@@ -1,0 +1,52 @@
+"""Limiter factory — the constructor seam.
+
+Reference parity: ``NewTokenBucket`` / ``NewSlidingWindow`` / ``NewFixedWindow``
+(``tokenbucket.go:63``, ``slidingwindow.go:41``, ``fixedwindow.go:38``) each
+validate config and return the interface type. Here one factory selects both
+the algorithm (Config.algorithm) and the state backend:
+
+* ``exact``  — host dict, exact semantics, the oracle (algorithms/exact.py).
+* ``dense``  — JAX device arrays, slot-addressed exact state, batched kernels.
+* ``sketch`` — count-min sketch + sub-window decay on device; approximate,
+  unbounded keys (the BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ratelimiter_tpu.core.clock import Clock
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.core.types import Algorithm
+from ratelimiter_tpu.algorithms.base import RateLimiter
+
+BACKENDS = ("exact", "dense", "sketch")
+
+
+def create_limiter(
+    config: Config,
+    backend: str = "exact",
+    clock: Optional[Clock] = None,
+    **kwargs,
+) -> RateLimiter:
+    """Build a limiter. Validation happens in the RateLimiter constructor
+    (reference shape: validate-then-construct, ``tokenbucket.go:63-81``);
+    no device or I/O work happens until the first decision."""
+    if backend == "exact":
+        from ratelimiter_tpu.algorithms.exact import ExactLimiter
+
+        return ExactLimiter(config, clock)
+    if backend == "dense":
+        from ratelimiter_tpu.algorithms.dense import DenseLimiter
+
+        return DenseLimiter(config, clock, **kwargs)
+    if backend == "sketch":
+        if config.algorithm not in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH,
+                                    Algorithm.FIXED_WINDOW):
+            raise InvalidConfigError(
+                f"sketch backend supports windowed algorithms, got {config.algorithm}")
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+
+        return SketchLimiter(config, clock, **kwargs)
+    raise InvalidConfigError(f"unknown backend {backend!r}; expected one of {BACKENDS}")

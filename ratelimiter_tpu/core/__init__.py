@@ -1,0 +1,9 @@
+"""Core types, configuration, errors and clock for ratelimiter_tpu.
+
+Capability parity with reference ``internal/ratelimiter/{interface,config,
+result,errors}.go`` (L3 in SURVEY.md §1), with the reference's dead code
+made live: result constructors are used by every backend, every error
+sentinel has a raising site, and empty keys are rejected (the reference
+defines ``ErrInvalidKey`` but never checks it — ``errors.go:13``,
+``interface_test.go:246-251``).
+"""

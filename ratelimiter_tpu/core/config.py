@@ -1,0 +1,149 @@
+"""Configuration, validation, defaults, and key formatting.
+
+Parity with reference ``internal/ratelimiter/config.go`` and the Config struct
+(``interface.go:46-70``): algorithm, limit, window, key prefix, fail-open.
+Extended with the TPU deployment axis (sketch geometry, dense capacity,
+admission-scan iterations) per SURVEY.md §5.6.
+
+Divergence note (deliberate, SURVEY.md §2.4.8): in the reference an
+empty-string prefix means "no prefix" inside ``FormatKey`` (``config.go:71-77``)
+but ``WithDefaults`` re-instates the default prefix, so "no prefix" is
+unreachable through public constructors. Here ``key_prefix=None`` (the default)
+means "use DEFAULT_PREFIX" and ``key_prefix=""`` genuinely means "no prefix" —
+the documented behavior becomes reachable. tests/test_config.py pins both.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.core.types import Algorithm
+
+#: Reference ``config.go:11``.
+DEFAULT_PREFIX = "ratelimit"
+
+#: Reference bounds, ``config.go:31-47``.
+MIN_WINDOW_SECONDS = 0.001
+MAX_WINDOW_SECONDS = 365.0 * 24 * 3600
+
+
+@dataclass(frozen=True)
+class SketchParams:
+    """Geometry of the count-min sketch backend (BASELINE.json configs 3-5).
+
+    depth × width int32 counters shared by all keys; the window is covered by
+    ``sub_windows`` equal sub-buckets (plus one boundary bucket in the ring)
+    so expiry is a cheap slab subtraction instead of Redis TTLs
+    (SURVEY.md §2.4.9, hard part #2).
+    """
+
+    depth: int = 4
+    width: int = 65536
+    sub_windows: int = 60
+    #: Conservative update: only raise the counters that are below the new
+    #: estimate; cuts CMS overestimate and therefore false denies
+    #: (SURVEY.md hard part #3).
+    conservative_update: bool = True
+    seed: int = 0x5bd1e995
+
+    def validate(self) -> None:
+        if self.depth < 1 or self.depth > 16:
+            raise InvalidConfigError(f"sketch depth must be in [1, 16], got {self.depth}")
+        if self.width < 16 or (self.width & (self.width - 1)) != 0:
+            raise InvalidConfigError(
+                f"sketch width must be a power of two >= 16, got {self.width}")
+        if self.sub_windows < 1 or self.sub_windows > 4096:
+            raise InvalidConfigError(
+                f"sketch sub_windows must be in [1, 4096], got {self.sub_windows}")
+
+
+@dataclass(frozen=True)
+class DenseParams:
+    """Geometry of the dense (exact, slot-addressed) device backend."""
+
+    #: Maximum number of distinct live keys; key -> slot assignment happens
+    #: host-side at ingest.
+    capacity: int = 1 << 16
+
+    def validate(self) -> None:
+        if self.capacity < 1:
+            raise InvalidConfigError(f"dense capacity must be positive, got {self.capacity}")
+
+
+@dataclass(frozen=True)
+class Config:
+    """User-facing limiter configuration (reference ``interface.go:46-70``).
+
+    Attributes:
+        algorithm: which algorithm decides (reference field ``Algorithm``).
+        limit: max requests per window (reference field ``Limit``); > 0.
+        window: window duration in float seconds (reference field ``Window``);
+            bounds 1 ms .. 365 d (``config.go:31-47``).
+        key_prefix: namespace prepended to every key. None -> DEFAULT_PREFIX;
+            "" -> genuinely no prefix (see module docstring).
+        fail_open: on backend failure allow (True) or raise (False)
+            (reference ``interface.go:65-69``, ADR-002).
+        max_batch_admission_iters: fixpoint iterations for same-key mixed-n
+            sequencing inside one batch (exact for uniform n; see
+            ops/segment.py).
+        sketch: CMS geometry (TPU_SKETCH / sketch backend only).
+        dense: dense-store geometry (dense backend only).
+    """
+
+    algorithm: Algorithm
+    limit: int
+    window: float
+    key_prefix: Optional[str] = None
+    fail_open: bool = False
+    max_batch_admission_iters: int = 4
+    sketch: SketchParams = field(default_factory=SketchParams)
+    dense: DenseParams = field(default_factory=DenseParams)
+
+    def validate(self) -> None:
+        """Reference ``Config.Validate`` (``config.go:16-50``), same bounds."""
+        if not isinstance(self.algorithm, Algorithm):
+            raise InvalidConfigError(f"invalid algorithm: {self.algorithm!r}")
+        if not isinstance(self.limit, int) or isinstance(self.limit, bool) or self.limit <= 0:
+            raise InvalidConfigError(f"limit must be a positive integer, got {self.limit!r}")
+        w = float(self.window)
+        if w < MIN_WINDOW_SECONDS:
+            raise InvalidConfigError(
+                f"window must be at least 1ms, got {self.window!r}")
+        if w > MAX_WINDOW_SECONDS:
+            raise InvalidConfigError(
+                f"window must be at most 365 days, got {self.window!r}")
+        if self.max_batch_admission_iters < 1:
+            raise InvalidConfigError(
+                "max_batch_admission_iters must be >= 1, "
+                f"got {self.max_batch_admission_iters}")
+        self.sketch.validate()
+        self.dense.validate()
+
+    def with_defaults(self) -> "Config":
+        """Non-mutating defaulting (reference ``config.go:54-67``): returns a
+        copy with ``key_prefix=None`` resolved to DEFAULT_PREFIX."""
+        if self.key_prefix is None:
+            return replace(self, key_prefix=DEFAULT_PREFIX)
+        return self
+
+    @property
+    def prefix(self) -> str:
+        """Resolved prefix ("" means no prefix)."""
+        return DEFAULT_PREFIX if self.key_prefix is None else self.key_prefix
+
+    def format_key(self, key: str, *parts: object) -> str:
+        """Reference ``config.go:81-87`` + the per-algorithm window suffixing
+        (``fixedwindow.go:139-141``): ``prefix:key[:part...]``; no leading
+        colon when prefix is ""."""
+        base = f"{self.prefix}:{key}" if self.prefix else key
+        for p in parts:
+            base = f"{base}:{p}"
+        return base
+
+    @property
+    def refill_rate(self) -> float:
+        """Token-bucket refill rate in tokens/second = limit / window
+        (reference ``tokenbucket.go:155-157``)."""
+        return self.limit / float(self.window)

@@ -1,0 +1,129 @@
+"""Algorithm enum, Result, and BatchResult.
+
+Parity with reference ``internal/ratelimiter/interface.go:9-43`` and
+``result.go:5-49``. The reference's result constructors are dead code
+(defined + tested, never called — SURVEY.md §2.1 row 3); here they are the
+only way backends build results, so the semantics in one place:
+
+* allowed  -> remaining = post-decision remaining quota, retry_after = 0
+* denied   -> remaining clamped >= 0, retry_after > 0 (algorithm-specific)
+* fail-open  (backend down, Config.fail_open=True)  -> allowed, remaining 0
+  (reference ``tokenbucket.go:103-110``)
+* fail-closed (backend down, fail_open=False) -> raises
+  StorageUnavailableError; there is deliberately no Result for it
+  (reference returns nil result + error, ``fixedwindow_integration_test.go:271-273``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+
+class Algorithm(enum.Enum):
+    """Rate-limiting algorithm (reference ``interface.go:9-23``) plus this
+    framework's own ``TPU_SKETCH`` (BASELINE.json north star)."""
+
+    TOKEN_BUCKET = "token_bucket"
+    SLIDING_WINDOW = "sliding_window"
+    FIXED_WINDOW = "fixed_window"
+    #: Count-min-sketch + sub-window decay; approximate, unbounded key space,
+    #: the TPU-native flagship. Semantics follow SLIDING_WINDOW.
+    TPU_SKETCH = "tpu_sketch"
+
+    def __str__(self) -> str:  # str(Algorithm.TOKEN_BUCKET) == "token_bucket"
+        return self.value
+
+
+@dataclass(frozen=True)
+class Result:
+    """Outcome of one allow / allow_n decision (reference ``interface.go:26-43``).
+
+    Attributes:
+        allowed: whether the request may proceed.
+        limit: the configured limit (for X-RateLimit-Limit headers).
+        remaining: quota remaining after this decision, clamped >= 0.
+        retry_after: seconds until a retry may succeed; 0 when allowed.
+        reset_at: unix seconds when the limit fully resets.
+        fail_open: True iff this is a backend-failure fail-open allowance.
+    """
+
+    allowed: bool
+    limit: int
+    remaining: int
+    retry_after: float
+    reset_at: float
+    fail_open: bool = False
+
+
+def allowed_result(limit: int, remaining: int, reset_at: float) -> Result:
+    """Reference ``result.go:6-14`` (NewAllowedResult)."""
+    return Result(allowed=True, limit=limit, remaining=max(0, int(remaining)),
+                  retry_after=0.0, reset_at=reset_at)
+
+
+def denied_result(limit: int, remaining: int, retry_after: float,
+                  reset_at: float) -> Result:
+    """Reference ``result.go:17-26`` (NewDeniedResult); retry_after clamped
+    >= 0 the way every algorithm clamps it (``fixedwindow.go:110-112``)."""
+    return Result(allowed=False, limit=limit, remaining=max(0, int(remaining)),
+                  retry_after=max(0.0, float(retry_after)), reset_at=reset_at)
+
+
+def fail_open_result(limit: int, reset_at: float) -> Result:
+    """Reference ``result.go:29-38``: backend down + fail_open -> allow with
+    remaining=0 (``tokenbucket.go:103-110``)."""
+    return Result(allowed=True, limit=limit, remaining=0, retry_after=0.0,
+                  reset_at=reset_at, fail_open=True)
+
+
+@dataclass
+class BatchResult:
+    """Vectorized outcome of allow_batch — the TPU-native first-class shape.
+
+    All arrays are NumPy, length = number of requests, in request order.
+    ``result(i)`` materializes a scalar Result for interop with the scalar
+    API (e.g. the serving fan-out).
+    """
+
+    allowed: np.ndarray      # bool[B]
+    limit: int
+    remaining: np.ndarray    # int64[B], post-decision, clamped >= 0
+    retry_after: np.ndarray  # float64[B] seconds, 0 where allowed
+    reset_at: np.ndarray     # float64[B] unix seconds
+    fail_open: bool = False
+
+    def __len__(self) -> int:
+        return int(self.allowed.shape[0])
+
+    def result(self, i: int) -> Result:
+        return Result(
+            allowed=bool(self.allowed[i]),
+            limit=self.limit,
+            remaining=int(self.remaining[i]),
+            retry_after=float(self.retry_after[i]),
+            reset_at=float(self.reset_at[i]),
+            fail_open=self.fail_open,
+        )
+
+    def results(self) -> list[Result]:
+        return [self.result(i) for i in range(len(self))]
+
+    @property
+    def allow_count(self) -> int:
+        return int(np.sum(self.allowed))
+
+
+def batch_fail_open(n: int, limit: int, reset_at: float) -> BatchResult:
+    """Whole-batch fail-open (dispatch failure with Config.fail_open=True)."""
+    return BatchResult(
+        allowed=np.ones(n, dtype=bool),
+        limit=limit,
+        remaining=np.zeros(n, dtype=np.int64),
+        retry_after=np.zeros(n, dtype=np.float64),
+        reset_at=np.full(n, reset_at, dtype=np.float64),
+        fail_open=True,
+    )

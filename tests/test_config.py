@@ -1,0 +1,111 @@
+"""Config validation / defaults / key formatting.
+
+Mirrors reference ``config_test.go`` (397 LoC of tables — SURVEY.md §4.1) in
+pytest-parametrized form, plus pins for this repo's deliberate divergences.
+"""
+
+import dataclasses
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    DEFAULT_PREFIX,
+    InvalidConfigError,
+    SketchParams,
+)
+from ratelimiter_tpu.core.config import MAX_WINDOW_SECONDS
+
+
+def cfg(**kw):
+    base = dict(algorithm=Algorithm.FIXED_WINDOW, limit=100, window=60.0)
+    base.update(kw)
+    return Config(**base)
+
+
+class TestValidate:
+    def test_valid(self):
+        cfg().validate()
+
+    @pytest.mark.parametrize("limit", [0, -1, -100])
+    def test_nonpositive_limit(self, limit):
+        with pytest.raises(InvalidConfigError, match="limit"):
+            cfg(limit=limit).validate()
+
+    @pytest.mark.parametrize("limit", [1.5, "10", None, True])
+    def test_non_integer_limit(self, limit):
+        with pytest.raises(InvalidConfigError, match="limit"):
+            cfg(limit=limit).validate()
+
+    def test_window_too_small(self):
+        # Reference bound: >= 1ms (config.go:31-47)
+        with pytest.raises(InvalidConfigError, match="1ms"):
+            cfg(window=0.0005).validate()
+        cfg(window=0.001).validate()
+
+    def test_window_too_large(self):
+        # Reference bound: <= 365 days
+        with pytest.raises(InvalidConfigError, match="365"):
+            cfg(window=MAX_WINDOW_SECONDS + 1).validate()
+        cfg(window=MAX_WINDOW_SECONDS).validate()
+
+    def test_invalid_algorithm(self):
+        with pytest.raises(InvalidConfigError, match="algorithm"):
+            cfg(algorithm="token_bucket").validate()  # must be the enum
+
+    @pytest.mark.parametrize("algo", list(Algorithm))
+    def test_all_algorithms_valid(self, algo):
+        cfg(algorithm=algo).validate()
+
+    def test_sketch_width_power_of_two(self):
+        with pytest.raises(InvalidConfigError, match="power of two"):
+            cfg(sketch=SketchParams(width=1000)).validate()
+
+    def test_sketch_depth_bounds(self):
+        with pytest.raises(InvalidConfigError, match="depth"):
+            cfg(sketch=SketchParams(depth=0)).validate()
+
+
+class TestDefaults:
+    def test_default_prefix_applied(self):
+        c = cfg().with_defaults()
+        assert c.key_prefix == DEFAULT_PREFIX
+
+    def test_with_defaults_non_mutating(self):
+        # Reference WithDefaults returns a copy (config.go:54-67)
+        c = cfg()
+        c2 = c.with_defaults()
+        assert c.key_prefix is None and c2.key_prefix == DEFAULT_PREFIX
+
+    def test_explicit_prefix_kept(self):
+        c = cfg(key_prefix="myapp").with_defaults()
+        assert c.key_prefix == "myapp"
+
+    def test_empty_prefix_reachable(self):
+        """Deliberate divergence (SURVEY.md §2.4.8): in the reference, empty
+        prefix is documented but unreachable (WithDefaults re-instates the
+        default). Here "" survives defaulting and means no prefix."""
+        c = cfg(key_prefix="").with_defaults()
+        assert c.key_prefix == ""
+        assert c.format_key("user:1") == "user:1"
+
+    def test_frozen(self):
+        with pytest.raises(dataclasses.FrozenInstanceError):
+            cfg().limit = 5  # type: ignore[misc]
+
+
+class TestFormatKey:
+    def test_default(self):
+        assert cfg().format_key("user:1") == "ratelimit:user:1"
+
+    def test_custom_prefix(self):
+        assert cfg(key_prefix="app").format_key("k") == "app:k"
+
+    def test_window_suffix(self):
+        # FW/SW key schema: prefix:key:windowStart (fixedwindow.go:139-141)
+        assert cfg().format_key("k", 1700000000) == "ratelimit:k:1700000000"
+
+    def test_refill_rate(self):
+        # rate = limit / window (tokenbucket.go:155-157)
+        assert cfg(limit=120, window=60.0).refill_rate == pytest.approx(2.0)

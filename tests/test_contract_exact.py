@@ -1,0 +1,7 @@
+"""Contract suite instantiated for the exact backend (the oracle)."""
+
+from tests.contract import ContractTests
+
+
+class TestExactContract(ContractTests):
+    backend = "exact"

@@ -1,0 +1,85 @@
+"""Pins for every deliberate divergence from the reference implementation.
+
+SURVEY.md §7.4.7: where the reference's code contradicts its own documented
+contract, this framework follows the contract — each such decision is pinned
+here with the reference citation, so the divergence is explicit and tested
+rather than accidental.
+"""
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidKeyError,
+    ManualClock,
+    create_limiter,
+)
+
+
+def make(algo, **kw):
+    clock = ManualClock()
+    cfg = Config(algorithm=algo, limit=kw.pop("limit", 10), window=kw.pop("window", 60.0), **kw)
+    return create_limiter(cfg, backend="exact", clock=clock), clock
+
+
+@pytest.mark.parametrize("algo", [Algorithm.FIXED_WINDOW, Algorithm.SLIDING_WINDOW])
+def test_denied_allow_n_consumes_nothing_in_windows(algo):
+    """Reference FW/SW increment unconditionally before checking
+    (``fixedwindow.go:22``, ``slidingwindow.go:24``), so a denied AllowN(5)
+    inflates the counter and a following AllowN(2) is wrongly denied —
+    violating the documented contract ``interface.go:104-105`` (SURVEY.md
+    §2.4.2). We follow the contract: after 9/10 consumed, a denied AllowN(5)
+    leaves quota at 9, and AllowN(1) still succeeds."""
+    lim, _ = make(algo, limit=10)
+    assert lim.allow_n("k", 9).allowed
+    assert not lim.allow_n("k", 5).allowed
+    res = lim.allow_n("k", 1)
+    assert res.allowed  # the reference's FW/SW would deny here
+    lim.close()
+
+
+def test_empty_key_is_validated():
+    """Reference defines ErrInvalidKey (``errors.go:13``) and its dormant
+    contract suite expects it (``interface_test.go:246-251``), but no code
+    path validates keys (SURVEY.md §2.4.11). We validate."""
+    lim, _ = make(Algorithm.TOKEN_BUCKET)
+    with pytest.raises(InvalidKeyError):
+        lim.allow("")
+    lim.close()
+
+
+def test_close_does_not_kill_shared_state():
+    """Reference Close() closes the *injected shared* redis client
+    (``tokenbucket.go:147-152``), so closing one limiter breaks every other
+    limiter sharing it (SURVEY.md §2.4.13). Here close() only invalidates the
+    closed limiter."""
+    clock = ManualClock()
+    cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=5, window=60.0)
+    a = create_limiter(cfg, backend="exact", clock=clock)
+    b = create_limiter(cfg, backend="exact", clock=clock)
+    a.close()
+    assert b.allow("k").allowed  # unaffected
+    b.close()
+
+
+def test_fw_reset_equivalent_to_current_window_delete():
+    """Reference FW Reset deletes only the current window's key
+    (``fixedwindow.go:118-128``, §2.4.12). Expired windows can never affect a
+    decision, so clearing all state is observationally equivalent — shown
+    here: state from an old window has no effect either way."""
+    lim, clock = make(Algorithm.FIXED_WINDOW, limit=2, window=10.0)
+    clock.set(1000.0)
+    lim.allow_n("k", 2)
+    clock.set(1015.0)          # old window expired on its own
+    assert lim.allow("k").allowed
+    lim.reset("k")
+    assert lim.allow("k").allowed
+    lim.close()
+
+
+def test_empty_prefix_reachable():
+    """SURVEY.md §2.4.8: reference makes the documented empty-prefix behavior
+    unreachable. Here Config(key_prefix="") is honored."""
+    cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=5, window=60.0, key_prefix="")
+    assert cfg.with_defaults().format_key("user") == "user"

@@ -1,0 +1,181 @@
+"""Algorithm-specific semantics on the exact backend, under virtual time.
+
+Covers the reference's integration scenarios (SURVEY.md §4.1 row 11) —
+window boundaries, refill, burst, weighting — deterministically via
+ManualClock instead of miniredis FastForward + real sleeps.
+"""
+
+import math
+
+import pytest
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, create_limiter
+
+
+def make(algo, limit=100, window=60.0, start=1_700_000_000.0, **kw):
+    clock = ManualClock(start)
+    lim = create_limiter(Config(algorithm=algo, limit=limit, window=window, **kw),
+                         backend="exact", clock=clock)
+    return lim, clock
+
+
+# --------------------------------------------------------------- fixed window
+
+class TestFixedWindow:
+    def test_window_rolls(self):
+        # Window boundary clears the count (fixedwindow_integration_test.go:173-180)
+        lim, clock = make(Algorithm.FIXED_WINDOW, limit=2, window=10.0, start=1000.0)
+        assert lim.allow("k").allowed and lim.allow("k").allowed
+        assert not lim.allow("k").allowed
+        clock.set(1010.0)  # next window
+        assert lim.allow("k").allowed
+
+    def test_windows_wall_clock_aligned(self):
+        # Truncation semantics (fixedwindow.go:71-72): window starts at
+        # floor(now/window)*window, not at first request.
+        lim, clock = make(Algorithm.FIXED_WINDOW, limit=1, window=10.0, start=1008.0)
+        assert lim.allow("k").allowed
+        clock.set(1011.0)  # only 3s later but into the next aligned window
+        assert lim.allow("k").allowed
+
+    def test_reset_at_is_window_end(self):
+        lim, _ = make(Algorithm.FIXED_WINDOW, limit=5, window=10.0, start=1003.0)
+        res = lim.allow("k")
+        assert res.reset_at == pytest.approx(1010.0)
+
+    def test_retry_after_is_time_to_reset(self):
+        lim, _ = make(Algorithm.FIXED_WINDOW, limit=1, window=10.0, start=1003.0)
+        lim.allow("k")
+        res = lim.allow("k")
+        assert not res.allowed
+        assert res.retry_after == pytest.approx(7.0)
+
+
+# ------------------------------------------------------------- sliding window
+
+class TestSlidingWindow:
+    @pytest.mark.parametrize("progress,expected_weight", [
+        (0.0, 1.0), (0.25, 0.75), (0.5, 0.5), (1.0 - 1e-9, 0.0),
+    ])
+    def test_weighted_count(self, progress, expected_weight):
+        # prev*(1-progress)+curr at 0/25/50/100% (slidingwindow_test.go:176-238)
+        window = 100.0
+        lim, clock = make(Algorithm.SLIDING_WINDOW, limit=100, window=window, start=0.0)
+        # Fill previous window with exactly 80.
+        assert lim.allow_n("k", 80).allowed
+        clock.set(window + progress * window)
+        res = lim.allow("k")
+        weighted_before = 80 * expected_weight
+        assert res.allowed == (weighted_before + 1 <= 100)
+        if res.allowed:
+            assert res.remaining == 100 - int(weighted_before + 1)
+
+    def test_smooths_boundary_burst(self):
+        # The boundary-gaming FW allows (docs/ALGORITHMS.md) is blocked:
+        # 100 at end of window + 100 at start of next must not both pass.
+        lim, clock = make(Algorithm.SLIDING_WINDOW, limit=100, window=60.0, start=0.0)
+        clock.set(59.0)
+        assert lim.allow_n("k", 100).allowed
+        clock.set(61.0)
+        res = lim.allow_n("k", 100)
+        assert not res.allowed  # weighted ≈ 100*(1-1/60) ≈ 98.3
+
+    def test_idle_two_windows_clears(self):
+        lim, clock = make(Algorithm.SLIDING_WINDOW, limit=5, window=10.0, start=0.0)
+        lim.allow_n("k", 5)
+        clock.set(25.0)  # skipped a whole window: prev must be 0, not stale
+        res = lim.allow_n("k", 5)
+        assert res.allowed
+
+    def test_denied_remaining_reports_free_quota(self):
+        # Unified remaining semantics (module docstring of exact.py): a
+        # denied allow_n(n) with some quota left reports that quota.
+        lim, _ = make(Algorithm.SLIDING_WINDOW, limit=10, window=60.0)
+        lim.allow_n("k", 8)
+        res = lim.allow_n("k", 5)
+        assert not res.allowed and res.remaining == 2
+
+
+# --------------------------------------------------------------- token bucket
+
+class TestTokenBucket:
+    def test_starts_full_burst(self):
+        # New bucket starts at capacity (tokenbucket.go Lua: `or capacity`).
+        lim, _ = make(Algorithm.TOKEN_BUCKET, limit=50, window=60.0)
+        assert lim.allow_n("k", 50).allowed
+        assert not lim.allow("k").allowed
+
+    def test_continuous_refill(self):
+        # rate = limit/window = 1 token/s; fractional refill is continuous,
+        # not window-stepped (tokenbucket.go:36-38).
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=60, window=60.0)
+        assert lim.allow_n("k", 60).allowed
+        clock.advance(1.5)
+        assert lim.allow("k").allowed          # 1.5 tokens accrued
+        assert not lim.allow("k").allowed      # only 0.5 left
+        clock.advance(0.5)
+        assert lim.allow("k").allowed
+
+    def test_refill_caps_at_limit(self):
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=10, window=10.0)
+        lim.allow_n("k", 10)
+        clock.advance(1000.0)
+        assert lim.allow_n("k", 10).allowed
+        assert not lim.allow("k").allowed  # not 10 + surplus
+
+    def test_denial_consumes_nothing(self):
+        # The reference TB already honors this (tokenbucket.go:41-45).
+        lim, _ = make(Algorithm.TOKEN_BUCKET, limit=10, window=60.0)
+        lim.allow_n("k", 8)
+        assert not lim.allow_n("k", 5).allowed
+        assert lim.allow_n("k", 2).allowed
+
+    def test_retry_after_is_deficit_over_rate(self):
+        # retry_after = (n - tokens)/rate (tokenbucket.go:122-130)
+        lim, _ = make(Algorithm.TOKEN_BUCKET, limit=60, window=60.0)  # 1 tok/s
+        lim.allow_n("k", 60)
+        res = lim.allow_n("k", 30)
+        assert not res.allowed
+        assert res.retry_after == pytest.approx(30.0)
+
+    def test_reset_at_approximation(self):
+        # reset_at = now + window (full-fill approximation,
+        # tokenbucket.go:161-165) regardless of current level.
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=10, window=60.0, start=500.0)
+        res = lim.allow("k")
+        assert res.reset_at == pytest.approx(560.0)
+
+    def test_remaining_is_floor(self):
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=10, window=10.0)  # 1/s
+        lim.allow_n("k", 10)
+        clock.advance(2.5)
+        res = lim.allow("k")  # 2.5 tokens -> consume 1 -> 1.5 -> floor 1
+        assert res.allowed and res.remaining == 1
+
+
+# ------------------------------------------------------------------ pruning
+
+class TestPrune:
+    def test_prune_drops_idle_entries(self):
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=10, window=10.0)
+        lim.allow("a")
+        lim.allow("b")
+        assert lim.key_count() == 2
+        clock.advance(19.0)
+        assert lim.prune() == 0      # TTL horizon is 2x window (SURVEY §2.4.9)
+        clock.advance(2.0)
+        assert lim.prune() == 2
+        assert lim.key_count() == 0
+
+    def test_prune_horizons_per_algorithm(self):
+        fw, fclock = make(Algorithm.FIXED_WINDOW, limit=10, window=10.0, start=1000.0)
+        fw.allow("a")
+        fclock.set(1010.0)
+        assert fw.prune() == 1       # FW horizon is 1 window
+
+    def test_pruned_key_starts_fresh(self):
+        lim, clock = make(Algorithm.TOKEN_BUCKET, limit=5, window=10.0)
+        lim.allow_n("k", 5)
+        clock.advance(21.0)
+        lim.prune()
+        assert lim.allow_n("k", 5).allowed  # fresh bucket, full again

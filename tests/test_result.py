@@ -1,0 +1,58 @@
+"""Result constructors (reference ``result_test.go`` — but here the
+constructors are live code used by every backend, not dead scaffolding)."""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.core.types import (
+    BatchResult,
+    allowed_result,
+    batch_fail_open,
+    denied_result,
+    fail_open_result,
+)
+
+
+def test_allowed():
+    r = allowed_result(limit=100, remaining=42, reset_at=123.0)
+    assert r.allowed and r.limit == 100 and r.remaining == 42
+    assert r.retry_after == 0.0 and r.reset_at == 123.0 and not r.fail_open
+
+
+def test_allowed_clamps_remaining():
+    assert allowed_result(10, -3, 0.0).remaining == 0
+
+
+def test_denied():
+    r = denied_result(limit=10, remaining=0, retry_after=5.5, reset_at=99.0)
+    assert not r.allowed and r.retry_after == 5.5
+
+
+def test_denied_clamps():
+    r = denied_result(10, -1, -2.0, 0.0)
+    assert r.remaining == 0 and r.retry_after == 0.0
+
+
+def test_fail_open():
+    r = fail_open_result(limit=7, reset_at=50.0)
+    assert r.allowed and r.fail_open and r.remaining == 0
+
+
+def test_batch_result_scalarizes():
+    b = BatchResult(
+        allowed=np.array([True, False]),
+        limit=5,
+        remaining=np.array([4, 0]),
+        retry_after=np.array([0.0, 3.0]),
+        reset_at=np.array([10.0, 10.0]),
+    )
+    assert len(b) == 2 and b.allow_count == 1
+    r1 = b.result(1)
+    assert not r1.allowed and r1.retry_after == 3.0 and r1.limit == 5
+    assert [r.allowed for r in b.results()] == [True, False]
+
+
+def test_batch_fail_open():
+    b = batch_fail_open(3, limit=9, reset_at=1.0)
+    assert b.fail_open and b.allow_count == 3
+    assert b.result(0).fail_open
