@@ -1,0 +1,203 @@
+"""Dense device backend: exact semantics, slot-addressed HBM state.
+
+The TPU answer to "Redis holds a key per user" (reference
+``docs/ARCHITECTURE.md:458-469``): keys are assigned integer slots host-side
+at ingest (the analog of Redis's keyspace hash), state lives in dense int64
+arrays in device memory, and every decision batch is one fused jitted call
+(ops/dense_kernels.py). Exactness matches the oracle bit-for-bit; capacity is
+bounded by the configured slot count (the sketch backend lifts that bound at
+the price of approximation).
+
+Failure semantics (reference ADR-002, ``interface.go:65-69``): any dispatch
+failure — including slot exhaustion, the analog of Redis OOM — resolves per
+Config.fail_open: allow with the fail_open flag set (the reference swallows
+the error the same way, ``tokenbucket.go:100-112``) or raise
+StorageUnavailableError.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.core.clock import Clock, MICROS, to_micros
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import StorageUnavailableError
+from ratelimiter_tpu.core.types import (
+    Algorithm,
+    BatchResult,
+    Result,
+    batch_fail_open,
+)
+
+_MIN_PAD = 8
+
+
+def _pad_size(n: int) -> int:
+    """Next power of two >= n (>= _MIN_PAD): bounds the number of distinct
+    batch shapes XLA compiles (first compile is slow; shapes are cached)."""
+    size = _MIN_PAD
+    while size < n:
+        size *= 2
+    return size
+
+
+class DenseLimiter(RateLimiter):
+    def __init__(self, config: Config, clock: Optional[Clock] = None,
+                 capacity: Optional[int] = None):
+        super().__init__(config, clock)
+        # Import lazily so the exact backend works without JAX present.
+        from ratelimiter_tpu.ops import dense_kernels
+
+        self._capacity = int(capacity if capacity is not None
+                             else self.config.dense.capacity)
+        self._window_us = to_micros(self.config.window)
+        self._step = dense_kernels.build_step(self.config)
+        self._state = dense_kernels.init_state(
+            self.config.algorithm, self._capacity, self.config.limit)
+        self._fresh_row = {
+            k: np.asarray(v[-1]) for k, v in self._state.items()
+        }  # padding row == pristine per-slot state, used to reset slots
+        self._slots: Dict[str, int] = {}
+        self._free: List[int] = list(range(self._capacity - 1, -1, -1))
+        self._last_used = np.zeros(self._capacity, dtype=np.int64)  # us
+        self._lock = threading.Lock()
+        self._injected_failure: Optional[Exception] = None
+
+    # ------------------------------------------------------------ slot admin
+
+    def _assign_slots(self, keys: List[str], now_us: int) -> np.ndarray:
+        sids = np.empty(len(keys), dtype=np.int32)
+        for i, key in enumerate(keys):
+            fkey = self.config.format_key(key)
+            slot = self._slots.get(fkey)
+            if slot is None:
+                if not self._free:
+                    self._prune_locked(now_us)
+                if not self._free:
+                    raise StorageUnavailableError(
+                        f"dense store full ({self._capacity} slots); "
+                        "prune idle keys or use the sketch backend")
+                slot = self._free.pop()
+                self._slots[fkey] = slot
+                self._zero_slot(slot)
+            sids[i] = slot
+            self._last_used[slot] = now_us
+        return sids
+
+    def _zero_slot(self, slot: int) -> None:
+        """Restore a slot to pristine state (count 0 / full bucket) before
+        reuse. Eager op outside jit; rare path (reset / slot recycling)."""
+        self._state = {
+            k: v.at[slot].set(self._fresh_row[k]) for k, v in self._state.items()
+        }
+
+    def _prune_locked(self, now_us: int) -> int:
+        """Free slots idle for >= 2 windows — the TTL analog (SURVEY.md
+        §2.4.9). Lock must be held."""
+        horizon = now_us - 2 * self._window_us
+        dropped = 0
+        for fkey, slot in list(self._slots.items()):
+            if self._last_used[slot] <= horizon:
+                del self._slots[fkey]
+                self._free.append(slot)
+                self._zero_slot(slot)
+                dropped += 1
+        return dropped
+
+    def prune(self, now: Optional[float] = None) -> int:
+        t_us = to_micros(self.clock.now() if now is None else float(now))
+        with self._lock:
+            return self._prune_locked(t_us)
+
+    def key_count(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    # -------------------------------------------------------------- dispatch
+
+    def _dispatch(self, keys: List[str], ns: np.ndarray, now: float) -> BatchResult:
+        import jax.numpy as jnp
+
+        now_us = to_micros(now)
+        with self._lock:
+            if self._injected_failure is not None:
+                raise self._injected_failure
+            sids = self._assign_slots(keys, now_us)
+            b = len(keys)
+            padded = _pad_size(b)
+            sid_arr = np.full(padded, self._capacity, dtype=np.int32)  # padding slot
+            n_arr = np.zeros(padded, dtype=np.int64)
+            sid_arr[:b] = sids
+            n_arr[:b] = ns
+            self._state, (allowed, remaining, retry_us) = self._step(
+                self._state, jnp.asarray(sid_arr), jnp.asarray(n_arr),
+                jnp.int64(now_us))
+        allowed = np.asarray(allowed)[:b]
+        remaining = np.asarray(remaining)[:b]
+        retry_us = np.asarray(retry_us)[:b]
+
+        if self.config.algorithm is Algorithm.TOKEN_BUCKET:
+            # reset_at = now + window (full-fill approximation, §2.4.6).
+            reset_at = (now_us + self._window_us) / MICROS
+            retry = retry_us / MICROS
+        else:
+            cur_ws = (now_us // self._window_us) * self._window_us
+            reset_at = (cur_ws + self._window_us) / MICROS
+            retry = np.where(allowed, 0.0, (cur_ws + self._window_us - now_us) / MICROS)
+        return BatchResult(
+            allowed=allowed,
+            limit=self.config.limit,
+            remaining=np.maximum(remaining, 0),
+            retry_after=np.asarray(retry, dtype=np.float64),
+            reset_at=np.full(b, reset_at, dtype=np.float64),
+        )
+
+    def _allow_batch(self, keys: list, ns: np.ndarray, now: float) -> BatchResult:
+        try:
+            return self._dispatch(keys, ns, now)
+        except Exception as exc:
+            if self.config.fail_open:
+                # Reference swallows the error on fail-open
+                # (``tokenbucket.go:100-112``).
+                reset_at = now + float(self.config.window)
+                return batch_fail_open(len(keys), self.config.limit, reset_at)
+            if isinstance(exc, StorageUnavailableError):
+                raise
+            raise StorageUnavailableError(f"device dispatch failed: {exc}") from exc
+
+    def _allow_n(self, key: str, n: int, now: float) -> Result:
+        return self._allow_batch([key], np.array([n], dtype=np.int64), now).result(0)
+
+    # ----------------------------------------------------------------- reset
+
+    def _reset(self, key: str) -> None:
+        fkey = self.config.format_key(key)
+        with self._lock:
+            slot = self._slots.pop(fkey, None)
+            if slot is not None:
+                self._free.append(slot)
+                self._zero_slot(slot)
+
+    def _close(self) -> None:
+        # State buffers are owned by this limiter; drop the references and
+        # let the device allocator reclaim. Shared clocks/meshes are not
+        # touched (divergence from reference Close(), SURVEY.md §2.4.13).
+        self._state = {}
+        self._slots.clear()
+        self._free.clear()
+
+    # ------------------------------------------------------- fault injection
+
+    def inject_failure(self, exc: Optional[Exception] = None) -> None:
+        """Test hook: make every subsequent dispatch fail (the analog of
+        miniredis ``mr.Close()`` mid-test, SURVEY.md §4.2.3). Pass None to
+        heal."""
+        self._injected_failure = exc if exc is not None else RuntimeError(
+            "injected backend failure")
+
+    def heal(self) -> None:
+        self._injected_failure = None

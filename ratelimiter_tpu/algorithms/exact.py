@@ -13,10 +13,25 @@ tests/test_divergences.py):
 * allow_n is conditional-consume for ALL algorithms — denial consumes nothing
   (the documented contract ``interface.go:104-105``; the reference's FW/SW
   code INCRBYs before checking, §2.4.2).
-* remaining is uniformly "floor of free quota after this decision" — which is
-  exactly the reference token bucket's behavior (``tokenbucket.go:51``), and
-  for denied FW/SW is what the count would allow (the reference reports 0
-  there only because its denials consumed the quota).
+* remaining is uniformly "floor of the free quota after this decision" —
+  the reference token bucket's behavior (``tokenbucket.go:51``) applied
+  everywhere. (At fractional sliding-window weights the reference instead
+  floors the weighted count, overstating free quota by <1.)
+
+Numerics (SURVEY.md §7.4 hard part #5): the reference does token math in
+float64 inside Lua (``tokenbucket.go:36-38``), which drifts under f32 and
+accumulates rounding under any float. Here ALL state math is exact integer
+arithmetic in microseconds / micro-tokens:
+
+* token bucket: tokens in int micro-tokens; refill rate as the reduced
+  fraction num/den of (limit * 1e6) / window_us; a per-key remainder carries
+  sub-micro-token credit so refill truncation never loses quota;
+* sliding window: weighted counts scaled by window_us
+  (``prev*(window-elapsed) + curr*window`` vs ``limit*window``), no division
+  at all on the decision path.
+
+The device backends implement the *same* integer recurrences, so exact and
+dense backends agree bit-for-bit (tests/test_cross_backend.py).
 
 State GC: the reference leans on Redis TTLs (window for FW, 2x window for
 SW-prev and TB hashes — §2.4.9). Here idle entries are pruned lazily on access
@@ -30,7 +45,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ratelimiter_tpu.algorithms.base import RateLimiter
-from ratelimiter_tpu.core.clock import Clock
+from ratelimiter_tpu.core.clock import Clock, MICROS, to_micros
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.types import (
     Algorithm,
@@ -47,93 +62,118 @@ class ExactLimiter(RateLimiter):
     def __init__(self, config: Config, clock: Optional[Clock] = None):
         super().__init__(config, clock)
         self._lock = threading.Lock()
-        # fixed window: formatted key -> (window_start, count)
-        self._fw: Dict[str, Tuple[float, int]] = {}
-        # sliding window: formatted key -> (curr_start, curr_count, prev_count)
-        self._sw: Dict[str, Tuple[float, int, int]] = {}
-        # token bucket: formatted key -> (tokens, last_refill)
-        self._tb: Dict[str, Tuple[float, float]] = {}
+        self._window_us = to_micros(self.config.window)
+        # Token-bucket refill rate as a reduced exact fraction:
+        # num/den micro-tokens per microsecond = (limit * 1e6) / window_us.
+        g = math.gcd(self.config.limit * MICROS, self._window_us)
+        self._rate_num = self.config.limit * MICROS // g
+        self._rate_den = self._window_us // g
+        # fixed window: formatted key -> (window_start_us, count)
+        self._fw: Dict[str, Tuple[int, int]] = {}
+        # sliding window: formatted key -> (curr_start_us, curr, prev)
+        self._sw: Dict[str, Tuple[int, int, int]] = {}
+        # token bucket: formatted key -> (tokens_micro, refill_remainder, last_us)
+        self._tb: Dict[str, Tuple[int, int, int]] = {}
 
     # ------------------------------------------------------------------ allow
 
     def _allow_n(self, key: str, n: int, now: float) -> Result:
         algo = self.config.algorithm
+        now_us = to_micros(now)
         with self._lock:
             if algo is Algorithm.FIXED_WINDOW:
-                return self._fixed_window(key, n, now)
+                return self._fixed_window(key, n, now_us)
             if algo in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
-                return self._sliding_window(key, n, now)
-            return self._token_bucket(key, n, now)
+                return self._sliding_window(key, n, now_us)
+            return self._token_bucket(key, n, now_us)
 
-    def _fixed_window(self, key: str, n: int, now: float) -> Result:
+    def _fixed_window(self, key: str, n: int, now_us: int) -> Result:
         """Reference ``fixedwindow.go:65-115``: counter per (key, window
         start); windows wall-clock aligned via truncation (§2.4.14); allow iff
         count + n <= limit (conditional consume, see module docstring)."""
         cfg = self.config
-        window = float(cfg.window)
-        window_start = math.floor(now / window) * window
+        W = self._window_us
+        window_start = (now_us // W) * W
         fkey = cfg.format_key(key)
         start, count = self._fw.get(fkey, (window_start, 0))
         if start != window_start:
             count = 0  # lazy window roll — the analog of the FW key TTL
-        reset_at = window_start + window
+        reset_at = (window_start + W) / MICROS
         if count + n <= cfg.limit:
             count += n
             self._fw[fkey] = (window_start, count)
             return allowed_result(cfg.limit, cfg.limit - count, reset_at)
         self._fw[fkey] = (window_start, count)
-        return denied_result(cfg.limit, cfg.limit - count, reset_at - now, reset_at)
+        retry = (window_start + W - now_us) / MICROS
+        return denied_result(cfg.limit, cfg.limit - count, retry, reset_at)
 
-    def _sliding_window(self, key: str, n: int, now: float) -> Result:
+    def _sliding_window(self, key: str, n: int, now_us: int) -> Result:
         """Reference ``slidingwindow.go:68-122``: weighted two-window count
         ``prev*(1-progress) + curr`` (``slidingwindow.go:190-197``), windows
         wall-clock aligned. Unlike the reference (which increments in Lua then
         decides in Go — a check-act race it accepts, §2.4.4), the check and
-        the consume here are one atomic step."""
+        the consume here are one atomic step. All math is window_us-scaled
+        integers (module docstring)."""
         cfg = self.config
-        window = float(cfg.window)
-        curr_start = math.floor(now / window) * window
+        W = self._window_us
+        curr_start = (now_us // W) * W
         fkey = cfg.format_key(key)
         start, curr, prev = self._sw.get(fkey, (curr_start, 0, 0))
         if start != curr_start:
-            if start == curr_start - window:
+            if start == curr_start - W:
                 prev, curr = curr, 0     # rolled exactly one window
             else:
                 prev, curr = 0, 0        # idle > one window: both expired
-        progress = (now - curr_start) / window
-        weighted = prev * (1.0 - progress) + curr
-        reset_at = curr_start + window
-        if weighted + n <= cfg.limit:
+        elapsed = now_us - curr_start
+        # weighted * W == prev*(W-elapsed) + curr*W ; free * W as below.
+        free_scaled = cfg.limit * W - prev * (W - elapsed) - curr * W
+        reset_at = (curr_start + W) / MICROS
+        if n * W <= free_scaled:
             curr += n
             self._sw[fkey] = (curr_start, curr, prev)
-            remaining = cfg.limit - int(weighted + n)
-            return allowed_result(cfg.limit, remaining, reset_at)
+            return allowed_result(cfg.limit, (free_scaled - n * W) // W, reset_at)
         self._sw[fkey] = (curr_start, curr, prev)
-        remaining = cfg.limit - int(weighted)
-        return denied_result(cfg.limit, remaining, reset_at - now, reset_at)
+        retry = (curr_start + W - now_us) / MICROS
+        return denied_result(cfg.limit, free_scaled // W, retry, reset_at)
 
-    def _token_bucket(self, key: str, n: int, now: float) -> Result:
+    def _token_bucket(self, key: str, n: int, now_us: int) -> Result:
         """Reference Lua ``tokenbucket.go:23-52``: lazy continuous refill
         ``tokens = min(cap, tokens + elapsed*rate)``; new buckets start full;
         consume only if sufficient (denial consumes nothing — the one
-        algorithm where the reference already honors the contract)."""
+        algorithm where the reference already honors the contract).
+
+        Exact integer refill: time-to-full from any level is <= window, so
+        elapsed >= window_us short-circuits to a full bucket; otherwise
+        ``elapsed*num + rem`` micro-token-numerator units accrue, with the
+        remainder carried per key (zero drift, module docstring)."""
         cfg = self.config
-        rate = cfg.refill_rate
+        cap = cfg.limit * MICROS
+        num, den = self._rate_num, self._rate_den
         fkey = cfg.format_key(key)
-        tokens, last = self._tb.get(fkey, (float(cfg.limit), now))
-        elapsed = max(0.0, now - last)
-        tokens = min(float(cfg.limit), tokens + elapsed * rate)
+        tokens, rem, last = self._tb.get(fkey, (cap, 0, now_us))
+        elapsed = max(0, now_us - last)
+        if elapsed >= self._window_us:
+            tokens, rem = cap, 0
+        else:
+            acc = elapsed * num + rem
+            tokens += acc // den
+            rem = acc % den
+            if tokens >= cap:
+                tokens, rem = cap, 0
         # Reference reset_at approximation: now + time to fill the whole
-        # bucket from empty, regardless of level (``tokenbucket.go:161-165``).
-        reset_at = now + cfg.limit / rate
-        if tokens >= n:
-            tokens -= n
-            self._tb[fkey] = (tokens, now)
-            return allowed_result(cfg.limit, math.floor(tokens), reset_at)
-        self._tb[fkey] = (tokens, now)
-        # Reference ``tokenbucket.go:122-130``: time until the deficit refills.
-        retry_after = (n - tokens) / rate
-        return denied_result(cfg.limit, math.floor(tokens), retry_after, reset_at)
+        # bucket from empty, regardless of level (``tokenbucket.go:161-165``)
+        # == now + window.
+        reset_at = (now_us + self._window_us) / MICROS
+        need = n * MICROS
+        if tokens >= need:
+            tokens -= need
+            self._tb[fkey] = (tokens, rem, now_us)
+            return allowed_result(cfg.limit, tokens // MICROS, reset_at)
+        self._tb[fkey] = (tokens, rem, now_us)
+        # Reference ``tokenbucket.go:122-130``: time for the deficit to refill
+        # (ceil so that retrying exactly then succeeds).
+        retry_us = -((need - tokens) * den // -num)  # ceil division
+        return denied_result(cfg.limit, tokens // MICROS, retry_us / MICROS, reset_at)
 
     # ------------------------------------------------------------------ reset
 
@@ -154,20 +194,20 @@ class ExactLimiter(RateLimiter):
         """Drop entries the reference's TTLs would have expired (§2.4.9):
         FW after 1 window, SW and TB after 2 windows of idleness. Returns the
         number of entries dropped."""
-        t = self.clock.now() if now is None else float(now)
-        window = float(self.config.window)
+        t_us = to_micros(self.clock.now() if now is None else float(now))
+        W = self._window_us
         dropped = 0
         with self._lock:
             for fkey, (start, _count) in list(self._fw.items()):
-                if t - start >= window:
+                if t_us - start >= W:
                     del self._fw[fkey]
                     dropped += 1
             for fkey, (start, _c, _p) in list(self._sw.items()):
-                if t - start >= 2 * window:
+                if t_us - start >= 2 * W:
                     del self._sw[fkey]
                     dropped += 1
-            for fkey, (_tok, last) in list(self._tb.items()):
-                if t - last >= 2 * window:
+            for fkey, (_tok, _rem, last) in list(self._tb.items()):
+                if t_us - last >= 2 * W:
                     del self._tb[fkey]
                     dropped += 1
         return dropped

@@ -1,0 +1,9 @@
+"""Device-side ops: the TPU-native replacement for the reference's Lua kernels.
+
+The reference's atomic compute unit is a Lua script executed inside Redis
+(``fixedwindow.go:21-27``, ``slidingwindow.go:22-30``, ``tokenbucket.go:23-52``
+— SURVEY.md §2.2). Here the atomic unit is a fused, jitted batched step:
+static shapes, no data-dependent Python control flow, int64 micro-units for
+drift-free token accounting, and sort+segment-scan sequencing so one batch
+behaves like the same requests serialized through Redis.
+"""

@@ -1,0 +1,222 @@
+"""Fused batched decision kernels over dense slot-addressed state.
+
+These are the TPU-native replacements for the reference's three Lua scripts
+(SURVEY.md §2.2): where Redis executes one interpreted script per request
+under a global lock, each kernel here decides a whole batch in one jitted
+XLA call — gather state for the batch's slots, sequence same-slot requests
+with ops.segment.admit, scatter the consumed amounts back. State lives in
+HBM across calls (donated buffers); time is an explicit int64-microsecond
+operand (SURVEY.md §2.4.14).
+
+The integer recurrences are bit-identical to algorithms/exact.py (see its
+module docstring for the micro-token / window-scaled representations), with
+an int64-overflow gate checked at build time: configs too large for the
+exact-integer path (limits or windows beyond the gates below) raise at
+construction rather than silently losing precision.
+
+State layout (arrays have capacity+1 rows; the last row is the padding slot
+batches are padded into — padding requests carry n=0 and are discarded on
+the host):
+
+* fixed window:  count:int64[C+1], win_start:int64[C+1] (us)
+* sliding:       curr:int64[C+1], prev:int64[C+1], win_start:int64[C+1]
+* token bucket:  tokens:int64[C+1] (micro-tokens), rem:int64[C+1]
+                 (refill remainder), last:int64[C+1] (us)
+
+Each step returns (new_state, outputs) where outputs are per-request
+(allowed, remaining, retry_us); retry_us is 0 for the window algorithms
+(their retry-after is the scalar time-to-window-reset, computed on the host).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+
+# Exact integer state math needs real int64 (microsecond timestamps and
+# micro-token levels exceed int32). Enabled once, at first import of a device
+# backend; hot-path sketch kernels pick explicit narrow dtypes so they do not
+# pay for this default.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.clock import MICROS, to_micros
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.core.types import Algorithm
+from ratelimiter_tpu.ops.segment import admit
+
+State = Dict[str, jnp.ndarray]
+Outputs = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # allowed, remaining, retry_us
+
+
+def _check_gates(cfg: Config) -> tuple[int, int, int]:
+    """Overflow gates for the exact-integer paths. Returns
+    (window_us, rate_num, rate_den)."""
+    W = to_micros(cfg.window)
+    g = math.gcd(cfg.limit * MICROS, W)
+    num, den = cfg.limit * MICROS // g, W // g
+    # token bucket: elapsed*num + rem with elapsed < W, rem < den
+    if W * num >= 2**62:
+        raise InvalidConfigError(
+            "limit*window too large for exact integer token math "
+            f"(window_us*rate_num = {W * num} >= 2^62)")
+    # sliding window: counts*(W) terms and the micro-rescale (x % W) * MICROS
+    if cfg.limit * W >= 2**61 or W * MICROS >= 2**63:
+        raise InvalidConfigError(
+            "limit*window too large for exact integer sliding-window math "
+            f"(limit*window_us = {cfg.limit * W} >= 2^61)")
+    # admission cumsum: batch_total <= B * limit * MICROS; B <= 2^20 assumed
+    if cfg.limit * MICROS >= 2**42:
+        raise InvalidConfigError(
+            f"limit {cfg.limit} too large for micro-unit batch accounting (>= 2^42/1e6)")
+    return W, num, den
+
+
+def _scale_to_micro(x_winscale: jnp.ndarray, window_us: int) -> jnp.ndarray:
+    """floor(x * MICROS / window_us) without int64 overflow, for
+    x <= limit*window_us < 2^61. Exactness of comparisons is preserved:
+    n*MICROS <= floor(x*MICROS/W)  <=>  n*W <= x  for integer n."""
+    q, r = x_winscale // window_us, x_winscale % window_us
+    return q * MICROS + (r * MICROS) // window_us
+
+
+# --------------------------------------------------------------- fixed window
+
+def _fixed_window_step(state: State, sid, n, now_us, *, limit, window_us, iters):
+    cur_ws = (now_us // window_us) * window_us
+    count = state["count"][sid]
+    stale = state["win_start"][sid] != cur_ws
+    count_eff = jnp.where(stale, 0, count)
+
+    n_units = n * MICROS
+    avail_units = (limit - count_eff) * MICROS
+    allowed, seen, consumed = admit(sid, n_units, avail_units, iters)
+
+    ncap = state["count"].shape[0]
+    base = state["count"].at[sid].set(count_eff)  # roll stale windows to 0
+    delta = jnp.zeros((ncap,), jnp.int64).at[sid].add(consumed)
+    new_state = {
+        "count": base + delta // MICROS,
+        "win_start": state["win_start"].at[sid].set(cur_ws),
+    }
+    remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
+    retry_us = jnp.zeros_like(remaining)
+    return new_state, (allowed, remaining, retry_us)
+
+
+# ------------------------------------------------------------- sliding window
+
+def _sliding_window_step(state: State, sid, n, now_us, *, limit, window_us, iters):
+    W = window_us
+    cur_ws = (now_us // W) * W
+    ws = state["win_start"][sid]
+    curr = state["curr"][sid]
+    prev = state["prev"][sid]
+    current = ws == cur_ws
+    rolled_one = ws == cur_ws - W
+    curr_eff = jnp.where(current, curr, 0)
+    prev_eff = jnp.where(current, prev, jnp.where(rolled_one, curr, 0))
+
+    elapsed = now_us - cur_ws
+    free_scaled = limit * W - prev_eff * (W - elapsed) - curr_eff * W
+    avail_units = _scale_to_micro(free_scaled, W)
+    n_units = n * MICROS
+    allowed, seen, consumed = admit(sid, n_units, avail_units, iters)
+
+    ncap = state["curr"].shape[0]
+    curr_base = state["curr"].at[sid].set(curr_eff)
+    delta = jnp.zeros((ncap,), jnp.int64).at[sid].add(consumed)
+    new_state = {
+        "curr": curr_base + delta // MICROS,
+        "prev": state["prev"].at[sid].set(prev_eff),
+        "win_start": state["win_start"].at[sid].set(cur_ws),
+    }
+    remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
+    retry_us = jnp.zeros_like(remaining)
+    return new_state, (allowed, remaining, retry_us)
+
+
+# --------------------------------------------------------------- token bucket
+
+def _token_bucket_step(state: State, sid, n, now_us, *, limit, window_us,
+                       rate_num, rate_den, iters):
+    cap = limit * MICROS
+    tokens = state["tokens"][sid]
+    rem = state["rem"][sid]
+    last = state["last"][sid]
+
+    elapsed = jnp.maximum(0, now_us - last)
+    full = elapsed >= window_us  # time-to-full from any level <= window
+    acc = jnp.where(full, 0, elapsed) * rate_num + rem
+    tokens_r = tokens + acc // rate_den
+    rem_r = acc % rate_den
+    capped = full | (tokens_r >= cap)
+    tokens_eff = jnp.where(capped, cap, tokens_r)
+    rem_eff = jnp.where(capped, 0, rem_r)
+
+    n_units = n * MICROS
+    allowed, seen, consumed = admit(sid, n_units, tokens_eff, iters)
+
+    ncap = state["tokens"].shape[0]
+    tokens_base = state["tokens"].at[sid].set(tokens_eff)
+    delta = jnp.zeros((ncap,), jnp.int64).at[sid].add(consumed)
+    new_state = {
+        "tokens": tokens_base - delta,
+        "rem": state["rem"].at[sid].set(rem_eff),
+        "last": state["last"].at[sid].set(now_us),
+    }
+    remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
+    # Reference ``tokenbucket.go:122-130``: deficit/rate, ceil'd (exact.py).
+    deficit = jnp.maximum(0, n_units - seen)
+    retry_us = jnp.where(allowed, 0, -((-deficit * rate_den) // rate_num))
+    return new_state, (allowed, remaining, retry_us)
+
+
+# ------------------------------------------------------------------- factory
+
+def init_state(algorithm: Algorithm, capacity: int, limit: int) -> State:
+    """Fresh state with capacity+1 rows (last = padding slot). Token buckets
+    start full with last=0: the first touch sees elapsed >= window and
+    saturates at capacity, which is exactly the reference's or-capacity
+    default for absent keys (``tokenbucket.go:31-33``)."""
+    n = capacity + 1
+    z = lambda: jnp.zeros((n,), jnp.int64)
+    if algorithm is Algorithm.FIXED_WINDOW:
+        return {"count": z(), "win_start": z()}
+    if algorithm in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
+        return {"curr": z(), "prev": z(), "win_start": z()}
+    return {"tokens": jnp.full((n,), limit * MICROS, jnp.int64), "rem": z(), "last": z()}
+
+
+#: Compiled steps memoized by their static parameters: limiter instances with
+#: the same (algorithm, limit, window, iters) share one jitted callable, so
+#: JAX's trace cache is hit instead of recompiling per instance.
+_STEP_CACHE: Dict[tuple, Callable] = {}
+
+
+def build_step(cfg: Config) -> Callable[[State, jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                                        Tuple[State, Outputs]]:
+    """Returns the jitted batched step for cfg's algorithm. State buffers are
+    donated: the caller must treat the passed-in state as consumed."""
+    W, num, den = _check_gates(cfg)
+    cache_key = (cfg.algorithm, cfg.limit, W, cfg.max_batch_admission_iters)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    common = dict(limit=cfg.limit, window_us=W, iters=cfg.max_batch_admission_iters)
+    if cfg.algorithm is Algorithm.FIXED_WINDOW:
+        fn = partial(_fixed_window_step, **common)
+    elif cfg.algorithm in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
+        fn = partial(_sliding_window_step, **common)
+    elif cfg.algorithm is Algorithm.TOKEN_BUCKET:
+        fn = partial(_token_bucket_step, **common, rate_num=num, rate_den=den)
+    else:
+        raise InvalidConfigError(f"unsupported algorithm {cfg.algorithm}")
+    step = jax.jit(fn, donate_argnums=(0,))
+    _STEP_CACHE[cache_key] = step
+    return step

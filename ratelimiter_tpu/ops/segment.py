@@ -1,0 +1,95 @@
+"""In-batch same-key sequencing (SURVEY.md §7.4 hard part #1).
+
+Redis serializes decisions; a batched device call does not. A batch holding k
+requests for one key must behave like k sequential Lua calls: greedy
+conditional consume in batch order (denied requests consume nothing —
+the documented contract, ``interface.go:104-105``).
+
+The greedy recurrence ``c_i = c_{i-1} + n_i * [c_{i-1} + n_i <= avail]`` is
+not associative, so it cannot be a plain prefix sum. This module computes it
+with a bounded fixpoint iteration plus a safety intersection:
+
+1. Stable-sort requests by slot id; segment = run of equal slots.
+2. Start from "everyone consumes" and iterate
+   ``allowed <- (segment-exclusive-cumsum(n * allowed) + n <= avail)``.
+   Each iteration alternates between under- and over-admitting relative to
+   the greedy solution and converges monotonically toward it.
+3. Safety intersection: one final pass keeps only requests that fit under the
+   final mask's own consumption, **intersected with** that mask. Because the
+   result is a subset of the mask used to compute consumption, every kept
+   request satisfies its quota check a fortiori — the op can under-admit in
+   adversarial mixed-n cases but can never over-admit.
+
+Exactness guarantees (tested in tests/test_segment.py):
+* uniform n within a segment (incl. the ubiquitous all-n=1 case): exact greedy
+  after iteration 1;
+* any segment whose greedy solution is reached within ``iters`` fixpoint
+  steps: exact.
+
+All quota quantities are int64 "micro-units" (1 request == 1_000_000 units)
+so token-bucket fractional levels and window counts share one kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MICRO = 1_000_000
+
+
+def _segment_exclusive_cumsum(x: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive cumsum of x restarting at each True in seg_head.
+
+    x is sorted by segment; seg_head[i] marks the first element of a segment
+    (seg_head[0] must be True).
+    """
+    c = jnp.cumsum(x) - x  # global exclusive cumsum
+    idx = jnp.arange(x.shape[0])
+    head_idx = jax.lax.cummax(jnp.where(seg_head, idx, 0))
+    return c - c[head_idx]
+
+
+def admit(
+    sid: jnp.ndarray,        # int32[B] slot/segment id per request
+    n_units: jnp.ndarray,    # int64[B] requested amount in micro-units (>=0; 0 = padding)
+    avail_units: jnp.ndarray,  # int64[B] per-request available quota (equal within a slot)
+    iters: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Greedy-in-batch-order admission.
+
+    Returns (in original request order):
+        allowed:    bool[B]
+        seen_units: int64[B] — free quota as seen by request i (after
+                    consumption by allowed same-slot requests earlier in the
+                    batch, before its own). ``seen - n*allowed`` is the
+                    post-decision remaining; ``n - seen`` is the deficit for
+                    retry-after math.
+        consumed_units: int64[B] — n_units where allowed else 0 (original
+                    order; callers scatter-add this into state by sid).
+    """
+    order = jnp.argsort(sid, stable=True)
+    s = sid[order]
+    nn = n_units[order]
+    av = avail_units[order]
+
+    seg_head = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]])
+
+    allowed = jnp.ones(s.shape, dtype=bool)
+    for _ in range(iters):
+        cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+        allowed = cons + nn <= av
+    # Safety intersection: subset of the last mask, checked against that
+    # mask's own consumption -> never over-admits (module docstring).
+    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+    allowed = allowed & (cons + nn <= av)
+    # Consumption under the final mask, for consistent per-request views.
+    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+    seen = av - cons
+
+    inv = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    allowed_o = allowed[inv]
+    seen_o = seen[inv]
+    consumed_o = jnp.where(allowed_o, n_units, 0)
+    return allowed_o, seen_o, consumed_o

@@ -1,8 +1,13 @@
-"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports.
+"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax backends
+initialize.
 
 This mirrors how the reference tests distributed behavior without a cluster
 (miniredis standing in for Redis, SURVEY.md §4.2): here an 8-device CPU host
 platform stands in for a v5e-8 pod so mesh/psum logic runs in CI.
+
+Note: the env var alone is NOT enough on machines with the axon TPU plugin
+(it registers regardless); jax.config.update('jax_platforms', ...) is what
+actually wins, and it must run before any computation initializes a backend.
 """
 
 import os
@@ -10,4 +15,8 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,88 @@
+"""Contract suite instantiated for the dense device backend, plus
+dense-specific behavior (slot capacity, recycling, fault injection)."""
+
+import numpy as np
+import pytest
+
+from tests.contract import ContractTests
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    DenseParams,
+    ManualClock,
+    StorageUnavailableError,
+    create_limiter,
+)
+
+
+class TestDenseContract(ContractTests):
+    backend = "dense"
+    supports_failure_injection = True
+
+    def inject_failure(self, lim) -> None:
+        lim.inject_failure()
+
+
+def make(algo=Algorithm.FIXED_WINDOW, limit=5, window=60.0, capacity=8, **kw):
+    clock = ManualClock()
+    cfg = Config(algorithm=algo, limit=limit, window=window,
+                 dense=DenseParams(capacity=capacity), **kw)
+    return create_limiter(cfg, backend="dense", clock=clock), clock
+
+
+class TestDenseSlots:
+    def test_capacity_exhaustion_fail_closed(self):
+        lim, _ = make(capacity=2)
+        lim.allow("a")
+        lim.allow("b")
+        with pytest.raises(StorageUnavailableError):
+            lim.allow("c")
+        lim.close()
+
+    def test_capacity_exhaustion_fail_open(self):
+        lim, _ = make(capacity=2, fail_open=True)
+        lim.allow("a")
+        lim.allow("b")
+        res = lim.allow("c")
+        assert res.allowed and res.fail_open
+        lim.close()
+
+    def test_prune_recycles_slots(self):
+        lim, clock = make(capacity=2, window=10.0)
+        lim.allow("a")
+        lim.allow("b")
+        clock.advance(21.0)  # 2x window -> TTL horizon
+        lim.allow("c")       # forces prune of a/b instead of failing
+        assert lim.key_count() == 1
+        lim.close()
+
+    def test_recycled_slot_state_is_fresh(self):
+        lim, clock = make(algo=Algorithm.TOKEN_BUCKET, limit=3, capacity=1,
+                          window=10.0)
+        assert lim.allow_n("a", 3).allowed      # drain a's bucket
+        clock.advance(21.0)
+        assert lim.allow_n("b", 3).allowed      # b reuses a's slot, starts full
+        lim.close()
+
+    def test_reset_frees_slot(self):
+        lim, _ = make(capacity=1)
+        lim.allow("a")
+        lim.reset("a")
+        assert lim.allow("b").allowed  # slot available again
+        lim.close()
+
+    def test_heal_after_injected_failure(self):
+        lim, _ = make(fail_open=True)
+        lim.inject_failure()
+        assert lim.allow("k").fail_open
+        lim.heal()
+        assert not lim.allow("k").fail_open
+        lim.close()
+
+    def test_large_batch_padding(self):
+        lim, _ = make(capacity=64, limit=100)
+        keys = [f"k{i % 50}" for i in range(100)]  # non-power-of-two batch
+        out = lim.allow_batch(keys)
+        assert out.allow_count == 100
+        lim.close()

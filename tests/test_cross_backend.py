@@ -1,0 +1,67 @@
+"""Property test: the dense device backend agrees bit-for-bit with the exact
+oracle on randomized traces (keys, n, virtual-time jumps, window rolls).
+
+This is the framework's analog of the reference testing the same go-redis
+code path against miniredis (SURVEY.md §4.2.1): two independent
+implementations of the same integer recurrences must never disagree.
+"""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import Algorithm, Config, DenseParams, ManualClock, create_limiter
+
+ALGOS = [Algorithm.TOKEN_BUCKET, Algorithm.SLIDING_WINDOW, Algorithm.FIXED_WINDOW]
+
+
+@pytest.mark.parametrize("algo", ALGOS, ids=str)
+@pytest.mark.parametrize("seed", range(4))
+def test_dense_matches_oracle_scalar_trace(algo, seed):
+    rng = np.random.default_rng(seed)
+    cfg = Config(algorithm=algo, limit=int(rng.integers(3, 30)),
+                 window=float(rng.choice([1.0, 7.5, 60.0])),
+                 dense=DenseParams(capacity=16))
+    ce, cd = ManualClock(1_700_000_000.0), ManualClock(1_700_000_000.0)
+    exact = create_limiter(cfg, backend="exact", clock=ce)
+    dense = create_limiter(cfg, backend="dense", clock=cd)
+    keys = [f"user:{i}" for i in range(6)]
+    for step in range(200):
+        dt = float(rng.exponential(cfg.window / 20))
+        ce.advance(dt)
+        cd.advance(dt)
+        key = keys[int(rng.integers(0, len(keys)))]
+        n = int(rng.integers(1, 4))
+        re = exact.allow_n(key, n)
+        rd = dense.allow_n(key, n)
+        assert re.allowed == rd.allowed, f"step {step}: {re} vs {rd}"
+        assert re.remaining == rd.remaining, f"step {step}: {re} vs {rd}"
+        assert re.retry_after == pytest.approx(rd.retry_after, abs=2e-6), f"step {step}"
+        assert re.reset_at == pytest.approx(rd.reset_at, abs=2e-6), f"step {step}"
+    exact.close()
+    dense.close()
+
+
+@pytest.mark.parametrize("algo", ALGOS, ids=str)
+@pytest.mark.parametrize("seed", range(3))
+def test_dense_matches_oracle_batched_trace(algo, seed):
+    """Batched dispatches with duplicate keys vs the oracle's sequential
+    semantics — the serialized-Lua equivalence (SURVEY.md §7.4.1).
+    Uniform n=1 per batch keeps the greedy fixpoint provably exact."""
+    rng = np.random.default_rng(1000 + seed)
+    cfg = Config(algorithm=algo, limit=25, window=10.0,
+                 dense=DenseParams(capacity=32))
+    ce, cd = ManualClock(1_700_000_000.0), ManualClock(1_700_000_000.0)
+    exact = create_limiter(cfg, backend="exact", clock=ce)
+    dense = create_limiter(cfg, backend="dense", clock=cd)
+    for step in range(30):
+        dt = float(rng.exponential(1.0))
+        ce.advance(dt)
+        cd.advance(dt)
+        B = int(rng.integers(1, 40))
+        keys = [f"u{rng.integers(0, 5)}" for _ in range(B)]
+        out_d = dense.allow_batch(keys)
+        out_e = exact.allow_batch(keys)
+        np.testing.assert_array_equal(out_d.allowed, out_e.allowed, err_msg=f"step {step}")
+        np.testing.assert_array_equal(out_d.remaining, out_e.remaining, err_msg=f"step {step}")
+    exact.close()
+    dense.close()

@@ -1,0 +1,117 @@
+"""Properties of the in-batch admission op (ops/segment.py).
+
+The correctness core of the batched design (SURVEY.md §7.4 hard part #1):
+exactness for uniform-n segments, never-over-admit for adversarial mixed-n,
+and agreement with a sequential greedy reference.
+"""
+
+import numpy as np
+import pytest
+
+import tests.conftest  # noqa: F401  (forces CPU platform before jax import)
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+from ratelimiter_tpu.ops.segment import admit
+
+
+def greedy_reference(sid, n, avail_by_slot):
+    """Sequential greedy conditional consume — the semantics of k serialized
+    Lua calls (SURVEY.md §4.2.4)."""
+    level = dict(avail_by_slot)
+    allowed = []
+    for s, k in zip(sid, n):
+        if k <= level[s]:
+            level[s] -= k
+            allowed.append(True)
+        else:
+            allowed.append(False)
+    return allowed
+
+
+def run_admit(sid, n, avail_by_slot, iters=4):
+    sid = np.asarray(sid, dtype=np.int32)
+    n = np.asarray(n, dtype=np.int64)
+    avail = np.asarray([avail_by_slot[s] for s in sid], dtype=np.int64)
+    allowed, seen, consumed = admit(
+        jnp.asarray(sid), jnp.asarray(n), jnp.asarray(avail), iters)
+    return np.asarray(allowed), np.asarray(seen), np.asarray(consumed)
+
+
+def test_single_segment_unit_requests():
+    allowed, seen, consumed = run_admit([0] * 10, [1] * 10, {0: 6})
+    assert list(allowed) == [True] * 6 + [False] * 4
+    assert consumed.sum() == 6
+
+
+def test_multiple_segments_independent():
+    sid = [2, 0, 2, 1, 0, 2]
+    n = [1, 1, 1, 1, 1, 1]
+    allowed, _, _ = run_admit(sid, n, {0: 1, 1: 0, 2: 2})
+    assert list(allowed) == [True, True, True, False, False, False]
+
+
+def test_uniform_n_exact():
+    # avail 10, n=3 each -> first 3 requests fit (9 <= 10), 4th denied
+    allowed, _, consumed = run_admit([5] * 5, [3] * 5, {5: 10})
+    assert list(allowed) == [True, True, True, False, False]
+    assert consumed.sum() == 9
+
+
+def test_mixed_n_greedy_convergence():
+    # R=10, n=[6,6,4]: greedy allows 1st and 3rd (fixpoint needs 2 iters).
+    allowed, _, _ = run_admit([0, 0, 0], [6, 6, 4], {0: 10})
+    assert list(allowed) == [True, False, True]
+
+
+def test_adversarial_never_over_admits():
+    # R=10, n=[11,6,6]: the fixpoint's even iterates over-admit ([F,T,T]);
+    # the safety intersection must land on a feasible mask.
+    allowed, _, consumed = run_admit([0, 0, 0], [11, 6, 6], {0: 10}, iters=1)
+    assert consumed.sum() <= 10
+    allowed, _, consumed = run_admit([0, 0, 0], [11, 6, 6], {0: 10}, iters=4)
+    assert list(allowed) == [False, True, False]  # greedy
+
+
+def test_seen_reports_pre_request_level():
+    allowed, seen, _ = run_admit([0, 0, 0], [4, 4, 4], {0: 10})
+    assert list(allowed) == [True, True, False]
+    assert list(seen) == [10, 6, 2]
+
+
+def test_padding_noop():
+    # n=0 padding entries consume nothing and do not disturb real requests.
+    allowed, _, consumed = run_admit([0, 7, 0, 7], [2, 0, 2, 0], {0: 3, 7: 0})
+    assert list(allowed)[0] and not list(allowed)[2]
+    assert consumed.sum() == 2
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_against_greedy_uniform_n(seed):
+    """For uniform n per slot the op must equal sequential greedy exactly."""
+    rng = np.random.default_rng(seed)
+    B = 257
+    sid = rng.integers(0, 13, B)
+    per_slot_n = {s: int(rng.integers(1, 5)) for s in range(13)}
+    n = np.array([per_slot_n[s] for s in sid])
+    avail = {s: int(rng.integers(0, 40)) for s in range(13)}
+    allowed, _, consumed = run_admit(sid, n, avail)
+    assert list(allowed) == greedy_reference(sid, n, avail)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_mixed_n_safe_and_usually_greedy(seed):
+    """Mixed n: never over-admit; with default iters, matches greedy on
+    random (non-adversarial) traffic."""
+    rng = np.random.default_rng(100 + seed)
+    B = 129
+    sid = rng.integers(0, 7, B)
+    n = rng.integers(1, 6, B)
+    avail = {s: int(rng.integers(0, 60)) for s in range(7)}
+    allowed, _, consumed = run_admit(sid, n, avail, iters=6)
+    # safety: per-slot consumption within avail
+    for s in range(7):
+        assert consumed[np.asarray(sid) == s].sum() <= avail[s]
+    assert list(allowed) == greedy_reference(sid, n, avail)
