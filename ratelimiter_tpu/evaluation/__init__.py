@@ -1,0 +1,5 @@
+"""Accuracy evaluation: sketch vs exact oracle (BASELINE.json metric)."""
+
+from ratelimiter_tpu.evaluation.accuracy import evaluate_accuracy, zipf_key_ids
+
+__all__ = ["evaluate_accuracy", "zipf_key_ids"]

@@ -1,0 +1,135 @@
+"""False-deny evaluation harness (BASELINE.json metric).
+
+The north-star accuracy number: on a Zipf(1.1) trace over ~1M keys, the
+sketch backend must produce <= 1% false-positive *denies* versus the exact
+sliding-window oracle (the stand-in for the reference's Redis sliding window,
+SURVEY.md §4.3). Over-admission versus the sketch's own semantics is
+impossible by construction (ops/segment.admit never over-admits against the
+estimate, and CMS estimates only err upward); any allow-where-oracle-denied
+events come from the *semantic* difference between sub-window-ring sliding
+and the reference's two-window weighting, and are reported separately.
+
+Three-way comparison (each isolates one error source):
+* sketch (CMS, d x w)        — the system under test;
+* twin   (CMS, huge width)   — same sub-window semantics, no collisions:
+                               sketch-vs-twin disagreement == pure CMS error;
+* oracle (dense, exact)      — reference two-window sliding semantics:
+                               twin-vs-oracle disagreement == pure semantic
+                               resolution difference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ratelimiter_tpu.core.clock import ManualClock
+from ratelimiter_tpu.core.config import Config, DenseParams, SketchParams
+from ratelimiter_tpu.core.types import Algorithm
+
+
+def zipf_key_ids(n_keys: int, n_requests: int, alpha: float = 1.1,
+                 seed: int = 0) -> np.ndarray:
+    """Sample request key ids from a bounded Zipf(alpha) over [0, n_keys):
+    inverse-CDF over the normalized 1/rank^alpha mass (BASELINE configs 3/5)."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_keys + 1, dtype=np.float64)
+    cdf = np.cumsum(ranks ** -alpha)
+    cdf /= cdf[-1]
+    u = rng.random(n_requests)
+    return np.searchsorted(cdf, u).astype(np.uint64)
+
+
+@dataclasses.dataclass
+class AccuracyReport:
+    requests: int
+    oracle_allows: int
+    false_denies_vs_oracle: int      # sketch denied, oracle allowed
+    false_allows_vs_oracle: int      # sketch allowed, oracle denied (semantic)
+    false_deny_rate: float           # vs oracle allows — the BASELINE metric
+    cms_false_denies_vs_twin: int    # sketch denied, twin allowed (pure CMS)
+    cms_false_deny_rate: float
+    semantic_disagreements: int      # twin vs oracle (resolution difference)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def evaluate_accuracy(
+    n_keys: int = 100_000,
+    n_requests: int = 200_000,
+    batch: int = 4096,
+    alpha: float = 1.1,
+    limit: int = 100,
+    window: float = 60.0,
+    request_rate: float = 50_000.0,
+    sketch: Optional[SketchParams] = None,
+    seed: int = 0,
+    include_twin: bool = True,
+) -> AccuracyReport:
+    """Run the same batched trace through sketch / twin / exact-dense oracle
+    under identical virtual time (requests arrive uniformly at request_rate)."""
+    from ratelimiter_tpu.algorithms.dense import DenseLimiter
+    from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+    from ratelimiter_tpu.ops.hashing import splitmix64
+
+    sketch = sketch or SketchParams()
+    ids = zipf_key_ids(n_keys, n_requests, alpha, seed)
+    hashes = splitmix64(ids)
+
+    base = dict(limit=limit, window=window, key_prefix="")
+    cfg_sketch = Config(algorithm=Algorithm.TPU_SKETCH, sketch=sketch, **base)
+    # Twin: identical sub-window semantics, collision-free width.
+    twin_width = max(sketch.width * 64, 1 << 22)
+    cfg_twin = Config(algorithm=Algorithm.TPU_SKETCH,
+                      sketch=dataclasses.replace(sketch, depth=1, width=twin_width),
+                      **base)
+    cfg_oracle = Config(algorithm=Algorithm.SLIDING_WINDOW,
+                        dense=DenseParams(capacity=n_keys + 1), **base)
+
+    t0 = 1_700_000_000.0
+    lim_sketch = SketchLimiter(cfg_sketch, ManualClock(t0))
+    lim_twin = SketchLimiter(cfg_twin, ManualClock(t0)) if include_twin else None
+    lim_oracle = DenseLimiter(cfg_oracle, ManualClock(t0), capacity=n_keys + 1)
+
+    allows_sketch = np.empty(n_requests, dtype=bool)
+    allows_twin = np.empty(n_requests, dtype=bool)
+    allows_oracle = np.empty(n_requests, dtype=bool)
+
+    # The dense oracle's key->slot map is fed integer-formatted keys once.
+    for start in range(0, n_requests, batch):
+        end = min(start + batch, n_requests)
+        now = t0 + start / request_rate
+        h = hashes[start:end]
+        allows_sketch[start:end] = lim_sketch.allow_hashed(h, now=now).allowed
+        if lim_twin is not None:
+            allows_twin[start:end] = lim_twin.allow_hashed(h, now=now).allowed
+        keys = [f"k{i}" for i in ids[start:end]]
+        allows_oracle[start:end] = lim_oracle.allow_batch(keys, now=now).allowed
+
+    lim_sketch.close()
+    if lim_twin is not None:
+        lim_twin.close()
+    lim_oracle.close()
+
+    oracle_allows = int(allows_oracle.sum())
+    fd = int((allows_oracle & ~allows_sketch).sum())
+    fa = int((~allows_oracle & allows_sketch).sum())
+    if include_twin:
+        cms_fd = int((allows_twin & ~allows_sketch).sum())
+        twin_allows = int(allows_twin.sum())
+        sem = int((allows_twin != allows_oracle).sum())
+    else:
+        cms_fd, twin_allows, sem = 0, 0, 0
+    return AccuracyReport(
+        requests=n_requests,
+        oracle_allows=oracle_allows,
+        false_denies_vs_oracle=fd,
+        false_allows_vs_oracle=fa,
+        false_deny_rate=fd / max(1, oracle_allows),
+        cms_false_denies_vs_twin=cms_fd,
+        cms_false_deny_rate=cms_fd / max(1, twin_allows),
+        semantic_disagreements=sem,
+    )

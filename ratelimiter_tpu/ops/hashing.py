@@ -1,0 +1,62 @@
+"""Host-side key hashing for the sketch backend.
+
+The reference sends raw string keys over RESP and lets Redis hash them
+internally; here keys are reduced to 64 bits at ingest (the serving tier's
+job — SURVEY.md §7.4 hard part #4: "keys pre-hashed to u64 on host") and the
+device only ever sees two 32-bit halves for Kirsch-Mitzenmacher double
+hashing (ops/sketch_kernels._columns).
+
+Two paths:
+* strings  -> blake2b-8 digests: stable across processes/restarts (so
+  checkpointed sketches stay addressable) — the slow path; a C extension
+  (ratelimiter_tpu/native) accelerates bulk hashing when built.
+* uint64 ids -> splitmix64 finalizer, fully vectorized in NumPy — the fast
+  path used by benchmarks and id-keyed tenants.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+_SALT = b"ratelimiter-tpu-v1"
+
+
+def hash_strings_u64(keys: Sequence[str]) -> np.ndarray:
+    """Stable 64-bit hashes of string keys (blake2b, 8-byte digest)."""
+    try:
+        from ratelimiter_tpu.native import bulk_hash_u64  # C fast path
+
+        return bulk_hash_u64(keys)
+    except Exception:
+        pass
+    out = np.empty(len(keys), dtype=np.uint64)
+    for i, k in enumerate(keys):
+        h = hashlib.blake2b(k.encode("utf-8"), digest_size=8, key=_SALT)
+        out[i] = np.uint64(int.from_bytes(h.digest(), "little"))
+    return out
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer: uniform 64-bit mixing of integer ids."""
+    x = np.asarray(x, dtype=np.uint64).copy()
+    with np.errstate(over="ignore"):
+        x += np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def split_hash(h64: np.ndarray, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """(h1, h2) uint32 halves for double hashing; h2 forced odd so strides
+    cycle the full power-of-two width. A seed remixes per-limiter so two
+    sketches never share collision patterns."""
+    h = h64
+    if seed:
+        h = splitmix64(h ^ np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+    h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    h2 = ((h >> np.uint64(32)).astype(np.uint32)) | np.uint32(1)
+    return h1, h2

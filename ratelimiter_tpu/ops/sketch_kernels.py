@@ -1,0 +1,228 @@
+"""Count-min-sketch sliding-window kernels — the TPU_SKETCH hot path.
+
+This is the framework's reason to exist (BASELINE.json north star): replace
+"one Redis round-trip per key per decision" with "one fused device call per
+*batch* against a fixed-size sketch". Key cardinality no longer costs memory
+(reference: ~200 B/user in Redis, ``docs/ARCHITECTURE.md:458-469``; here:
+depth x width x ring counters TOTAL, shared by all keys) — the cost moves to
+a bounded, measured overestimate that can only cause false *denies*, never
+over-admission (SURVEY.md §7.4 hard part #3).
+
+Design (SURVEY.md §2.2 sliding-window row, BASELINE config 4):
+
+* The window is covered by ``SW`` sub-windows of ``sub_us`` each; a ring of
+  ``S = SW + 1`` slabs ``int32[S, d, w]`` holds per-sub-window CMS counts.
+  The +1 slab is the *boundary* sub-window, weighted by its remaining
+  overlap fraction — the same ``prev * (1 - progress)`` shape as the exact
+  sliding window (``slidingwindow.go:190-197``), at sub-window resolution.
+* A running ``totals int32[d, w]`` equals the sum of all fully-in-window
+  slabs, maintained incrementally: slabs are subtracted when they age out
+  (a lax.cond that fires ~once per sub-window, not per dispatch — the
+  "decay/rotate kernel" of BASELINE config 4) and added to by each batch's
+  scatter. No Redis TTLs, no full-state sweep per call (hard part #2).
+* Row indices use Kirsch-Mitzenmacher double hashing
+  ``col_r = (h1 + r * h2) mod w`` so the device only does 32-bit math; the
+  host supplies two 32-bit hash halves per key (uint64 emulation avoided on
+  the TPU hot path).
+* Estimate = min over rows of ``totals + frac * boundary_slab`` (classic CMS
+  min-read), clamped >= 0. Admission reuses ops.segment.admit in f32 units,
+  segmenting by h1 (a 32-bit segment-id collision merges two keys' in-batch
+  sequencing for that batch only — conservative and vanishingly rare).
+* Writes are conditional on admission (denial consumes nothing — the
+  documented contract the reference's windows violate, SURVEY.md §2.4.2):
+  one scatter-add into the current slab and one into totals.
+
+Time is an explicit int64-microsecond scalar operand; everything about
+"which sub-window is current / expired" is integer period arithmetic, so
+virtual-time tests are exact (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.clock import MICROS, to_micros
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.ops.segment import admit
+
+State = Dict[str, jnp.ndarray]
+
+#: slab_period init: far enough in the past that every slab reads as expired.
+_NEVER = -(1 << 40)
+
+
+def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
+    """Returns (window_us, sub_us, SW, S, limit).
+
+    Fixed-window mode uses a single sub-window (the whole window) and no
+    boundary weighting. Sliding mode uses the largest divisor of window_us
+    that is <= the requested sketch.sub_windows, so any window duration gets
+    an exact integer sub-window size (no fractional-period drift)."""
+    from ratelimiter_tpu.core.types import Algorithm
+
+    W = to_micros(cfg.window)
+    if cfg.algorithm is Algorithm.FIXED_WINDOW:
+        SW = 1
+    else:
+        SW = next(k for k in range(min(cfg.sketch.sub_windows, W), 0, -1)
+                  if W % k == 0)
+    return W, W // SW, SW, SW + 1, cfg.limit
+
+
+def init_state(cfg: Config) -> State:
+    _, _, _, S, _ = sketch_geometry(cfg)
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    return {
+        "slabs": jnp.zeros((S, d, w), jnp.int32),
+        "totals": jnp.zeros((d, w), jnp.int32),
+        "slab_period": jnp.full((S,), _NEVER, jnp.int64),
+        "last_period": jnp.asarray(_NEVER, jnp.int64),
+    }
+
+
+def _advance(state: State, p, *, SW: int, S: int) -> State:
+    """Advance ring time to period p: subtract slabs that aged out of the
+    window from totals (rare; guarded by cond) and recycle the current slab
+    if it still holds a previous ring lap."""
+    slab_period = state["slab_period"]
+    slabs = state["slabs"]
+    totals = state["totals"]
+    p_old = state["last_period"]
+
+    # Slabs leaving the full-window set (p_old-SW, p_old] -> (p-SW, p].
+    was_full = slab_period > p_old - SW
+    now_full = slab_period > p - SW
+    leaving = was_full & ~now_full
+
+    def sub_leaving(t):
+        return t - jnp.tensordot(leaving.astype(jnp.int32), slabs, axes=1)
+
+    totals = jax.lax.cond(jnp.any(leaving), sub_leaving, lambda t: t, totals)
+
+    # Recycle the current slab. Ring invariant: its stored period is
+    # congruent to idx mod S and <= p - S, hence already out of the window,
+    # so zeroing it never needs a totals correction.
+    idx = (p % S).astype(jnp.int32)
+    stale = slab_period[idx] != p
+    slabs = jax.lax.cond(
+        stale, lambda s: s.at[idx].set(jnp.zeros_like(s[0])), lambda s: s, slabs)
+    slab_period = slab_period.at[idx].set(p)
+
+    return {"slabs": slabs, "totals": totals, "slab_period": slab_period,
+            "last_period": jnp.asarray(p, jnp.int64)}
+
+
+def _columns(h1, h2, d: int, w: int):
+    """Kirsch-Mitzenmacher double-hashed CMS columns, (B, d) int32 flat
+    indices into a (d, w) array flattened to (d*w,)."""
+    r = jnp.arange(d, dtype=jnp.uint32)
+    cols = (h1[:, None] + r[None, :] * h2[:, None]) & jnp.uint32(w - 1)
+    return (r[None, :].astype(jnp.int32) * w + cols.astype(jnp.int32))
+
+
+def _estimate(state: State, flat_cols, p, now_us, *, sub_us: int, SW: int, S: int,
+              weighted: bool = True):
+    """Min-over-rows window estimate at the given flat columns. ``weighted``
+    adds the boundary sub-window scaled by its overlap fraction (sliding
+    semantics); fixed-window mode reads totals alone."""
+    totals_f = state["totals"].reshape(-1)[flat_cols].astype(jnp.float32)
+    if weighted:
+        b_idx = ((p - SW) % S).astype(jnp.int32)
+        boundary_valid = state["slab_period"][b_idx] == p - SW
+        elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
+        frac = jnp.where(boundary_valid, 1.0 - elapsed_in / jnp.float32(sub_us), 0.0)
+        boundary_f = state["slabs"][b_idx].reshape(-1)[flat_cols].astype(jnp.float32)
+        est_rows = totals_f + frac * boundary_f
+    else:
+        est_rows = totals_f
+    return jnp.maximum(jnp.min(est_rows, axis=1), 0.0)  # (B,)
+
+
+def _sketch_step(state: State, h1, h2, n, now_us, *,
+                 limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
+                 iters: int, weighted: bool):
+    p = now_us // sub_us
+    state = _advance(state, p, SW=SW, S=S)
+
+    flat_cols = _columns(h1, h2, d, w)                       # (B, d)
+    est = _estimate(state, flat_cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
+                    weighted=weighted)
+
+    avail = jnp.maximum(jnp.float32(limit) - est, 0.0)
+    n_f = n.astype(jnp.float32)
+    sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
+    allowed, seen, _ = admit(sid, n_f, avail, iters)
+
+    add = jnp.where(allowed, n, 0).astype(jnp.int32)         # (B,)
+    add_bd = jnp.broadcast_to(add[:, None], flat_cols.shape).reshape(-1)
+    flat = flat_cols.reshape(-1)
+    totals = state["totals"].reshape(-1).at[flat].add(add_bd).reshape(d, w)
+    idx = (p % S).astype(jnp.int32)
+    cur = state["slabs"][idx].reshape(-1).at[flat].add(add_bd).reshape(d, w)
+    slabs = state["slabs"].at[idx].set(cur)
+
+    new_state = {"slabs": slabs, "totals": totals,
+                 "slab_period": state["slab_period"],
+                 "last_period": state["last_period"]}
+    remaining = jnp.maximum(
+        jnp.floor(seen - jnp.where(allowed, n_f, 0.0)), 0.0).astype(jnp.int32)
+    return new_state, (allowed, remaining, est)
+
+
+def _sketch_reset(state: State, h1, h2, now_us, *,
+                  sub_us: int, SW: int, S: int, d: int, w: int, weighted: bool):
+    """Per-key reset: subtract the key's current min-estimate from all its
+    cells in both the current slab and totals (equal amounts, preserving the
+    totals == sum-of-full-slabs invariant; cells may go transiently negative
+    in the slab, reads clamp at 0). Colliding keys gain allowance — errors
+    toward allowing, never toward false denial."""
+    p = now_us // sub_us
+    state = _advance(state, p, SW=SW, S=S)
+    flat_cols = _columns(h1, h2, d, w)
+    est = _estimate(state, flat_cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
+                    weighted=weighted)
+    sub = jnp.broadcast_to(
+        jnp.floor(est)[:, None].astype(jnp.int32), flat_cols.shape).reshape(-1)
+    flat = flat_cols.reshape(-1)
+    totals = state["totals"].reshape(-1).at[flat].add(-sub).reshape(d, w)
+    idx = (p % S).astype(jnp.int32)
+    cur = state["slabs"][idx].reshape(-1).at[flat].add(-sub).reshape(d, w)
+    slabs = state["slabs"].at[idx].set(cur)
+    return {"slabs": slabs, "totals": totals,
+            "slab_period": state["slab_period"],
+            "last_period": state["last_period"]}
+
+
+_STEP_CACHE: Dict[tuple, Callable] = {}
+
+
+def build_steps(cfg: Config) -> tuple[Callable, Callable]:
+    """Returns (step, reset) jitted callables; memoized per static config."""
+    from ratelimiter_tpu.core.types import Algorithm
+
+    W, sub_us, SW, S, limit = sketch_geometry(cfg)
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step = jax.jit(
+        partial(_sketch_step, limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                iters=cfg.max_batch_admission_iters, weighted=weighted),
+        donate_argnums=(0,))
+    reset = jax.jit(
+        partial(_sketch_reset, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                weighted=weighted),
+        donate_argnums=(0,))
+    _STEP_CACHE[key] = (step, reset)
+    return step, reset

@@ -28,7 +28,8 @@ from ratelimiter_tpu import (
     create_limiter,
 )
 
-ALGORITHMS = [Algorithm.TOKEN_BUCKET, Algorithm.SLIDING_WINDOW, Algorithm.FIXED_WINDOW]
+ALGORITHMS = [Algorithm.TOKEN_BUCKET, Algorithm.SLIDING_WINDOW,
+              Algorithm.FIXED_WINDOW, Algorithm.TPU_SKETCH]
 
 
 class ContractTests:
