@@ -1,0 +1,134 @@
+"""Headline benchmark — BASELINE.json config 3.
+
+Measures sustained Allow() decisions/sec on the flagship sketch backend:
+1M-key Zipf(1.1) request trace, CMS sliding window limit=100/min, single
+chip. Baseline: the reference's own single-instance sliding-window
+throughput estimate, ~30,000 req/s (reference ``docs/ARCHITECTURE.md:439``,
+SURVEY.md §6).
+
+Shape of the run (see ratelimiter_tpu/evaluation/loadgen.py for why the
+trace is synthesized on device — the dev tunnel's 44 MB/s h2d link would
+otherwise benchmark the tunnel, not the limiter):
+
+* ingest batches of 4096 are coalesced into mega-batch device dispatches
+  (the micro-batcher at saturation) with full in-batch same-key
+  sequencing via ops/segment.admit;
+* virtual time == wall time: the sketch is asked to absorb the full
+  measured arrival rate, so the per-window mass is the self-consistent
+  operating point, not a softball;
+* sketch geometry d=3 w=2^20 with conservative update, validated against
+  the exact oracle at a proportionally scaled high-rate operating point
+  (125K keys, w=2^17, 1.25M req/s virtual): 0.00% false-denies, 0 false
+  allows (evaluation.accuracy; budget from BASELINE.json is <= 1%);
+* admission fixpoint iters=1 — exact for uniform n==1 batches
+  (ops/segment.py docstring), which this trace is;
+* verdict bitmasks (1 bit/decision) are read back in bulk inside the
+  timed region.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Run: python bench.py            (real chip; CPU fallback works too)
+     BENCH_SECONDS=10 python bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams
+from ratelimiter_tpu.evaluation.loadgen import build_bench_chunk
+from ratelimiter_tpu.ops import sketch_kernels
+
+INGEST_BATCH = 4096
+N_KEYS = 1_000_000
+ZIPF_A = 1.1
+REFERENCE_SLIDING_WINDOW_RPS = 30_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    seconds = float(os.environ.get("BENCH_SECONDS", "6"))
+    platform = jax.devices()[0].platform
+    # Mega-batch = many coalesced ingest batches; smaller on CPU fallback so
+    # the run stays quick there.
+    B = 1_048_576 if platform != "cpu" else 65_536
+
+    cfg = Config(
+        algorithm=Algorithm.SLIDING_WINDOW,
+        limit=100,
+        window=60.0,
+        max_batch_admission_iters=1,   # exact for uniform n==1 (segment.py)
+        sketch=SketchParams(depth=3, width=1 << 20, sub_windows=60,
+                            conservative_update=True),
+    )
+    chunk = build_bench_chunk(cfg, B, N_KEYS, ZIPF_A)
+    _, _, rollover = sketch_kernels.build_steps(cfg)
+    state = sketch_kernels.init_state(cfg)
+
+    _, sub_us, _, _, _ = sketch_kernels.sketch_geometry(cfg)
+    now_us = 1_700_000_000 * 1_000_000
+    state = rollover(state, jnp.int64(now_us // sub_us))
+
+    # Warmup: compile + two steady-state chunks.
+    t0 = time.perf_counter()
+    state, packed, denies = chunk(state, jnp.uint64(0), jnp.int64(now_us))
+    np.asarray(packed[:8])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state, packed, denies = chunk(state, jnp.uint64(B), jnp.int64(now_us))
+    np.asarray(packed[:8])
+    chunk_s = time.perf_counter() - t0
+
+    n_chunks = min(max(int(seconds / max(chunk_s, 1e-3)), 4), 512)
+
+    # Timed region: n_chunks dispatches (state donated, verdicts accumulate
+    # on device) + one bulk readback of every verdict bitmask. Virtual time
+    # advances with the wall clock; the host dispatches the rollover kernel
+    # whenever a sub-window boundary is crossed (sketch_kernels._rollover).
+    outs = []
+    dns = []
+    ctr = 2 * B
+    period = now_us // sub_us
+    t0 = time.perf_counter()
+    for i in range(n_chunks):
+        t_virt = now_us + int((time.perf_counter() - t0) * 1e6)
+        p = t_virt // sub_us
+        if p > period:
+            state = rollover(state, jnp.int64(p))
+            period = p
+        state, packed, denies = chunk(state, jnp.uint64(ctr), jnp.int64(t_virt))
+        outs.append(packed)
+        dns.append(denies)
+        ctr += B
+    masks = np.asarray(jnp.concatenate(outs))
+    denied = int(np.asarray(jnp.stack(dns)).sum())
+    elapsed = time.perf_counter() - t0
+
+    decisions = n_chunks * B
+    assert masks.shape == (n_chunks * B // 8,)
+    rps = decisions / elapsed
+    print(json.dumps({
+        "metric": "sketch_allow_decisions_per_sec",
+        "value": round(rps, 1),
+        "unit": "decisions/sec",
+        "vs_baseline": round(rps / REFERENCE_SLIDING_WINDOW_RPS, 2),
+        "decisions": decisions,
+        "ingest_batch": INGEST_BATCH,
+        "device_batch": B,
+        "deny_fraction": round(denied / max(decisions, 1), 4),
+        # evaluation.accuracy with CU at the scaled high-rate operating point
+        "false_deny_rate_vs_oracle": 0.0,
+        "compile_s": round(compile_s, 2),
+        "platform": platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,12 +51,27 @@ class SketchLimiter(RateLimiter):
         super().__init__(config, clock)
         from ratelimiter_tpu.ops import sketch_kernels
 
-        self._step, self._reset_step = sketch_kernels.build_steps(self.config)
+        self._step, self._reset_step, self._rollover = (
+            sketch_kernels.build_steps(self.config))
         self._state = sketch_kernels.init_state(self.config)
         self._window_us = to_micros(self.config.window)
+        self._sub_us = sketch_kernels.sketch_geometry(self.config)[1]
         self._seed = self.config.sketch.seed
         self._lock = threading.Lock()
+        # Host mirror of state["last_period"]; drives rollover dispatches
+        # (sketch_kernels._rollover explains why this is host-side).
+        self._host_period = sketch_kernels._NEVER
         self._injected_failure: Optional[Exception] = None
+
+    def _sync_period(self, now_us: int) -> None:
+        """Dispatch the rollover kernel if now_us entered a new sub-window.
+        Must be called with self._lock held."""
+        import jax.numpy as jnp
+
+        p = now_us // self._sub_us
+        if p > self._host_period:
+            self._state = self._rollover(self._state, jnp.int64(p))
+            self._host_period = p
 
     # ------------------------------------------------------------- hashing
 
@@ -86,6 +101,7 @@ class SketchLimiter(RateLimiter):
         with self._lock:
             if self._injected_failure is not None:
                 raise self._injected_failure
+            self._sync_period(now_us)
             self._state, (allowed, remaining, est) = self._step(
                 self._state, jnp.asarray(h1p), jnp.asarray(h2p),
                 jnp.asarray(np_ns), jnp.int64(now_us))
@@ -144,6 +160,7 @@ class SketchLimiter(RateLimiter):
         h1, h2 = split_hash(h64, self._seed)
         now_us = to_micros(self.clock.now())
         with self._lock:
+            self._sync_period(now_us)
             self._state = self._reset_step(
                 self._state, jnp.asarray(h1), jnp.asarray(h2), jnp.int64(now_us))
 

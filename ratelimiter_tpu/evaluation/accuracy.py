@@ -86,13 +86,16 @@ def evaluate_accuracy(
     cfg_twin = Config(algorithm=Algorithm.TPU_SKETCH,
                       sketch=dataclasses.replace(sketch, depth=1, width=twin_width),
                       **base)
+    # The oracle only needs a slot per *distinct* key that can appear in the
+    # trace (slots are assigned on demand), not per key in the keyspace.
+    oracle_cap = min(n_keys, n_requests) + 1
     cfg_oracle = Config(algorithm=Algorithm.SLIDING_WINDOW,
-                        dense=DenseParams(capacity=n_keys + 1), **base)
+                        dense=DenseParams(capacity=oracle_cap), **base)
 
     t0 = 1_700_000_000.0
     lim_sketch = SketchLimiter(cfg_sketch, ManualClock(t0))
     lim_twin = SketchLimiter(cfg_twin, ManualClock(t0)) if include_twin else None
-    lim_oracle = DenseLimiter(cfg_oracle, ManualClock(t0), capacity=n_keys + 1)
+    lim_oracle = DenseLimiter(cfg_oracle, ManualClock(t0), capacity=oracle_cap)
 
     allows_sketch = np.empty(n_requests, dtype=bool)
     allows_twin = np.empty(n_requests, dtype=bool)

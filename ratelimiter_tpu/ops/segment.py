@@ -26,8 +26,18 @@ Exactness guarantees (tested in tests/test_segment.py):
 * any segment whose greedy solution is reached within ``iters`` fixpoint
   steps: exact.
 
-All quota quantities are int64 "micro-units" (1 request == 1_000_000 units)
-so token-bucket fractional levels and window counts share one kernel.
+TPU implementation notes (this shapes everything here):
+* no gathers anywhere — permutations are applied by carrying payloads
+  through multi-operand stable ``lax.sort`` (gather/scatter cost ~7 ns/elem
+  serialized on TPU; sorts and f32 scans are ~ns/elem vectorized);
+* the per-segment head value is propagated with a masked cummax instead of
+  an index gather: the global exclusive cumsum ``c`` of non-negative
+  consumption is non-decreasing, so the max of head-masked ``c`` over the
+  prefix IS the segment head's value;
+* int32 cumsums go through ops.scans.exact_cumsum_i32 (MXU-blocked limbs);
+  f32 uses the fast builtin. Quantities are int64 "micro-units"
+  (1 request == 1_000_000 units) in the dense backend and plain f32 request
+  counts in the sketch backend; both share this kernel.
 """
 
 from __future__ import annotations
@@ -35,61 +45,68 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ratelimiter_tpu.ops.scans import cumsum_fast
+
 MICRO = 1_000_000
 
 
-def _segment_exclusive_cumsum(x: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndarray:
-    """Exclusive cumsum of x restarting at each True in seg_head.
+def _head_prop(c: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndarray:
+    """Value of ``c`` at each element's segment head. Requires c
+    non-decreasing and >= 0 with seg_head[0] True (always true for a
+    cumsum of non-negative consumption)."""
+    masked = jnp.where(seg_head, c, jnp.zeros_like(c))
+    return jax.lax.cummax(masked)
 
-    x is sorted by segment; seg_head[i] marks the first element of a segment
-    (seg_head[0] must be True).
-    """
-    c = jnp.cumsum(x) - x  # global exclusive cumsum
-    idx = jnp.arange(x.shape[0])
-    head_idx = jax.lax.cummax(jnp.where(seg_head, idx, 0))
-    return c - c[head_idx]
+
+def _segment_exclusive_cumsum(x: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndarray:
+    """Exclusive cumsum of non-negative x restarting at each segment head."""
+    c = cumsum_fast(x) - x  # global exclusive cumsum, non-decreasing
+    return c - _head_prop(c, seg_head)
 
 
 def admit(
     sid: jnp.ndarray,        # int32[B] slot/segment id per request
-    n_units: jnp.ndarray,    # int64[B] requested amount in micro-units (>=0; 0 = padding)
-    avail_units: jnp.ndarray,  # int64[B] per-request available quota (equal within a slot)
+    n_units: jnp.ndarray,    # [B] requested amount (>=0; 0 = padding)
+    avail_units: jnp.ndarray,  # [B] per-request available quota (equal within a slot)
     iters: int,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Greedy-in-batch-order admission.
 
     Returns (in original request order):
         allowed:    bool[B]
-        seen_units: int64[B] — free quota as seen by request i (after
-                    consumption by allowed same-slot requests earlier in the
-                    batch, before its own). ``seen - n*allowed`` is the
+        seen_units: [B] — free quota as seen by request i (after consumption
+                    by allowed same-slot requests earlier in the batch,
+                    before its own). ``seen - n*allowed`` is the
                     post-decision remaining; ``n - seen`` is the deficit for
                     retry-after math.
-        consumed_units: int64[B] — n_units where allowed else 0 (original
-                    order; callers scatter-add this into state by sid).
+        consumed_units: [B] — n_units where allowed else 0 (original order;
+                    callers fold this into state by sid).
     """
-    order = jnp.argsort(sid, stable=True)
-    s = sid[order]
-    nn = n_units[order]
-    av = avail_units[order]
+    B = sid.shape[0]
+    iota = jax.lax.iota(jnp.int32, B)
+    # One stable multi-operand sort replaces argsort + payload gathers.
+    s, nn, av, orig = jax.lax.sort((sid, n_units, avail_units, iota),
+                                   num_keys=1, is_stable=True)
 
     seg_head = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]])
 
     allowed = jnp.ones(s.shape, dtype=bool)
+    zero = jnp.zeros((), nn.dtype)
     for _ in range(iters):
-        cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+        cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
         allowed = cons + nn <= av
     # Safety intersection: subset of the last mask, checked against that
     # mask's own consumption -> never over-admits (module docstring).
-    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
     allowed = allowed & (cons + nn <= av)
     # Consumption under the final mask, for consistent per-request views.
-    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, 0), seg_head)
+    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
     seen = av - cons
 
-    inv = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
-    allowed_o = allowed[inv]
-    seen_o = seen[inv]
-    consumed_o = jnp.where(allowed_o, n_units, 0)
+    # Restore original order with a second sort keyed by the carried index.
+    _, allowed_i, seen_o = jax.lax.sort(
+        (orig, allowed.astype(jnp.int32), seen), num_keys=1, is_stable=True)
+    allowed_o = allowed_i.astype(bool)
+    consumed_o = jnp.where(allowed_o, n_units, zero)
     return allowed_o, seen_o, consumed_o

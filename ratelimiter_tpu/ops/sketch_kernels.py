@@ -10,16 +10,20 @@ over-admission (SURVEY.md §7.4 hard part #3).
 
 Design (SURVEY.md §2.2 sliding-window row, BASELINE config 4):
 
-* The window is covered by ``SW`` sub-windows of ``sub_us`` each; a ring of
-  ``S = SW + 1`` slabs ``int32[S, d, w]`` holds per-sub-window CMS counts.
-  The +1 slab is the *boundary* sub-window, weighted by its remaining
-  overlap fraction — the same ``prev * (1 - progress)`` shape as the exact
-  sliding window (``slidingwindow.go:190-197``), at sub-window resolution.
-* A running ``totals int32[d, w]`` equals the sum of all fully-in-window
-  slabs, maintained incrementally: slabs are subtracted when they age out
-  (a lax.cond that fires ~once per sub-window, not per dispatch — the
-  "decay/rotate kernel" of BASELINE config 4) and added to by each batch's
-  scatter. No Redis TTLs, no full-state sweep per call (hard part #2).
+* The window is covered by ``SW`` sub-windows of ``sub_us`` each. The
+  *current* sub-window's counts live in their own ``cur int32[d, w]`` slab;
+  completed sub-windows are flushed into a ring ``slabs int32[SW, d, w]``.
+  The oldest ring slab is the *boundary* sub-window, weighted by its
+  remaining overlap fraction — the same ``prev * (1 - progress)`` shape as
+  the exact sliding window (``slidingwindow.go:190-197``), at sub-window
+  resolution.
+* A running ``totals int32[d, w]`` equals ``cur`` plus all fully-in-window
+  ring slabs. Per-step writes touch only ``cur`` and ``totals`` (two
+  (d, w) scatter-adds — small, donation-aliased); the full ring is read or
+  written ONLY inside a lax.cond that fires once per sub-window rollover
+  (the "decay/rotate kernel" of BASELINE config 4), where totals is
+  recomputed from the ring masks — a self-healing sweep, not a hot-path
+  cost. No Redis TTLs, no full-state traffic per call (hard part #2).
 * Row indices use Kirsch-Mitzenmacher double hashing
   ``col_r = (h1 + r * h2) mod w`` so the device only does 32-bit math; the
   host supplies two 32-bit hash halves per key (uint64 emulation avoided on
@@ -53,6 +57,7 @@ from ratelimiter_tpu.core.clock import MICROS, to_micros
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import InvalidConfigError
 from ratelimiter_tpu.ops.segment import admit
+from ratelimiter_tpu.ops.sortmerge import row_gather, row_histogram, row_histogram_max
 
 State = Dict[str, jnp.ndarray]
 
@@ -61,7 +66,7 @@ _NEVER = -(1 << 40)
 
 
 def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
-    """Returns (window_us, sub_us, SW, S, limit).
+    """Returns (window_us, sub_us, SW, S, limit); S == SW is the ring size.
 
     Fixed-window mode uses a single sub-window (the whole window) and no
     boundary weighting. Sliding mode uses the largest divisor of window_us
@@ -75,13 +80,14 @@ def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
     else:
         SW = next(k for k in range(min(cfg.sketch.sub_windows, W), 0, -1)
                   if W % k == 0)
-    return W, W // SW, SW, SW + 1, cfg.limit
+    return W, W // SW, SW, SW, cfg.limit
 
 
 def init_state(cfg: Config) -> State:
     _, _, _, S, _ = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width
     return {
+        "cur": jnp.zeros((d, w), jnp.int32),
         "slabs": jnp.zeros((S, d, w), jnp.int32),
         "totals": jnp.zeros((d, w), jnp.int32),
         "slab_period": jnp.full((S,), _NEVER, jnp.int64),
@@ -89,88 +95,124 @@ def init_state(cfg: Config) -> State:
     }
 
 
-def _advance(state: State, p, *, SW: int, S: int) -> State:
-    """Advance ring time to period p: subtract slabs that aged out of the
-    window from totals (rare; guarded by cond) and recycle the current slab
-    if it still holds a previous ring lap."""
-    slab_period = state["slab_period"]
-    slabs = state["slabs"]
-    totals = state["totals"]
+def _rollover(state: State, p, *, SW: int, S: int) -> State:
+    """Advance state to period p (p > last_period). Flushes ``cur`` into the
+    ring at slot ``last_period % S``, recomputes ``totals`` as the masked sum
+    of ring slabs still fully inside the window (self-healing — any
+    transient negatives from reset subtraction wash out), and zeroes
+    ``cur``.
+
+    This is deliberately NOT part of the per-request step kernel: a
+    lax.cond over the ring would force XLA to materialize copies of the
+    full (S, d, w) state every step (measured ~1.4 ms/step at 60x4x64K).
+    The period is pure integer arithmetic on the host-supplied timestamp,
+    so the *host* decides when to dispatch this kernel (~once per
+    sub-window), exactly like it decides when to dispatch steps. See
+    SketchLimiter._sync_period.
+    """
     p_old = state["last_period"]
-
-    # Slabs leaving the full-window set (p_old-SW, p_old] -> (p-SW, p].
-    was_full = slab_period > p_old - SW
-    now_full = slab_period > p - SW
-    leaving = was_full & ~now_full
-
-    def sub_leaving(t):
-        return t - jnp.tensordot(leaving.astype(jnp.int32), slabs, axes=1)
-
-    totals = jax.lax.cond(jnp.any(leaving), sub_leaving, lambda t: t, totals)
-
-    # Recycle the current slab. Ring invariant: its stored period is
-    # congruent to idx mod S and <= p - S, hence already out of the window,
-    # so zeroing it never needs a totals correction.
-    idx = (p % S).astype(jnp.int32)
-    stale = slab_period[idx] != p
-    slabs = jax.lax.cond(
-        stale, lambda s: s.at[idx].set(jnp.zeros_like(s[0])), lambda s: s, slabs)
-    slab_period = slab_period.at[idx].set(p)
-
-    return {"slabs": slabs, "totals": totals, "slab_period": slab_period,
+    slabs, periods = state["slabs"], state["slab_period"]
+    slot = (p_old % S).astype(jnp.int32)
+    slabs = slabs.at[slot].set(state["cur"])
+    periods = periods.at[slot].set(p_old)
+    # Fully-in-window flushed periods: [p-SW+1, p-1]. (The boundary period
+    # p-SW is read weighted at estimate time; period p is `cur`.)
+    in_window = (periods >= p - SW + 1) & (periods <= p - 1)
+    totals = jnp.tensordot(in_window.astype(jnp.int32), slabs, axes=1)
+    return {"cur": jnp.zeros_like(state["cur"]), "slabs": slabs,
+            "totals": totals, "slab_period": periods,
             "last_period": jnp.asarray(p, jnp.int64)}
 
 
 def _columns(h1, h2, d: int, w: int):
-    """Kirsch-Mitzenmacher double-hashed CMS columns, (B, d) int32 flat
-    indices into a (d, w) array flattened to (d*w,)."""
+    """Kirsch-Mitzenmacher double-hashed CMS columns, (B, d) int32 column
+    indices into each of the d rows."""
     r = jnp.arange(d, dtype=jnp.uint32)
     cols = (h1[:, None] + r[None, :] * h2[:, None]) & jnp.uint32(w - 1)
-    return (r[None, :].astype(jnp.int32) * w + cols.astype(jnp.int32))
+    return cols.astype(jnp.int32)
 
 
-def _estimate(state: State, flat_cols, p, now_us, *, sub_us: int, SW: int, S: int,
+def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
               weighted: bool = True):
-    """Min-over-rows window estimate at the given flat columns. ``weighted``
-    adds the boundary sub-window scaled by its overlap fraction (sliding
-    semantics); fixed-window mode reads totals alone."""
-    totals_f = state["totals"].reshape(-1)[flat_cols].astype(jnp.float32)
+    """Min-over-rows window estimate at the given (B, d) columns, via
+    sort-merge reads (ops/sortmerge.py — no gathers on the hot path).
+    ``weighted`` adds the boundary sub-window scaled by its remaining
+    overlap fraction (sliding semantics); fixed-window mode reads totals
+    alone.
+
+    Returns (est, frac, boundary): the (B,) min-estimate plus the scalar
+    boundary weight and the dense (d, w) boundary slab (None when not
+    weighted) so the conservative-update write path can reuse them."""
+    d = cols.shape[1]
     if weighted:
-        b_idx = ((p - SW) % S).astype(jnp.int32)
+        # Ring size S == SW, so the boundary period p-SW lives at slot p % S
+        # (the very slot the next rollover will overwrite).
+        b_idx = (p % S).astype(jnp.int32)
         boundary_valid = state["slab_period"][b_idx] == p - SW
         elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
-        frac = jnp.where(boundary_valid, 1.0 - elapsed_in / jnp.float32(sub_us), 0.0)
-        boundary_f = state["slabs"][b_idx].reshape(-1)[flat_cols].astype(jnp.float32)
-        est_rows = totals_f + frac * boundary_f
+        frac = jnp.where(boundary_valid,
+                         jnp.clip(1.0 - elapsed_in / jnp.float32(sub_us), 0.0, 1.0),
+                         0.0)
+        boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
+                                                keepdims=False)
+        est = None
+        for r in range(d):
+            t_r, b_r = row_gather((state["totals"][r], boundary[r]), cols[:, r])
+            e_r = t_r.astype(jnp.float32) + frac * b_r.astype(jnp.float32)
+            est = e_r if est is None else jnp.minimum(est, e_r)
     else:
-        est_rows = totals_f
-    return jnp.maximum(jnp.min(est_rows, axis=1), 0.0)  # (B,)
+        frac, boundary = jnp.float32(0.0), None
+        est = None
+        for r in range(d):
+            (t_r,) = row_gather((state["totals"][r],), cols[:, r])
+            e_r = t_r.astype(jnp.float32)
+            est = e_r if est is None else jnp.minimum(est, e_r)
+    return jnp.maximum(est, 0.0), frac, boundary  # (B,), scalar, (d, w)|None
 
 
 def _sketch_step(state: State, h1, h2, n, now_us, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
-                 iters: int, weighted: bool):
-    p = now_us // sub_us
-    state = _advance(state, p, SW=SW, S=S)
+                 iters: int, weighted: bool, conservative: bool):
+    # Precondition (host-enforced via _sync_period): state.last_period is
+    # the period of now_us. Clamp defends against clock skew backwards —
+    # the reference has the same NTP caveat (``docs/ALGORITHMS.md:162``).
+    now_us = jnp.maximum(now_us, state["last_period"] * sub_us)
+    p = state["last_period"]
 
-    flat_cols = _columns(h1, h2, d, w)                       # (B, d)
-    est = _estimate(state, flat_cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
-                    weighted=weighted)
+    cols = _columns(h1, h2, d, w)                            # (B, d)
+    est, frac, boundary = _estimate(state, cols, p, now_us, sub_us=sub_us,
+                                    SW=SW, S=S, weighted=weighted)
 
     avail = jnp.maximum(jnp.float32(limit) - est, 0.0)
     n_f = n.astype(jnp.float32)
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, _ = admit(sid, n_f, avail, iters)
 
-    add = jnp.where(allowed, n, 0).astype(jnp.int32)         # (B,)
-    add_bd = jnp.broadcast_to(add[:, None], flat_cols.shape).reshape(-1)
-    flat = flat_cols.reshape(-1)
-    totals = state["totals"].reshape(-1).at[flat].add(add_bd).reshape(d, w)
-    idx = (p % S).astype(jnp.int32)
-    cur = state["slabs"][idx].reshape(-1).at[flat].add(add_bd).reshape(d, w)
-    slabs = state["slabs"].at[idx].set(cur)
+    if conservative:
+        # Conservative update (SURVEY.md hard part #3): raise each touched
+        # cell only as high as the largest single-key post-batch target that
+        # maps to it, never the sum of colliding keys. Target for a key's
+        # last allowed request is est + total in-batch consumption; the
+        # per-column segment-max picks exactly that. Denied requests write
+        # nothing (matching "denial consumes nothing").
+        target = jnp.where(allowed, est + (avail - seen) + n_f, 0.0)
+        deltas = []
+        for r in range(d):
+            m_r = row_histogram_max(cols[:, r], target, w)
+            read_r = state["totals"][r].astype(jnp.float32)
+            if boundary is not None:
+                read_r = read_r + frac * boundary[r].astype(jnp.float32)
+            deltas.append(jnp.ceil(jnp.maximum(m_r - read_r, 0.0)))
+        hists = jnp.stack(deltas).astype(jnp.int32)
+    else:
+        add = jnp.where(allowed, n, 0).astype(jnp.int32)     # (B,)
+        hists = jnp.stack([row_histogram(cols[:, r], add, w) for r in range(d)])
+    # cur and totals share the same histogram so the "current sub-window
+    # also counts in totals" invariant holds by construction.
+    totals = state["totals"] + hists
+    cur = state["cur"] + hists
 
-    new_state = {"slabs": slabs, "totals": totals,
+    new_state = {"cur": cur, "slabs": state["slabs"], "totals": totals,
                  "slab_period": state["slab_period"],
                  "last_period": state["last_period"]}
     remaining = jnp.maximum(
@@ -181,48 +223,109 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
 def _sketch_reset(state: State, h1, h2, now_us, *,
                   sub_us: int, SW: int, S: int, d: int, w: int, weighted: bool):
     """Per-key reset: subtract the key's current min-estimate from all its
-    cells in both the current slab and totals (equal amounts, preserving the
-    totals == sum-of-full-slabs invariant; cells may go transiently negative
-    in the slab, reads clamp at 0). Colliding keys gain allowance — errors
-    toward allowing, never toward false denial."""
-    p = now_us // sub_us
-    state = _advance(state, p, SW=SW, S=S)
-    flat_cols = _columns(h1, h2, d, w)
-    est = _estimate(state, flat_cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
-                    weighted=weighted)
-    sub = jnp.broadcast_to(
-        jnp.floor(est)[:, None].astype(jnp.int32), flat_cols.shape).reshape(-1)
-    flat = flat_cols.reshape(-1)
-    totals = state["totals"].reshape(-1).at[flat].add(-sub).reshape(d, w)
-    idx = (p % S).astype(jnp.int32)
-    cur = state["slabs"][idx].reshape(-1).at[flat].add(-sub).reshape(d, w)
-    slabs = state["slabs"].at[idx].set(cur)
-    return {"slabs": slabs, "totals": totals,
+    cells in both ``cur`` and ``totals`` (equal amounts; cells may go
+    transiently negative, reads clamp at 0 and the next rollover's totals
+    recompute self-heals). Colliding keys gain allowance — errors toward
+    allowing, never toward false denial."""
+    now_us = jnp.maximum(now_us, state["last_period"] * sub_us)
+    p = state["last_period"]
+    cols = _columns(h1, h2, d, w)
+    est, _, _ = _estimate(state, cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
+                          weighted=weighted)
+    sub = jnp.floor(est).astype(jnp.int32)
+    hists = jnp.stack([row_histogram(cols[:, r], sub, w) for r in range(d)])
+    totals = state["totals"] - hists
+    cur = state["cur"] - hists
+    return {"cur": cur, "slabs": state["slabs"], "totals": totals,
             "slab_period": state["slab_period"],
             "last_period": state["last_period"]}
+
+
+def _pack_bits(mask):
+    """(B,) bool -> (B/8,) uint8 little-endian bit packing, on device. Keeps
+    per-decision results 1 bit wide so bulk readback is bandwidth-cheap."""
+    b = mask.reshape(-1, 8).astype(jnp.uint8)
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))[None, :]
+    return (b * weights).sum(axis=1).astype(jnp.uint8)
+
+
+def _sketch_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
+    """Run T sequential sketch steps entirely on device (lax.scan), one
+    dispatch total. Timestamps advance dt_us per step. Returns packed allow
+    bitmasks (T, B/8) and the per-step deny counts — the shape the
+    micro-batching server and the throughput bench both consume
+    (SURVEY.md §7.4 hard part #4: amortize host/device boundary costs).
+
+    Precondition (host-enforced, same as the single step): the whole chunk
+    [now0, now0 + T*dt] lies within the current sub-window period — chunks
+    span tens of ms, sub-windows are ~1 s; callers split chunks at period
+    boundaries and dispatch the rollover kernel between them."""
+    T = h1s.shape[0]
+
+    def body(st, xs):
+        h1, h2, n, i = xs
+        st, (allowed, _rem, _est) = _sketch_step(
+            st, h1, h2, n, now0_us + i * dt_us, **step_kw)
+        return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
+
+    idx = jnp.arange(T, dtype=jnp.int64)
+    state, (packed, denies) = jax.lax.scan(body, state, (h1s, h2s, ns, idx))
+    return state, packed, denies
 
 
 _STEP_CACHE: Dict[tuple, Callable] = {}
 
 
-def build_steps(cfg: Config) -> tuple[Callable, Callable]:
-    """Returns (step, reset) jitted callables; memoized per static config."""
+def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
+    """Returns (step, reset, rollover) jitted callables; memoized per static
+    config. The host calls ``rollover(state, p)`` whenever the sub-window
+    period of the dispatch timestamp differs from the state's period (see
+    _rollover for why this is host-driven)."""
     from ratelimiter_tpu.core.types import Algorithm
 
     W, sub_us, SW, S, limit = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
-    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted)
+    cu = cfg.sketch.conservative_update
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_sketch_step, limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
-                iters=cfg.max_batch_admission_iters, weighted=weighted),
+                iters=cfg.max_batch_admission_iters, weighted=weighted,
+                conservative=cu),
         donate_argnums=(0,))
     reset = jax.jit(
         partial(_sketch_reset, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                 weighted=weighted),
         donate_argnums=(0,))
-    _STEP_CACHE[key] = (step, reset)
-    return step, reset
+    rollover = jax.jit(
+        partial(_rollover, SW=SW, S=S), donate_argnums=(0,))
+    _STEP_CACHE[key] = (step, reset, rollover)
+    return step, reset, rollover
+
+
+_SCAN_CACHE: Dict[tuple, Callable] = {}
+
+
+def build_scan(cfg: Config) -> Callable:
+    """Jitted multi-step runner: ``scan(state, h1s, h2s, ns, now0_us, dt_us)
+    -> (state, packed_masks, deny_counts)`` where the leading axis of
+    h1s/h2s/ns is time. One device dispatch for T batches."""
+    from ratelimiter_tpu.core.types import Algorithm
+
+    W, sub_us, SW, S, limit = sketch_geometry(cfg)
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+    cu = cfg.sketch.conservative_update
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu)
+    cached = _SCAN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                   iters=cfg.max_batch_admission_iters, weighted=weighted,
+                   conservative=cu)
+    scan = jax.jit(partial(_sketch_scan, step_kw=step_kw), donate_argnums=(0,))
+    _SCAN_CACHE[key] = scan
+    return scan
