@@ -85,12 +85,22 @@ class SketchLimiter(RateLimiter):
 
     # ------------------------------------------------------------ dispatch
 
+    def _padded_size(self, b: int) -> int:
+        """Device batch size for b requests; subclasses align to mesh shape."""
+        return _pad_size(b)
+
+    def _place(self, arr: np.ndarray):
+        """Host->device placement hook; mesh subclass shards over chips."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+
     def _dispatch_hashed(self, h64: np.ndarray, ns: np.ndarray,
                          now_us: int) -> BatchResult:
         import jax.numpy as jnp
 
         b = h64.shape[0]
-        padded = _pad_size(b)
+        padded = self._padded_size(b)
         h1, h2 = split_hash(h64, self._seed)
         h1p = np.zeros(padded, dtype=np.uint32)
         h2p = np.ones(padded, dtype=np.uint32)
@@ -103,8 +113,8 @@ class SketchLimiter(RateLimiter):
                 raise self._injected_failure
             self._sync_period(now_us)
             self._state, (allowed, remaining, est) = self._step(
-                self._state, jnp.asarray(h1p), jnp.asarray(h2p),
-                jnp.asarray(np_ns), jnp.int64(now_us))
+                self._state, self._place(h1p), self._place(h2p),
+                self._place(np_ns), jnp.int64(now_us))
         allowed = np.asarray(allowed)[:b]
         remaining = np.asarray(remaining)[:b]
 
@@ -153,6 +163,12 @@ class SketchLimiter(RateLimiter):
 
     # --------------------------------------------------------------- reset
 
+    def _place_replicated(self, arr: np.ndarray):
+        """Placement for inputs of replicated (non-sharded) computations."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+
     def _reset(self, key: str) -> None:
         import jax.numpy as jnp
 
@@ -162,7 +178,8 @@ class SketchLimiter(RateLimiter):
         with self._lock:
             self._sync_period(now_us)
             self._state = self._reset_step(
-                self._state, jnp.asarray(h1), jnp.asarray(h2), jnp.int64(now_us))
+                self._state, self._place_replicated(h1),
+                self._place_replicated(h2), jnp.int64(now_us))
 
     def _close(self) -> None:
         self._state = {}

@@ -172,7 +172,8 @@ def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
 
 def _sketch_step(state: State, h1, h2, n, now_us, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
-                 iters: int, weighted: bool, conservative: bool):
+                 iters: int, weighted: bool, conservative: bool,
+                 axis_name: str | None = None):
     # Precondition (host-enforced via _sync_period): state.last_period is
     # the period of now_us. Clamp defends against clock skew backwards —
     # the reference has the same NTP caveat (``docs/ALGORITHMS.md:162``).
@@ -188,13 +189,21 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, _ = admit(sid, n_f, avail, iters)
 
-    if conservative:
+    if conservative and axis_name is None:
         # Conservative update (SURVEY.md hard part #3): raise each touched
         # cell only as high as the largest single-key post-batch target that
         # maps to it, never the sum of colliding keys. Target for a key's
         # last allowed request is est + total in-batch consumption; the
         # per-column segment-max picks exactly that. Denied requests write
         # nothing (matching "denial consumes nothing").
+        #
+        # CU requires a globally-sequenced view of the batch, so it applies
+        # on single-chip and mesh-gather paths only. Under the delta merge
+        # (axis_name set) the else-branch's psum-of-increments runs instead:
+        # a pmax of per-chip CU targets would UNDERCOUNT cross-chip traffic
+        # (true counts add across chips) and a psum of per-chip CU deltas
+        # can undercount rows whose dense read exceeds the min-estimate —
+        # both break the never-over-admit direction. Vanilla sums never do.
         target = jnp.where(allowed, est + (avail - seen) + n_f, 0.0)
         deltas = []
         for r in range(d):
@@ -207,6 +216,11 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
     else:
         add = jnp.where(allowed, n, 0).astype(jnp.int32)     # (B,)
         hists = jnp.stack([row_histogram(cols[:, r], add, w) for r in range(d)])
+        if axis_name is not None:
+            # Multi-chip delta merge: every chip adds the summed histogram,
+            # keeping the replicated-state invariant (ICI psum — the analog
+            # of all app servers sharing one Redis, SURVEY.md §2.6).
+            hists = jax.lax.psum(hists, axis_name)
     # cur and totals share the same histogram so the "current sub-window
     # also counts in totals" invariant holds by construction.
     totals = state["totals"] + hists

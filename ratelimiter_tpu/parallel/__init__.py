@@ -1,0 +1,31 @@
+"""Multi-chip deployment: mesh construction + the MeshSketchLimiter.
+
+The reference scales horizontally with Redis Cluster hash-slot sharding
+(``docs/ARCHITECTURE.md:199-219``, ``docs/ADR/001:29-34``): more nodes, each
+owning a key range, every decision still one network round-trip. The
+TPU-native story replaces that with state *replicated in HBM on every chip*
+and ICI collectives keeping the replicas coherent — no decision ever leaves
+the device mesh (SURVEY.md §2.6).
+
+Two merge modes (ratelimiter_tpu/parallel/mesh_kernels.py):
+
+* ``gather`` — all_gather the per-chip request shards, every chip runs the
+  identical global decision kernel. Bit-exact global sequencing (a limit-L
+  key admits exactly L across all chips in one step) — *stronger* than
+  Redis Cluster, which serializes per key but not across keys.
+* ``delta`` — each chip admits its local shard against the replicated
+  counts, then a single psum merges the write histograms. One collective
+  per step, batch-size-independent; staleness is at most one step's worth
+  of same-key cross-chip traffic (the analog of the reference's NTP-skew
+  caveat, SURVEY.md §2.4.14). Conservative update is gather/single-chip
+  only — cross-chip counts must ADD, so delta mode always uses vanilla
+  sums (see sketch_kernels._sketch_step for the two undercount hazards).
+
+Multi-host note: both collectives compile identically over DCN-connected
+meshes (jax.distributed); cadence over DCN is the accuracy/bandwidth knob.
+"""
+
+from ratelimiter_tpu.parallel.mesh import make_mesh, mesh_axis
+from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter
+
+__all__ = ["make_mesh", "mesh_axis", "MeshSketchLimiter"]

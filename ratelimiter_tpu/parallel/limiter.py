@@ -1,0 +1,70 @@
+"""MeshSketchLimiter — the multi-chip flagship limiter.
+
+Same RateLimiter contract and Config as the single-chip SketchLimiter
+(algorithms/sketch.py); the difference is deployment: the request batch is
+sharded over a ``jax.sharding.Mesh`` and the sketch state is replicated on
+every chip, kept coherent by the collectives in parallel/mesh_kernels.py.
+
+This is the capability analog of the reference's Redis Cluster scale-out
+(``docs/ARCHITECTURE.md:199-219``) with the opposite data placement: the
+reference shards *state* and moves every request to the owning node; here
+state is replicated and only compact write-deltas (or the compact request
+shards, in gather mode) cross ICI. A decision never pays a network RTT.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ratelimiter_tpu.algorithms.sketch import SketchLimiter, _pad_size
+from ratelimiter_tpu.core.clock import Clock
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.parallel import mesh_kernels
+from ratelimiter_tpu.parallel.mesh import make_mesh
+
+
+class MeshSketchLimiter(SketchLimiter):
+    """Sketch limiter whose dispatch spans every chip of a mesh.
+
+    Args:
+        config: limiter configuration (validated as usual).
+        mesh: a 1-D ``jax.sharding.Mesh``; default = all visible devices.
+        merge: "gather" (bit-exact global sequencing via all_gather) or
+            "delta" (one psum/pmax per step, <=1 step staleness). See
+            parallel/__init__ for the tradeoff.
+        clock: time source (tests inject ManualClock).
+    """
+
+    def __init__(self, config: Config, clock: Optional[Clock] = None, *,
+                 mesh=None, merge: str = "gather"):
+        super().__init__(config, clock)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.merge = merge
+        self.n_chips = int(np.prod(self.mesh.devices.shape))
+        # Replace the single-chip step with the mesh step; reset/rollover
+        # stay the plain replicated kernels (already built by super()).
+        self._step, self._reset_step, self._rollover = (
+            mesh_kernels.build_mesh_steps(self.config, self.mesh, merge))
+        self._state = mesh_kernels.replicate_state(self._state, self.mesh)
+
+    # -- placement hooks (SketchLimiter._dispatch_hashed) -----------------
+
+    def _padded_size(self, b: int) -> int:
+        per_chip = _pad_size(max(1, -(-b // self.n_chips)))
+        return per_chip * self.n_chips
+
+    def _place(self, arr: np.ndarray):
+        return mesh_kernels.shard_batch(arr, self.mesh)
+
+    def _place_replicated(self, arr: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def memory_bytes(self) -> int:
+        """Total HBM across the mesh: state is fully replicated, so each of
+        the n_chips devices holds a complete copy."""
+        return super().memory_bytes() * self.n_chips

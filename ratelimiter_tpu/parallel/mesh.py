@@ -1,0 +1,32 @@
+"""Mesh construction helpers.
+
+One flat axis ("chips") is the deployment unit: a v5e-8 pod slice, or N
+virtual CPU devices in CI (``XLA_FLAGS=--xla_force_host_platform_device_count``,
+the miniredis-analog of SURVEY.md §4.3). Collectives over a flat axis ride
+ICI on real hardware; a two-level ("hosts", "chips") mesh is the DCN tier
+and uses the same kernels with axis_name over both axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+AXIS = "chips"
+
+
+def mesh_axis() -> str:
+    return AXIS
+
+
+def make_mesh(devices: Optional[Sequence] = None, n_devices: Optional[int] = None):
+    """Flat 1-D mesh over the given (default: all) devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (AXIS,))

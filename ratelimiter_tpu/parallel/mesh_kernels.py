@@ -1,0 +1,123 @@
+"""shard_map'd sketch step kernels for the chip mesh.
+
+State is fully replicated (every chip holds the identical sketch); the
+request batch is sharded over the mesh axis. The two merge modes and their
+collectives:
+
+* gather: one ``all_gather`` of the (h1, h2, n) shards -> every chip runs
+  ratelimiter_tpu.ops.sketch_kernels._sketch_step on the full global batch
+  and slices out its own shard's verdicts. The state update is a replicated
+  deterministic computation — no further collective. Global request order is
+  chip-major (chip 0's shard first), the batched analog of Redis serializing
+  whichever client's EVAL lands first (SURVEY.md §3.1).
+* delta: ``_sketch_step(axis_name=...)`` — local admission against the
+  replicated counts, one ``psum`` of the write histograms (always vanilla
+  update: cross-chip counts must add — see _sketch_step's CU note). The
+  merged delta is identical on every chip, so replication is preserved by
+  construction.
+
+Rollover and reset are replicated computations on replicated state — plain
+jit, no collective, no shard_map (ratelimiter_tpu.ops.sketch_kernels).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.ops import sketch_kernels
+from ratelimiter_tpu.parallel.mesh import AXIS
+
+# jax >= 0.8 (top-level shard_map with check_vma); older jax is unsupported —
+# the experimental shim's check_rep kwarg is incompatible with this module.
+shard_map = jax.shard_map
+
+MERGE_MODES = ("gather", "delta")
+
+
+def _gather_step(state, h1, h2, n, now_us, *, step_kw):
+    """Gather-mode per-chip body: all_gather shards, decide globally,
+    slice local verdicts."""
+    Bl = h1.shape[0]
+    h1g = jax.lax.all_gather(h1, AXIS).reshape(-1)
+    h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
+    ng = jax.lax.all_gather(n, AXIS).reshape(-1)
+    state, (allowed, remaining, est) = sketch_kernels._sketch_step(
+        state, h1g, h2g, ng, now_us, **step_kw)
+    i = jax.lax.axis_index(AXIS)
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
+    return state, (sl(allowed), sl(remaining), sl(est))
+
+
+def _delta_step(state, h1, h2, n, now_us, *, step_kw):
+    """Delta-mode per-chip body: local decide, collective-merged write."""
+    return sketch_kernels._sketch_step(
+        state, h1, h2, n, now_us, axis_name=AXIS, **step_kw)
+
+
+_MESH_CACHE: Dict[tuple, Tuple[Callable, Callable, Callable]] = {}
+
+
+def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
+                     ) -> Tuple[Callable, Callable, Callable]:
+    """Returns (step, reset, rollover) for the mesh.
+
+    ``step(state, h1, h2, n, now_us)`` expects h1/h2/n sharded over AXIS
+    (length divisible by mesh size) and replicated state; returns sharded
+    verdicts and replicated state. ``reset`` / ``rollover`` are the plain
+    replicated kernels from sketch_kernels.build_steps (they run unsharded
+    on the replicated state arrays).
+    """
+    if merge not in MERGE_MODES:
+        raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
+    W, sub_us, SW, S, limit = sketch_kernels.sketch_geometry(cfg)
+    from ratelimiter_tpu.core.types import Algorithm
+
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+    cu = cfg.sketch.conservative_update
+    key = (id(mesh), merge, limit, W, SW, d, w,
+           cfg.max_batch_admission_iters, weighted, cu)
+    cached = _MESH_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                   iters=cfg.max_batch_admission_iters, weighted=weighted,
+                   conservative=cu)
+    body = _gather_step if merge == "gather" else _delta_step
+
+    state_spec = {k: P() for k in ("cur", "slabs", "totals",
+                                   "slab_period", "last_period")}
+    # check_vma=False: the state outputs ARE replicated — they are a
+    # deterministic function of replicated state and all_gathered/psum'd
+    # batch data — but the static checker cannot prove that through
+    # lax.sort/cumsum chains. tests/test_multichip.py asserts the
+    # replication invariant behaviorally (mesh result == single-chip).
+    mapped = shard_map(
+        partial(body, step_kw=step_kw),
+        mesh=mesh,
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0,))
+    _, reset, rollover = sketch_kernels.build_steps(cfg)
+    _MESH_CACHE[key] = (step, reset, rollover)
+    return step, reset, rollover
+
+
+def replicate_state(state, mesh: Mesh):
+    """Place a (host or single-device) state dict fully replicated on the mesh."""
+    sh = NamedSharding(mesh, P())
+    return {k: jax.device_put(v, sh) for k, v in state.items()}
+
+
+def shard_batch(arr, mesh: Mesh):
+    """Place a host batch array sharded over the mesh axis."""
+    return jax.device_put(arr, NamedSharding(mesh, P(AXIS)))

@@ -1,0 +1,40 @@
+"""Contract suite instantiated for the multi-chip mesh backend (gather mode).
+
+Gather mode gives bit-exact global sequencing, so the FULL exact contract —
+including concurrency- and batch-exactness — must hold across an 8-device
+mesh, the same bar the single-chip sketch meets. (Delta mode's relaxed
+within-step semantics are covered separately in tests/test_multichip.py.)
+"""
+
+import jax
+import pytest
+
+from tests.contract import ContractTests
+from tests.test_contract_sketch import SKETCH_ALGOS
+
+from ratelimiter_tpu import Config
+from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+_MESH = None
+
+
+def _mesh():
+    global _MESH
+    if _MESH is None:
+        _MESH = make_mesh(n_devices=8)
+    return _MESH
+
+
+class TestMeshContract(ContractTests):
+    backend = "mesh-sketch-gather"
+    algorithms = SKETCH_ALGOS
+    supports_failure_injection = True
+
+    def make_limiter(self, config: Config, clock):
+        return MeshSketchLimiter(config, clock, mesh=_mesh(), merge="gather")
+
+    def inject_failure(self, lim) -> None:
+        lim.inject_failure()

@@ -1,0 +1,190 @@
+"""Multi-chip mesh tests on the 8-virtual-CPU-device mesh (conftest.py).
+
+This is the miniredis move transplanted (SURVEY.md §4.3): the reference
+tests cluster behavior without a cluster by faking Redis in-process; here a
+v5e-8 pod is stood in for by 8 XLA host devices, and the very same
+shard_map/psum code that runs over ICI runs over the fake mesh.
+
+The core invariant (reference ``interface_test.go:299-335``, transplanted
+from 100 goroutines to a mesh): a key with limit L must be admitted at most
+L times *globally*, no matter how its traffic is spread over chips.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, SketchParams
+from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+T0 = 1_700_000_000.0
+
+
+def _cfg(**kw):
+    base = dict(
+        algorithm=Algorithm.SLIDING_WINDOW,
+        limit=100,
+        window=60.0,
+        sketch=SketchParams(depth=2, width=1 << 12, sub_windows=6),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(n_devices=8)
+
+
+# ---------------------------------------------------------------- gather
+
+
+def test_gather_global_exactness_single_key(mesh):
+    """256 requests for one key spread over 8 chips, limit 100 -> exactly
+    100 global admits in one step (the mesh analog of the reference's
+    concurrency-exactness test)."""
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(), clock, mesh=mesh, merge="gather")
+    out = lim.allow_batch(["hot"] * 256)
+    assert out.allow_count == 100
+    # And the admitted ones are the *first* 100 in batch order.
+    assert bool(np.all(out.allowed[:100])) and not bool(np.any(out.allowed[100:]))
+
+
+def test_gather_matches_single_chip(mesh):
+    """The mesh limiter in gather mode is bit-identical to the single-chip
+    limiter on the same trace: same decisions, same evolution."""
+    rng = np.random.default_rng(7)
+    keys = [f"k{int(i)}" for i in rng.integers(0, 50, size=300)]
+    cfg = _cfg(limit=5)
+
+    c1, c2 = ManualClock(T0), ManualClock(T0)
+    single = SketchLimiter(cfg, c1)
+    meshed = MeshSketchLimiter(cfg, c2, mesh=mesh, merge="gather")
+    for lo in range(0, 300, 100):
+        batch = keys[lo:lo + 100]
+        a = single.allow_batch(batch)
+        b = meshed.allow_batch(batch)
+        np.testing.assert_array_equal(a.allowed, b.allowed)
+        np.testing.assert_array_equal(a.remaining, b.remaining)
+        c1.advance(1.0)
+        c2.advance(1.0)
+
+
+def test_gather_never_over_admits_across_steps(mesh):
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=40), clock, mesh=mesh, merge="gather")
+    total = 0
+    for _ in range(5):
+        total += lim.allow_batch(["k"] * 16).allow_count
+        clock.advance(0.25)
+    assert total == 40
+
+
+# ----------------------------------------------------------------- delta
+
+
+def test_delta_bounded_staleness_then_convergence(mesh):
+    """Delta mode may over-admit within ONE step (each chip sees counts
+    that exclude same-step traffic on other chips) but never beyond
+    n_chips * limit, and the psum-merged state denies from the next step
+    on. This bounded-staleness contract is ADR'd (the analog of the
+    reference accepting NTP skew, SURVEY.md §2.4.14)."""
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=10), clock, mesh=mesh, merge="delta")
+    first = lim.allow_batch(["hot"] * 256)
+    assert 10 <= first.allow_count <= 8 * 10
+    second = lim.allow_batch(["hot"] * 256)
+    assert second.allow_count == 0
+
+
+def test_delta_exact_when_keys_do_not_cross_chips(mesh):
+    """Keys confined to one chip's shard see exact semantics in delta mode
+    (in-shard sequencing is the single-chip admission kernel)."""
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=3), clock, mesh=mesh, merge="delta")
+    # 8 chips x 32-slot shards; give each chip its own key, 32 requests.
+    keys = []
+    for chip in range(8):
+        keys.extend([f"chip{chip}"] * 32)
+    out = lim.allow_batch(keys)
+    for chip in range(8):
+        seg = out.allowed[chip * 32:(chip + 1) * 32]
+        assert int(seg.sum()) == 3
+        assert bool(np.all(seg[:3]))
+
+
+def test_delta_with_cu_config_never_undercounts(mesh):
+    """Conservative update needs a globally-sequenced view, so delta mode
+    falls back to vanilla psum-of-increments even when CU is configured
+    (sketch_kernels._sketch_step). The merged counts are true sums: even
+    per-chip traffic far below the limit must accumulate globally and deny
+    from the next step on (the pmax-of-targets design this replaces
+    undercounted exactly this case)."""
+    clock = ManualClock(T0)
+    cfg = _cfg(limit=10,
+               sketch=SketchParams(depth=2, width=1 << 12, sub_windows=6,
+                                   conservative_update=True))
+    lim = MeshSketchLimiter(cfg, clock, mesh=mesh, merge="delta")
+    # 64 requests pad to 8 per chip (contiguous shard placement), each chip
+    # far under limit=10: all 64 admitted in step 1 (documented staleness),
+    # then the psum across all 8 chips sums to 64 >= 10 and denies.
+    first = lim.allow_batch(["hot"] * 64)
+    assert first.allow_count == 64
+    out = lim.allow_batch(["hot"] * 64)
+    assert out.allow_count == 0
+
+
+# ------------------------------------------------------- time + lifecycle
+
+
+def test_window_expiry_on_mesh_gather(mesh):
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=8, window=6.0), clock,
+                            mesh=mesh, merge="gather")
+    assert lim.allow_batch(["k"] * 16).allow_count == 8
+    clock.advance(12.0)  # two full windows: state fully expired
+    assert lim.allow_batch(["k"] * 16).allow_count == 8
+
+
+def test_window_expiry_on_mesh_delta(mesh):
+    """Delta mode: drive with scalar calls (batch of 1 lands on one chip,
+    so local admission is exact); expiry must fully restore quota."""
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=8, window=6.0), clock,
+                            mesh=mesh, merge="delta")
+    assert lim.allow_n("k", 8).allowed
+    assert not lim.allow("k").allowed
+    clock.advance(12.0)  # two full windows: state fully expired
+    assert lim.allow_n("k", 8).allowed
+
+
+def test_reset_on_mesh_gather(mesh):
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=5), clock, mesh=mesh, merge="gather")
+    assert lim.allow_batch(["k"] * 8).allow_count == 5
+    lim.reset("k")
+    assert lim.allow_batch(["k"] * 8).allow_count == 5
+
+
+def test_reset_on_mesh_delta(mesh):
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=5), clock, mesh=mesh, merge="delta")
+    assert lim.allow_n("k", 5).allowed
+    assert not lim.allow("k").allowed
+    lim.reset("k")
+    assert lim.allow_n("k", 5).allowed
+
+
+def test_scalar_api_on_mesh(mesh):
+    clock = ManualClock(T0)
+    lim = MeshSketchLimiter(_cfg(limit=2), clock, mesh=mesh)
+    assert lim.allow("u").allowed
+    assert lim.allow("u").allowed
+    r = lim.allow("u")
+    assert not r.allowed and r.retry_after > 0
