@@ -77,6 +77,7 @@ def main() -> None:
     n_keys = N_KEYS if on_accel else 50_000
     acc_windows = float(os.environ.get("BENCH_ACC_WINDOWS",
                                        "0.25" if on_accel else "0.02"))
+    bench_seconds = float(os.environ.get("BENCH_SECONDS", "6"))
 
     cfg = Config(
         algorithm=Algorithm.SLIDING_WINDOW,
@@ -103,7 +104,7 @@ def main() -> None:
     _sync(packed)
     est_rate = 3 * B / (time.perf_counter() - t0)
 
-    n_chunks = max(4, min(int(6.0 * est_rate / B), 256))
+    n_chunks = max(4, min(int(bench_seconds * est_rate / B), 256))
     period = T0_US // sub_us
     denies = []
     ctr = 4 * B
@@ -135,7 +136,10 @@ def main() -> None:
     _sync(stats[0])
     compile_b = time.perf_counter() - t0
 
-    acc_chunks = max(2, int(acc_windows * cfg.window * rps / B))
+    # Cap like phase A: each eval chunk is ~2x a phase-A chunk of work, so an
+    # uncapped count would make the accuracy phase's wall time unbounded on a
+    # fast chip. The achieved (possibly reduced) coverage is reported below.
+    acc_chunks = max(2, min(int(acc_windows * cfg.window * rps / B), 512))
     period = T0_US // sub_us
     acc = []
     ctr = B

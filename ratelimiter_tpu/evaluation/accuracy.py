@@ -3,11 +3,19 @@
 The north-star accuracy number: on a Zipf(1.1) trace over ~1M keys, the
 sketch backend must produce <= 1% false-positive *denies* versus the exact
 sliding-window oracle (the stand-in for the reference's Redis sliding window,
-SURVEY.md §4.3). Over-admission versus the sketch's own semantics is
-impossible by construction (ops/segment.admit never over-admits against the
-estimate, and CMS estimates only err upward); any allow-where-oracle-denied
-events come from the *semantic* difference between sub-window-ring sliding
-and the reference's two-window weighting, and are reported separately.
+SURVEY.md §4.3). Error direction: ops/segment.admit never over-admits
+against the *estimate*, and with vanilla (non-conservative) updates CMS
+estimates only err upward, so over-admission versus the sketch's own
+semantics is impossible in that configuration. With
+``conservative_update=True`` (the flagship bench config) the guarantee is
+weaker: CU writes raise a cell only to the largest single-key target, so a
+cell can undercount colliding traffic once boundary slabs holding part of a
+CU write expire — a small, *measured* false-allow risk (BENCH_r02:
+``false_allow_rate_vs_oracle ~= 2e-8``), traded for a large false-deny
+reduction. Allow-where-oracle-denied events therefore combine that CU
+effect with the *semantic* difference between sub-window-ring sliding and
+the reference's two-window weighting; the three-way comparison below
+separates the CMS-error component from the semantic component.
 
 Three-way comparison (each isolates one error source):
 * sketch (CMS, d x w)        — the system under test;

@@ -1,0 +1,169 @@
+"""Native host runtime: C++ bulk string hashing behind a ctypes seam.
+
+The decision hot path is JAX/XLA on device; the *host* hot path is turning
+string keys into u64 hashes at ingest (SURVEY.md §7.4 hard part #4). The
+reference pays a Redis round-trip per key so its host cost never shows; at
+10M+ decisions/s ours does, so hashing is native:
+
+* ``hasher.cpp``   — the C++ kernel, built into ``_hasher.so`` by make
+                     (or automatically, once, on first import when a
+                     compiler is present — exactly the role a prebuilt
+                     wheel would play);
+* ``fallback.py``  — bit-identical vectorized NumPy twin for hosts with no
+                     compiler;
+* this module      — packing (Python strings -> one contiguous byte buffer
+                     + offsets/lengths) and dispatch.
+
+pybind11 is deliberately not used (not in the image); the ABI is a C array
+call through ctypes — zero copies beyond the unavoidable UTF-8 encode.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ratelimiter_tpu.native.fallback import hash_packed_numpy
+
+DEFAULT_SEED = 0x52_4C_54_50_55_31  # "RLTPU1"
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "_hasher.so")
+_SRC = os.path.join(_DIR, "hasher.cpp")
+_ABI = 2
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_mod = None  # the CPython extension module (hash_keylist lives here)
+_tried = False
+
+
+def _try_build() -> bool:
+    """One-shot best-effort build of the extension (g++ in the image)."""
+    try:
+        inc = sysconfig.get_paths()["include"]
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", f"-I{inc}",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _check_abi(lib: ctypes.CDLL) -> bool:
+    lib.rl_hasher_abi_version.restype = ctypes.c_int64
+    return lib.rl_hasher_abi_version() == _ABI
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _mod, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) and os.environ.get(
+                    "RATELIMITER_TPU_NO_BUILD") != "1":
+                _try_build()
+            if not os.path.exists(_SO):
+                return None
+            lib = ctypes.CDLL(_SO)
+            if not _check_abi(lib):
+                # Stale binary from an older algorithm; rebuild once. (The
+                # stale .so stays mapped — harmless — and the fresh one is
+                # loaded under a distinct temp name to avoid dlopen caching.)
+                os.remove(_SO)
+                if not _try_build():
+                    return None
+                lib = ctypes.CDLL(_SO)
+                if not _check_abi(lib):
+                    return None
+            lib.rl_bulk_hash_u64.restype = None
+            lib.rl_bulk_hash_u64.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int64,
+            ]
+            # The same .so is also a CPython extension module exposing the
+            # list fast path; import it through the normal machinery.
+            from ratelimiter_tpu.native import _hasher  # type: ignore
+
+            _mod = _hasher
+            _lib = lib
+        except Exception:
+            _lib = None
+            _mod = None
+        return _lib
+
+
+def native_available() -> bool:
+    """True when the C extension is loaded (built or buildable here)."""
+    return _load() is not None
+
+
+def pack_keys(keys: Sequence[str]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack strings into (buf uint8[], offsets int64[], byte_lengths int64[]).
+
+    Fast path: one ``str.join`` + one encode for the whole batch, with
+    per-key byte lengths taken from ``len`` — valid exactly when every key
+    is ASCII, which the total-bytes check proves after the fact. Non-ASCII
+    batches fall back to per-key encoding (correct, slower).
+    """
+    n = len(keys)
+    if n == 0:
+        return (np.empty(0, np.uint8), np.empty(0, np.int64),
+                np.empty(0, np.int64))
+    lengths = np.fromiter((len(k) for k in keys), dtype=np.int64, count=n)
+    blob = "".join(keys).encode("utf-8")
+    if len(blob) != int(lengths.sum()):
+        # Some key is non-ASCII: char count != byte count. Re-pack exactly.
+        encoded = [k.encode("utf-8") for k in keys]
+        lengths = np.fromiter((len(e) for e in encoded), dtype=np.int64,
+                              count=n)
+        blob = b"".join(encoded)
+    buf = np.frombuffer(blob, dtype=np.uint8)
+    offsets = np.cumsum(lengths) - lengths
+    return buf, offsets, lengths
+
+
+def hash_packed(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
+                seed: int = DEFAULT_SEED) -> np.ndarray:
+    """Hash a packed batch; native kernel when available, NumPy twin else."""
+    lib = _load()
+    if lib is None:
+        return hash_packed_numpy(buf, offsets, lengths, seed)
+    n = offsets.shape[0]
+    out = np.empty(n, dtype=np.uint64)
+    if n:
+        buf = np.ascontiguousarray(buf)
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+        lib.rl_bulk_hash_u64(
+            buf.ctypes.data, offsets.ctypes.data, lengths.ctypes.data,
+            ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF),
+            out.ctypes.data, ctypes.c_int64(n))
+    return out
+
+
+def bulk_hash_u64(keys: Sequence[str], seed: int = DEFAULT_SEED) -> np.ndarray:
+    """Hash a batch of string keys to uint64.
+
+    Fast path: the CPython extension iterates the list directly (zero-copy
+    UTF-8 views, no Python-level packing). Fallback: pack + NumPy twin.
+    """
+    _load()
+    if _mod is not None:
+        if not isinstance(keys, list):
+            keys = list(keys)
+        out = np.empty(len(keys), dtype=np.uint64)
+        _mod.hash_keylist(keys, seed & 0xFFFFFFFFFFFFFFFF, out.ctypes.data)
+        return out
+    return hash_packed(*pack_keys(keys), seed=seed)

@@ -7,36 +7,27 @@ device only ever sees two 32-bit halves for Kirsch-Mitzenmacher double
 hashing (ops/sketch_kernels._columns).
 
 Two paths:
-* strings  -> blake2b-8 digests: stable across processes/restarts (so
-  checkpointed sketches stay addressable) — the slow path; a C extension
-  (ratelimiter_tpu/native) accelerates bulk hashing when built.
+* strings  -> ratelimiter_tpu.native bulk hasher (word-at-a-time
+  multiply-rotate, C++ kernel with a bit-identical vectorized NumPy twin):
+  stable across processes/restarts, so checkpointed sketches stay
+  addressable. Benched >= 10M keys/s including packing (tests/test_hashing
+  has the cross-checks; benchmarks/ the numbers).
 * uint64 ids -> splitmix64 finalizer, fully vectorized in NumPy — the fast
   path used by benchmarks and id-keyed tenants.
 """
 
 from __future__ import annotations
 
-import hashlib
 from typing import Sequence
 
 import numpy as np
 
-_SALT = b"ratelimiter-tpu-v1"
+from ratelimiter_tpu.native import bulk_hash_u64
 
 
 def hash_strings_u64(keys: Sequence[str]) -> np.ndarray:
-    """Stable 64-bit hashes of string keys (blake2b, 8-byte digest)."""
-    try:
-        from ratelimiter_tpu.native import bulk_hash_u64  # C fast path
-
-        return bulk_hash_u64(keys)
-    except Exception:
-        pass
-    out = np.empty(len(keys), dtype=np.uint64)
-    for i, k in enumerate(keys):
-        h = hashlib.blake2b(k.encode("utf-8"), digest_size=8, key=_SALT)
-        out[i] = np.uint64(int.from_bytes(h.digest(), "little"))
-    return out
+    """Stable 64-bit hashes of string keys (native bulk hasher)."""
+    return bulk_hash_u64(keys)
 
 
 def splitmix64(x: np.ndarray) -> np.ndarray:

@@ -45,9 +45,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ratelimiter_tpu.ops.scans import cumsum_fast
+from ratelimiter_tpu.ops.scans import cumsum_fast, exact_cumsum_i32
 
 MICRO = 1_000_000
+
+#: f32 integers are exact below this; the fast f32 cumsum path is only used
+#: while the batch's total consumption stays under it (see admit).
+_F32_EXACT = 1 << 24
 
 
 def _head_prop(c: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndarray:
@@ -62,6 +66,25 @@ def _segment_exclusive_cumsum(x: jnp.ndarray, seg_head: jnp.ndarray) -> jnp.ndar
     """Exclusive cumsum of non-negative x restarting at each segment head."""
     c = cumsum_fast(x) - x  # global exclusive cumsum, non-decreasing
     return c - _head_prop(c, seg_head)
+
+
+def _segment_exclusive_cumsum_exact_f32(x: jnp.ndarray,
+                                        seg_head: jnp.ndarray) -> jnp.ndarray:
+    """Exact segment-exclusive cumsum for *integer-valued* f32 x.
+
+    The f32 builtin cumsum loses integer exactness once a partial sum
+    crosses 2^24; this path runs the scan on int32 (MXU limb cumsum +
+    int32 head propagation — both exact while true prefix sums fit int32)
+    and only casts the *segment-relative* value back to f32. The final
+    cast is exact below 2^24; above it, the value already exceeds any
+    admissible quota (limits are validated < 2^24), so the f32 rounding
+    (relative error 2^-24) can never flip a ``cons + n <= avail``
+    comparison. Decision-exact for total batch consumption < 2^31.
+    """
+    xi = x.astype(jnp.int32)
+    c = exact_cumsum_i32(xi) - xi
+    seg = c - jax.lax.cummax(jnp.where(seg_head, c, jnp.zeros_like(c)))
+    return seg.astype(x.dtype)
 
 
 def admit(
@@ -91,18 +114,39 @@ def admit(
     seg_head = jnp.concatenate(
         [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]])
 
-    allowed = jnp.ones(s.shape, dtype=bool)
     zero = jnp.zeros((), nn.dtype)
-    for _ in range(iters):
-        cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
-        allowed = cons + nn <= av
-    # Safety intersection: subset of the last mask, checked against that
-    # mask's own consumption -> never over-admits (module docstring).
-    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
-    allowed = allowed & (cons + nn <= av)
-    # Consumption under the final mask, for consistent per-request views.
-    cons = _segment_exclusive_cumsum(jnp.where(allowed, nn, zero), seg_head)
-    seen = av - cons
+
+    def _solve(excl_cumsum):
+        allowed = jnp.ones(s.shape, dtype=bool)
+        for _ in range(iters):
+            cons = excl_cumsum(jnp.where(allowed, nn, zero), seg_head)
+            allowed = cons + nn <= av
+        # Safety intersection: subset of the last mask, checked against that
+        # mask's own consumption -> never over-admits (module docstring).
+        cons = excl_cumsum(jnp.where(allowed, nn, zero), seg_head)
+        allowed = allowed & (cons + nn <= av)
+        # Consumption under the final mask, for consistent per-request views.
+        cons = excl_cumsum(jnp.where(allowed, nn, zero), seg_head)
+        seen = av - cons
+        return allowed, seen
+
+    if jnp.issubdtype(nn.dtype, jnp.floating):
+        # f32 exactness guard (2^24 precondition): the fast f32 cumsum is
+        # only exact while every partial sum of consumption is an exactly
+        # representable integer, i.e. total batch consumption < 2^24. The
+        # total is data-dependent, so the guard is a runtime cond, not a
+        # trace-time assert: mega-batches whose cumulative cost crosses
+        # 2^24 take the int32 limb-exact path instead of silently
+        # mis-admitting. Floating n_units must be integer-valued request
+        # counts (the sketch path's contract).
+        total = jnp.sum(nn.astype(jnp.int64))
+        allowed, seen = jax.lax.cond(
+            total < _F32_EXACT,
+            lambda: _solve(_segment_exclusive_cumsum),
+            lambda: _solve(_segment_exclusive_cumsum_exact_f32),
+        )
+    else:
+        allowed, seen = _solve(_segment_exclusive_cumsum)
 
     # Restore original order with a second sort keyed by the carried index.
     _, allowed_i, seen_o = jax.lax.sort(

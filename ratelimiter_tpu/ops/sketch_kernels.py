@@ -74,6 +74,13 @@ def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
     an exact integer sub-window size (no fractional-period drift)."""
     from ratelimiter_tpu.core.types import Algorithm
 
+    if cfg.limit >= (1 << 24):
+        # The sketch admission path compares f32 quantities; limits at or
+        # above 2^24 would make boundary comparisons inexact (ops/segment
+        # _segment_exclusive_cumsum_exact_f32's cast argument). Use the
+        # dense backend for limits that large.
+        raise InvalidConfigError(
+            f"sketch backend requires limit < 2**24, got {cfg.limit}")
     W = to_micros(cfg.window)
     if cfg.algorithm is Algorithm.FIXED_WINDOW:
         SW = 1

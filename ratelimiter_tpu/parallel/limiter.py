@@ -14,6 +14,7 @@ shards, in gather mode) cross ICI. A decision never pays a network RTT.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import numpy as np
@@ -31,15 +32,27 @@ class MeshSketchLimiter(SketchLimiter):
     Args:
         config: limiter configuration (validated as usual).
         mesh: a 1-D ``jax.sharding.Mesh``; default = all visible devices.
-        merge: "gather" (bit-exact global sequencing via all_gather) or
-            "delta" (one psum/pmax per step, <=1 step staleness). See
-            parallel/__init__ for the tradeoff.
+        merge: "gather" (bit-exact global sequencing via all_gather — the
+            default, and the only mode that preserves the reference's
+            strict never-over-admit contract) or "delta" (one psum per
+            step, <=1 step staleness: a key hammered from every chip in the
+            SAME step can be over-admitted up to n_chips * limit in that
+            step; converged and denying from the next step on). See
+            parallel/__init__ and docs/ADR/002 for the tradeoff.
         clock: time source (tests inject ManualClock).
     """
 
     def __init__(self, config: Config, clock: Optional[Clock] = None, *,
                  mesh=None, merge: str = "gather"):
         super().__init__(config, clock)
+        if merge == "delta":
+            # The only configuration in the codebase that relaxes the strict
+            # never-over-admit invariant — say so once, loudly.
+            logging.getLogger(__name__).warning(
+                "MeshSketchLimiter merge='delta': cross-chip admission is "
+                "eventually consistent; a key can be over-admitted up to "
+                "n_chips*limit within one step (bounded staleness, see "
+                "docs/ADR/002). Use merge='gather' for strict exactness.")
         self.mesh = mesh if mesh is not None else make_mesh()
         self.merge = merge
         self.n_chips = int(np.prod(self.mesh.devices.shape))

@@ -81,7 +81,11 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
-    key = (id(mesh), merge, limit, W, SW, d, w,
+    # Key on the mesh's *identity-bearing contents* (device objects + axis
+    # names), not id(mesh): a GC'd mesh's id can be reused by a new mesh,
+    # which would receive a stale compiled step bound to dead devices.
+    mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
+    key = (mesh_key, merge, limit, W, SW, d, w,
            cfg.max_batch_admission_iters, weighted, cu)
     cached = _MESH_CACHE.get(key)
     if cached is not None:
