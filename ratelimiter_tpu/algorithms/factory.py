@@ -42,10 +42,10 @@ def create_limiter(
 
         return DenseLimiter(config, clock, **kwargs)
     if backend == "sketch":
-        if config.algorithm not in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH,
-                                    Algorithm.FIXED_WINDOW):
-            raise InvalidConfigError(
-                f"sketch backend supports windowed algorithms, got {config.algorithm}")
+        if config.algorithm is Algorithm.TOKEN_BUCKET:
+            from ratelimiter_tpu.algorithms.sketch import SketchTokenBucketLimiter
+
+            return SketchTokenBucketLimiter(config, clock, **kwargs)
         from ratelimiter_tpu.algorithms.sketch import SketchLimiter
 
         return SketchLimiter(config, clock, **kwargs)

@@ -112,9 +112,16 @@ class SketchLimiter(RateLimiter):
             if self._injected_failure is not None:
                 raise self._injected_failure
             self._sync_period(now_us)
-            self._state, (allowed, remaining, est) = self._step(
+            self._state, outs = self._step(
                 self._state, self._place(h1p), self._place(h2p),
                 self._place(np_ns), jnp.int64(now_us))
+        return self._finish(outs, b, now_us)
+
+    def _finish(self, outs, b: int, now_us: int) -> BatchResult:
+        """Window-algorithm result assembly: retry-after is time to window
+        reset (``fixedwindow.go:107-112``). The token-bucket subclass
+        overrides with device-computed deficit/rate retry."""
+        allowed, remaining, _est = outs
         allowed = np.asarray(allowed)[:b]
         remaining = np.asarray(remaining)[:b]
 
@@ -199,3 +206,50 @@ class SketchLimiter(RateLimiter):
         """Device memory held by the sketch — constant in key cardinality."""
         return sum(int(np.prod(v.shape)) * v.dtype.itemsize
                    for v in self._state.values() if hasattr(v, "shape"))
+
+
+class SketchTokenBucketLimiter(SketchLimiter):
+    """TOKEN_BUCKET at unbounded key cardinality: CMS over per-key *debt*
+    (ops/bucket_kernels.py — the GCRA meter form of the reference's
+    ``tokenbucket.go:23-52`` semantics). Continuous fractional refill,
+    burst up to ``limit``, denial consumes nothing; overestimated debt can
+    only cause false denies, never over-admission.
+
+    Shares the SketchLimiter shell (hashing, padding, locking, fault
+    injection, fail-open) and swaps the kernels: no sub-window ring, no
+    rollover dispatches — decay is inside the step itself."""
+
+    def __init__(self, config: Config, clock: Optional[Clock] = None):
+        RateLimiter.__init__(self, config, clock)
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        self._step, self._reset_step = bucket_kernels.build_steps(self.config)
+        self._state = bucket_kernels.init_state(self.config)
+        self._window_us = to_micros(self.config.window)
+        self._seed = self.config.sketch.seed
+        self._lock = threading.Lock()
+        self._injected_failure: Optional[Exception] = None
+
+    def _sync_period(self, now_us: int) -> None:
+        """No ring, no rollover: decay happens inside every step."""
+
+    def _finish(self, outs, b: int, now_us: int) -> BatchResult:
+        """Token-bucket result assembly: retry-after = deficit / refill rate
+        computed exactly on device (``tokenbucket.go:122-130``); reset_at is
+        the reference's approximation now + window (time to refill the whole
+        bucket from empty, ``tokenbucket.go:159-165``)."""
+        allowed, remaining, retry_us = outs
+        allowed = np.asarray(allowed)[:b]
+        remaining = np.asarray(remaining)[:b]
+        retry_us = np.asarray(retry_us)[:b]
+        return BatchResult(
+            allowed=allowed,
+            limit=self.config.limit,
+            remaining=remaining.astype(np.int64),
+            retry_after=(retry_us / MICROS).astype(np.float64),
+            reset_at=np.full(b, (now_us + self._window_us) / MICROS,
+                             dtype=np.float64),
+        )
+
+    # _reset is inherited: the base implementation's _sync_period call is a
+    # no-op here, and the reset-step dispatch shape is identical.

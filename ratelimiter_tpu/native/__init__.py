@@ -77,14 +77,20 @@ def _load() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_SO):
                 return None
             lib = ctypes.CDLL(_SO)
+            mod_path = _SO
             if not _check_abi(lib):
-                # Stale binary from an older algorithm; rebuild once. (The
-                # stale .so stays mapped — harmless — and the fresh one is
-                # loaded under a distinct temp name to avoid dlopen caching.)
+                # Stale binary from an older algorithm; rebuild once. dlopen
+                # caches by pathname — asking for _SO again would hand back
+                # the still-mapped stale object — so the fresh build is
+                # copied to and loaded from a distinct per-process name.
                 os.remove(_SO)
                 if not _try_build():
                     return None
-                lib = ctypes.CDLL(_SO)
+                import shutil
+
+                mod_path = os.path.join(_DIR, f"_hasher_r{os.getpid()}.so")
+                shutil.copy2(_SO, mod_path)
+                lib = ctypes.CDLL(mod_path)
                 if not _check_abi(lib):
                     return None
             lib.rl_bulk_hash_u64.restype = None
@@ -93,8 +99,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_uint64, ctypes.c_void_p, ctypes.c_int64,
             ]
             # The same .so is also a CPython extension module exposing the
-            # list fast path; import it through the normal machinery.
-            from ratelimiter_tpu.native import _hasher  # type: ignore
+            # list fast path; load it from the SAME file the ctypes handle
+            # came from (spec_from_file_location derives PyInit__hasher
+            # from the final name component, so the temp name is fine).
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "ratelimiter_tpu.native._hasher", mod_path)
+            _hasher = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(_hasher)
 
             _mod = _hasher
             _lib = lib

@@ -1,0 +1,30 @@
+"""Observability: metrics registry + decorator wrappers (reference L4,
+``docs/ADR/003-decorator-pattern-for-observability.md``)."""
+
+from ratelimiter_tpu.observability.metrics import (
+    BATCH_BUCKETS,
+    Counter,
+    DEFAULT,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    Registry,
+)
+from ratelimiter_tpu.observability.decorators import (
+    LimiterDecorator,
+    LoggingDecorator,
+    MetricsDecorator,
+)
+
+__all__ = [
+    "BATCH_BUCKETS",
+    "Counter",
+    "DEFAULT",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "LimiterDecorator",
+    "LoggingDecorator",
+    "MetricsDecorator",
+    "Registry",
+]

@@ -1,0 +1,249 @@
+"""Observability decorators around RateLimiter.
+
+The reference's L4 layer, designed but unbuilt
+(``docs/ADR/003-decorator-pattern-for-observability.md:44-125``,
+``docs/ARCHITECTURE.md:269-285``): wrappers that implement the same
+RateLimiter surface, so they compose with each other and with any backend
+— ``MetricsDecorator(LoggingDecorator(create_limiter(cfg, "sketch")))`` —
+and pass the full contract suite (tests/test_decorators.py instantiates
+it for a decorated limiter).
+
+Metric names follow the reference's spec (``docs/ARCHITECTURE.md:550-566``):
+
+* ``rate_limiter_requests_total{algorithm,result}`` — result is allowed /
+  denied / fail_open / error:<kind>; counts *requests* (allow_n(n) is one).
+* ``rate_limiter_decisions_allowed_total`` / ``_denied_total`` — device-side
+  per-decision counters, one reduction over the batch mask (free on TPU).
+* ``rate_limiter_latency_seconds{algorithm,op}`` — wall time of the inner
+  call (the batched dispatch for allow_batch).
+* ``rate_limiter_batch_size`` — histogram of decisions per inner dispatch.
+* ``rate_limiter_storage_errors_total{algorithm}`` — backend failures,
+  whether surfaced as fail-open or raised (analog of
+  ``rate_limiter_redis_errors_total``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.core.errors import (
+    ClosedError,
+    InvalidKeyError,
+    InvalidNError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import BatchResult, Result
+from ratelimiter_tpu.observability import metrics as m
+
+
+class LimiterDecorator(RateLimiter):
+    """Base decorator: delegates the whole RateLimiter surface to ``inner``.
+
+    Validation, clocking, and locking all live in the inner limiter; the
+    decorator only observes. Subclasses override the ``_observe_*`` hooks.
+    """
+
+    def __init__(self, inner: RateLimiter):
+        # Deliberately NOT calling RateLimiter.__init__: config is already
+        # validated by (and owned by) the inner limiter; re-validating here
+        # would double any validation side effects.
+        self.inner = inner
+        self._closed = False
+
+    # Delegated attributes ------------------------------------------------
+
+    @property
+    def config(self):  # type: ignore[override]
+        return self.inner.config
+
+    @property
+    def clock(self):  # type: ignore[override]
+        return self.inner.clock
+
+    # Public surface (decorated) ------------------------------------------
+
+    def allow(self, key: str, *, now: Optional[float] = None) -> Result:
+        return self.allow_n(key, 1, now=now)
+
+    def allow_n(self, key: str, n: int, *, now: Optional[float] = None) -> Result:
+        t0 = time.perf_counter()
+        try:
+            res = self.inner.allow_n(key, n, now=now)
+        except Exception as exc:
+            self._observe_error("allow_n", exc, time.perf_counter() - t0)
+            raise
+        self._observe_result("allow_n", res, n, time.perf_counter() - t0)
+        return res
+
+    def allow_batch(self, keys: Sequence[str], ns=None, *,
+                    now: Optional[float] = None) -> BatchResult:
+        t0 = time.perf_counter()
+        try:
+            out = self.inner.allow_batch(keys, ns, now=now)
+        except Exception as exc:
+            self._observe_error("allow_batch", exc, time.perf_counter() - t0)
+            raise
+        self._observe_batch("allow_batch", out, ns, time.perf_counter() - t0)
+        return out
+
+    def reset(self, key: str) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.inner.reset(key)
+        except Exception as exc:
+            self._observe_error("reset", exc, time.perf_counter() - t0)
+            raise
+        self._observe_op("reset", time.perf_counter() - t0)
+
+    def close(self) -> None:
+        self._closed = True
+        self.inner.close()
+
+    # Pass-through for backend extras (allow_hashed, inject_failure, ...) --
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    # Hooks ----------------------------------------------------------------
+
+    def _observe_result(self, op: str, res: Result, n: int, dt: float) -> None:
+        pass
+
+    def _observe_batch(self, op: str, out: BatchResult, ns, dt: float) -> None:
+        pass
+
+    def _observe_op(self, op: str, dt: float) -> None:
+        pass
+
+    def _observe_error(self, op: str, exc: Exception, dt: float) -> None:
+        pass
+
+    # The abstract hooks are never reached (public surface is overridden),
+    # but the ABC requires concrete definitions.
+
+    def _allow_n(self, key: str, n: int, now: float) -> Result:  # pragma: no cover
+        raise AssertionError("decorator delegates the public surface")
+
+    def _reset(self, key: str) -> None:  # pragma: no cover
+        raise AssertionError("decorator delegates the public surface")
+
+
+def _error_kind(exc: Exception) -> str:
+    if isinstance(exc, StorageUnavailableError):
+        return "storage_unavailable"
+    if isinstance(exc, InvalidNError):
+        return "invalid_n"
+    if isinstance(exc, InvalidKeyError):
+        return "invalid_key"
+    if isinstance(exc, ClosedError):
+        return "closed"
+    return "internal"
+
+
+class MetricsDecorator(LimiterDecorator):
+    """Records the reference-specced metric families into a Registry
+    (``docs/ADR/003:44-66``; names ``docs/ARCHITECTURE.md:550-566``)."""
+
+    def __init__(self, inner: RateLimiter, registry: Optional[m.Registry] = None):
+        super().__init__(inner)
+        reg = registry if registry is not None else m.DEFAULT
+        self.registry = reg
+        self._algo = str(inner.config.algorithm)
+        self._requests = reg.counter(
+            "rate_limiter_requests_total",
+            "Rate limit checks by algorithm and result")
+        self._allowed = reg.counter(
+            "rate_limiter_decisions_allowed_total",
+            "Individual decisions allowed (device-side mask sum)")
+        self._denied = reg.counter(
+            "rate_limiter_decisions_denied_total",
+            "Individual decisions denied (device-side mask sum)")
+        self._latency = reg.histogram(
+            "rate_limiter_latency_seconds",
+            "Inner limiter call latency", m.LATENCY_BUCKETS)
+        self._batch = reg.histogram(
+            "rate_limiter_batch_size",
+            "Decisions per batched dispatch", m.BATCH_BUCKETS)
+        self._errors = reg.counter(
+            "rate_limiter_storage_errors_total",
+            "Backend failures (fail-open allowances included)")
+
+    def _result_label(self, res: Result) -> str:
+        if res.fail_open:
+            return "fail_open"
+        return "allowed" if res.allowed else "denied"
+
+    def _observe_result(self, op: str, res: Result, n: int, dt: float) -> None:
+        self._requests.inc(algorithm=self._algo, result=self._result_label(res))
+        if res.fail_open:
+            self._errors.inc(algorithm=self._algo)
+        if res.allowed:
+            self._allowed.inc(algorithm=self._algo)
+        else:
+            self._denied.inc(algorithm=self._algo)
+        self._latency.observe(dt, algorithm=self._algo, op=op)
+        self._batch.observe(1.0)
+
+    def _observe_batch(self, op: str, out: BatchResult, ns, dt: float) -> None:
+        b = len(out)
+        n_allowed = int(np.sum(out.allowed))
+        result = "fail_open" if out.fail_open else "mixed"
+        self._requests.inc(b, algorithm=self._algo, result=result)
+        if out.fail_open:
+            self._errors.inc(algorithm=self._algo)
+        self._allowed.inc(n_allowed, algorithm=self._algo)
+        self._denied.inc(b - n_allowed, algorithm=self._algo)
+        self._latency.observe(dt, algorithm=self._algo, op=op)
+        self._batch.observe(float(b))
+
+    def _observe_op(self, op: str, dt: float) -> None:
+        self._latency.observe(dt, algorithm=self._algo, op=op)
+
+    def _observe_error(self, op: str, exc: Exception, dt: float) -> None:
+        kind = _error_kind(exc)
+        self._requests.inc(algorithm=self._algo, result=f"error:{kind}")
+        if kind == "storage_unavailable":
+            self._errors.inc(algorithm=self._algo)
+        self._latency.observe(dt, algorithm=self._algo, op=op)
+
+
+class LoggingDecorator(LimiterDecorator):
+    """Structured logging wrapper (``docs/ADR/003:68-91``): decisions at
+    DEBUG, fail-open allowances at WARNING, errors at ERROR. Keys are
+    logged as given (the caller owns PII policy, as in the reference)."""
+
+    def __init__(self, inner: RateLimiter,
+                 logger: Optional[logging.Logger] = None):
+        super().__init__(inner)
+        self.logger = logger if logger is not None else logging.getLogger(
+            "ratelimiter_tpu")
+        self._algo = str(inner.config.algorithm)
+
+    def _observe_result(self, op: str, res: Result, n: int, dt: float) -> None:
+        if res.fail_open:
+            self.logger.warning(
+                "fail-open allowance algorithm=%s n=%d latency=%.6f",
+                self._algo, n, dt)
+        elif self.logger.isEnabledFor(logging.DEBUG):
+            self.logger.debug(
+                "decision algorithm=%s allowed=%s n=%d remaining=%d latency=%.6f",
+                self._algo, res.allowed, n, res.remaining, dt)
+
+    def _observe_batch(self, op: str, out: BatchResult, ns, dt: float) -> None:
+        if out.fail_open:
+            self.logger.warning(
+                "fail-open batch algorithm=%s size=%d latency=%.6f",
+                self._algo, len(out), dt)
+        elif self.logger.isEnabledFor(logging.DEBUG):
+            self.logger.debug(
+                "batch algorithm=%s size=%d allowed=%d latency=%.6f",
+                self._algo, len(out), int(np.sum(out.allowed)), dt)
+
+    def _observe_error(self, op: str, exc: Exception, dt: float) -> None:
+        self.logger.error("limiter error op=%s algorithm=%s error=%s",
+                          op, self._algo, exc)

@@ -1,0 +1,189 @@
+"""Minimal thread-safe metrics registry with Prometheus text exposition.
+
+The reference plans a Prometheus ``MetricsDecorator``
+(``docs/ADR/003-decorator-pattern-for-observability.md:44-66``) with metric
+names specced in ``docs/ARCHITECTURE.md:550-566``. No Prometheus client
+library is vendored in this environment, so this module implements the
+small subset the decorators and the serving tier need — counters, gauges,
+histograms, with labels — and renders the standard text format an actual
+Prometheus scraper would accept. No external deps, O(1) hot-path cost
+(a dict lookup + float add under a lock).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+#: Default histogram buckets, seconds — spans 10 µs host overhead to multi-
+#: second SLO breaches (device dispatches land in the 100 µs .. 10 ms range).
+LATENCY_BUCKETS = (1e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+                   1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+#: Batch-size buckets for the micro-batcher (powers of two up to 64K).
+BATCH_BUCKETS = tuple(float(1 << i) for i in range(17))
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(labels.items()))
+
+
+def _fmt_labels(items: Iterable[Tuple[str, str]]) -> str:
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}" if inner else ""
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic counter family, keyed by label values."""
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "counter")
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return lines
+
+
+class Gauge(_Metric):
+    """Point-in-time value family."""
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_, "gauge")
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+        return lines
+
+
+class Histogram(_Metric):
+    """Cumulative histogram family (Prometheus bucket semantics)."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = LATENCY_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[tuple, list] = {}   # key -> per-bucket counts + inf
+        self._sums: Dict[tuple, float] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)
+                self._counts[key] = counts
+                self._sums[key] = 0.0
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[key] += value
+
+    def count(self, **labels: str) -> int:
+        return sum(self._counts.get(_label_key(labels), []))
+
+    def sum(self, **labels: str) -> float:
+        return self._sums.get(_label_key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key, counts in sorted(self._counts.items()):
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum += counts[i]
+                    lines.append(
+                        f"{self.name}_bucket{_fmt_labels(key + (('le', f'{ub:g}'),))} {cum}")
+                cum += counts[-1]
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {cum}")
+                lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]:g}")
+                lines.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
+        return lines
+
+
+class Registry:
+    """A named collection of metric families; renders the Prometheus text
+    exposition format. One default registry per process (DEFAULT), but
+    tests and multi-limiter deployments can build private ones."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if existing.kind != metric.kind:
+                    raise ValueError(
+                        f"metric {metric.name} already registered as {existing.kind}")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._register(Counter(name, help_))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._register(Gauge(name, help_))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_, buckets))  # type: ignore[return-value]
+
+    def get(self, name: str) -> Optional[_Metric]:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+#: Process-default registry (the serving tier exposes it over /metrics).
+DEFAULT = Registry()

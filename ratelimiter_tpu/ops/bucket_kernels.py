@@ -1,0 +1,209 @@
+"""Sketched token bucket — unbounded-key TOKEN_BUCKET on the CMS backend.
+
+The reference's flagship algorithm (``tokenbucket.go:23-52``) keeps one
+{tokens, last_refill} hash per key in Redis; memory grows with key
+cardinality (~170 B/user, ``docs/ALGORITHMS.md:635``). This module gives the
+same continuous-refill / burst / denial-consumes-nothing semantics at
+O(depth x width) memory, independent of key count, via the classic
+token-bucket <-> leaky-meter equivalence (GCRA):
+
+    tokens(t) = limit - debt(t),   where debt decays at the refill rate
+    (limit/window tokens per second) and clamps at 0; a consume of n adds
+    n to debt; allow iff debt + n <= limit.
+
+The meter form sketches cleanly where the token form does not: per-key
+*debt* is a non-negative counter, so a count-min sketch over debts keeps
+the CMS error direction — a cell holds the SUM of colliding keys' debts,
+so the min-over-rows read can only OVERestimate a key's true debt, which
+can only cause false *denies*, never over-admission (the same contract as
+ops/sketch_kernels.py, SURVEY.md §7.4 hard part #3).
+
+Decay is exact integer math, no float drift (SURVEY.md §7.4 hard part #5):
+every cell decays at the SAME rate, so one scalar per-step decay amount
+serves the whole (d, w) slab, with a single global remainder carrying
+fractional micro-tokens across steps (the per-key analog is
+dense_kernels._token_bucket_step's per-slot ``rem``; here the clamp at 0
+happens per cell, which is exactly per-key-correct because linear decay
+followed by clamp composes: max(0, max(0, x-a)-b) == max(0, x-(a+b))).
+
+Accuracy model (documented tradeoff, measured by evaluation/accuracy.py):
+colliding *active* keys share refill — K hot keys in one cell drain it at
+K x their admission rate while it refills at 1 x rate, so persistent
+colliders are throttled toward one key's worth of combined throughput.
+Errors are always toward denying. Width sizing follows the usual CMS rule
+(w >> active hot keys); the conservative-update trick does not apply here
+(there is no globally-consistent "window read" target — the decayed debt
+is a moving quantity), so writes are vanilla sums.
+
+State (see init_state):
+    debt int64[d, w]  micro-token debt cells (1 token = 1e6 micro)
+    rem  int64[]      global decay remainder, < rate_den
+    last int64[]      timestamp of the last step, microseconds
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.clock import MICROS
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.ops.dense_kernels import _check_gates
+from ratelimiter_tpu.ops.segment import admit
+from ratelimiter_tpu.ops.sketch_kernels import _columns, _pack_bits
+from ratelimiter_tpu.ops.sortmerge import row_gather, row_histogram
+
+State = Dict[str, jnp.ndarray]
+
+#: Cells clamp here on write so debt arithmetic can never overflow int64
+#: even under adversarial collision pileups (2^61 micro-tokens = 2.3e12
+#: tokens — clamping errs toward denying, preserving the error direction).
+_DEBT_CAP = 1 << 61
+
+
+def init_state(cfg: Config) -> State:
+    """All-zero debt == every bucket full (the reference's absent-key
+    default, ``tokenbucket.go:31-33``); last=0 makes the first step see a
+    huge elapsed whose decay is a no-op on zero debt."""
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    return {
+        "debt": jnp.zeros((d, w), jnp.int64),
+        "rem": jnp.asarray(0, jnp.int64),
+        "last": jnp.asarray(0, jnp.int64),
+    }
+
+
+def _decay(state: State, now_us, *, rate_num: int, rate_den: int):
+    """Scalar micro-token decay since state['last'], exact and
+    overflow-safe. rate = rate_num/rate_den micro-tokens per us, in lowest
+    terms (dense_kernels._check_gates guarantees rate_den * rate_num <
+    2^62). The quotient arm is clamped so idle-for-years elapsed values
+    cannot overflow: past _DEBT_CAP the extra decay is irrelevant because
+    every cell has long since clamped at 0."""
+    elapsed = jnp.maximum(0, now_us - state["last"])
+    e_q = elapsed // rate_den
+    acc = (elapsed - e_q * rate_den) * rate_num + state["rem"]
+    e_q = jnp.minimum(e_q, _DEBT_CAP // rate_num)
+    decay = e_q * rate_num + acc // rate_den
+    return decay, acc % rate_den
+
+
+def _bucket_step(state: State, h1, h2, n, now_us, *,
+                 limit: int, rate_num: int, rate_den: int,
+                 d: int, w: int, iters: int,
+                 axis_name: str | None = None):
+    """One batched decision step. Returns (state, (allowed, remaining,
+    retry_us)) — dense_kernels._token_bucket_step's output shape, so the
+    limiter-side retry/reset plumbing is shared."""
+    decay, rem = _decay(state, now_us, rate_num=rate_num, rate_den=rate_den)
+    debt = jnp.maximum(jnp.int64(0), state["debt"] - decay)
+
+    cols = _columns(h1, h2, d, w)                       # (B, d)
+    est = None
+    for r in range(d):
+        (e_r,) = row_gather((debt[r],), cols[:, r])
+        est = e_r if est is None else jnp.minimum(est, e_r)
+
+    cap = limit * MICROS
+    avail = jnp.maximum(jnp.int64(0), cap - est)        # micro-tokens
+    n_units = n.astype(jnp.int64) * MICROS
+    sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
+    allowed, seen, consumed = admit(sid, n_units, avail, iters)
+
+    hists = jnp.stack([row_histogram(cols[:, r], consumed, w)
+                       for r in range(d)])
+    if axis_name is not None:
+        # Multi-chip delta merge: replicated debt, psum of increments over
+        # ICI (same invariant as sketch_kernels' delta mode).
+        hists = jax.lax.psum(hists, axis_name)
+    debt = jnp.minimum(debt + hists, _DEBT_CAP)
+
+    new_state = {"debt": debt, "rem": rem,
+                 "last": jnp.maximum(state["last"], now_us)}
+    remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
+    # Reference retry semantics (``tokenbucket.go:122-130``): time to refill
+    # the deficit, ceil'd to whole microseconds.
+    deficit = jnp.maximum(0, n_units - seen)
+    retry_us = jnp.where(allowed, 0, -((-deficit * rate_den) // rate_num))
+    return new_state, (allowed, remaining, retry_us)
+
+
+def _bucket_reset(state: State, h1, h2, now_us, *,
+                  rate_num: int, rate_den: int, d: int, w: int):
+    """Per-key reset: zero the key's debt by subtracting its min-estimate
+    from all its cells, clamped at 0 (no self-healing sweep exists here, so
+    unlike sketch_kernels._sketch_reset transient negatives are not allowed
+    to persist). Colliding keys gain allowance — errs toward allowing."""
+    decay, rem = _decay(state, now_us, rate_num=rate_num, rate_den=rate_den)
+    debt = jnp.maximum(jnp.int64(0), state["debt"] - decay)
+    cols = _columns(h1, h2, d, w)
+    est = None
+    for r in range(d):
+        (e_r,) = row_gather((debt[r],), cols[:, r])
+        est = e_r if est is None else jnp.minimum(est, e_r)
+    hists = jnp.stack([row_histogram(cols[:, r], est, w) for r in range(d)])
+    debt = jnp.maximum(jnp.int64(0), debt - hists)
+    return {"debt": debt, "rem": rem,
+            "last": jnp.maximum(state["last"], now_us)}
+
+
+def _bucket_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
+    """T sequential bucket steps on device (lax.scan), one dispatch —
+    sketch_kernels._sketch_scan's shape for the serving/bench loops. No
+    sub-window rollover precondition: decay is part of the step itself."""
+    def body(st, xs):
+        h1, h2, n, i = xs
+        st, (allowed, _rem, _retry) = _bucket_step(
+            st, h1, h2, n, now0_us + i * dt_us, **step_kw)
+        return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
+
+    T = h1s.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int64)
+    state, (packed, denies) = jax.lax.scan(body, state, (h1s, h2s, ns, idx))
+    return state, packed, denies
+
+
+_STEP_CACHE: Dict[tuple, Tuple[Callable, Callable]] = {}
+_SCAN_CACHE: Dict[tuple, Callable] = {}
+
+
+def _params(cfg: Config) -> tuple:
+    W, num, den = _check_gates(cfg)
+    return (cfg.limit, num, den, cfg.sketch.depth, cfg.sketch.width,
+            cfg.max_batch_admission_iters)
+
+
+def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
+    """Returns (step, reset) jitted callables, memoized per static config."""
+    limit, num, den, d, w, iters = key = _params(cfg)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step = jax.jit(
+        partial(_bucket_step, limit=limit, rate_num=num, rate_den=den,
+                d=d, w=w, iters=iters),
+        donate_argnums=(0,))
+    reset = jax.jit(
+        partial(_bucket_reset, rate_num=num, rate_den=den, d=d, w=w),
+        donate_argnums=(0,))
+    _STEP_CACHE[key] = (step, reset)
+    return step, reset
+
+
+def build_scan(cfg: Config) -> Callable:
+    """Jitted multi-step runner, one dispatch for T batches (bench shape)."""
+    limit, num, den, d, w, iters = key = _params(cfg)
+    cached = _SCAN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
+                   iters=iters)
+    scan = jax.jit(partial(_bucket_scan, step_kw=step_kw), donate_argnums=(0,))
+    _SCAN_CACHE[key] = scan
+    return scan

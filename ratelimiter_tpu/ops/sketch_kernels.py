@@ -74,6 +74,13 @@ def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
     an exact integer sub-window size (no fractional-period drift)."""
     from ratelimiter_tpu.core.types import Algorithm
 
+    if cfg.algorithm is Algorithm.TOKEN_BUCKET:
+        # Token-bucket semantics live in ops/bucket_kernels.py (decaying
+        # debt meter, no sub-window ring); building windowed kernels for a
+        # TOKEN_BUCKET config would silently change semantics.
+        raise InvalidConfigError(
+            "token bucket uses bucket_kernels, not the windowed sketch "
+            "(construct via create_limiter or SketchTokenBucketLimiter)")
     if cfg.limit >= (1 << 24):
         # The sketch admission path compares f32 quantities; limits at or
         # above 2^24 would make boundary comparisons inexact (ops/segment

@@ -19,12 +19,14 @@ from ratelimiter_tpu import (
     create_limiter,
 )
 
+#: Windowed algorithms only — used by the mesh contract suite. The sketched
+#: token bucket is single-chip for now: MeshSketchLimiter builds windowed
+#: kernels and sketch_geometry rejects TOKEN_BUCKET configs outright.
 SKETCH_ALGOS = [Algorithm.SLIDING_WINDOW, Algorithm.FIXED_WINDOW, Algorithm.TPU_SKETCH]
 
 
 class TestSketchContract(ContractTests):
     backend = "sketch"
-    algorithms = SKETCH_ALGOS
     supports_failure_injection = True
 
     def inject_failure(self, lim) -> None:
@@ -125,4 +127,102 @@ class TestSketchBehavior:
         assert not lim.allow("k").allowed
         clock.set(1010.5)  # next aligned window: full quota, no carryover
         assert lim.allow_n("k", 5).allowed
+        lim.close()
+
+
+class TestSketchTokenBucket:
+    """Sketched token bucket (ops/bucket_kernels.py): reference TB semantics
+    (``tokenbucket.go:23-52``) at constant memory in key cardinality."""
+
+    def test_continuous_refill(self):
+        # rate = 10/10s = 1 token/s: after draining, one token back per second.
+        lim, clock = make(algo=Algorithm.TOKEN_BUCKET, limit=10, window=10.0)
+        assert lim.allow_n("k", 10).allowed
+        assert not lim.allow("k").allowed
+        clock.advance(1.0)
+        assert lim.allow("k").allowed        # exactly 1 token refilled
+        assert not lim.allow("k").allowed
+        clock.advance(2.5)
+        assert lim.allow_n("k", 2).allowed   # 2.5 tokens: 2 whole ones spendable
+        assert not lim.allow("k").allowed    # 0.5 left < 1
+        lim.close()
+
+    def test_burst_after_idle_capped_at_limit(self):
+        lim, clock = make(algo=Algorithm.TOKEN_BUCKET, limit=5, window=1.0)
+        assert lim.allow_n("k", 5).allowed
+        clock.advance(3600.0)                # idle an hour: cap, not 18000
+        assert lim.allow_n("k", 5).allowed
+        assert not lim.allow("k").allowed
+        lim.close()
+
+    def test_matches_exact_backend_without_collisions(self):
+        # With width 65536 and a handful of keys, the sketch holds each key
+        # in private cells, and the integer decay is exact: decisions and
+        # remaining match the exact oracle step for step.
+        clock_s, clock_e = ManualClock(50.0), ManualClock(50.0)
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=7, window=3.0)
+        sk = create_limiter(cfg, backend="sketch", clock=clock_s)
+        ex = create_limiter(cfg, backend="exact", clock=clock_e)
+        rng = np.random.default_rng(7)
+        for _ in range(60):
+            dt = float(rng.uniform(0, 1.5))
+            clock_s.advance(dt)
+            clock_e.advance(dt)
+            key = f"user:{rng.integers(3)}"
+            n = int(rng.integers(1, 4))
+            rs, re = sk.allow_n(key, n), ex.allow_n(key, n)
+            assert rs.allowed == re.allowed
+            assert rs.remaining == re.remaining
+        sk.close()
+        ex.close()
+
+    def test_collisions_only_deny(self):
+        # Tiny sketch forces collisions: colliding keys share refill, so
+        # errors are extra denies — never extra allows beyond n*limit.
+        lim, _ = make(algo=Algorithm.TOKEN_BUCKET, limit=10, window=10.0,
+                      sketch=SketchParams(depth=2, width=16))
+        h = np.arange(64, dtype=np.uint64)
+        out = lim.allow_hashed(h, ns=np.full(64, 10, dtype=np.int64))
+        assert out.allow_count <= 64
+        # Immediately after, every key's debt estimate >= its true debt:
+        # nothing more may be admitted anywhere near the limit.
+        again = lim.allow_hashed(h, ns=np.full(64, 10, dtype=np.int64))
+        assert again.allow_count == 0
+        lim.close()
+
+    def test_retry_after_is_deficit_over_rate(self):
+        # rate = 6/60s = 0.1 tokens/s; deficit of 1 token -> 10 s.
+        lim, _ = make(algo=Algorithm.TOKEN_BUCKET, limit=6, window=60.0)
+        assert lim.allow_n("k", 6).allowed
+        res = lim.allow("k")
+        assert not res.allowed
+        assert res.retry_after == pytest.approx(10.0, abs=1e-5)
+        lim.close()
+
+    def test_memory_constant_in_keys(self):
+        lim, _ = make(algo=Algorithm.TOKEN_BUCKET, limit=100, window=60.0,
+                      sketch=SketchParams(depth=4, width=1024))
+        before = lim.memory_bytes()
+        out = lim.allow_hashed(np.arange(5000, dtype=np.uint64))
+        assert out.allow_count == 5000
+        assert lim.memory_bytes() == before
+        lim.close()
+
+    def test_windowed_kernels_reject_token_bucket_config(self):
+        # Constructing the windowed SketchLimiter machinery with a
+        # TOKEN_BUCKET config must raise, not silently build sliding-window
+        # semantics; only the factory/SketchTokenBucketLimiter route is legal.
+        from ratelimiter_tpu import InvalidConfigError
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=5, window=10.0)
+        with pytest.raises(InvalidConfigError):
+            sketch_kernels.sketch_geometry(cfg)
+        with pytest.raises(InvalidConfigError):
+            sketch_kernels.build_steps(cfg)
+
+    def test_unweighted_n_greater_than_limit_never_admits(self):
+        lim, _ = make(algo=Algorithm.TOKEN_BUCKET, limit=5, window=10.0)
+        assert not lim.allow_n("k", 6).allowed
+        assert lim.allow_n("k", 5).allowed  # denial consumed nothing
         lim.close()
