@@ -1,0 +1,18 @@
+"""Serving tier: RPC front door + micro-batching dispatcher + clients.
+
+The reference's planned L5 (``docs/ARCHITECTURE.md:287-304``, stub
+``cmd/server/main.go``), built TPU-first: every connection's requests
+coalesce into shared batched device dispatches (serving/batcher.py).
+"""
+
+from ratelimiter_tpu.serving.batcher import MicroBatcher
+from ratelimiter_tpu.serving.client import AsyncClient, Client
+from ratelimiter_tpu.serving.server import RateLimitServer, run_server
+
+__all__ = [
+    "AsyncClient",
+    "Client",
+    "MicroBatcher",
+    "RateLimitServer",
+    "run_server",
+]

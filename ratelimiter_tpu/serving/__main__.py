@@ -1,0 +1,83 @@
+"""Server binary: ``python -m ratelimiter_tpu.serving``.
+
+Realizes the reference's stub entry point (``cmd/server/main.go:9-18`` —
+its TODO list is exactly this file's job): config from flags, limiter
+init, serve, graceful shutdown on SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams, create_limiter
+from ratelimiter_tpu.observability import MetricsDecorator
+from ratelimiter_tpu.serving.server import RateLimitServer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="ratelimiter_tpu.serving",
+        description="TPU-backed rate-limit service")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8432)
+    ap.add_argument("--algorithm", default="tpu_sketch",
+                    choices=[a.value for a in Algorithm])
+    ap.add_argument("--backend", default="sketch",
+                    choices=["exact", "dense", "sketch"])
+    ap.add_argument("--limit", type=int, default=100)
+    ap.add_argument("--window", type=float, default=60.0,
+                    help="window seconds")
+    ap.add_argument("--fail-open", action="store_true")
+    ap.add_argument("--sketch-depth", type=int, default=4)
+    ap.add_argument("--sketch-width", type=int, default=65536)
+    ap.add_argument("--sub-windows", type=int, default=60)
+    ap.add_argument("--max-batch", type=int, default=4096,
+                    help="micro-batcher flush size")
+    ap.add_argument("--max-delay-us", type=float, default=200.0,
+                    help="micro-batcher coalescing window, microseconds")
+    ap.add_argument("--dispatch-timeout-ms", type=float, default=None,
+                    help="SLO per dispatch; breach triggers fail-open/closed")
+    ap.add_argument("--log-level", default="info")
+    return ap
+
+
+async def amain(args) -> None:
+    logging.basicConfig(level=args.log_level.upper())
+    cfg = Config(
+        algorithm=Algorithm(args.algorithm),
+        limit=args.limit,
+        window=args.window,
+        fail_open=args.fail_open,
+        sketch=SketchParams(depth=args.sketch_depth, width=args.sketch_width,
+                            sub_windows=args.sub_windows),
+    )
+    limiter = MetricsDecorator(create_limiter(cfg, backend=args.backend))
+    server = RateLimitServer(
+        limiter, args.host, args.port,
+        max_batch=args.max_batch,
+        max_delay=args.max_delay_us * 1e-6,
+        dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
+                          if args.dispatch_timeout_ms else None))
+    await server.start()
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    print(f"serving {args.algorithm}/{args.backend} "
+          f"limit={args.limit}/{args.window:g}s on "
+          f"{args.host}:{server.port}", flush=True)
+    await stop.wait()
+    await server.shutdown()
+    limiter.close()
+
+
+def main() -> None:
+    asyncio.run(amain(build_parser().parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,196 @@
+"""Micro-batching dispatcher: many concurrent RPCs -> one device call.
+
+This is the TPU-native analog of the reference's "Redis serializes all
+Lua scripts" (SURVEY.md §2.6): where the reference pays one network
+round-trip per decision and lets Redis order them, the front door
+coalesces every request that arrives within ``max_delay`` (or until
+``max_batch`` is reached) into ONE ``allow_batch`` dispatch, whose in-batch
+segment sequencing (ops/segment.py) provides exactly the serialized
+semantics. BASELINE.json's north star assumes this shape (batch 4096).
+
+Policy knobs (ADR-002 analog at the dispatch layer):
+
+* dispatch failure: handled inside the limiter (fail-open allowance or
+  StorageUnavailableError per Config.fail_open);
+* SLO breach (``dispatch_timeout``): if one dispatch takes longer than the
+  timeout, waiting requests stop waiting — fail-open configs answer
+  "allowed (fail_open)" immediately, fail-closed configs get
+  StorageUnavailableError. The device call itself is NOT cancelled: its
+  state update still lands (over-admission is bounded by the documented
+  fail-open contract), and the batcher keeps serving.
+
+Thread model: the event loop owns the queue; the (single-threaded)
+executor owns device dispatches, so the loop never blocks on the TPU and
+dispatch k+1 coalesces while k is in flight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import time
+from typing import List, Optional, Tuple
+
+from ratelimiter_tpu.algorithms.base import RateLimiter, check_key, check_n
+from ratelimiter_tpu.core.errors import StorageUnavailableError
+from ratelimiter_tpu.core.types import Result, fail_open_result
+from ratelimiter_tpu.observability import metrics as m
+
+
+class MicroBatcher:
+    """Coalesce concurrent allow/allow_n calls into batched dispatches.
+
+    Args:
+        limiter: any RateLimiter (decorated or not).
+        max_batch: flush as soon as this many requests are pending
+            (BASELINE config 3 serving shape: 4096).
+        max_delay: flush this many seconds after the first pending request
+            (the latency the batcher may add to coalesce; default 200 µs).
+        dispatch_timeout: SLO for one dispatch, seconds; None disables.
+        registry: metrics registry for queue/batch/SLO gauges.
+    """
+
+    def __init__(self, limiter: RateLimiter, *, max_batch: int = 4096,
+                 max_delay: float = 200e-6,
+                 dispatch_timeout: Optional[float] = None,
+                 registry: Optional[m.Registry] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.limiter = limiter
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self.dispatch_timeout = dispatch_timeout
+        self._pending: List[Tuple[str, int, asyncio.Future]] = []
+        self._timer: Optional[asyncio.TimerHandle] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rl-dispatch")
+        self._inflight: set = set()
+        self._draining = False
+        self.decisions_total = 0
+
+        reg = registry if registry is not None else m.DEFAULT
+        self._queue_depth = reg.gauge(
+            "rate_limiter_server_queue_depth",
+            "Requests waiting for the next batched dispatch")
+        self._dispatch_batch = reg.histogram(
+            "rate_limiter_server_batch_size",
+            "Requests per batched dispatch", m.BATCH_BUCKETS)
+        self._dispatch_latency = reg.histogram(
+            "rate_limiter_server_dispatch_seconds",
+            "Wall time of one batched device dispatch", m.LATENCY_BUCKETS)
+        self._slo_breaches = reg.counter(
+            "rate_limiter_server_slo_breaches_total",
+            "Dispatches that exceeded dispatch_timeout")
+
+    # ------------------------------------------------------------ submit
+
+    async def submit(self, key: str, n: int = 1) -> Result:
+        """Queue one decision; resolves when its batch's dispatch lands.
+        Validation happens here, before batching, so malformed requests
+        fail fast and never poison a batch (reference pre-Redis guards,
+        ``tokenbucket.go:91-93``)."""
+        if self._draining:
+            raise StorageUnavailableError("server is shutting down")
+        check_key(key)
+        check_n(n)
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((key, n, fut))
+        depth = len(self._pending)
+        self._queue_depth.set(depth)
+        if depth >= self.max_batch:
+            self._flush()
+        elif self._timer is None:
+            self._timer = loop.call_later(self.max_delay, self._flush)
+        return await fut
+
+    # ------------------------------------------------------------- flush
+
+    def _flush(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._pending:
+            return
+        batch = self._pending
+        self._pending = []
+        self._queue_depth.set(0)
+        task = asyncio.ensure_future(self._dispatch(batch))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch(self, batch) -> None:
+        keys = [k for k, _, _ in batch]
+        ns = [n for _, n, _ in batch]
+        self._dispatch_batch.observe(float(len(batch)))
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        work = loop.run_in_executor(
+            self._pool, lambda: self.limiter.allow_batch(keys, ns))
+        timed_out = False
+        try:
+            if self.dispatch_timeout is not None:
+                out = await asyncio.wait_for(
+                    asyncio.shield(work), self.dispatch_timeout)
+            else:
+                out = await work
+        except asyncio.TimeoutError:
+            timed_out = True
+        except Exception as exc:
+            # Fail-open dispatch failures never get here (the limiter maps
+            # them to a fail-open BatchResult); this is fail-closed or a
+            # validation race — every waiter gets the error.
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        finally:
+            self._dispatch_latency.observe(time.perf_counter() - t0)
+
+        if timed_out:
+            # SLO breach (ADR-002 at the dispatch layer). The shielded
+            # device call keeps running so state converges; waiters are
+            # answered NOW by policy.
+            self._slo_breaches.inc()
+            cfg = self.limiter.config
+            if cfg.fail_open:
+                reset_at = self.limiter.clock.now() + float(cfg.window)
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(fail_open_result(cfg.limit, reset_at))
+                self.decisions_total += len(batch)
+            else:
+                err = StorageUnavailableError(
+                    f"dispatch exceeded SLO ({self.dispatch_timeout * 1e3:.1f} ms)")
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(err)
+            # Keep the eventual result from leaking an un-awaited error.
+            work.add_done_callback(lambda f: f.exception())
+            return
+
+        self.decisions_total += len(batch)
+        for i, (_, _, fut) in enumerate(batch):
+            if not fut.done():
+                fut.set_result(out.result(i))
+
+    # ----------------------------------------------------------- control
+
+    async def drain(self) -> None:
+        """Flush what is queued and wait for every in-flight dispatch —
+        the graceful-shutdown half the reference stubs
+        (``cmd/server/main.go:17``)."""
+        self._draining = True
+        self._flush()
+        while self._inflight:
+            tasks = list(self._inflight)
+            await asyncio.gather(*tasks, return_exceptions=True)
+            # Remove directly: awaiting an already-done task does not yield
+            # to the loop, so the done-callback discard may not have run
+            # yet and the while would otherwise busy-spin.
+            self._inflight.difference_update(tasks)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)

@@ -1,0 +1,211 @@
+"""Clients for the rate-limit service.
+
+The reference plans a Go client library (``pkg/client/`` placeholder,
+``ROADMAP.md``); these are the Python equivalents over the binary protocol
+(serving/protocol.py):
+
+* ``Client`` — blocking, one outstanding request per call; the simple
+  integration surface (HTTP-middleware style usage, ``docs/EXAMPLES.md``).
+* ``AsyncClient`` — pipelined: many in-flight requests per connection,
+  matched by request id. This is what makes the micro-batcher's coalescing
+  reachable from a single process, and what the e2e benchmark drives.
+
+Both re-raise server-side errors as the same exception types the library
+raises locally (core/errors.py), so "local limiter" and "remote limiter"
+are drop-in interchangeable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Sequence
+
+from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.serving import protocol as p
+
+
+class Client:
+    """Blocking client, thread-safe (a lock serializes request/response)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout: Optional[float] = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = b""
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("server closed the connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _roundtrip(self, frame: bytes, req_id: int):
+        with self._lock:
+            self._sock.sendall(frame)
+            hdr = self._recv_exact(p.HEADER_SIZE)
+            length, type_, rid = p.parse_header(hdr)
+            body = self._recv_exact(length - 9)
+        if rid != req_id:
+            raise p.ProtocolError(f"response id {rid} != request id {req_id}")
+        if type_ == p.T_ERROR:
+            code, msg = p.parse_error(body)
+            raise p.exception_for(code, msg)
+        return type_, body
+
+    # ------------------------------------------------------------- surface
+
+    def allow(self, key: str) -> Result:
+        return self.allow_n(key, 1)
+
+    def allow_n(self, key: str, n: int) -> Result:
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(p.encode_allow_n(req_id, key, n), req_id)
+        if type_ != p.T_RESULT:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result(body)
+
+    def reset(self, key: str) -> None:
+        req_id = next(self._ids)
+        type_, _ = self._roundtrip(p.encode_reset(req_id, key), req_id)
+        if type_ != p.T_OK:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+
+    def health(self) -> tuple[bool, float, int]:
+        """(serving, uptime_seconds, decisions_total)."""
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(p.encode_simple(p.T_HEALTH, req_id), req_id)
+        if type_ != p.T_HEALTH_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_health(body)
+
+    def metrics(self) -> str:
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(p.encode_simple(p.T_METRICS, req_id), req_id)
+        if type_ != p.T_METRICS_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_metrics(body)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class AsyncClient:
+    """Pipelined asyncio client: unlimited in-flight requests, responses
+    matched by id. One reader task per connection."""
+
+    def __init__(self):
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._waiting: Dict[int, asyncio.Future] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def connect(cls, host: str = "127.0.0.1", port: int = 0) -> "AsyncClient":
+        self = cls()
+        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._writer.get_extra_info("socket").setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        return self
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(p.HEADER_SIZE)
+                length, type_, rid = p.parse_header(hdr)
+                body = await self._reader.readexactly(length - 9)
+                fut = self._waiting.pop(rid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result((type_, body))
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                asyncio.CancelledError) as exc:
+            for fut in self._waiting.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError(f"connection lost: {exc!r}"))
+            self._waiting.clear()
+
+    async def _request(self, frame: bytes, req_id: int):
+        fut = asyncio.get_running_loop().create_future()
+        self._waiting[req_id] = fut
+        self._writer.write(frame)
+        await self._writer.drain()
+        type_, body = await fut
+        if type_ == p.T_ERROR:
+            code, msg = p.parse_error(body)
+            raise p.exception_for(code, msg)
+        return type_, body
+
+    async def allow(self, key: str) -> Result:
+        return await self.allow_n(key, 1)
+
+    async def allow_n(self, key: str, n: int) -> Result:
+        req_id = next(self._ids)
+        type_, body = await self._request(p.encode_allow_n(req_id, key, n), req_id)
+        if type_ != p.T_RESULT:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result(body)
+
+    async def allow_many(self, keys: Sequence[str],
+                         ns: Optional[Sequence[int]] = None) -> list:
+        """Fire a pipelined burst and gather results in order — the load
+        shape that exercises the server's micro-batching."""
+        if ns is None:
+            ns = [1] * len(keys)
+        return await asyncio.gather(
+            *(self.allow_n(k, n) for k, n in zip(keys, ns)),
+            return_exceptions=True)
+
+    async def reset(self, key: str) -> None:
+        req_id = next(self._ids)
+        type_, _ = await self._request(p.encode_reset(req_id, key), req_id)
+        if type_ != p.T_OK:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+
+    async def health(self) -> tuple[bool, float, int]:
+        req_id = next(self._ids)
+        type_, body = await self._request(p.encode_simple(p.T_HEALTH, req_id), req_id)
+        if type_ != p.T_HEALTH_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_health(body)
+
+    async def metrics(self) -> str:
+        req_id = next(self._ids)
+        type_, body = await self._request(p.encode_simple(p.T_METRICS, req_id), req_id)
+        if type_ != p.T_METRICS_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_metrics(body)
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except asyncio.CancelledError:
+                pass
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass

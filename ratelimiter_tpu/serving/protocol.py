@@ -1,0 +1,215 @@
+"""Wire protocol for the rate-limit service.
+
+The reference plans a gRPC ``Allow/AllowN/Reset`` service plus health
+(``docs/ARCHITECTURE.md:287-304``, stub ``cmd/server/main.go:13-17``). No
+gRPC runtime ships in this environment, so the service speaks an
+equivalent compact binary protocol over TCP — same RPC surface, same
+semantics, pipelinable (requests carry ids; responses may arrive out of
+order, which is what lets the server micro-batch across in-flight
+requests from every connection).
+
+Frame layout (little-endian):
+
+    u32  payload_length          (not counting these 4 bytes)
+    u8   type
+    u64  request_id              (echoed in the response)
+    ...  type-specific body
+
+Requests:
+    ALLOW_N  (1): u32 n, u16 key_len, key utf-8
+    RESET    (2): u16 key_len, key utf-8
+    HEALTH   (3): -
+    METRICS  (4): -
+
+Responses:
+    RESULT   (129): u8 flags (bit0 allowed, bit1 fail_open), i64 limit,
+                    i64 remaining, f64 retry_after, f64 reset_at
+    OK       (130): -
+    HEALTH   (131): u8 status (1 serving, 0 draining), f64 uptime_s,
+                    u64 decisions_total
+    METRICS  (132): u32 text_len, prometheus text utf-8
+    ERROR    (255): u16 code, u16 msg_len, msg utf-8
+
+Error codes mirror the error sentinels (core/errors.py; reference
+``errors.go:5-20``) so clients can re-raise the right exception type.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple, Union
+
+from ratelimiter_tpu.core.errors import (
+    ClosedError,
+    InvalidConfigError,
+    InvalidKeyError,
+    InvalidNError,
+    RateLimiterError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import Result
+
+MAX_FRAME = 1 << 20  # 1 MiB: far above any legal request, bounds bad input
+MAX_KEY_LEN = 4096
+
+# Request types
+T_ALLOW_N = 1
+T_RESET = 2
+T_HEALTH = 3
+T_METRICS = 4
+# Response types
+T_RESULT = 129
+T_OK = 130
+T_HEALTH_R = 131
+T_METRICS_R = 132
+T_ERROR = 255
+
+# Error codes <-> exceptions (reference errors.go:5-20 analogs)
+E_INVALID_N = 1
+E_INVALID_KEY = 2
+E_STORAGE_UNAVAILABLE = 3
+E_CLOSED = 4
+E_INVALID_CONFIG = 5
+E_SHUTTING_DOWN = 6
+E_INTERNAL = 7
+
+_CODE_TO_EXC = {
+    E_INVALID_N: InvalidNError,
+    E_INVALID_KEY: InvalidKeyError,
+    E_STORAGE_UNAVAILABLE: StorageUnavailableError,
+    E_CLOSED: ClosedError,
+    E_INVALID_CONFIG: InvalidConfigError,
+    E_SHUTTING_DOWN: StorageUnavailableError,
+    E_INTERNAL: RateLimiterError,
+}
+
+
+def code_for(exc: Exception) -> int:
+    if isinstance(exc, InvalidNError):
+        return E_INVALID_N
+    if isinstance(exc, InvalidKeyError):
+        return E_INVALID_KEY
+    if isinstance(exc, StorageUnavailableError):
+        return E_STORAGE_UNAVAILABLE
+    if isinstance(exc, ClosedError):
+        return E_CLOSED
+    if isinstance(exc, InvalidConfigError):
+        return E_INVALID_CONFIG
+    return E_INTERNAL
+
+
+def exception_for(code: int, msg: str) -> Exception:
+    return _CODE_TO_EXC.get(code, RateLimiterError)(msg)
+
+
+_HDR = struct.Struct("<IBQ")          # length, type, request_id
+_ALLOW_BODY = struct.Struct("<IH")    # n, key_len
+_KEYLEN = struct.Struct("<H")
+_RESULT_BODY = struct.Struct("<Bqqdd")
+_HEALTH_BODY = struct.Struct("<BdQ")
+_ERROR_HEAD = struct.Struct("<HH")
+_U32 = struct.Struct("<I")
+
+
+def encode_allow_n(req_id: int, key: str, n: int) -> bytes:
+    kb = key.encode("utf-8")
+    body = _ALLOW_BODY.pack(n, len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_ALLOW_N, req_id) + body
+
+
+def encode_reset(req_id: int, key: str) -> bytes:
+    kb = key.encode("utf-8")
+    body = _KEYLEN.pack(len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_RESET, req_id) + body
+
+
+def encode_simple(type_: int, req_id: int) -> bytes:
+    return _HDR.pack(1 + 8, type_, req_id)
+
+
+def encode_result(req_id: int, res: Result) -> bytes:
+    flags = (1 if res.allowed else 0) | (2 if res.fail_open else 0)
+    body = _RESULT_BODY.pack(flags, res.limit, res.remaining,
+                             res.retry_after, res.reset_at)
+    return _HDR.pack(1 + 8 + len(body), T_RESULT, req_id) + body
+
+
+def encode_ok(req_id: int) -> bytes:
+    return _HDR.pack(1 + 8, T_OK, req_id)
+
+
+def encode_health(req_id: int, serving: bool, uptime_s: float,
+                  decisions: int) -> bytes:
+    body = _HEALTH_BODY.pack(1 if serving else 0, uptime_s, decisions)
+    return _HDR.pack(1 + 8 + len(body), T_HEALTH_R, req_id) + body
+
+
+def encode_metrics(req_id: int, text: str) -> bytes:
+    tb = text.encode("utf-8")
+    body = _U32.pack(len(tb)) + tb
+    return _HDR.pack(1 + 8 + len(body), T_METRICS_R, req_id) + body
+
+
+def encode_error(req_id: int, code: int, msg: str) -> bytes:
+    mb = msg.encode("utf-8")[:65535]
+    body = _ERROR_HEAD.pack(code, len(mb)) + mb
+    return _HDR.pack(1 + 8 + len(body), T_ERROR, req_id) + body
+
+
+@dataclass
+class Frame:
+    type: int
+    req_id: int
+    body: bytes
+
+
+class ProtocolError(RateLimiterError):
+    """Malformed frame — the connection is beyond recovery."""
+
+
+def parse_header(buf: bytes) -> Tuple[int, int, int]:
+    """(payload_length, type, req_id) from the 13 header bytes."""
+    length, type_, req_id = _HDR.unpack_from(buf)
+    if length < 9 or length > MAX_FRAME:
+        raise ProtocolError(f"bad frame length {length}")
+    return length, type_, req_id
+
+
+HEADER_SIZE = _HDR.size  # 13
+
+
+def parse_allow_n(body: bytes) -> Tuple[str, int]:
+    n, key_len = _ALLOW_BODY.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _ALLOW_BODY.size + key_len:
+        raise ProtocolError("bad ALLOW_N body")
+    return body[_ALLOW_BODY.size:].decode("utf-8"), n
+
+
+def parse_reset(body: bytes) -> str:
+    (key_len,) = _KEYLEN.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _KEYLEN.size + key_len:
+        raise ProtocolError("bad RESET body")
+    return body[_KEYLEN.size:].decode("utf-8")
+
+
+def parse_result(body: bytes) -> Result:
+    flags, limit, remaining, retry_after, reset_at = _RESULT_BODY.unpack(body)
+    return Result(allowed=bool(flags & 1), limit=limit, remaining=remaining,
+                  retry_after=retry_after, reset_at=reset_at,
+                  fail_open=bool(flags & 2))
+
+
+def parse_health(body: bytes) -> Tuple[bool, float, int]:
+    status, uptime, decisions = _HEALTH_BODY.unpack(body)
+    return bool(status), uptime, decisions
+
+
+def parse_metrics(body: bytes) -> str:
+    (n,) = _U32.unpack_from(body)
+    return body[_U32.size:_U32.size + n].decode("utf-8")
+
+
+def parse_error(body: bytes) -> Tuple[int, str]:
+    code, msg_len = _ERROR_HEAD.unpack_from(body)
+    return code, body[_ERROR_HEAD.size:_ERROR_HEAD.size + msg_len].decode("utf-8")

@@ -1,0 +1,164 @@
+"""The rate-limit service: asyncio TCP front door over a micro-batcher.
+
+Realizes the reference's planned L5 layer (``docs/ARCHITECTURE.md:287-304``
+— Allow/AllowN/Reset RPCs, health check, graceful shutdown; the stub
+``cmd/server/main.go:13-17`` lists exactly these TODOs). Differences are
+deliberate TPU-first design, not omissions:
+
+* every request from every connection funnels into ONE MicroBatcher, so
+  concurrent clients share device dispatches (the BASELINE north-star
+  serving shape) instead of each costing a backend round-trip;
+* responses carry request ids and may return out of order — clients
+  pipeline, the server coalesces;
+* metrics are a first-class RPC (Prometheus text over T_METRICS) as well
+  as whatever registry the embedding process scrapes.
+
+Reset is deliberately NOT batched: it is rare, and its semantics are
+"take effect before any later decision", which the per-limiter lock
+already gives.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving.batcher import MicroBatcher
+
+log = logging.getLogger("ratelimiter_tpu.serving")
+
+
+class RateLimitServer:
+    def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
+                 port: int = 0, *, max_batch: int = 4096,
+                 max_delay: float = 200e-6,
+                 dispatch_timeout: Optional[float] = None,
+                 registry: Optional[m.Registry] = None):
+        self.limiter = limiter
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None else m.DEFAULT
+        self.batcher = MicroBatcher(
+            limiter, max_batch=max_batch, max_delay=max_delay,
+            dispatch_timeout=dispatch_timeout, registry=self.registry)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._started_at = time.time()
+        self._serving = False
+        self._conn_tasks: set = set()
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started_at = time.time()
+        self._serving = True
+        log.info("rate-limit server listening on %s:%d", self.host, self.port)
+
+    async def shutdown(self) -> None:
+        """Graceful: stop accepting, answer what is in flight, drain the
+        batcher, then close connections (``cmd/server/main.go:17``)."""
+        self._serving = False
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.batcher.drain()
+        for t in list(self._conn_tasks):
+            t.cancel()
+        await asyncio.gather(*list(self._conn_tasks), return_exceptions=True)
+        self.batcher.close()
+        log.info("rate-limit server stopped")
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None, "call start() first"
+        await self._server.serve_forever()
+
+    # ---------------------------------------------------------- connection
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        write_lock = asyncio.Lock()
+        req_tasks: set = set()
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            while True:
+                try:
+                    hdr = await reader.readexactly(p.HEADER_SIZE)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                try:
+                    length, type_, req_id = p.parse_header(hdr)
+                    body = await reader.readexactly(length - 9)
+                except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
+                    log.warning("protocol error, dropping connection: %s", exc)
+                    break
+                # Each request is its own task so pipelined requests from
+                # one connection coalesce into shared batches.
+                t = asyncio.ensure_future(self._handle_frame(
+                    type_, req_id, body, writer, write_lock))
+                req_tasks.add(t)
+                t.add_done_callback(req_tasks.discard)
+        finally:
+            if req_tasks:
+                await asyncio.gather(*list(req_tasks), return_exceptions=True)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            if task is not None:
+                self._conn_tasks.discard(task)
+
+    async def _handle_frame(self, type_: int, req_id: int, body: bytes,
+                            writer: asyncio.StreamWriter,
+                            write_lock: asyncio.Lock) -> None:
+        try:
+            if type_ == p.T_ALLOW_N:
+                key, n = p.parse_allow_n(body)
+                try:
+                    res = await self.batcher.submit(key, n)
+                    out = p.encode_result(req_id, res)
+                except Exception as exc:
+                    out = p.encode_error(req_id, p.code_for(exc), str(exc))
+            elif type_ == p.T_RESET:
+                key = p.parse_reset(body)
+                try:
+                    # Off the event loop: reset takes the limiter lock.
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.limiter.reset, key)
+                    out = p.encode_ok(req_id)
+                except Exception as exc:
+                    out = p.encode_error(req_id, p.code_for(exc), str(exc))
+            elif type_ == p.T_HEALTH:
+                out = p.encode_health(
+                    req_id, self._serving, time.time() - self._started_at,
+                    self.batcher.decisions_total)
+            elif type_ == p.T_METRICS:
+                out = p.encode_metrics(req_id, self.registry.render())
+            else:
+                out = p.encode_error(req_id, p.E_INTERNAL,
+                                     f"unknown request type {type_}")
+        except p.ProtocolError as exc:
+            out = p.encode_error(req_id, p.E_INTERNAL, str(exc))
+        async with write_lock:
+            try:
+                writer.write(out)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+async def run_server(limiter: RateLimiter, host: str = "127.0.0.1",
+                     port: int = 0, **kw) -> RateLimitServer:
+    """Start and return a server (test/embedding convenience)."""
+    srv = RateLimitServer(limiter, host, port, **kw)
+    await srv.start()
+    return srv

@@ -1,0 +1,394 @@
+"""Serving tier: protocol, micro-batcher, and server integration tests.
+
+The integration tier mirrors the reference's miniredis-based tests
+(SURVEY.md §4.2): a real server speaking the real wire protocol over real
+sockets, in-process so tests control time and failure injection. The
+headline test is the VERDICT r2 "done" criterion: many concurrent clients
+through a live server, limit-L key admits exactly L globally.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from contextlib import contextmanager
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidNError,
+    ManualClock,
+    StorageUnavailableError,
+    create_limiter,
+)
+from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.observability import Registry
+from ratelimiter_tpu.serving import AsyncClient, Client, MicroBatcher, RateLimitServer
+from ratelimiter_tpu.serving import protocol as p
+
+
+# --------------------------------------------------------------- protocol
+
+class TestProtocol:
+    def test_allow_n_roundtrip(self):
+        frame = p.encode_allow_n(42, "user:1", 7)
+        length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert (type_, rid) == (p.T_ALLOW_N, 42)
+        key, n = p.parse_allow_n(frame[p.HEADER_SIZE:])
+        assert (key, n) == ("user:1", 7)
+
+    def test_result_roundtrip(self):
+        res = Result(allowed=True, limit=100, remaining=3, retry_after=0.0,
+                     reset_at=1234.5, fail_open=True)
+        frame = p.encode_result(9, res)
+        body = frame[p.HEADER_SIZE:]
+        back = p.parse_result(body)
+        assert back == res
+
+    def test_error_roundtrip_maps_exception(self):
+        frame = p.encode_error(1, p.E_INVALID_N, "n must be positive")
+        code, msg = p.parse_error(frame[p.HEADER_SIZE:])
+        exc = p.exception_for(code, msg)
+        assert isinstance(exc, InvalidNError)
+
+    def test_unicode_keys(self):
+        frame = p.encode_allow_n(1, "ключ:héllo", 1)
+        key, _ = p.parse_allow_n(frame[p.HEADER_SIZE:])
+        assert key == "ключ:héllo"
+
+    def test_bad_length_rejected(self):
+        import struct
+
+        bad = struct.pack("<IBQ", 2 ** 24, p.T_ALLOW_N, 1)
+        with pytest.raises(p.ProtocolError):
+            p.parse_header(bad)
+
+
+# ---------------------------------------------------------------- batcher
+
+def _mk_limiter(limit=100, window=60.0, algo=Algorithm.SLIDING_WINDOW,
+                backend="exact", **kw):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=algo, limit=limit, window=window, **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_submits(self):
+        lim, _ = _mk_limiter(limit=100)
+        reg = Registry()
+        batcher = MicroBatcher(lim, max_batch=64, max_delay=5e-3, registry=reg)
+
+        async def go():
+            results = await asyncio.gather(
+                *(batcher.submit(f"k{i % 4}") for i in range(32)))
+            await batcher.drain()
+            return results
+
+        results = asyncio.run(go())
+        assert all(r.allowed for r in results)
+        h = reg.get("rate_limiter_server_batch_size")
+        # All 32 submits landed within one coalescing window -> one dispatch.
+        assert h.count() == 1 and h.sum() == 32.0
+        batcher.close()
+        lim.close()
+
+    def test_flushes_at_max_batch(self):
+        lim, _ = _mk_limiter(limit=1000)
+        reg = Registry()
+        batcher = MicroBatcher(lim, max_batch=8, max_delay=10.0, registry=reg)
+
+        async def go():
+            return await asyncio.gather(*(batcher.submit(f"k{i}")
+                                          for i in range(8)))
+
+        results = asyncio.run(go())  # returns despite the 10s max_delay
+        assert len(results) == 8
+        assert reg.get("rate_limiter_server_batch_size").sum() == 8.0
+        batcher.close()
+        lim.close()
+
+    def test_exactness_through_batching(self):
+        lim, _ = _mk_limiter(limit=10)
+        batcher = MicroBatcher(lim, max_batch=256, max_delay=2e-3)
+
+        async def go():
+            return await asyncio.gather(
+                *(batcher.submit("hot") for _ in range(40)))
+
+        results = asyncio.run(go())
+        assert sum(r.allowed for r in results) == 10
+        batcher.close()
+        lim.close()
+
+    def test_validation_rejected_before_batching(self):
+        lim, _ = _mk_limiter()
+        batcher = MicroBatcher(lim, max_batch=8, max_delay=1e-3)
+
+        async def go():
+            with pytest.raises(InvalidNError):
+                await batcher.submit("k", 0)
+            with pytest.raises(Exception):
+                await batcher.submit("", 1)
+
+        asyncio.run(go())
+        batcher.close()
+        lim.close()
+
+    def test_slo_breach_fail_open(self):
+        lim, _ = _mk_limiter(limit=5, fail_open=True)
+        slow = _SlowLimiter(lim, delay=0.2)
+        batcher = MicroBatcher(slow, max_batch=4, max_delay=1e-4,
+                               dispatch_timeout=0.02)
+
+        async def go():
+            t0 = time.perf_counter()
+            res = await batcher.submit("k")
+            dt = time.perf_counter() - t0
+            await batcher.drain()
+            return res, dt
+
+        res, dt = asyncio.run(go())
+        assert res.allowed and res.fail_open
+        assert dt < 0.15  # answered at SLO, not at dispatch completion
+        batcher.close()
+        lim.close()
+
+    def test_slo_breach_fail_closed(self):
+        lim, _ = _mk_limiter(limit=5, fail_open=False)
+        slow = _SlowLimiter(lim, delay=0.2)
+        batcher = MicroBatcher(slow, max_batch=4, max_delay=1e-4,
+                               dispatch_timeout=0.02)
+
+        async def go():
+            with pytest.raises(StorageUnavailableError):
+                await batcher.submit("k")
+            await batcher.drain()
+
+        asyncio.run(go())
+        batcher.close()
+        lim.close()
+
+
+class _SlowLimiter:
+    """Wraps a limiter, delaying allow_batch — the SLO-breach fixture."""
+
+    def __init__(self, inner, delay: float):
+        self._inner = inner
+        self._delay = delay
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def allow_batch(self, keys, ns=None, *, now=None):
+        time.sleep(self._delay)
+        return self._inner.allow_batch(keys, ns, now=now)
+
+
+# ----------------------------------------------------------------- server
+
+@contextmanager
+def running_server(limiter, **kw):
+    """A live server on a background event loop; yields (server, port)."""
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    server = RateLimitServer(limiter, "127.0.0.1", 0, **kw)
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=10)
+    try:
+        yield server, server.port, loop
+    finally:
+        asyncio.run_coroutine_threadsafe(server.shutdown(), loop).result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
+
+
+class TestServerIntegration:
+    def test_allow_deny_over_the_wire(self):
+        lim, _ = _mk_limiter(limit=3)
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                for i in range(3):
+                    res = c.allow("user:1")
+                    assert res.allowed and res.remaining == 2 - i
+                res = c.allow("user:1")
+                assert not res.allowed and res.retry_after > 0
+        lim.close()
+
+    def test_allow_n_and_reset(self):
+        lim, _ = _mk_limiter(limit=10)
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                assert c.allow_n("k", 10).allowed
+                assert not c.allow("k").allowed
+                c.reset("k")
+                assert c.allow("k").allowed
+        lim.close()
+
+    def test_invalid_n_comes_back_as_typed_error(self):
+        lim, _ = _mk_limiter()
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                with pytest.raises(InvalidNError):
+                    c.allow_n("k", 0)
+                # Connection still usable after an error response.
+                assert c.allow("k").allowed
+        lim.close()
+
+    def test_health_and_metrics(self):
+        lim, _ = _mk_limiter()
+        reg = Registry()
+        with running_server(lim, registry=reg) as (_, port, _loop):
+            with Client(port=port) as c:
+                serving, uptime, decisions = c.health()
+                assert serving and uptime >= 0 and decisions == 0
+                c.allow("k")
+                _, _, decisions = c.health()
+                assert decisions == 1
+                text = c.metrics()
+                assert "rate_limiter_server_batch_size" in text
+        lim.close()
+
+    def test_concurrent_clients_global_exactness(self):
+        """VERDICT r2 done-criterion: many concurrent clients, one hot key,
+        limit L -> exactly L allowed globally (exact backend; the batcher
+        coalesces across connections and the in-batch sequencing keeps the
+        serialized-Lua semantics)."""
+        lim, _ = _mk_limiter(limit=100)
+        with running_server(lim, max_batch=512, max_delay=2e-3) as (srv, port, _loop):
+            allowed = []
+            lock = threading.Lock()
+
+            def worker(count: int):
+                with Client(port=port) as c:
+                    mine = [c.allow("hot").allowed for _ in range(count)]
+                with lock:
+                    allowed.extend(mine)
+
+            threads = [threading.Thread(target=worker, args=(15,))
+                       for _ in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(allowed) == 150
+            assert sum(allowed) == 100
+        lim.close()
+
+    def test_pipelined_client_coalesces_into_batches(self):
+        lim, _ = _mk_limiter(limit=5000)
+        reg = Registry()
+        with running_server(lim, max_batch=4096, max_delay=5e-3,
+                            registry=reg) as (_, port, loop):
+            async def burst():
+                c = await AsyncClient.connect(port=port)
+                results = await c.allow_many([f"k{i % 50}" for i in range(500)])
+                await c.close()
+                return results
+
+            results = asyncio.run_coroutine_threadsafe(
+                burst(), loop).result(timeout=30)
+            assert all(isinstance(r, Result) and r.allowed for r in results)
+        h = reg.get("rate_limiter_server_batch_size")
+        assert h.count() < 500, "pipelined requests must share dispatches"
+        assert h.sum() == 500.0
+        lim.close()
+
+    def test_fail_open_through_the_server(self):
+        lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch", fail_open=True)
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                assert c.allow("k").allowed
+                lim.inject_failure()
+                res = c.allow("k")
+                assert res.allowed and res.fail_open
+        lim.close()
+
+    def test_fail_closed_through_the_server(self):
+        lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch", fail_open=False)
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                assert c.allow("k").allowed
+                lim.inject_failure()
+                with pytest.raises(StorageUnavailableError):
+                    c.allow("k")
+        lim.close()
+
+    def test_graceful_shutdown_answers_inflight(self):
+        lim, _ = _mk_limiter(limit=1000)
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=loop.run_forever, daemon=True)
+        thread.start()
+        server = RateLimitServer(lim, "127.0.0.1", 0, max_batch=512,
+                                 max_delay=50e-3)
+        asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=10)
+        port = server.port
+
+        results = []
+
+        def client_burst():
+            with Client(port=port) as c:
+                results.extend(c.allow(f"k{i}").allowed for i in range(20))
+
+        t = threading.Thread(target=client_burst)
+        t.start()
+        time.sleep(0.01)  # let some requests queue inside the 50ms window
+        asyncio.run_coroutine_threadsafe(server.shutdown(), loop).result(timeout=10)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # Every request that reached the server before shutdown got a real
+        # answer (drain flushes the queue rather than dropping it).
+        assert all(results)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
+        lim.close()
+
+
+class TestServerBinary:
+    def test_cli_serves_and_shuts_down_cleanly(self, tmp_path):
+        """Spawn the real binary (python -m ratelimiter_tpu.serving), drive
+        it over TCP, SIGTERM it, assert clean exit — the reference's
+        cmd/server TODO list, end to end."""
+        import os
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        # Pick a free port up front.
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "exact", "--algorithm", "fixed_window",
+             "--limit", "2", "--window", "60", "--port", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "serving" in line, line
+            with Client(port=port, timeout=10.0) as c:
+                assert c.allow("k").allowed
+                assert c.allow("k").allowed
+                assert not c.allow("k").allowed
+                serving, _, decisions = c.health()
+                assert serving and decisions == 3
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
