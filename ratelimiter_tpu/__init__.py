@@ -38,6 +38,7 @@ from ratelimiter_tpu.core.errors import (
     InvalidNError,
     StorageUnavailableError,
     ClosedError,
+    CheckpointError,
 )
 from ratelimiter_tpu.core.clock import Clock, SystemClock, ManualClock
 from ratelimiter_tpu.algorithms.base import RateLimiter
@@ -59,6 +60,7 @@ __all__ = [
     "InvalidNError",
     "StorageUnavailableError",
     "ClosedError",
+    "CheckpointError",
     "Clock",
     "SystemClock",
     "ManualClock",

@@ -190,6 +190,59 @@ class DenseLimiter(RateLimiter):
         self._slots.clear()
         self._free.clear()
 
+    # ------------------------------------------------- checkpoint/restore
+
+    def save(self, path: str) -> None:
+        """Snapshot device state + the host slot map to ``path`` (.npz).
+        Format/staleness contract: ratelimiter_tpu/checkpoint.py."""
+        from ratelimiter_tpu.checkpoint import save_state
+
+        self._check_open()
+        with self._lock:
+            arrays = {f"state_{k}": np.asarray(v)
+                      for k, v in self._state.items()}
+            arrays["slot_keys"] = np.array(list(self._slots.keys()), dtype=str)
+            arrays["slot_ids"] = np.array(list(self._slots.values()),
+                                          dtype=np.int32)
+            arrays["last_used"] = self._last_used.copy()
+            extra = {"saved_at": self.clock.now(), "capacity": self._capacity}
+        save_state(path, "dense", self.config, arrays, extra)
+
+    def restore(self, path: str) -> None:
+        """Replace device state and slot map with the snapshot. Elapsed-time
+        catch-up is automatic (window roll / token refill key off absolute
+        timestamps); keys idle across the gap are reclaimed by the usual
+        prune horizon."""
+        import jax
+
+        from ratelimiter_tpu.checkpoint import load_state
+        from ratelimiter_tpu.core.errors import CheckpointError
+
+        self._check_open()
+        arrays, meta = load_state(path, "dense", self.config)
+        if meta.get("capacity") != self._capacity:
+            raise CheckpointError(
+                f"{path}: snapshot capacity {meta.get('capacity')} != "
+                f"limiter capacity {self._capacity}")
+        state_keys = {f"state_{k}" for k in self._state}
+        expected = state_keys | {"slot_keys", "slot_ids", "last_used"}
+        if set(arrays) != expected:
+            raise CheckpointError(
+                f"{path}: state arrays {sorted(arrays)} != expected "
+                f"{sorted(expected)}")
+        with self._lock:
+            self._state = {
+                k: jax.device_put(arrays[f"state_{k}"], v.sharding)
+                for k, v in self._state.items()
+            }
+            ids = arrays["slot_ids"]
+            self._slots = {str(k): int(s)
+                           for k, s in zip(arrays["slot_keys"], ids)}
+            taken = set(int(s) for s in ids)
+            self._free = [s for s in range(self._capacity - 1, -1, -1)
+                          if s not in taken]
+            self._last_used = arrays["last_used"].astype(np.int64).copy()
+
     # ------------------------------------------------------- fault injection
 
     def inject_failure(self, exc: Optional[Exception] = None) -> None:

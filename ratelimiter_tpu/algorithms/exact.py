@@ -212,6 +212,42 @@ class ExactLimiter(RateLimiter):
                     dropped += 1
         return dropped
 
+    # ------------------------------------------------- checkpoint/restore
+
+    def save(self, path: str) -> None:
+        """Snapshot the host dicts to ``path`` (.npz) — same format family
+        as the device backends (ratelimiter_tpu/checkpoint.py), so the
+        oracle can be checkpointed alongside the backend it validates."""
+        import numpy as np
+
+        from ratelimiter_tpu.checkpoint import save_state
+
+        self._check_open()
+        with self._lock:
+            arrays = {}
+            for name, d, width in (("fw", self._fw, 2), ("sw", self._sw, 3),
+                                   ("tb", self._tb, 3)):
+                arrays[f"{name}_keys"] = np.array(list(d.keys()), dtype=str)
+                arrays[f"{name}_vals"] = (
+                    np.array(list(d.values()), dtype=np.int64).reshape(-1, width))
+            extra = {"saved_at": self.clock.now()}
+        save_state(path, "exact", self.config, arrays, extra)
+
+    def restore(self, path: str) -> None:
+        import numpy as np  # noqa: F401  (symmetry with save)
+
+        from ratelimiter_tpu.checkpoint import load_state
+
+        self._check_open()
+        arrays, _meta = load_state(path, "exact", self.config)
+        with self._lock:
+            self._fw = {str(k): tuple(int(x) for x in v)
+                        for k, v in zip(arrays["fw_keys"], arrays["fw_vals"])}
+            self._sw = {str(k): tuple(int(x) for x in v)
+                        for k, v in zip(arrays["sw_keys"], arrays["sw_vals"])}
+            self._tb = {str(k): tuple(int(x) for x in v)
+                        for k, v in zip(arrays["tb_keys"], arrays["tb_vals"])}
+
     # ------------------------------------------------------------------ intro
 
     def key_count(self) -> int:

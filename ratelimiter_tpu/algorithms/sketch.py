@@ -191,6 +191,51 @@ class SketchLimiter(RateLimiter):
     def _close(self) -> None:
         self._state = {}
 
+    # ------------------------------------------------- checkpoint/restore
+
+    _CKPT_KIND = "sketch"
+
+    def save(self, path: str) -> None:
+        """Snapshot device state to ``path`` (.npz). See
+        ratelimiter_tpu/checkpoint.py for format and staleness contract."""
+        from ratelimiter_tpu.checkpoint import save_state
+
+        self._check_open()
+        with self._lock:
+            arrays = {k: np.asarray(v) for k, v in self._state.items()}
+            extra = {"saved_at": self.clock.now()}
+            hp = getattr(self, "_host_period", None)
+            if hp is not None:
+                extra["host_period"] = int(hp)
+        save_state(path, self._CKPT_KIND, self.config, arrays, extra)
+
+    def restore(self, path: str) -> None:
+        """Replace device state with the snapshot at ``path``. Catch-up for
+        elapsed time is automatic: the next dispatch's rollover sweep (or
+        token-bucket decay) advances the restored state to 'now'."""
+        import jax
+
+        from ratelimiter_tpu.checkpoint import load_state
+
+        self._check_open()
+        arrays, meta = load_state(path, self._CKPT_KIND, self.config)
+        with self._lock:
+            if set(arrays) != set(self._state):
+                from ratelimiter_tpu.core.errors import CheckpointError
+
+                raise CheckpointError(
+                    f"{path}: state arrays {sorted(arrays)} != expected "
+                    f"{sorted(self._state)}")
+            # Preserve each buffer's placement (single-device or mesh-
+            # replicated NamedSharding) — restore works identically for
+            # SketchLimiter and MeshSketchLimiter.
+            self._state = {
+                k: jax.device_put(arrays[k], self._state[k].sharding)
+                for k in self._state
+            }
+            if "host_period" in meta:
+                self._host_period = int(meta["host_period"])
+
     # ---------------------------------------------------- fault injection
 
     def inject_failure(self, exc: Optional[Exception] = None) -> None:

@@ -1,0 +1,100 @@
+"""Checkpoint / restore of limiter state.
+
+The reference gets durability for free: state lives server-side in Redis
+and outlives the Go process, bounded by TTLs (``fixedwindow.go:151``,
+``docs/ADR/001:51-52`` — losing Redis loses all counters). Here state
+lives in HBM and dies with the process, so snapshot/restore is explicit
+(SURVEY.md §5.4).
+
+Format: one ``.npz`` holding the state arrays plus a JSON header with a
+format version, a backend kind tag, and a **config fingerprint** — restore
+refuses a snapshot taken under a different algorithm/limit/window/geometry
+(the arrays would be reinterpreted silently otherwise).
+
+Staleness semantics (documented contract, tested in
+tests/test_checkpoint.py):
+
+* decisions made after the snapshot are lost on restore — the restored
+  limiter *under*-counts the crash window, so errors are toward ALLOWING,
+  exactly the reference's "losing Redis = losing counters" posture and
+  the right direction for availability;
+* elapsed wall time between save and restore needs no special handling:
+  every backend keys its state off absolute host timestamps, so the first
+  post-restore dispatch applies the usual catch-up (sketch: sub-window
+  rollover sweep masks out expired slabs; token bucket: decay/refill from
+  the restored ``last``; dense/exact windows: lazy window roll). A
+  snapshot restored after >= 1 full window therefore behaves like a fresh
+  limiter, as it must.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from dataclasses import asdict
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import CheckpointError
+
+FORMAT_VERSION = 1
+_META_KEY = "__ratelimiter_tpu_meta__"
+
+
+def config_fingerprint(config: Config) -> str:
+    """Stable hash over every semantic config field (dataclass fields are
+    all plain values, so the sorted-JSON of asdict is canonical)."""
+    payload = json.dumps(
+        {**asdict(config), "algorithm": str(config.algorithm)},
+        sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def save_state(path: str, kind: str, config: Config,
+               arrays: Dict[str, np.ndarray], extra: Dict[str, Any]) -> None:
+    """Atomic write (tmp + rename): a crash mid-save never corrupts the
+    previous snapshot."""
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "kind": kind,
+        "config_fingerprint": config_fingerprint(config),
+        **extra,
+    }
+    if _META_KEY in arrays:
+        raise CheckpointError(f"array name {_META_KEY!r} is reserved")
+    buf = io.BytesIO()
+    np.savez(buf, **arrays,
+             **{_META_KEY: np.frombuffer(
+                 json.dumps(meta).encode(), dtype=np.uint8)})
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
+def load_state(path: str, kind: str, config: Config,
+               ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load + validate a snapshot for the given limiter kind and config."""
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        if _META_KEY not in z.files:
+            raise CheckpointError(f"{path}: not a ratelimiter_tpu checkpoint")
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+    if meta.get("format_version") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"{path}: format version {meta.get('format_version')} != "
+            f"{FORMAT_VERSION}")
+    if meta.get("kind") != kind:
+        raise CheckpointError(
+            f"{path}: snapshot kind {meta.get('kind')!r} cannot restore a "
+            f"{kind!r} limiter")
+    fp = config_fingerprint(config)
+    if meta.get("config_fingerprint") != fp:
+        raise CheckpointError(
+            f"{path}: config fingerprint mismatch — snapshot was taken "
+            "under a different algorithm/limit/window/geometry")
+    return arrays, meta

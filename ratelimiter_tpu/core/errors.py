@@ -54,3 +54,13 @@ class ClosedError(RateLimiterError, RuntimeError):
     Reference: ``ErrClosed`` (``errors.go:19``) — defined, never used. Here
     every public method checks it.
     """
+
+
+class CheckpointError(RateLimiterError, RuntimeError):
+    """Raised when a state snapshot cannot be written or restored (missing
+    file, wrong format, or a config fingerprint mismatch).
+
+    No reference analog: the reference delegates durability to Redis
+    (``docs/ADR/001:51-52``); HBM-resident state makes snapshotting an
+    explicit subsystem here (SURVEY.md §5.4, ratelimiter_tpu/checkpoint.py).
+    """

@@ -1,0 +1,194 @@
+"""Checkpoint/restore: crash-equivalence and staleness-contract tests.
+
+The done-criterion (VERDICT r2 item 3): decide -> snapshot -> fresh
+limiter -> restore -> decisions consistent with an uncrashed control
+limiter, modulo the documented staleness window (decisions after the
+snapshot are lost; the restored limiter errs toward allowing).
+"""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    CheckpointError,
+    Config,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+
+T0 = 1_700_000_000.0
+
+
+def pair(algo, backend, limit=10, window=60.0, **kw):
+    """(limiter, control) with independent ManualClocks at T0."""
+    mk = lambda: create_limiter(
+        Config(algorithm=algo, limit=limit, window=window, **kw),
+        backend=backend, clock=ManualClock(T0))
+    return mk, mk()
+
+
+BACKEND_ALGOS = [
+    ("exact", Algorithm.FIXED_WINDOW),
+    ("exact", Algorithm.SLIDING_WINDOW),
+    ("exact", Algorithm.TOKEN_BUCKET),
+    ("dense", Algorithm.FIXED_WINDOW),
+    ("dense", Algorithm.SLIDING_WINDOW),
+    ("dense", Algorithm.TOKEN_BUCKET),
+    ("sketch", Algorithm.TPU_SKETCH),
+    ("sketch", Algorithm.FIXED_WINDOW),
+    ("sketch", Algorithm.TOKEN_BUCKET),
+]
+
+
+class TestCrashEquivalence:
+    @pytest.mark.parametrize("backend,algo", BACKEND_ALGOS,
+                             ids=lambda v: str(v))
+    def test_restore_matches_uncrashed_control(self, backend, algo, tmp_path):
+        """Same op sequence on (snapshot -> crash -> restore) and on an
+        uncrashed control must yield identical decisions."""
+        path = str(tmp_path / "snap.npz")
+        mk, control = pair(algo, backend, limit=10)
+        victim = mk()
+
+        ops1 = [("a", 3), ("b", 7), ("a", 4), ("c", 1)]
+        for k, n in ops1:
+            assert (victim.allow_n(k, n).allowed
+                    == control.allow_n(k, n).allowed)
+        victim.save(path)
+        victim.close()  # the crash
+
+        restored = mk()
+        restored.restore(path)
+        # Post-restore decisions must match the control step for step —
+        # including denials that depend on pre-crash consumption.
+        ops2 = [("a", 4), ("a", 3), ("b", 3), ("b", 1), ("c", 9), ("d", 10)]
+        for k, n in ops2:
+            rv, rc = restored.allow_n(k, n), control.allow_n(k, n)
+            assert rv.allowed == rc.allowed, (k, n)
+            assert rv.remaining == rc.remaining, (k, n)
+        restored.close()
+        control.close()
+
+    @pytest.mark.parametrize("backend,algo", BACKEND_ALGOS,
+                             ids=lambda v: str(v))
+    def test_elapsed_time_catches_up(self, backend, algo, tmp_path):
+        """Restoring a snapshot older than the full history horizon behaves
+        like a fresh limiter: quotas fully recovered (window expiry or
+        bucket refill), nothing stuck."""
+        path = str(tmp_path / "snap.npz")
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=algo, limit=5, window=10.0)
+        lim = create_limiter(cfg, backend=backend, clock=clock)
+        assert lim.allow_n("k", 5).allowed
+        assert not lim.allow("k").allowed
+        lim.save(path)
+        lim.close()
+
+        clock2 = ManualClock(T0 + 25.0)  # > 2 windows later
+        lim2 = create_limiter(cfg, backend=backend, clock=clock2)
+        lim2.restore(path)
+        assert lim2.allow_n("k", 5).allowed  # full quota back
+        lim2.close()
+
+    def test_lost_tail_errs_toward_allowing(self, tmp_path):
+        """Decisions AFTER the snapshot are lost: the restored limiter may
+        re-admit them (under-count), never over-deny relative to its own
+        snapshot — the documented direction."""
+        path = str(tmp_path / "snap.npz")
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0)
+        clock = ManualClock(T0)
+        lim = create_limiter(cfg, backend="sketch", clock=clock)
+        assert lim.allow_n("k", 4).allowed
+        lim.save(path)
+        assert lim.allow_n("k", 6).allowed   # after snapshot: lost
+        assert not lim.allow("k").allowed
+        lim.close()
+
+        lim2 = create_limiter(cfg, backend="sketch", clock=ManualClock(T0))
+        lim2.restore(path)
+        res = lim2.allow_n("k", 6)
+        assert res.allowed               # the lost 6 are re-admittable
+        assert not lim2.allow("k").allowed
+        lim2.close()
+
+
+class TestValidation:
+    def test_config_fingerprint_mismatch(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        c1 = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0)
+        lim = create_limiter(c1, backend="sketch", clock=ManualClock(T0))
+        lim.allow("k")
+        lim.save(path)
+        lim.close()
+
+        c2 = Config(algorithm=Algorithm.TPU_SKETCH, limit=11, window=60.0)
+        lim2 = create_limiter(c2, backend="sketch", clock=ManualClock(T0))
+        with pytest.raises(CheckpointError, match="fingerprint"):
+            lim2.restore(path)
+        lim2.close()
+
+    def test_geometry_change_rejected(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        c1 = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0,
+                    sketch=SketchParams(depth=2, width=1024))
+        lim = create_limiter(c1, backend="sketch", clock=ManualClock(T0))
+        lim.save(path)
+        lim.close()
+        c2 = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0,
+                    sketch=SketchParams(depth=2, width=2048))
+        lim2 = create_limiter(c2, backend="sketch", clock=ManualClock(T0))
+        with pytest.raises(CheckpointError, match="fingerprint"):
+            lim2.restore(path)
+        lim2.close()
+
+    def test_kind_mismatch(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10, window=60.0)
+        create_limiter(cfg, backend="exact", clock=ManualClock(T0)).save(path)
+        dense = create_limiter(cfg, backend="dense", clock=ManualClock(T0))
+        with pytest.raises(CheckpointError, match="kind"):
+            dense.restore(path)
+        dense.close()
+
+    def test_not_a_checkpoint(self, tmp_path):
+        path = tmp_path / "junk.npz"
+        np.savez(path, a=np.arange(3))
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        with pytest.raises(CheckpointError):
+            lim.restore(str(path))
+        lim.close()
+
+    def test_dense_slot_map_round_trips(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=60.0)
+        clock = ManualClock(T0)
+        lim = create_limiter(cfg, backend="dense", clock=clock, capacity=64)
+        for i in range(40):
+            lim.allow(f"user:{i}")
+        assert lim.key_count() == 40
+        lim.save(path)
+        lim.close()
+        lim2 = create_limiter(cfg, backend="dense", clock=ManualClock(T0),
+                              capacity=64)
+        lim2.restore(path)
+        assert lim2.key_count() == 40
+        # Slot reuse still works post-restore: new keys claim free slots.
+        for i in range(40, 64):
+            assert lim2.allow(f"user:{i}").allowed
+        lim2.close()
+
+    def test_dense_capacity_mismatch(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=60.0)
+        lim = create_limiter(cfg, backend="dense", clock=ManualClock(T0),
+                             capacity=64)
+        lim.save(path)
+        lim.close()
+        lim2 = create_limiter(cfg, backend="dense", clock=ManualClock(T0),
+                              capacity=128)
+        with pytest.raises(CheckpointError, match="capacity"):
+            lim2.restore(path)
+        lim2.close()
