@@ -70,7 +70,13 @@ class DenseLimiter(RateLimiter):
     # ------------------------------------------------------------ slot admin
 
     def _assign_slots(self, keys: List[str], now_us: int) -> np.ndarray:
+        """Key -> slot for a whole batch. The mapping itself is a host dict
+        (O(1) amortized per key — the keyspace directory, like Redis's own
+        hash table); the *device* work is batched: all slots newly claimed
+        by this batch are zeroed in ONE fused update, not one eager op per
+        key."""
         sids = np.empty(len(keys), dtype=np.int32)
+        fresh: List[int] = []
         for i, key in enumerate(keys):
             fkey = self.config.format_key(key)
             slot = self._slots.get(fkey)
@@ -83,16 +89,19 @@ class DenseLimiter(RateLimiter):
                         "prune idle keys or use the sketch backend")
                 slot = self._free.pop()
                 self._slots[fkey] = slot
-                self._zero_slot(slot)
+                fresh.append(slot)
             sids[i] = slot
             self._last_used[slot] = now_us
+        if fresh:
+            self._zero_slots(fresh)
         return sids
 
-    def _zero_slot(self, slot: int) -> None:
-        """Restore a slot to pristine state (count 0 / full bucket) before
-        reuse. Eager op outside jit; rare path (reset / slot recycling)."""
+    def _zero_slots(self, slots: List[int]) -> None:
+        """Restore slots to pristine state (count 0 / full bucket) before
+        reuse — one fused scatter per call, however many slots."""
+        idx = np.asarray(slots, dtype=np.int32)
         self._state = {
-            k: v.at[slot].set(self._fresh_row[k]) for k, v in self._state.items()
+            k: v.at[idx].set(self._fresh_row[k]) for k, v in self._state.items()
         }
 
     def _prune_locked(self, now_us: int) -> int:
@@ -104,7 +113,7 @@ class DenseLimiter(RateLimiter):
             if self._last_used[slot] <= horizon:
                 del self._slots[fkey]
                 self._free.append(slot)
-                self._zero_slot(slot)
+                self._zero_slots([slot])
                 dropped += 1
         return dropped
 
@@ -180,7 +189,7 @@ class DenseLimiter(RateLimiter):
             slot = self._slots.pop(fkey, None)
             if slot is not None:
                 self._free.append(slot)
-                self._zero_slot(slot)
+                self._zero_slots([slot])
 
     def _close(self) -> None:
         # State buffers are owned by this limiter; drop the references and

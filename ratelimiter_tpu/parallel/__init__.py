@@ -26,6 +26,6 @@ meshes (jax.distributed); cadence over DCN is the accuracy/bandwidth knob.
 """
 
 from ratelimiter_tpu.parallel.mesh import make_mesh, mesh_axis
-from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter
+from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter, MeshTokenBucketLimiter
 
-__all__ = ["make_mesh", "mesh_axis", "MeshSketchLimiter"]
+__all__ = ["make_mesh", "mesh_axis", "MeshSketchLimiter", "MeshTokenBucketLimiter"]

@@ -19,14 +19,51 @@ from typing import Optional
 
 import numpy as np
 
-from ratelimiter_tpu.algorithms.sketch import SketchLimiter, _pad_size
+from ratelimiter_tpu.algorithms.sketch import (
+    SketchLimiter,
+    SketchTokenBucketLimiter,
+    _pad_size,
+)
 from ratelimiter_tpu.core.clock import Clock
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.parallel import mesh_kernels
 from ratelimiter_tpu.parallel.mesh import make_mesh
 
 
-class MeshSketchLimiter(SketchLimiter):
+def _warn_delta() -> None:
+    # The only configuration in the codebase that relaxes the strict
+    # never-over-admit invariant — say so once, loudly.
+    logging.getLogger(__name__).warning(
+        "merge='delta': cross-chip admission is eventually consistent; a "
+        "key can be over-admitted up to n_chips*limit within one step "
+        "(bounded staleness, see docs/ADR/002-mesh-merge-modes.md). Use "
+        "merge='gather' for strict exactness.")
+
+
+class _MeshPlacement:
+    """Placement hooks shared by every mesh limiter: batch sharded over the
+    mesh axis, state and scalar operands replicated."""
+
+    def _padded_size(self, b: int) -> int:
+        per_chip = _pad_size(max(1, -(-b // self.n_chips)))
+        return per_chip * self.n_chips
+
+    def _place(self, arr: np.ndarray):
+        return mesh_kernels.shard_batch(arr, self.mesh)
+
+    def _place_replicated(self, arr: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def memory_bytes(self) -> int:
+        """Total HBM across the mesh: state is fully replicated, so each of
+        the n_chips devices holds a complete copy."""
+        return super().memory_bytes() * self.n_chips
+
+
+class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
     """Sketch limiter whose dispatch spans every chip of a mesh.
 
     Args:
@@ -46,13 +83,7 @@ class MeshSketchLimiter(SketchLimiter):
                  mesh=None, merge: str = "gather"):
         super().__init__(config, clock)
         if merge == "delta":
-            # The only configuration in the codebase that relaxes the strict
-            # never-over-admit invariant — say so once, loudly.
-            logging.getLogger(__name__).warning(
-                "MeshSketchLimiter merge='delta': cross-chip admission is "
-                "eventually consistent; a key can be over-admitted up to "
-                "n_chips*limit within one step (bounded staleness, see "
-                "docs/ADR/002). Use merge='gather' for strict exactness.")
+            _warn_delta()
         self.mesh = mesh if mesh is not None else make_mesh()
         self.merge = merge
         self.n_chips = int(np.prod(self.mesh.devices.shape))
@@ -62,22 +93,21 @@ class MeshSketchLimiter(SketchLimiter):
             mesh_kernels.build_mesh_steps(self.config, self.mesh, merge))
         self._state = mesh_kernels.replicate_state(self._state, self.mesh)
 
-    # -- placement hooks (SketchLimiter._dispatch_hashed) -----------------
 
-    def _padded_size(self, b: int) -> int:
-        per_chip = _pad_size(max(1, -(-b // self.n_chips)))
-        return per_chip * self.n_chips
+class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
+    """Sketched token bucket spanning a mesh: replicated debt slab, batch
+    sharded over chips, same merge modes and staleness contract as
+    MeshSketchLimiter (the scalar decay is deterministic on replicated
+    state, so only the debt increments need a collective)."""
 
-    def _place(self, arr: np.ndarray):
-        return mesh_kernels.shard_batch(arr, self.mesh)
-
-    def _place_replicated(self, arr: np.ndarray):
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        return jax.device_put(arr, NamedSharding(self.mesh, P()))
-
-    def memory_bytes(self) -> int:
-        """Total HBM across the mesh: state is fully replicated, so each of
-        the n_chips devices holds a complete copy."""
-        return super().memory_bytes() * self.n_chips
+    def __init__(self, config: Config, clock: Optional[Clock] = None, *,
+                 mesh=None, merge: str = "gather"):
+        super().__init__(config, clock)
+        if merge == "delta":
+            _warn_delta()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.merge = merge
+        self.n_chips = int(np.prod(self.mesh.devices.shape))
+        self._step, self._reset_step = mesh_kernels.build_mesh_bucket_steps(
+            self.config, self.mesh, merge)
+        self._state = mesh_kernels.replicate_state(self._state, self.mesh)

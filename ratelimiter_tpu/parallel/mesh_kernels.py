@@ -116,6 +116,70 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     return step, reset, rollover
 
 
+# ------------------------------------------------------------ token bucket
+
+def _bucket_gather_step(state, h1, h2, n, now_us, *, step_kw):
+    """Gather-mode bucket body: all_gather shards, decide globally on the
+    replicated debt slab, slice local verdicts (same shape as _gather_step;
+    the decided tuple is (allowed, remaining, retry_us))."""
+    from ratelimiter_tpu.ops import bucket_kernels
+
+    Bl = h1.shape[0]
+    h1g = jax.lax.all_gather(h1, AXIS).reshape(-1)
+    h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
+    ng = jax.lax.all_gather(n, AXIS).reshape(-1)
+    state, (allowed, remaining, retry_us) = bucket_kernels._bucket_step(
+        state, h1g, h2g, ng, now_us, **step_kw)
+    i = jax.lax.axis_index(AXIS)
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
+    return state, (sl(allowed), sl(remaining), sl(retry_us))
+
+
+def _bucket_delta_step(state, h1, h2, n, now_us, *, step_kw):
+    """Delta-mode bucket body: local admission, psum'd debt increments.
+    The scalar decay is a deterministic function of replicated (rem, last),
+    so replication is preserved without a collective for it."""
+    from ratelimiter_tpu.ops import bucket_kernels
+
+    return bucket_kernels._bucket_step(
+        state, h1, h2, n, now_us, axis_name=AXIS, **step_kw)
+
+
+_MESH_BUCKET_CACHE: Dict[tuple, Tuple[Callable, Callable]] = {}
+
+
+def build_mesh_bucket_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
+                            ) -> Tuple[Callable, Callable]:
+    """(step, reset) for the sketched token bucket on a mesh. Same sharding
+    contract as build_mesh_steps."""
+    from ratelimiter_tpu.ops import bucket_kernels
+
+    if merge not in MERGE_MODES:
+        raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
+    limit, num, den, d, w, iters = bucket_kernels._params(cfg)
+    mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
+    key = (mesh_key, merge, limit, num, den, d, w, iters)
+    cached = _MESH_BUCKET_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
+                   iters=iters)
+    body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
+    state_spec = {k: P() for k in ("debt", "rem", "last")}
+    mapped = shard_map(
+        partial(body, step_kw=step_kw),
+        mesh=mesh,
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0,))
+    _, reset = bucket_kernels.build_steps(cfg)
+    _MESH_BUCKET_CACHE[key] = (step, reset)
+    return step, reset
+
+
 def replicate_state(state, mesh: Mesh):
     """Place a (host or single-device) state dict fully replicated on the mesh."""
     sh = NamedSharding(mesh, P())

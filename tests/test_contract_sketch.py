@@ -19,12 +19,6 @@ from ratelimiter_tpu import (
     create_limiter,
 )
 
-#: Windowed algorithms only — used by the mesh contract suite. The sketched
-#: token bucket is single-chip for now: MeshSketchLimiter builds windowed
-#: kernels and sketch_geometry rejects TOKEN_BUCKET configs outright.
-SKETCH_ALGOS = [Algorithm.SLIDING_WINDOW, Algorithm.FIXED_WINDOW, Algorithm.TPU_SKETCH]
-
-
 class TestSketchContract(ContractTests):
     backend = "sketch"
     supports_failure_injection = True
