@@ -20,6 +20,11 @@ C. Serving shape: ingest batches of 4096 (BASELINE config 3) coalesced
    latency and serving-shape throughput. (Through the dev tunnel, e2e
    dispatch latency is dominated by ~100 ms tunnel RTT — that is an
    environment property; dispatch_rtt_ms reports it for completeness.)
+D. End-to-end serving: a real ``python -m ratelimiter_tpu.serving``
+   subprocess (sketch backend on the CPU device — the host/RPC path
+   without the tunnel artifact) driven by pipelined clients with STRING
+   keys, so the number includes ingest, hashing, batching, and fan-out
+   (benchmarks/e2e.py). Skipped gracefully if the subprocess fails.
 
 Baseline: the reference's own single-instance sliding-window estimate,
 ~30,000 req/s (``docs/ARCHITECTURE.md:439``, SURVEY.md §6); north star:
@@ -40,10 +45,17 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # JAX_PLATFORMS=cpu must be applied via jax.config before backend init on
 # hosts with the axon TPU plugin (see tests/conftest.py).
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+import jax
 
+if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# Persistent compile cache (shared with benchmarks/ and the serving tier):
+# first run pays each compile once; re-runs start hot.
+_cache = os.environ.get("RATELIMITER_TPU_COMPILE_CACHE",
+                        os.path.expanduser("~/.cache/ratelimiter_tpu_jax"))
+if _cache:
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from ratelimiter_tpu import Algorithm, Config, SketchParams
 from ratelimiter_tpu.evaluation.loadgen import build_bench_chunk
@@ -193,6 +205,28 @@ def main() -> None:
     serving_rps = SCAN_STEPS * INGEST_BATCH / scan_s
     step_latency_ms = scan_s / SCAN_STEPS * 1e3
 
+    # ---------------------------------------------- phase D: e2e serving
+    e2e: dict = {}
+    try:
+        from benchmarks.e2e import _drive, _spawn_server
+        import asyncio
+
+        proc, port = _spawn_server("sketch", platform="cpu",
+                                   max_batch=4096, max_delay_us=500.0)
+        try:
+            e2e_out = asyncio.run(_drive(port, seconds=4.0, conns=4,
+                                         window=2048, n_keys=100_000))
+            e2e = {
+                "e2e_server_decisions_per_sec": e2e_out["decisions_per_sec"],
+                "e2e_server_scalar_p50_ms": e2e_out["scalar_p50_ms"],
+                "e2e_server_scalar_p99_ms": e2e_out["scalar_p99_ms"],
+            }
+        finally:
+            proc.terminate()
+            proc.wait(timeout=15)
+    except Exception as exc:  # report the omission, never fail the bench
+        e2e = {"e2e_server_error": str(exc)[:200]}
+
     print(json.dumps({
         "metric": "sketch_allow_decisions_per_sec",
         "value": round(rps, 1),
@@ -215,6 +249,7 @@ def main() -> None:
         "platform": platform,
         "sketch_geometry": {"depth": cfg.sketch.depth, "width": cfg.sketch.width,
                             "sub_windows": 60, "conservative_update": True},
+        **e2e,
     }))
 
 

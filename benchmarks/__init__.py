@@ -1,0 +1,17 @@
+"""Benchmark suite: the reference's 31-benchmark matrix re-expressed for
+the TPU framework, plus the five BASELINE.json configs and an end-to-end
+serving benchmark.
+
+Run everything:     python -m benchmarks            (writes RESULTS.json/md)
+Quick/CI subset:    python -m benchmarks --quick
+One group:          python -m benchmarks --only matrix|configs|e2e
+
+The reference's matrix (``fixedwindow_bench_test.go:26-346``,
+``tokenbucket_bench_test.go:26-443``, ``slidingwindow_bench_test.go:26-383``)
+measures ns/op of one Allow against miniredis over dimensions
+{algorithm, AllowN(1/10/100), parallel, window size, key cardinality,
+denied path, fail-open path}. Here the same dimensions exist, but the
+unit of work is the *batched dispatch* — the TPU-native hot path — so
+cells report decisions/sec and µs/decision at each shape, with the
+scalar (single-request) path measured separately as the latency floor.
+"""

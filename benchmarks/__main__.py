@@ -1,0 +1,124 @@
+"""Run the benchmark suite: ``python -m benchmarks [--quick] [--only G]``.
+
+Writes benchmarks/RESULTS.json (machine) and benchmarks/RESULTS.md
+(human). Committed result snapshots are named RESULTS_r{N}.{json,md}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform as _platform
+import sys
+import time
+from datetime import datetime, timezone
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+# Persistent compile cache: the matrix touches many (shape, algo, backend)
+# cells; caching makes re-runs cheap (first run pays each compile once).
+_cache = os.environ.get("RATELIMITER_TPU_COMPILE_CACHE",
+                        os.path.expanduser("~/.cache/ratelimiter_tpu_jax"))
+if _cache:
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def _render_md(doc: dict) -> str:
+    lines = [
+        "# Benchmark results",
+        "",
+        f"- timestamp: {doc['meta']['timestamp']}",
+        f"- platform: {doc['meta']['jax_platform']} "
+        f"({doc['meta']['device_count']} device(s))",
+        f"- mode: {'quick' if doc['meta']['quick'] else 'full'}",
+        "",
+    ]
+    if "matrix" in doc:
+        lines += ["## Matrix (reference 31-benchmark analog)", "",
+                  "| group | algorithm | backend | shape | µs/call | decisions/s |",
+                  "|---|---|---|---|---:|---:|"]
+        for r in doc["matrix"]:
+            lines.append(
+                f"| {r['group']} | {r['algorithm']} | {r['backend']} | "
+                f"{r['shape']} | {r['us_per_call']} | "
+                f"{r['decisions_per_sec']:,} |")
+        lines.append("")
+    if "configs" in doc:
+        lines += ["## BASELINE configs", ""]
+        for c in doc["configs"]:
+            lines.append(f"### Config {c['config']}")
+            lines.append("")
+            for k, v in c.items():
+                if k != "config":
+                    lines.append(f"- {k}: {v}")
+            lines.append("")
+    if "e2e" in doc:
+        lines += ["## End-to-end serving (string keys over the wire)", "",
+                  "| variant | decisions/s | scalar p50 ms | scalar p99 ms "
+                  "| conns×inflight |",
+                  "|---|---:|---:|---:|---|"]
+        for r in doc["e2e"]:
+            if "error" in r:
+                lines.append(f"| {r['variant']} | error: {r['error']} | | | |")
+            else:
+                lines.append(
+                    f"| {r['variant']} | {r['decisions_per_sec']:,} | "
+                    f"{r['scalar_p50_ms']} | {r['scalar_p99_ms']} | "
+                    f"{r['connections']}×{r['inflight_per_conn']} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="benchmarks")
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes, CI-friendly")
+    ap.add_argument("--only", choices=["matrix", "configs", "e2e"],
+                    default=None)
+    ap.add_argument("--out", default=os.path.join(HERE, "RESULTS"))
+    args = ap.parse_args()
+
+    import jax
+
+    t_start = time.time()
+    doc: dict = {"meta": {
+        "timestamp": datetime.now(timezone.utc).isoformat(timespec="seconds"),
+        "jax_platform": jax.devices()[0].platform,
+        "device_count": len(jax.devices()),
+        "python": _platform.python_version(),
+        "quick": args.quick,
+    }}
+
+    def log(msg: str) -> None:
+        print(f"[{time.time() - t_start:7.1f}s] {msg}", flush=True)
+
+    if args.only in (None, "matrix"):
+        from benchmarks.matrix import run_matrix
+
+        doc["matrix"] = run_matrix(quick=args.quick, log=log)
+    if args.only in (None, "configs"):
+        from benchmarks.configs import run_configs
+
+        doc["configs"] = run_configs(quick=args.quick, log=log)
+    if args.only in (None, "e2e"):
+        from benchmarks.e2e import run_e2e
+
+        doc["e2e"] = run_e2e(quick=args.quick, log=log)
+
+    doc["meta"]["wall_seconds"] = round(time.time() - t_start, 1)
+    with open(f"{args.out}.json", "w") as f:
+        json.dump(doc, f, indent=1)
+    with open(f"{args.out}.md", "w") as f:
+        f.write(_render_md(doc))
+    log(f"wrote {args.out}.json / .md")
+
+
+if __name__ == "__main__":
+    main()

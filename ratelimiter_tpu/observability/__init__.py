@@ -14,6 +14,7 @@ from ratelimiter_tpu.observability.decorators import (
     LimiterDecorator,
     LoggingDecorator,
     MetricsDecorator,
+    TracingDecorator,
 )
 
 __all__ = [
@@ -27,4 +28,5 @@ __all__ = [
     "LoggingDecorator",
     "MetricsDecorator",
     "Registry",
+    "TracingDecorator",
 ]

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import numpy as np
@@ -210,6 +211,53 @@ class MetricsDecorator(LimiterDecorator):
         if kind == "storage_unavailable":
             self._errors.inc(algorithm=self._algo)
         self._latency.observe(dt, algorithm=self._algo, op=op)
+
+
+class TracingDecorator(LimiterDecorator):
+    """Profiler-trace wrapper (the reference's planned OpenTelemetry
+    ``TracingDecorator``, ``docs/ADR/003:115-124``, realized with the
+    JAX profiler — the native tracing stack on TPU).
+
+    Every decorated call runs inside a named ``jax.profiler``
+    TraceAnnotation, so device dispatches show up attributed by
+    op/algorithm in xplane traces. ``capture(path)`` context-manages a
+    full profiler capture around a workload for offline analysis
+    (tensorboard / xprof)."""
+
+    def __init__(self, inner: RateLimiter):
+        super().__init__(inner)
+        self._algo = str(inner.config.algorithm)
+
+    def _annotation(self, op: str):
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(
+            f"ratelimiter/{self._algo}/{op}")
+
+    def allow_n(self, key: str, n: int, *, now: Optional[float] = None) -> Result:
+        with self._annotation("allow_n"):
+            return self.inner.allow_n(key, n, now=now)
+
+    def allow_batch(self, keys: Sequence[str], ns=None, *,
+                    now: Optional[float] = None) -> BatchResult:
+        with self._annotation("allow_batch"):
+            return self.inner.allow_batch(keys, ns, now=now)
+
+    def reset(self, key: str) -> None:
+        with self._annotation("reset"):
+            self.inner.reset(key)
+
+    @contextmanager
+    def capture(self, path: str):
+        """Profile everything inside the with-block to ``path`` (xplane
+        format; view with tensorboard's profile plugin)."""
+        import jax.profiler
+
+        jax.profiler.start_trace(path)
+        try:
+            yield self
+        finally:
+            jax.profiler.stop_trace()
 
 
 class LoggingDecorator(LimiterDecorator):

@@ -10,7 +10,9 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
+import time
 
 from ratelimiter_tpu import Algorithm, Config, SketchParams, create_limiter
 from ratelimiter_tpu.observability import MetricsDecorator
@@ -40,12 +42,54 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batcher coalescing window, microseconds")
     ap.add_argument("--dispatch-timeout-ms", type=float, default=None,
                     help="SLO per dispatch; breach triggers fail-open/closed")
+    ap.add_argument("--no-prewarm", action="store_true",
+                    help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
     return ap
 
 
+def _prewarm(limiter, max_batch: int) -> None:
+    """Compile every batch pad shape the micro-batcher can produce (powers
+    of two up to max_batch) BEFORE accepting traffic, so no client request
+    ever pays a jit compile. With the persistent compilation cache this is
+    fast on every start after the first."""
+    import numpy as np
+
+    t0 = time.time()
+    size = 8
+    while True:
+        size = min(size, max_batch)
+        h = np.arange(size, dtype=np.uint64) + (1 << 62)
+        limiter.allow_hashed(h, now=0.0)
+        if size >= max_batch:
+            break
+        size *= 2
+    logging.getLogger("ratelimiter_tpu.serving").info(
+        "prewarmed pad shapes up to %d in %.1fs", max_batch, time.time() - t0)
+
+
+def _configure_jax(args) -> None:
+    """Apply platform selection + persistent compile cache BEFORE any JAX
+    backend initializes. JAX_PLATFORMS alone loses to the axon TPU plugin
+    (tests/conftest.py explains); the exact backend never imports JAX, so
+    skip entirely there to keep its startup instant."""
+    if args.backend == "exact":
+        return
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    cache = os.environ.get(
+        "RATELIMITER_TPU_COMPILE_CACHE",
+        os.path.expanduser("~/.cache/ratelimiter_tpu_jax"))
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 async def amain(args) -> None:
     logging.basicConfig(level=args.log_level.upper())
+    _configure_jax(args)
     cfg = Config(
         algorithm=Algorithm(args.algorithm),
         limit=args.limit,
@@ -55,6 +99,8 @@ async def amain(args) -> None:
                             sub_windows=args.sub_windows),
     )
     limiter = MetricsDecorator(create_limiter(cfg, backend=args.backend))
+    if args.backend != "exact" and not args.no_prewarm:
+        _prewarm(limiter, args.max_batch)
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,

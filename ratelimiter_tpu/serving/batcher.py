@@ -85,11 +85,13 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ submit
 
-    async def submit(self, key: str, n: int = 1) -> Result:
-        """Queue one decision; resolves when its batch's dispatch lands.
+    def submit_nowait(self, key: str, n: int = 1) -> asyncio.Future:
+        """Queue one decision and return its future WITHOUT awaiting —
+        the zero-task fast path the server's reader loop uses (a done
+        callback writes the response; no coroutine per request).
         Validation happens here, before batching, so malformed requests
         fail fast and never poison a batch (reference pre-Redis guards,
-        ``tokenbucket.go:91-93``)."""
+        ``tokenbucket.go:91-93``). Must run on the event loop thread."""
         if self._draining:
             raise StorageUnavailableError("server is shutting down")
         check_key(key)
@@ -104,7 +106,11 @@ class MicroBatcher:
             self._flush()
         elif self._timer is None:
             self._timer = loop.call_later(self.max_delay, self._flush)
-        return await fut
+        return fut
+
+    async def submit(self, key: str, n: int = 1) -> Result:
+        """Queue one decision; resolves when its batch's dispatch lands."""
+        return await self.submit_nowait(key, n)
 
     # ------------------------------------------------------------- flush
 

@@ -20,7 +20,6 @@ from __future__ import annotations
 import asyncio
 import itertools
 import socket
-import struct
 import threading
 from typing import Dict, Optional, Sequence
 
@@ -74,6 +73,18 @@ class Client:
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result(body)
+
+    def allow_batch(self, keys: Sequence[str],
+                    ns: Optional[Sequence[int]] = None) -> list:
+        """One ALLOW_BATCH frame; results in request order."""
+        if ns is None:
+            ns = [1] * len(keys)
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(
+            p.encode_allow_batch(req_id, keys, ns), req_id)
+        if type_ != p.T_RESULT_BATCH:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result_batch(body)
 
     def reset(self, key: str) -> None:
         req_id = next(self._ids)
@@ -175,6 +186,20 @@ class AsyncClient:
         return await asyncio.gather(
             *(self.allow_n(k, n) for k, n in zip(keys, ns)),
             return_exceptions=True)
+
+    async def allow_batch(self, keys: Sequence[str],
+                          ns: Optional[Sequence[int]] = None) -> list:
+        """One ALLOW_BATCH frame for the whole sequence (amortized framing;
+        decisions still coalesce with other connections server-side).
+        Returns results in request order."""
+        if ns is None:
+            ns = [1] * len(keys)
+        req_id = next(self._ids)
+        type_, body = await self._request(
+            p.encode_allow_batch(req_id, keys, ns), req_id)
+        if type_ != p.T_RESULT_BATCH:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result_batch(body)
 
     async def reset(self, key: str) -> None:
         req_id = next(self._ids)

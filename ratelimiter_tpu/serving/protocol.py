@@ -16,10 +16,14 @@ Frame layout (little-endian):
     ...  type-specific body
 
 Requests:
-    ALLOW_N  (1): u32 n, u16 key_len, key utf-8
-    RESET    (2): u16 key_len, key utf-8
-    HEALTH   (3): -
-    METRICS  (4): -
+    ALLOW_N     (1): u32 n, u16 key_len, key utf-8
+    RESET       (2): u16 key_len, key utf-8
+    HEALTH      (3): -
+    METRICS     (4): -
+    ALLOW_BATCH (5): u32 count, then count x {u32 n, u16 key_len, key} —
+                     one frame, many decisions (the client-side batching
+                     analog of Redis pipelining; decisions still coalesce
+                     with every other connection in the micro-batcher)
 
 Responses:
     RESULT   (129): u8 flags (bit0 allowed, bit1 fail_open), i64 limit,
@@ -28,7 +32,10 @@ Responses:
     HEALTH   (131): u8 status (1 serving, 0 draining), f64 uptime_s,
                     u64 decisions_total
     METRICS  (132): u32 text_len, prometheus text utf-8
-    ERROR    (255): u16 code, u16 msg_len, msg utf-8
+    RESULT_BATCH (133): i64 limit, u32 count, then count x {u8 flags,
+                    i64 remaining, f64 retry_after, f64 reset_at}
+    ERROR    (255): u16 code, u16 msg_len, msg utf-8; for ALLOW_BATCH an
+                    error response covers the whole frame
 
 Error codes mirror the error sentinels (core/errors.py; reference
 ``errors.go:5-20``) so clients can re-raise the right exception type.
@@ -38,7 +45,7 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass
-from typing import Optional, Tuple, Union
+from typing import Tuple
 
 from ratelimiter_tpu.core.errors import (
     ClosedError,
@@ -58,11 +65,13 @@ T_ALLOW_N = 1
 T_RESET = 2
 T_HEALTH = 3
 T_METRICS = 4
+T_ALLOW_BATCH = 5
 # Response types
 T_RESULT = 129
 T_OK = 130
 T_HEALTH_R = 131
 T_METRICS_R = 132
+T_RESULT_BATCH = 133
 T_ERROR = 255
 
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
@@ -155,6 +164,64 @@ def encode_error(req_id: int, code: int, msg: str) -> bytes:
     mb = msg.encode("utf-8")[:65535]
     body = _ERROR_HEAD.pack(code, len(mb)) + mb
     return _HDR.pack(1 + 8 + len(body), T_ERROR, req_id) + body
+
+
+_BATCH_ITEM = struct.Struct("<IH")       # n, key_len (per request)
+_BATCH_RES_HEAD = struct.Struct("<qI")   # limit, count
+_BATCH_RES_ITEM = struct.Struct("<Bqdd")  # flags, remaining, retry, reset
+
+
+def encode_allow_batch(req_id: int, keys, ns) -> bytes:
+    parts = [_U32.pack(len(keys))]
+    for key, n in zip(keys, ns):
+        kb = key.encode("utf-8")
+        parts.append(_BATCH_ITEM.pack(n, len(kb)))
+        parts.append(kb)
+    body = b"".join(parts)
+    return _HDR.pack(1 + 8 + len(body), T_ALLOW_BATCH, req_id) + body
+
+
+def parse_allow_batch(body: bytes):
+    """-> (keys, ns). Bounded by MAX_FRAME at the header layer."""
+    (count,) = _U32.unpack_from(body)
+    off = _U32.size
+    keys, ns = [], []
+    for _ in range(count):
+        if off + _BATCH_ITEM.size > len(body):
+            raise ProtocolError("truncated ALLOW_BATCH body")
+        n, key_len = _BATCH_ITEM.unpack_from(body, off)
+        off += _BATCH_ITEM.size
+        if key_len > MAX_KEY_LEN or off + key_len > len(body):
+            raise ProtocolError("bad ALLOW_BATCH key")
+        keys.append(body[off:off + key_len].decode("utf-8"))
+        ns.append(n)
+        off += key_len
+    if off != len(body):
+        raise ProtocolError("trailing bytes in ALLOW_BATCH body")
+    return keys, ns
+
+
+def encode_result_batch(req_id: int, limit: int, results) -> bytes:
+    parts = [_BATCH_RES_HEAD.pack(limit, len(results))]
+    for r in results:
+        flags = (1 if r.allowed else 0) | (2 if r.fail_open else 0)
+        parts.append(_BATCH_RES_ITEM.pack(flags, r.remaining, r.retry_after,
+                                          r.reset_at))
+    body = b"".join(parts)
+    return _HDR.pack(1 + 8 + len(body), T_RESULT_BATCH, req_id) + body
+
+
+def parse_result_batch(body: bytes):
+    limit, count = _BATCH_RES_HEAD.unpack_from(body)
+    off = _BATCH_RES_HEAD.size
+    out = []
+    for _ in range(count):
+        flags, remaining, retry, reset = _BATCH_RES_ITEM.unpack_from(body, off)
+        off += _BATCH_RES_ITEM.size
+        out.append(Result(allowed=bool(flags & 1), limit=limit,
+                          remaining=remaining, retry_after=retry,
+                          reset_at=reset, fail_open=bool(flags & 2)))
+    return out
 
 
 @dataclass

@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from functools import partial
 from typing import Optional
 
 from ratelimiter_tpu.algorithms.base import RateLimiter
@@ -88,6 +89,23 @@ class RateLimitServer:
         task = asyncio.current_task()
         if task is not None:
             self._conn_tasks.add(task)
+
+        def write_out(frame: bytes) -> None:
+            # Done-callback writer: transport buffering handles
+            # backpressure (writes never block the loop); broken pipes
+            # surface in the reader loop, which owns teardown.
+            try:
+                writer.write(frame)
+            except (ConnectionResetError, BrokenPipeError, RuntimeError):
+                pass
+
+        def complete_allow(req_id: int, fut: asyncio.Future) -> None:
+            exc = fut.exception()
+            if exc is not None:
+                write_out(p.encode_error(req_id, p.code_for(exc), str(exc)))
+            else:
+                write_out(p.encode_result(req_id, fut.result()))
+
         try:
             while True:
                 try:
@@ -100,8 +118,42 @@ class RateLimitServer:
                 except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
                     log.warning("protocol error, dropping connection: %s", exc)
                     break
-                # Each request is its own task so pipelined requests from
-                # one connection coalesce into shared batches.
+                if type_ == p.T_ALLOW_N:
+                    # Zero-task fast path: queue into the shared batcher,
+                    # write the response from the future's done callback.
+                    try:
+                        key, n = p.parse_allow_n(body)
+                        fut = self.batcher.submit_nowait(key, n)
+                    except Exception as exc:
+                        write_out(p.encode_error(req_id, p.code_for(exc),
+                                                 str(exc)))
+                        continue
+                    fut.add_done_callback(partial(complete_allow, req_id))
+                    continue
+                if type_ == p.T_ALLOW_BATCH:
+                    try:
+                        keys, ns = p.parse_allow_batch(body)
+                        futs = [self.batcher.submit_nowait(k, n)
+                                for k, n in zip(keys, ns)]
+                    except Exception as exc:
+                        write_out(p.encode_error(req_id, p.code_for(exc),
+                                                 str(exc)))
+                        continue
+
+                    def complete_batch(req_id, agg: asyncio.Future) -> None:
+                        exc = agg.exception()
+                        if exc is not None:
+                            write_out(p.encode_error(
+                                req_id, p.code_for(exc), str(exc)))
+                        else:
+                            write_out(p.encode_result_batch(
+                                req_id, self.limiter.config.limit,
+                                agg.result()))
+
+                    agg = asyncio.gather(*futs)
+                    agg.add_done_callback(partial(complete_batch, req_id))
+                    continue
+                # Slow-path frames (rare): one task each.
                 t = asyncio.ensure_future(self._handle_frame(
                     type_, req_id, body, writer, write_lock))
                 req_tasks.add(t)
@@ -121,14 +173,7 @@ class RateLimitServer:
                             writer: asyncio.StreamWriter,
                             write_lock: asyncio.Lock) -> None:
         try:
-            if type_ == p.T_ALLOW_N:
-                key, n = p.parse_allow_n(body)
-                try:
-                    res = await self.batcher.submit(key, n)
-                    out = p.encode_result(req_id, res)
-                except Exception as exc:
-                    out = p.encode_error(req_id, p.code_for(exc), str(exc))
-            elif type_ == p.T_RESET:
+            if type_ == p.T_RESET:
                 key = p.parse_reset(body)
                 try:
                     # Off the event loop: reset takes the limiter lock.

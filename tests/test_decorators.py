@@ -163,6 +163,30 @@ class TestLoggingDecorator:
         lim.close()
 
 
+class TestTracingDecorator:
+    def test_contract_preserved_and_capture_writes(self, tmp_path):
+        from ratelimiter_tpu.observability import TracingDecorator
+
+        clock = ManualClock(0.0)
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=3, window=60.0)
+        lim = TracingDecorator(create_limiter(cfg, backend="sketch",
+                                              clock=clock))
+        # Semantics unchanged through the annotation wrapper.
+        for expect in (True, True, True, False):
+            assert lim.allow("k").allowed is expect
+        lim.reset("k")
+        assert lim.allow("k").allowed
+        # capture() produces an xplane trace directory.
+        out = str(tmp_path / "trace")
+        with lim.capture(out):
+            lim.allow_batch(["a", "b", "c"])
+        import os
+
+        assert any("plugins" in d or f for d, _, f in os.walk(out)), \
+            "profiler capture wrote nothing"
+        lim.close()
+
+
 class TestDecoratorComposition:
     def test_stack_order_is_transparent(self):
         clock = ManualClock(0.0)

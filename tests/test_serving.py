@@ -298,6 +298,39 @@ class TestServerIntegration:
         assert h.sum() == 500.0
         lim.close()
 
+    def test_allow_batch_rpc(self):
+        """One ALLOW_BATCH frame: results in order, in-frame exactness
+        preserved (duplicates contend through the shared batcher)."""
+        lim, _ = _mk_limiter(limit=3)
+        with running_server(lim) as (_, port, loop):
+            async def go():
+                c = await AsyncClient.connect(port=port)
+                res = await c.allow_batch(["h", "h", "h", "h", "x"],
+                                          [1, 1, 1, 1, 2])
+                await c.close()
+                return res
+
+            res = asyncio.run_coroutine_threadsafe(go(), loop).result(timeout=30)
+            assert [r.allowed for r in res] == [True, True, True, False, True]
+            assert res[0].limit == 3
+        # Sync client path too.
+        lim2, _ = _mk_limiter(limit=2)
+        with running_server(lim2) as (_, port, _loop):
+            with Client(port=port) as c:
+                res = c.allow_batch(["a", "a", "a"])
+                assert [r.allowed for r in res] == [True, True, False]
+        lim.close()
+        lim2.close()
+
+    def test_allow_batch_rpc_validation_error(self):
+        lim, _ = _mk_limiter()
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                with pytest.raises(InvalidNError):
+                    c.allow_batch(["a", "b"], [1, 0])
+                assert c.allow("a").allowed  # connection survives
+        lim.close()
+
     def test_fail_open_through_the_server(self):
         lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
                              backend="sketch", fail_open=True)
