@@ -1,0 +1,58 @@
+# Dev loop for ratelimiter_tpu (reference Makefile:16-93 analog).
+# All targets run against the repo in place; PYTHONPATH is appended, never
+# replaced (the existing PYTHONPATH carries the TPU plugin registration).
+
+PY ?= python
+REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
+export PYTHONPATH := $(REPO):$(PYTHONPATH)
+
+.PHONY: help test test-all test-serving test-mesh lint check native \
+        bench bench-quick bench-matrix serve verify clean
+
+help:            ## list targets
+	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
+
+test:            ## fast suite (CPU, 8 virtual devices; excludes slow gates)
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+test-all:        ## full suite including slow accuracy/scale gates
+	$(PY) -m pytest tests/ -q
+
+test-serving:    ## serving tier only
+	$(PY) -m pytest tests/test_serving.py -q
+
+test-mesh:       ## mesh contract + multichip tests only
+	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py -q
+
+lint:            ## in-repo linter (ruff config in pyproject.toml where available)
+	$(PY) tools/lint.py
+
+check: lint test ## what CI runs on every push
+
+native:          ## (re)build the C++ bulk hasher extension in place
+	rm -f ratelimiter_tpu/native/_hasher.so
+	$(PY) -c "from ratelimiter_tpu.native import native_available; \
+	          assert native_available(), 'build failed (g++ required)'; \
+	          print('native hasher built')"
+
+bench:           ## headline benchmark, one JSON line (real chip if present)
+	$(PY) bench.py
+
+bench-quick:     ## 3-second smoke bench
+	BENCH_SECONDS=3 $(PY) bench.py
+
+bench-matrix:    ## full matrix + BASELINE configs + e2e serving bench
+	$(PY) -m benchmarks
+
+serve:           ## run the server binary locally (exact backend, instant start)
+	$(PY) -m ratelimiter_tpu.serving --backend exact --algorithm fixed_window \
+	    --limit 100 --window 60 --port 8432
+
+verify:          ## driver protocol: entry() compile + 8-device mesh dry run
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	    $(PY) __graft_entry__.py
+
+clean:           ## remove caches and build artifacts
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -f ratelimiter_tpu/native/_hasher.so ratelimiter_tpu/native/_hasher_r*.so
+	rm -rf .pytest_cache

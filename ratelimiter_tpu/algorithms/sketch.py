@@ -21,7 +21,7 @@ fail-closed on dispatch failure, ADR-002 parity).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 
@@ -34,7 +34,7 @@ from ratelimiter_tpu.core.types import (
     Result,
     batch_fail_open,
 )
-from ratelimiter_tpu.ops.hashing import hash_strings_u64, split_hash, splitmix64
+from ratelimiter_tpu.ops.hashing import hash_strings_u64, split_hash
 
 _MIN_PAD = 8
 

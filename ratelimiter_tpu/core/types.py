@@ -17,8 +17,7 @@ only way backends build results, so the semantics in one place:
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
-from typing import Sequence
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -43,9 +43,8 @@ virtual-time tests are exact (SURVEY.md §4.3).
 
 from __future__ import annotations
 
-import math
 from functools import partial
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict
 
 import jax
 
@@ -53,7 +52,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp
 
-from ratelimiter_tpu.core.clock import MICROS, to_micros
+from ratelimiter_tpu.core.clock import to_micros
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import InvalidConfigError
 from ratelimiter_tpu.ops.segment import admit

@@ -26,7 +26,6 @@ from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ratelimiter_tpu.core.config import Config

@@ -1,7 +1,6 @@
 """Contract suite instantiated for the dense device backend, plus
 dense-specific behavior (slot capacity, recycling, fault injection)."""
 
-import numpy as np
 import pytest
 
 from tests.contract import ContractTests

@@ -5,7 +5,6 @@ window boundaries, refill, burst, weighting — deterministically via
 ManualClock instead of miniredis FastForward + real sleeps.
 """
 
-import math
 
 import pytest
 

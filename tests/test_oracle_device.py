@@ -4,7 +4,6 @@ per-key sliding window at sub-window resolution, identical time
 discretization to the sketch, zero collision error."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

@@ -2,7 +2,6 @@
 constructors are live code used by every backend, not dead scaffolding)."""
 
 import numpy as np
-import pytest
 
 from ratelimiter_tpu.core.types import (
     BatchResult,

@@ -3,7 +3,6 @@ micro-batching server): equivalence to single-step dispatches, bit-packing,
 and the sub-window-boundary precondition (ADVICE r1)."""
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
