@@ -29,6 +29,9 @@ lint:            ## in-repo linter (ruff config in pyproject.toml where availabl
 
 check: lint test ## what CI runs on every push
 
+cpp-client:      ## build + conformance-test the native C++ client
+	$(PY) -m pytest tests/test_cpp_client.py -q
+
 native:          ## (re)build the C++ bulk hasher extension in place
 	rm -f ratelimiter_tpu/native/_hasher.so
 	$(PY) -c "from ratelimiter_tpu.native import native_available; \

@@ -1,0 +1,77 @@
+// Conformance demo/test driver for the C++ client.
+//
+// Drives a live server through every RPC and prints one status line per
+// check; tests/test_cpp_client.py builds this with g++ and asserts the
+// output against a real Python server process.
+//
+// Usage: rltpu_demo <host> <port>
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "ratelimiter_client.hpp"
+
+#define CHECK(cond, name)                              \
+  do {                                                 \
+    if (cond) {                                        \
+      std::printf("ok %s\n", name);                    \
+    } else {                                           \
+      std::printf("FAIL %s\n", name);                  \
+      return 1;                                        \
+    }                                                  \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <host> <port>\n", argv[0]);
+    return 2;
+  }
+  rltpu::Client c(argv[1], static_cast<uint16_t>(std::atoi(argv[2])));
+
+  // Health before traffic.
+  auto h = c.health();
+  CHECK(h.serving, "health.serving");
+
+  // Scalar allow up to the limit (server started with limit=3).
+  auto r1 = c.allow("cpp:user");
+  CHECK(r1.allowed && r1.limit == 3 && r1.remaining == 2, "allow#1");
+  auto r2 = c.allow_n("cpp:user", 2);
+  CHECK(r2.allowed && r2.remaining == 0, "allow_n#2");
+  auto r3 = c.allow("cpp:user");
+  CHECK(!r3.allowed && r3.retry_after > 0.0, "deny-over-limit");
+
+  // Reset restores quota.
+  c.reset("cpp:user");
+  CHECK(c.allow("cpp:user").allowed, "reset-restores");
+
+  // Batch frame: duplicates contend in order.
+  std::vector<std::string> keys = {"cpp:hot", "cpp:hot", "cpp:hot",
+                                   "cpp:hot", "cpp:other"};
+  auto batch = c.allow_batch(keys);
+  CHECK(batch.size() == 5, "batch-size");
+  CHECK(batch[0].allowed && batch[1].allowed && batch[2].allowed &&
+            !batch[3].allowed && batch[4].allowed,
+        "batch-exactness");
+
+  // Typed errors: n = 0 must raise with the invalid_n code.
+  bool raised = false;
+  try {
+    c.allow_n("cpp:user", 0);
+  } catch (const rltpu::RateLimitError& e) {
+    raised = (e.code == 1);  // E_INVALID_N
+  }
+  CHECK(raised, "invalid-n-typed-error");
+  // The connection survives an error response.
+  CHECK(c.allow("cpp:alive").allowed, "connection-survives-error");
+
+  // Metrics exposition reaches the client.
+  auto m = c.metrics();
+  CHECK(m.find("rate_limiter_server_batch_size") != std::string::npos,
+        "metrics-text");
+
+  auto h2 = c.health();
+  CHECK(h2.decisions_total > h.decisions_total, "health-counts");
+
+  std::printf("ALL-OK\n");
+  return 0;
+}

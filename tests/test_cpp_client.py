@@ -1,0 +1,55 @@
+"""Native C++ client conformance: build with g++, drive a real server.
+
+The reference's planned client library (pkg/client) exists here twice —
+Python (serving/client.py) and native C++ (clients/cpp/). This test is
+the native half's conformance gate: compile the demo driver and run its
+checks against a live Python server subprocess over real sockets.
+"""
+
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP_DIR = os.path.join(REPO, "clients", "cpp")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_cpp_client_conformance(tmp_path):
+    binary = str(tmp_path / "rltpu_demo")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-Wall", "-Werror",
+         os.path.join(CPP_DIR, "demo.cpp"), "-o", binary],
+        check=True, capture_output=True, timeout=120)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    server = subprocess.Popen(
+        [sys.executable, "-m", "ratelimiter_tpu.serving",
+         "--backend", "exact", "--algorithm", "fixed_window",
+         "--limit", "3", "--window", "60", "--port", str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        banner = server.stdout.readline()
+        assert "serving" in banner, banner
+        out = subprocess.run([binary, "127.0.0.1", str(port)],
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "ALL-OK" in out.stdout
+        assert "FAIL" not in out.stdout
+        server.send_signal(signal.SIGTERM)
+        assert server.wait(timeout=15) == 0
+    finally:
+        if server.poll() is None:
+            server.kill()
+            server.wait()
