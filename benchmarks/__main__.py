@@ -88,13 +88,19 @@ def main() -> None:
     import jax
 
     t_start = time.time()
-    doc: dict = {"meta": {
+    # --only merges into an existing results file (other groups' data is
+    # preserved) so one group can be re-run without redoing the suite.
+    doc: dict = {}
+    if args.only and os.path.exists(f"{args.out}.json"):
+        with open(f"{args.out}.json") as f:
+            doc = json.load(f)
+    doc["meta"] = {
         "timestamp": datetime.now(timezone.utc).isoformat(timespec="seconds"),
         "jax_platform": jax.devices()[0].platform,
         "device_count": len(jax.devices()),
         "python": _platform.python_version(),
         "quick": args.quick,
-    }}
+    }
 
     def log(msg: str) -> None:
         print(f"[{time.time() - t_start:7.1f}s] {msg}", flush=True)

@@ -1,0 +1,22 @@
+"""Basic limiting: create, allow, deny, retry-after, reset.
+
+Runs on the exact (host) backend — no device needed.
+"""
+
+from ratelimiter_tpu import Algorithm, Config, create_limiter
+
+lim = create_limiter(
+    Config(algorithm=Algorithm.SLIDING_WINDOW, limit=5, window=60.0),
+    backend="exact")
+
+for i in range(5):
+    res = lim.allow("user:1")
+    print(f"request {i}: allowed={res.allowed} remaining={res.remaining}")
+
+res = lim.allow("user:1")
+print(f"over limit: allowed={res.allowed} retry_after={res.retry_after:.1f}s")
+
+lim.reset("user:1")
+print(f"after reset: allowed={lim.allow('user:1').allowed}")
+lim.close()
+print("OK")

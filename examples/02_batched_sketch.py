@@ -1,0 +1,28 @@
+"""The TPU hot path: batched decisions on the sketch backend.
+
+Constant memory in key cardinality; one device dispatch per batch.
+(Runs on whatever JAX backend is available — CPU works.)
+"""
+
+import numpy as np
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams, create_limiter
+
+lim = create_limiter(
+    Config(algorithm=Algorithm.TPU_SKETCH, limit=100, window=60.0,
+           sketch=SketchParams(depth=4, width=1 << 14)),
+    backend="sketch")
+
+# String-key batch (hashed host-side by the native bulk hasher).
+keys = [f"user:{i % 1000}" for i in range(4096)]
+out = lim.allow_batch(keys)
+print(f"batch of {len(out)}: {out.allow_count} allowed")
+
+# Pre-hashed fast path: no string handling at all.
+before = lim.memory_bytes()
+h64 = np.arange(100_000, dtype=np.uint64)
+out = lim.allow_hashed(h64)
+print(f"100K distinct keys: {out.allow_count} allowed, "
+      f"memory unchanged: {lim.memory_bytes() == before}")
+lim.close()
+print("OK")

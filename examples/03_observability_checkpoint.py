@@ -1,0 +1,28 @@
+"""Decorators + checkpoint/restore in one flow."""
+
+import tempfile
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, create_limiter
+from ratelimiter_tpu.observability import MetricsDecorator, Registry
+
+clock = ManualClock(1_700_000_000.0)
+cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0)
+reg = Registry()
+lim = MetricsDecorator(
+    create_limiter(cfg, backend="sketch", clock=clock), reg)
+
+assert lim.allow_n("k", 10).allowed
+assert not lim.allow("k").allowed
+
+with tempfile.NamedTemporaryFile(suffix=".npz") as f:
+    lim.save(f.name)                       # decorator passes through
+    lim2 = create_limiter(cfg, backend="sketch", clock=clock)
+    lim2.restore(f.name)
+    assert not lim2.allow("k").allowed     # restored state denies too
+    clock.advance(1.0)
+    assert lim2.allow("k").allowed         # 1 token refilled post-restore
+    lim2.close()
+
+print(reg.render().splitlines()[2])        # one emitted metric line
+lim.close()
+print("OK")

@@ -1,0 +1,40 @@
+"""Serving end to end: spawn the server binary, drive it with both
+clients, shut it down gracefully."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+from ratelimiter_tpu.serving import Client
+
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]
+s.close()
+
+env = dict(os.environ)
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+env["PYTHONPATH"] = os.pathsep.join(
+    [repo] + env.get("PYTHONPATH", "").split(os.pathsep))
+server = subprocess.Popen(
+    [sys.executable, "-m", "ratelimiter_tpu.serving",
+     "--backend", "exact", "--algorithm", "token_bucket",
+     "--limit", "3", "--window", "60", "--port", str(port)],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+print(server.stdout.readline().strip())
+
+with Client(port=port) as c:
+    for i in range(4):
+        res = c.allow("user:1")
+        print(f"rpc {i}: allowed={res.allowed} remaining={res.remaining}")
+    results = c.allow_batch(["a", "b", "a"])
+    print(f"batch rpc: {[r.allowed for r in results]}")
+    serving, uptime, decisions = c.health()
+    print(f"health: serving={serving} decisions={decisions}")
+
+server.send_signal(signal.SIGTERM)
+assert server.wait(timeout=15) == 0
+print("graceful shutdown: exit 0")
+print("OK")

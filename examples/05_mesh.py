@@ -1,0 +1,33 @@
+"""Multi-chip mesh limiting. Run with a virtual mesh on any host:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/05_mesh.py
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+
+if len(jax.devices()) < 2:
+    print("SKIP: need >= 2 devices (see module docstring)")
+    raise SystemExit(0)
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, SketchParams
+from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
+
+mesh = make_mesh()
+cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0,
+             sketch=SketchParams(depth=2, width=1024, sub_windows=6))
+
+lim = MeshSketchLimiter(cfg, ManualClock(1.7e9), mesh=mesh, merge="gather")
+out = lim.allow_batch(["hot"] * 64)
+print(f"{len(mesh.devices.flat)}-device mesh, gather mode: "
+      f"{out.allow_count}/64 admitted (bit-exact global limit=10)")
+assert out.allow_count == 10
+lim.close()
+print("OK")
