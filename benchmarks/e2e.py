@@ -43,7 +43,8 @@ def _free_port() -> int:
 
 
 def _spawn_server(backend: str, *, platform: Optional[str] = None,
-                  max_batch: int = 4096, max_delay_us: float = 500.0):
+                  max_batch: int = 4096, max_delay_us: float = 500.0,
+                  native: bool = False):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
@@ -57,7 +58,8 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
          "--limit", "100", "--window", "60",
          "--max-batch", str(max_batch),
          "--max-delay-us", str(max_delay_us),
-         "--port", str(port)],
+         "--port", str(port)]
+        + (["--native"] if native else []),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline()  # blocks until "serving ..." banner
     if "serving" not in line:
@@ -139,8 +141,8 @@ async def _drive(port: int, *, seconds: float, conns: int, window: int,
 
 
 def _run_variant(name: str, backend: str, *, platform=None, seconds=6.0,
-                 conns=4, window=2048, log=print) -> Dict:
-    proc, port = _spawn_server(backend, platform=platform)
+                 conns=4, window=2048, native=False, log=print) -> Dict:
+    proc, port = _spawn_server(backend, platform=platform, native=native)
     try:
         out = asyncio.run(_drive(port, seconds=seconds, conns=conns,
                                  window=window, n_keys=100_000))
@@ -166,6 +168,16 @@ def run_e2e(quick: bool = False, log=print) -> List[Dict]:
     rows.append(_run_variant("sketch on cpu device", "sketch",
                              platform="cpu", seconds=seconds, window=window,
                              log=log))
+    try:
+        rows.append(_run_variant(
+            "NATIVE server, host-only (exact backend)", "exact",
+            seconds=seconds, window=window, native=True, log=log))
+        rows.append(_run_variant(
+            "NATIVE server, sketch on cpu device", "sketch",
+            platform="cpu", seconds=seconds, window=window, native=True,
+            log=log))
+    except Exception as exc:  # no compiler -> skip, never fail the suite
+        rows.append({"variant": "native server", "error": str(exc)})
     if not quick:
         try:
             rows.append(_run_variant(

@@ -1,0 +1,780 @@
+// Native front door for the rate-limit service.
+//
+// The Python asyncio server tops out around 60K decisions/s — the event
+// loop, per-frame Python parsing, and response encoding dominate long
+// before the device does. This extension moves the ENTIRE serving hot
+// path into C++ threads; Python is entered exactly once per batched
+// dispatch (the decide callback), which is the same cadence at which the
+// device is entered. Protocol and semantics are identical to
+// ratelimiter_tpu/serving/protocol.py — the Python clients and the
+// serving test suite drive both servers interchangeably.
+//
+// Threading model:
+//   io thread          epoll on listener + conns + eventfd; frame
+//                      assembly; C++-side validation (empty key, n==0,
+//                      oversized frames) answers ERROR inline; ALLOW
+//                      work lands in the pending queue; HEALTH answered
+//                      inline from atomics; writes flushed from
+//                      per-conn output queues.
+//   dispatcher thread  waits up to max_delay_us for work, drains up to
+//                      max_batch keys, builds contiguous (blob, offsets,
+//                      lengths, ns) buffers, calls the Python callback
+//                      under PyGILState_Ensure, encodes RESULT /
+//                      RESULT_BATCH frames, queues them, kicks eventfd.
+//
+// The Python side (serving/native_server.py) supplies three callbacks:
+//   decide(blob, offsets, lengths, ns) -> (flags, remaining, retry,
+//       reset_at, limit)            [bytes in, buffer-protocol out]
+//   reset(key_bytes) -> None
+//   metrics() -> bytes
+//
+// Build: automatic on first import (native/__init__.py pattern), or
+// `make native-server`.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---- protocol constants (serving/protocol.py) ----
+constexpr uint8_t T_ALLOW_N = 1, T_RESET = 2, T_HEALTH = 3, T_METRICS = 4,
+                  T_ALLOW_BATCH = 5;
+constexpr uint8_t T_RESULT = 129, T_OK = 130, T_HEALTH_R = 131,
+                  T_METRICS_R = 132, T_RESULT_BATCH = 133, T_ERROR = 255;
+constexpr uint16_t E_INVALID_N = 1, E_INVALID_KEY = 2,
+                   E_STORAGE_UNAVAILABLE = 3, E_INTERNAL = 7;
+constexpr uint32_t MAX_FRAME = 1u << 20;
+constexpr uint32_t MAX_KEY_LEN = 4096;
+
+void put_u32(std::string& b, uint32_t v) { b.append((char*)&v, 4); }
+void put_u16(std::string& b, uint16_t v) { b.append((char*)&v, 2); }
+void put_u64(std::string& b, uint64_t v) { b.append((char*)&v, 8); }
+void put_i64(std::string& b, int64_t v) { b.append((char*)&v, 8); }
+void put_f64(std::string& b, double v) { b.append((char*)&v, 8); }
+
+void frame_header(std::string& b, uint8_t type, uint64_t req_id,
+                  uint32_t body_len) {
+  put_u32(b, 1 + 8 + body_len);
+  b.push_back((char)type);
+  put_u64(b, req_id);
+}
+
+std::string make_error(uint64_t req_id, uint16_t code, const std::string& msg) {
+  std::string out;
+  frame_header(out, T_ERROR, req_id, 4 + (uint32_t)msg.size());
+  put_u16(out, code);
+  put_u16(out, (uint16_t)msg.size());
+  out += msg;
+  return out;
+}
+
+struct Conn {
+  int fd = -1;
+  std::string rbuf;                 // partial frames (io thread only)
+  std::deque<std::string> wq;       // outgoing frames
+  size_t woff = 0;                  // offset into wq.front()
+  std::mutex wmx;
+  std::atomic<bool> closed{false};
+  bool want_write = false;          // io thread only
+};
+
+using ConnPtr = std::shared_ptr<Conn>;
+
+// One queued decision unit: a scalar ALLOW_N or a whole ALLOW_BATCH frame.
+struct Pending {
+  ConnPtr conn;
+  uint64_t req_id;
+  bool is_batch;
+  std::vector<std::string> keys;
+  std::vector<int64_t> ns;
+};
+
+struct Server {
+  int listen_fd = -1, epoll_fd = -1, event_fd = -1;
+  uint16_t port = 0;
+  uint32_t max_batch = 4096;
+  uint32_t max_delay_us = 200;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> draining{false};
+  std::atomic<uint64_t> decisions{0};
+  double started_at = 0.0;
+
+  std::thread io_thread, dispatch_thread;
+  std::map<int, ConnPtr> conns;  // io thread only
+
+  std::mutex qmx;
+  std::condition_variable qcv;
+  std::deque<Pending> queue;
+  size_t queued_keys = 0;
+
+  PyObject* cb_decide = nullptr;
+  PyObject* cb_reset = nullptr;
+  PyObject* cb_metrics = nullptr;
+};
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+void conn_send(Server* s, const ConnPtr& c, std::string frame) {
+  if (c->closed.load()) return;
+  {
+    std::lock_guard<std::mutex> g(c->wmx);
+    c->wq.push_back(std::move(frame));
+  }
+  uint64_t one = 1;  // wake the io thread to flush
+  ssize_t r = write(s->event_fd, &one, 8);
+  (void)r;
+}
+
+// ---- dispatcher ----------------------------------------------------------
+
+// Calls the Python decide callback for a drained run of Pending items.
+// Returns false if the callback raised (all items get ERROR frames).
+bool run_decide(Server* s, std::vector<Pending>& items) {
+  size_t total = 0;
+  for (auto& p : items) total += p.keys.size();
+
+  std::string blob;
+  std::vector<int64_t> offsets, lengths, ns;
+  offsets.reserve(total);
+  lengths.reserve(total);
+  ns.reserve(total);
+  for (auto& p : items) {
+    for (size_t i = 0; i < p.keys.size(); ++i) {
+      offsets.push_back((int64_t)blob.size());
+      lengths.push_back((int64_t)p.keys[i].size());
+      blob += p.keys[i];
+      ns.push_back(p.ns[i]);
+    }
+  }
+
+  std::vector<uint8_t> flags(total);
+  std::vector<int64_t> remaining(total);
+  std::vector<double> retry(total), reset_at(total);
+  int64_t limit = 0;
+  uint16_t err_code = 0;
+  std::string err_msg;
+
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(y#y#y#y#)", blob.data(), (Py_ssize_t)blob.size(),
+        (const char*)offsets.data(), (Py_ssize_t)(offsets.size() * 8),
+        (const char*)lengths.data(), (Py_ssize_t)(lengths.size() * 8),
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+    PyObject* res = args ? PyObject_CallObject(s->cb_decide, args) : nullptr;
+    Py_XDECREF(args);
+    if (res == nullptr) {
+      PyObject *t, *v, *tb;
+      PyErr_Fetch(&t, &v, &tb);
+      PyObject* str = v ? PyObject_Str(v) : nullptr;
+      const char* u =
+          (str && PyUnicode_Check(str)) ? PyUnicode_AsUTF8(str) : nullptr;
+      err_msg = u ? u : "decide callback failed";
+      // Python-side mapping: the bridge returns a typed code via the
+      // exception's .rl_code when it can; default storage_unavailable.
+      err_code = E_STORAGE_UNAVAILABLE;
+      if (v != nullptr) {
+        PyObject* codeattr = PyObject_GetAttrString(v, "rl_code");
+        if (codeattr && PyLong_Check(codeattr))
+          err_code = (uint16_t)PyLong_AsLong(codeattr);
+        Py_XDECREF(codeattr);
+        if (PyErr_Occurred()) PyErr_Clear();
+      }
+      Py_XDECREF(str);
+      Py_XDECREF(t);
+      Py_XDECREF(v);
+      Py_XDECREF(tb);
+    } else {
+      // (flags, remaining, retry, reset_at, limit) — buffer protocol.
+      PyObject *o_fl, *o_rem, *o_ret, *o_rst;
+      long long o_lim = 0;
+      if (!PyArg_ParseTuple(res, "OOOOL", &o_fl, &o_rem, &o_ret, &o_rst,
+                            &o_lim)) {
+        err_code = E_INTERNAL;
+        err_msg = "decide returned a malformed tuple";
+        PyErr_Clear();
+      } else {
+        limit = (int64_t)o_lim;
+        Py_buffer b_fl, b_rem, b_ret, b_rst;
+        bool ok = PyObject_GetBuffer(o_fl, &b_fl, PyBUF_SIMPLE) == 0;
+        ok = ok && PyObject_GetBuffer(o_rem, &b_rem, PyBUF_SIMPLE) == 0;
+        ok = ok && PyObject_GetBuffer(o_ret, &b_ret, PyBUF_SIMPLE) == 0;
+        ok = ok && PyObject_GetBuffer(o_rst, &b_rst, PyBUF_SIMPLE) == 0;
+        if (!ok || (size_t)b_fl.len < total || (size_t)b_rem.len < total * 8 ||
+            (size_t)b_ret.len < total * 8 || (size_t)b_rst.len < total * 8) {
+          err_code = E_INTERNAL;
+          err_msg = "decide returned short buffers";
+          PyErr_Clear();
+        } else {
+          memcpy(flags.data(), b_fl.buf, total);
+          memcpy(remaining.data(), b_rem.buf, total * 8);
+          memcpy(retry.data(), b_ret.buf, total * 8);
+          memcpy(reset_at.data(), b_rst.buf, total * 8);
+        }
+        if (ok) {
+          PyBuffer_Release(&b_fl);
+          PyBuffer_Release(&b_rem);
+          PyBuffer_Release(&b_ret);
+          PyBuffer_Release(&b_rst);
+        }
+      }
+      Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+  }
+
+  if (err_code != 0) {
+    for (auto& p : items)
+      conn_send(s, p.conn, make_error(p.req_id, err_code, err_msg));
+    return false;
+  }
+
+  s->decisions.fetch_add(total);
+  size_t idx = 0;
+  for (auto& p : items) {
+    std::string out;
+    if (!p.is_batch) {
+      frame_header(out, T_RESULT, p.req_id, 33);
+      out.push_back((char)flags[idx]);
+      put_i64(out, limit);
+      put_i64(out, remaining[idx]);
+      put_f64(out, retry[idx]);
+      put_f64(out, reset_at[idx]);
+      ++idx;
+    } else {
+      uint32_t count = (uint32_t)p.keys.size();
+      frame_header(out, T_RESULT_BATCH, p.req_id, 12 + 25 * count);
+      put_i64(out, limit);
+      put_u32(out, count);
+      for (uint32_t i = 0; i < count; ++i) {
+        out.push_back((char)flags[idx]);
+        put_i64(out, remaining[idx]);
+        put_f64(out, retry[idx]);
+        put_f64(out, reset_at[idx]);
+        ++idx;
+      }
+    }
+    conn_send(s, p.conn, std::move(out));
+  }
+  return true;
+}
+
+void handle_reset(Server* s, const Pending& p) {
+  uint16_t err_code = 0;
+  std::string err_msg;
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = PyObject_CallFunction(
+        s->cb_reset, "y#", p.keys[0].data(), (Py_ssize_t)p.keys[0].size());
+    if (res == nullptr) {
+      PyObject *t, *v, *tb;
+      PyErr_Fetch(&t, &v, &tb);
+      PyObject* str = v ? PyObject_Str(v) : nullptr;
+      const char* u =
+          (str && PyUnicode_Check(str)) ? PyUnicode_AsUTF8(str) : nullptr;
+      err_msg = u ? u : "reset failed";
+      err_code = E_STORAGE_UNAVAILABLE;
+      if (v != nullptr) {
+        PyObject* codeattr = PyObject_GetAttrString(v, "rl_code");
+        if (codeattr && PyLong_Check(codeattr))
+          err_code = (uint16_t)PyLong_AsLong(codeattr);
+        Py_XDECREF(codeattr);
+        if (PyErr_Occurred()) PyErr_Clear();
+      }
+      Py_XDECREF(str);
+      Py_XDECREF(t);
+      Py_XDECREF(v);
+      Py_XDECREF(tb);
+    } else {
+      Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+  }
+  std::string out;
+  if (err_code) {
+    out = make_error(p.req_id, err_code, err_msg);
+  } else {
+    frame_header(out, T_OK, p.req_id, 0);
+  }
+  conn_send(s, p.conn, std::move(out));
+}
+
+void handle_metrics(Server* s, const Pending& p) {
+  std::string text;
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = s->cb_metrics && s->cb_metrics != Py_None
+                        ? PyObject_CallNoArgs(s->cb_metrics)
+                        : nullptr;
+    if (res != nullptr) {
+      if (PyBytes_Check(res))
+        text.assign(PyBytes_AsString(res), PyBytes_Size(res));
+      else if (PyUnicode_Check(res)) {
+        Py_ssize_t n = 0;
+        const char* u = PyUnicode_AsUTF8AndSize(res, &n);
+        text.assign(u, n);
+      }
+      Py_DECREF(res);
+    } else if (PyErr_Occurred()) {
+      PyErr_Clear();
+    }
+    PyGILState_Release(g);
+  }
+  std::string out;
+  frame_header(out, T_METRICS_R, p.req_id, 4 + (uint32_t)text.size());
+  put_u32(out, (uint32_t)text.size());
+  out += text;
+  conn_send(s, p.conn, std::move(out));
+}
+
+void dispatcher_main(Server* s) {
+  while (true) {
+    std::vector<Pending> run;
+    size_t run_keys = 0;
+    {
+      std::unique_lock<std::mutex> lk(s->qmx);
+      if (s->queue.empty()) {
+        s->qcv.wait(lk, [&] { return s->stop.load() || !s->queue.empty(); });
+      } else {
+        // First item already waiting: coalesce for up to max_delay.
+        s->qcv.wait_for(lk, std::chrono::microseconds(s->max_delay_us),
+                        [&] {
+                          return s->stop.load() ||
+                                 s->queued_keys >= s->max_batch;
+                        });
+      }
+      if (s->stop.load() && s->queue.empty()) return;
+      while (!s->queue.empty() && run_keys < s->max_batch) {
+        // RESET/METRICS ride the same queue (keys empty or kind marker).
+        run_keys += s->queue.front().keys.size();
+        run.push_back(std::move(s->queue.front()));
+        s->queue.pop_front();
+      }
+      s->queued_keys -= std::min(s->queued_keys, run_keys);
+    }
+    // Split control items (req_id flag via ns sentinel) from decisions.
+    std::vector<Pending> decisions;
+    for (auto& p : run) {
+      if (p.ns.size() == 1 && p.ns[0] == -1) {
+        handle_reset(s, p);
+      } else if (p.ns.size() == 1 && p.ns[0] == -2) {
+        handle_metrics(s, p);
+      } else {
+        decisions.push_back(std::move(p));
+      }
+    }
+    if (!decisions.empty()) run_decide(s, decisions);
+  }
+}
+
+// ---- io thread -----------------------------------------------------------
+
+void close_conn(Server* s, const ConnPtr& c) {
+  if (c->closed.exchange(true)) return;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  s->conns.erase(c->fd);
+}
+
+void flush_writes(Server* s, const ConnPtr& c) {
+  std::lock_guard<std::mutex> g(c->wmx);
+  while (!c->wq.empty()) {
+    const std::string& front = c->wq.front();
+    ssize_t w = send(c->fd, front.data() + c->woff, front.size() - c->woff,
+                     MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      close_conn(s, c);
+      return;
+    }
+    c->woff += (size_t)w;
+    if (c->woff == front.size()) {
+      c->wq.pop_front();
+      c->woff = 0;
+    }
+  }
+  bool want = !c->wq.empty();
+  if (want != c->want_write) {
+    c->want_write = want;
+    struct epoll_event ev{};
+    ev.events = EPOLLIN | (want ? EPOLLOUT : 0);
+    ev.data.fd = c->fd;
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+}
+
+// Parse complete frames out of c->rbuf; enqueue work.
+bool process_rbuf(Server* s, const ConnPtr& c) {
+  size_t off = 0;
+  while (c->rbuf.size() - off >= 13) {
+    uint32_t length;
+    memcpy(&length, c->rbuf.data() + off, 4);
+    if (length < 9 || length > MAX_FRAME) return false;  // protocol error
+    if (c->rbuf.size() - off < 4 + length) break;
+    uint8_t type = (uint8_t)c->rbuf[off + 4];
+    uint64_t req_id;
+    memcpy(&req_id, c->rbuf.data() + off + 5, 8);
+    const char* body = c->rbuf.data() + off + 13;
+    uint32_t blen = length - 9;
+    off += 4 + length;
+
+    auto enqueue = [&](Pending&& p, size_t nkeys) {
+      std::lock_guard<std::mutex> g(s->qmx);
+      s->queue.push_back(std::move(p));
+      s->queued_keys += nkeys;
+      s->qcv.notify_one();
+    };
+
+    if (type == T_ALLOW_N) {
+      if (blen < 6) return false;
+      uint32_t n;
+      uint16_t klen;
+      memcpy(&n, body, 4);
+      memcpy(&klen, body + 4, 2);
+      if (blen != 6u + klen || klen > MAX_KEY_LEN) return false;
+      if (s->draining.load()) {
+        conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                                   "server is shutting down"));
+      } else if (n == 0) {
+        conn_send(s, c, make_error(req_id, E_INVALID_N,
+                                   "n must be a positive integer, got 0"));
+      } else if (klen == 0) {
+        conn_send(s, c, make_error(req_id, E_INVALID_KEY,
+                                   "key must be a non-empty string"));
+      } else {
+        Pending p{c, req_id, false, {std::string(body + 6, klen)}, {(int64_t)n}};
+        enqueue(std::move(p), 1);
+      }
+    } else if (type == T_ALLOW_BATCH) {
+      if (blen < 4) return false;
+      uint32_t count;
+      memcpy(&count, body, 4);
+      Pending p{c, req_id, true, {}, {}};
+      p.keys.reserve(count);
+      p.ns.reserve(count);
+      size_t pos = 4;
+      bool bad_n = false, bad_key = false;
+      for (uint32_t i = 0; i < count; ++i) {
+        if (pos + 6 > blen) return false;
+        uint32_t n;
+        uint16_t klen;
+        memcpy(&n, body + pos, 4);
+        memcpy(&klen, body + pos + 4, 2);
+        pos += 6;
+        if (klen > MAX_KEY_LEN || pos + klen > blen) return false;
+        if (n == 0) bad_n = true;
+        if (klen == 0) bad_key = true;
+        p.keys.emplace_back(body + pos, klen);
+        p.ns.push_back((int64_t)n);
+        pos += klen;
+      }
+      if (pos != blen) return false;
+      if (s->draining.load()) {
+        conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                                   "server is shutting down"));
+      } else if (bad_n) {
+        conn_send(s, c, make_error(req_id, E_INVALID_N,
+                                   "n must be a positive integer"));
+      } else if (bad_key) {
+        conn_send(s, c, make_error(req_id, E_INVALID_KEY,
+                                   "key must be a non-empty string"));
+      } else {
+        size_t nk = p.keys.size();
+        enqueue(std::move(p), nk);
+      }
+    } else if (type == T_RESET) {
+      if (blen < 2) return false;
+      uint16_t klen;
+      memcpy(&klen, body, 2);
+      if (blen != 2u + klen || klen > MAX_KEY_LEN) return false;
+      if (klen == 0) {
+        conn_send(s, c, make_error(req_id, E_INVALID_KEY,
+                                   "key must be a non-empty string"));
+      } else {
+        Pending p{c, req_id, false, {std::string(body + 2, klen)}, {-1}};
+        enqueue(std::move(p), 0);
+      }
+    } else if (type == T_HEALTH) {
+      std::string out;
+      frame_header(out, T_HEALTH_R, req_id, 17);
+      out.push_back(s->draining.load() ? 0 : 1);
+      put_f64(out, now_s() - s->started_at);
+      uint64_t d = s->decisions.load();
+      out.append((char*)&d, 8);
+      conn_send(s, c, std::move(out));
+    } else if (type == T_METRICS) {
+      Pending p{c, req_id, false, {std::string()}, {-2}};
+      enqueue(std::move(p), 0);
+    } else {
+      conn_send(s, c, make_error(req_id, E_INTERNAL, "unknown request type"));
+    }
+  }
+  if (off) c->rbuf.erase(0, off);
+  return true;
+}
+
+void io_main(Server* s) {
+  std::vector<struct epoll_event> events(128);
+  char buf[65536];
+  while (!s->stop.load()) {
+    int n = epoll_wait(s->epoll_fd, events.data(), (int)events.size(), 100);
+    for (int i = 0; i < n; ++i) {
+      int fd = events[i].data.fd;
+      if (fd == s->listen_fd) {
+        while (true) {
+          int cfd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int one = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          auto c = std::make_shared<Conn>();
+          c->fd = cfd;
+          s->conns[cfd] = c;
+          struct epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.fd = cfd;
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+      } else if (fd == s->event_fd) {
+        uint64_t drain;
+        ssize_t r = read(s->event_fd, &drain, 8);
+        (void)r;
+        // Flush every conn with queued writes.
+        for (auto it = s->conns.begin(); it != s->conns.end();) {
+          auto c = it->second;
+          ++it;  // flush may erase
+          flush_writes(s, c);
+        }
+      } else {
+        auto it = s->conns.find(fd);
+        if (it == s->conns.end()) continue;
+        ConnPtr c = it->second;
+        if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+          close_conn(s, c);
+          continue;
+        }
+        if (events[i].events & EPOLLIN) {
+          bool dead = false;
+          while (true) {
+            ssize_t r = recv(fd, buf, sizeof(buf), 0);
+            if (r > 0) {
+              c->rbuf.append(buf, (size_t)r);
+              if (c->rbuf.size() > 4 * MAX_FRAME) { dead = true; break; }
+            } else if (r == 0) {
+              dead = true;
+              break;
+            } else {
+              if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+              dead = true;
+              break;
+            }
+          }
+          if (!dead && !process_rbuf(s, c)) dead = true;
+          if (dead) {
+            close_conn(s, c);
+            continue;
+          }
+        }
+        if (events[i].events & EPOLLOUT) flush_writes(s, c);
+      }
+    }
+  }
+  // Teardown: close everything (pending writes were flushed by drain).
+  for (auto& kv : std::map<int, ConnPtr>(s->conns)) close_conn(s, kv.second);
+}
+
+// ---- Python object -------------------------------------------------------
+
+struct PyServer {
+  PyObject_HEAD
+  Server* s;
+};
+
+PyObject* server_start(PyObject* self, PyObject* args) {
+  PyServer* ps = (PyServer*)self;
+  Server* s = ps->s;
+  const char* host;
+  int port;
+  if (!PyArg_ParseTuple(args, "si", &host, &port)) return nullptr;
+
+  s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (bind(s->listen_fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(s->listen_fd, 512) != 0) {
+    PyErr_SetFromErrno(PyExc_OSError);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (struct sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+
+  s->epoll_fd = epoll_create1(0);
+  s->event_fd = eventfd(0, EFD_NONBLOCK);
+  struct epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = s->listen_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  ev.data.fd = s->event_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->event_fd, &ev);
+
+  s->started_at = now_s();
+  s->io_thread = std::thread(io_main, s);
+  s->dispatch_thread = std::thread(dispatcher_main, s);
+  return PyLong_FromLong(s->port);
+}
+
+PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
+  PyServer* ps = (PyServer*)self;
+  Server* s = ps->s;
+  if (s->listen_fd >= 0) {
+    // Graceful: stop new work, let the dispatcher drain the queue.
+    s->draining.store(true);
+    Py_BEGIN_ALLOW_THREADS;
+    for (int i = 0; i < 200; ++i) {  // up to ~2 s of drain
+      {
+        std::lock_guard<std::mutex> g(s->qmx);
+        if (s->queue.empty()) break;
+      }
+      usleep(10000);
+    }
+    usleep(20000);  // let final responses flush
+    s->stop.store(true);
+    s->qcv.notify_all();
+    uint64_t one_ = 1;
+    ssize_t r = write(s->event_fd, &one_, 8);
+    (void)r;
+    if (s->io_thread.joinable()) s->io_thread.join();
+    if (s->dispatch_thread.joinable()) s->dispatch_thread.join();
+    Py_END_ALLOW_THREADS;
+    close(s->listen_fd);
+    close(s->epoll_fd);
+    close(s->event_fd);
+    s->listen_fd = -1;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
+  PyServer* ps = (PyServer*)self;
+  return Py_BuildValue("{s:K,s:d}", "decisions_total",
+                       (unsigned long long)ps->s->decisions.load(), "uptime_s",
+                       now_s() - ps->s->started_at);
+}
+
+void server_dealloc(PyObject* self) {
+  PyServer* ps = (PyServer*)self;
+  if (ps->s != nullptr) {
+    if (ps->s->listen_fd >= 0) {
+      ps->s->stop.store(true);
+      ps->s->qcv.notify_all();
+      uint64_t one = 1;
+      ssize_t r = write(ps->s->event_fd, &one, 8);
+      (void)r;
+      if (ps->s->io_thread.joinable()) ps->s->io_thread.join();
+      if (ps->s->dispatch_thread.joinable()) ps->s->dispatch_thread.join();
+      close(ps->s->listen_fd);
+      close(ps->s->epoll_fd);
+      close(ps->s->event_fd);
+    }
+    Py_XDECREF(ps->s->cb_decide);
+    Py_XDECREF(ps->s->cb_reset);
+    Py_XDECREF(ps->s->cb_metrics);
+    delete ps->s;
+  }
+  Py_TYPE(self)->tp_free(self);
+}
+
+PyMethodDef server_methods[] = {
+    {"start", server_start, METH_VARARGS, "start(host, port) -> bound port"},
+    {"shutdown", server_shutdown, METH_NOARGS, "graceful drain + stop"},
+    {"stats", server_stats, METH_NOARGS, "{decisions_total, uptime_s}"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject PyServerType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
+                        PyObject* kwargs) {
+  static const char* kwlist[] = {"decide",      "reset",     "metrics",
+                                 "max_batch",   "max_delay_us", nullptr};
+  PyObject *decide, *reset, *metrics = Py_None;
+  unsigned int max_batch = 4096, max_delay_us = 200;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OII", (char**)kwlist,
+                                   &decide, &reset, &metrics, &max_batch,
+                                   &max_delay_us))
+    return nullptr;
+  PyServer* ps = PyObject_New(PyServer, &PyServerType);
+  if (ps == nullptr) return nullptr;
+  ps->s = new Server();
+  ps->s->max_batch = max_batch;
+  ps->s->max_delay_us = max_delay_us;
+  Py_INCREF(decide);
+  Py_INCREF(reset);
+  Py_INCREF(metrics);
+  ps->s->cb_decide = decide;
+  ps->s->cb_reset = reset;
+  ps->s->cb_metrics = metrics;
+  return (PyObject*)ps;
+}
+
+PyMethodDef module_methods[] = {
+    {"create_server", (PyCFunction)create_server,
+     METH_VARARGS | METH_KEYWORDS,
+     "create_server(decide, reset, metrics=None, max_batch=4096, "
+     "max_delay_us=200) -> Server"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+struct PyModuleDef server_module = {
+    PyModuleDef_HEAD_INIT, "_server",
+    "Native epoll front door for the rate-limit service", -1, module_methods,
+};
+
+}  // namespace
+
+extern "C" {
+
+// C ABI probe so the loader can verify the build (native/__init__ pattern).
+int64_t rl_server_abi_version() { return 1; }
+
+PyMODINIT_FUNC PyInit__server(void) {
+  PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";
+  PyServerType.tp_basicsize = sizeof(PyServer);
+  PyServerType.tp_dealloc = server_dealloc;
+  PyServerType.tp_flags = Py_TPFLAGS_DEFAULT;
+  PyServerType.tp_methods = server_methods;
+  if (PyType_Ready(&PyServerType) < 0) return nullptr;
+  return PyModule_Create(&server_module);
+}
+
+}  // extern "C"

@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batcher coalescing window, microseconds")
     ap.add_argument("--dispatch-timeout-ms", type=float, default=None,
                     help="SLO per dispatch; breach triggers fail-open/closed")
+    ap.add_argument("--native", action="store_true",
+                    help="use the C++ epoll front door (native/server.cpp) "
+                         "instead of the asyncio server; no dispatch SLO")
     ap.add_argument("--no-prewarm", action="store_true",
                     help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
@@ -101,6 +104,24 @@ async def amain(args) -> None:
     limiter = MetricsDecorator(create_limiter(cfg, backend=args.backend))
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
+    if args.native:
+        from ratelimiter_tpu.serving.native_server import NativeRateLimitServer
+
+        server = NativeRateLimitServer(
+            limiter, args.host, args.port,
+            max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6)
+        server.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        print(f"serving(native) {args.algorithm}/{args.backend} "
+              f"limit={args.limit}/{args.window:g}s on "
+              f"{args.host}:{server.port}", flush=True)
+        await stop.wait()
+        server.shutdown()
+        limiter.close()
+        return
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,

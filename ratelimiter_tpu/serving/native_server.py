@@ -1,0 +1,204 @@
+"""Python bridge for the native (C++) front door.
+
+The C++ extension (native/server.cpp) owns sockets, frame parsing,
+micro-batch coalescing, and response encoding in GIL-free threads;
+Python is entered once per batched dispatch through the callbacks this
+module builds. Same protocol, same semantics, same test suite as the
+asyncio server (serving/server.py) — the asyncio server remains the
+reference implementation; this one is the throughput path
+(ROADMAP "server hot-path in C++").
+
+Hot path: the decide callback receives the batch as four flat buffers
+(key blob + offsets + lengths + ns). For sketch-family limiters the keys
+never become Python strings: the blob is prefix-packed with NumPy and
+bulk-hashed (native hasher) straight into ``allow_hashed``. Other
+backends decode to strings and use ``allow_batch``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.core.errors import (
+    InvalidKeyError,
+    InvalidNError,
+)
+from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.serving import protocol as p
+
+
+def _load_extension():
+    """Build/load native/_server.so (same auto-build pattern as the
+    hasher; returns None when no compiler is available)."""
+    import ctypes
+    import os
+    import subprocess
+    import sysconfig
+
+    d = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    so = os.path.join(d, "native", "_server.so")
+    src = os.path.join(d, "native", "server.cpp")
+    if not os.path.exists(so) and os.environ.get(
+            "RATELIMITER_TPU_NO_BUILD") != "1":
+        try:
+            inc = sysconfig.get_paths()["include"]
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", f"-I{inc}",
+                 "-o", so, src],
+                check=True, capture_output=True, timeout=180)
+        except Exception:
+            return None
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.rl_server_abi_version.restype = ctypes.c_int64
+        if lib.rl_server_abi_version() != 1:
+            return None
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "ratelimiter_tpu.native._server", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+def native_server_available() -> bool:
+    return _load_extension() is not None
+
+
+class _BridgeError(Exception):
+    """Carries a protocol error code for the C++ layer (read via
+    ``rl_code``)."""
+
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.rl_code = code
+
+
+class NativeRateLimitServer:
+    """Drop-in sibling of RateLimitServer backed by the C++ front door.
+
+    Args mirror RateLimitServer; ``dispatch_timeout`` is not supported
+    (the native dispatcher is synchronous per batch — an SLO would need
+    a second dispatch thread; ROADMAP).
+    """
+
+    def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
+                 port: int = 0, *, max_batch: int = 4096,
+                 max_delay: float = 200e-6,
+                 registry: Optional[m.Registry] = None):
+        ext = _load_extension()
+        if ext is None:
+            raise RuntimeError(
+                "native server extension unavailable (no g++?); use the "
+                "asyncio RateLimitServer")
+        self.limiter = limiter
+        self.host = host
+        self.port = port
+        self.registry = registry if registry is not None else m.DEFAULT
+        self._lock = threading.Lock()  # serializes limiter dispatch
+        self._batch_hist = self.registry.histogram(
+            "rate_limiter_server_batch_size",
+            "Decisions per batched dispatch", m.BATCH_BUCKETS)
+
+        # Sketch-family limiters expose the hashed fast path; detect once.
+        self._fast = hasattr(limiter, "allow_hashed")
+        prefix = limiter.config.prefix
+        self._prefix_bytes = (f"{prefix}:".encode() if prefix else b"")
+
+        self._server = ext.create_server(
+            decide=self._decide, reset=self._reset, metrics=self._metrics,
+            max_batch=max_batch, max_delay_us=int(max_delay * 1e6))
+
+    # ------------------------------------------------------------ callbacks
+
+    def _decide(self, blob: bytes, offsets_b: bytes, lengths_b: bytes,
+                ns_b: bytes):
+        offsets = np.frombuffer(offsets_b, dtype=np.int64)
+        lengths = np.frombuffer(lengths_b, dtype=np.int64)
+        ns = np.frombuffer(ns_b, dtype=np.int64)
+        b = offsets.shape[0]
+        try:
+            if self._fast:
+                from ratelimiter_tpu.native import hash_packed
+
+                buf = np.frombuffer(blob, dtype=np.uint8)
+                if self._prefix_bytes:
+                    buf, offsets, lengths = _prefix_pack(
+                        buf, offsets, lengths, self._prefix_bytes)
+                h64 = hash_packed(buf, offsets, lengths)
+                with self._lock:
+                    out = self.limiter.allow_hashed(h64, ns)
+            else:
+                keys = [blob[o:o + l].decode("utf-8")
+                        for o, l in zip(offsets.tolist(), lengths.tolist())]
+                with self._lock:
+                    out = self.limiter.allow_batch(keys, ns.tolist())
+        except (InvalidNError, InvalidKeyError) as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        self._batch_hist.observe(float(b))
+        flags = out.allowed.astype(np.uint8)
+        if out.fail_open:
+            flags |= 2
+        return (flags.tobytes(),
+                np.ascontiguousarray(out.remaining, dtype=np.int64).tobytes(),
+                np.ascontiguousarray(out.retry_after, dtype=np.float64).tobytes(),
+                np.ascontiguousarray(out.reset_at, dtype=np.float64).tobytes(),
+                int(out.limit))
+
+    def _reset(self, key_bytes: bytes) -> None:
+        try:
+            self.limiter.reset(key_bytes.decode("utf-8"))
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+
+    def _metrics(self) -> bytes:
+        return self.registry.render().encode()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.port = self._server.start(self.host, self.port)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+
+    def stats(self) -> dict:
+        return self._server.stats()
+
+
+def _prefix_pack(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray,
+                 prefix: bytes):
+    """Rebuild (buf, offsets, lengths) with ``prefix`` prepended to every
+    key — vectorized, one pass, no Python-level per-key work."""
+    n = offsets.shape[0]
+    plen = len(prefix)
+    new_lengths = lengths + plen
+    new_offsets = np.concatenate(([0], np.cumsum(new_lengths)[:-1]))
+    total = int(new_lengths.sum())
+    out = np.empty(total, dtype=np.uint8)
+    parr = np.frombuffer(prefix, dtype=np.uint8)
+    # Fill prefixes: one strided assignment per prefix byte.
+    for j in range(plen):
+        out[new_offsets + j] = parr[j]
+    # Fill key bytes with a single scatter: build source and destination
+    # index vectors spanning all keys.
+    if total - n * plen:
+        src_idx = np.concatenate(
+            [np.arange(o, o + l) for o, l in
+             zip(offsets.tolist(), lengths.tolist())]) if n else np.empty(0, np.int64)
+        dst_idx = np.concatenate(
+            [np.arange(o + plen, o + plen + l) for o, l in
+             zip(new_offsets.tolist(), lengths.tolist())]) if n else np.empty(0, np.int64)
+        out[dst_idx] = buf[src_idx]
+    return out, new_offsets, new_lengths

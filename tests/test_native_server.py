@@ -1,0 +1,241 @@
+"""Native (C++) front door: the asyncio server's test scenarios against
+the epoll implementation — same protocol, same clients, same semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidKeyError,
+    InvalidNError,
+    ManualClock,
+    StorageUnavailableError,
+    create_limiter,
+)
+from ratelimiter_tpu.serving import Client
+from ratelimiter_tpu.serving.native_server import (
+    NativeRateLimitServer,
+    native_server_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_server_available(), reason="needs g++ for the native server")
+
+
+def _mk_limiter(limit=100, window=60.0, algo=Algorithm.SLIDING_WINDOW,
+                backend="exact", **kw):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=algo, limit=limit, window=window, **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+@contextmanager
+def running(limiter, **kw):
+    srv = NativeRateLimitServer(limiter, "127.0.0.1", 0, **kw)
+    srv.start()
+    try:
+        yield srv, srv.port
+    finally:
+        srv.shutdown()
+
+
+class TestNativeServer:
+    def test_allow_deny_over_the_wire(self):
+        lim, _ = _mk_limiter(limit=3)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                for i in range(3):
+                    res = c.allow("user:1")
+                    assert res.allowed and res.remaining == 2 - i
+                res = c.allow("user:1")
+                assert not res.allowed and res.retry_after > 0
+        lim.close()
+
+    def test_allow_n_and_reset(self):
+        lim, _ = _mk_limiter(limit=10)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                assert c.allow_n("k", 10).allowed
+                assert not c.allow("k").allowed
+                c.reset("k")
+                assert c.allow("k").allowed
+        lim.close()
+
+    def test_batch_rpc_exactness(self):
+        lim, _ = _mk_limiter(limit=3)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                res = c.allow_batch(["h", "h", "h", "h", "x"], [1, 1, 1, 1, 2])
+                assert [r.allowed for r in res] == [True, True, True, False,
+                                                   True]
+                assert res[0].limit == 3
+        lim.close()
+
+    def test_validation_errors_typed(self):
+        lim, _ = _mk_limiter()
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                with pytest.raises(InvalidNError):
+                    c.allow_n("k", 0)
+                with pytest.raises(InvalidKeyError):
+                    c.allow("")
+                with pytest.raises(InvalidNError):
+                    c.allow_batch(["a", "b"], [1, 0])
+                assert c.allow("k").allowed  # connection survives
+        lim.close()
+
+    def test_health_and_metrics(self):
+        from ratelimiter_tpu.observability import Registry
+
+        lim, _ = _mk_limiter()
+        with running(lim, registry=Registry()) as (srv, port):
+            with Client(port=port) as c:
+                serving, uptime, decisions = c.health()
+                assert serving and decisions == 0
+                c.allow("k")
+                _, _, decisions = c.health()
+                assert decisions == 1
+                assert "rate_limiter_server_batch_size" in c.metrics()
+            assert srv.stats()["decisions_total"] == 1
+        lim.close()
+
+    def test_concurrent_clients_global_exactness(self):
+        """The flagship invariant through the native batcher: 150
+        concurrent requests on a limit-100 key admit exactly 100."""
+        lim, _ = _mk_limiter(limit=100)
+        with running(lim, max_batch=512, max_delay=2e-3) as (_, port):
+            allowed = []
+            lock = threading.Lock()
+
+            def worker(count):
+                with Client(port=port) as c:
+                    mine = [c.allow("hot").allowed for _ in range(count)]
+                with lock:
+                    allowed.extend(mine)
+
+            threads = [threading.Thread(target=worker, args=(15,))
+                       for _ in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(allowed) == 150
+            assert sum(allowed) == 100
+        lim.close()
+
+    def test_sketch_fast_path_with_prefix(self):
+        """Sketch limiters take the no-decode packed-hash path; the key
+        prefix must namespace exactly like the string path."""
+        lim, _ = _mk_limiter(limit=4, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch", key_prefix="app1")
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                for _ in range(4):
+                    assert c.allow("user:7").allowed
+                assert not c.allow("user:7").allowed
+        # Same counters as the library path under the same prefix.
+        assert not lim.allow("user:7").allowed
+        lim.close()
+
+    def test_fail_open_through_native_server(self):
+        lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch", fail_open=True)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                assert c.allow("k").allowed
+                lim.inject_failure()
+                res = c.allow("k")
+                assert res.allowed and res.fail_open
+        lim.close()
+
+    def test_fail_closed_through_native_server(self):
+        lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch", fail_open=False)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                assert c.allow("k").allowed
+                lim.inject_failure()
+                with pytest.raises(StorageUnavailableError):
+                    c.allow("k")
+        lim.close()
+
+    def test_unicode_keys(self):
+        lim, _ = _mk_limiter(limit=2)
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                assert c.allow("ключ:héllo").allowed
+                assert c.allow("ключ:héllo").allowed
+                assert not c.allow("ключ:héllo").allowed
+        lim.close()
+
+    def test_pipelined_coalescing(self):
+        """Many concurrent scalar requests share dispatches (batch-size
+        histogram must show multi-request batches)."""
+        import asyncio
+
+        from ratelimiter_tpu.observability import Registry
+        from ratelimiter_tpu.serving import AsyncClient
+
+        reg = Registry()
+        lim, _ = _mk_limiter(limit=100000)
+        with running(lim, registry=reg, max_batch=4096,
+                     max_delay=5e-3) as (_, port):
+            async def burst():
+                c = await AsyncClient.connect(port=port)
+                res = await c.allow_many([f"k{i % 50}" for i in range(400)])
+                await c.close()
+                return res
+
+            res = asyncio.run(burst())
+            assert all(r.allowed for r in res
+                       if not isinstance(r, Exception))
+        h = reg.get("rate_limiter_server_batch_size")
+        assert h.count() < 400 and h.sum() == 400.0
+        lim.close()
+
+    def test_graceful_shutdown_drains(self):
+        lim, _ = _mk_limiter(limit=10000)
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, max_delay=20e-3)
+        srv.start()
+        results = []
+
+        def client_burst():
+            with Client(port=srv.port) as c:
+                try:
+                    results.extend(c.allow(f"k{i}").allowed
+                                   for i in range(20))
+                except Exception:
+                    pass
+
+        t = threading.Thread(target=client_burst)
+        t.start()
+        import time
+
+        time.sleep(0.02)
+        srv.shutdown()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert all(results)
+        lim.close()
+
+
+class TestPrefixPack:
+    def test_prefix_pack_matches_python(self):
+        from ratelimiter_tpu.serving.native_server import _prefix_pack
+
+        keys = ["a", "user:42", "", "xyz"]
+        blob = "".join(keys).encode()
+        lengths = np.array([len(k) for k in keys], dtype=np.int64)
+        offsets = np.concatenate(([0], np.cumsum(lengths)[:-1]))
+        buf = np.frombuffer(blob, dtype=np.uint8)
+        nb, no, nl = _prefix_pack(buf, offsets, lengths, b"pre:")
+        out = [bytes(nb[o:o + l]).decode() for o, l in zip(no.tolist(),
+                                                           nl.tolist())]
+        assert out == [f"pre:{k}" for k in keys]
