@@ -211,8 +211,13 @@ def main() -> None:
         from benchmarks.e2e import _drive, _spawn_server
         import asyncio
 
-        proc, port = _spawn_server("sketch", platform="cpu",
-                                   max_batch=4096, max_delay_us=500.0)
+        try:  # native C++ front door first; asyncio as fallback
+            proc, port = _spawn_server("sketch", platform="cpu",
+                                       max_batch=4096, max_delay_us=500.0,
+                                       native=True)
+        except Exception:
+            proc, port = _spawn_server("sketch", platform="cpu",
+                                       max_batch=4096, max_delay_us=500.0)
         try:
             e2e_out = asyncio.run(_drive(port, seconds=4.0, conns=4,
                                          window=2048, n_keys=100_000))

@@ -86,6 +86,31 @@ class RateLimiter(abc.ABC):
             self._closed = True
             self._close()
 
+    def update_limit(self, new_limit: int) -> None:
+        """Change the limit without losing state (the reference's
+        'dynamic configuration' roadmap item, ``ROADMAP.md``).
+
+        Semantics: takes effect for every subsequent decision; quota
+        already consumed stands. For the token bucket the refill rate
+        (limit/window) and capacity both change; stored levels clamp to
+        the new capacity lazily on each key's next refill. The window
+        cannot change dynamically (it defines the state's time geometry
+        — build a new limiter for that)."""
+        from dataclasses import replace
+
+        self._check_open()
+        new_cfg = replace(self.config, limit=new_limit)
+        new_cfg.validate()
+        self._apply_config(new_cfg)
+        self.config = new_cfg
+
+    def _apply_config(self, new_cfg: Config) -> None:
+        """Backend hook: rebuild compiled steps / derived constants for
+        the new config. Default covers host-state backends with no
+        compiled artifacts."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support dynamic limit updates")
+
     # -- batched API (TPU-native first-class) -----------------------------
 
     def allow_batch(

@@ -67,6 +67,32 @@ class DenseLimiter(RateLimiter):
         self._lock = threading.Lock()
         self._injected_failure: Optional[Exception] = None
 
+    def _apply_config(self, new_cfg: Config) -> None:
+        """Dynamic limit: swap in the step compiled for the new limit
+        (memoized per config). Window state carries over untouched;
+        token-bucket levels shift by the limit delta clamped to
+        [0, new_cap] (the consumption-stands contract, see
+        exact.ExactLimiter._apply_config) and the pristine row used for
+        fresh slots moves to the new full level."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import dense_kernels
+
+        new_step = dense_kernels.build_step(new_cfg)
+        with self._lock:
+            self._step = new_step
+            if self.config.algorithm is Algorithm.TOKEN_BUCKET:
+                delta = (new_cfg.limit - self.config.limit) * 1_000_000
+                cap = new_cfg.limit * 1_000_000
+                self._state = dict(
+                    self._state,
+                    tokens=jnp.clip(self._state["tokens"] + delta, 0, cap),
+                    rem=jnp.zeros_like(self._state["rem"]),
+                )
+                self._fresh_row = dict(self._fresh_row,
+                                       tokens=np.asarray(cap, dtype=np.int64),
+                                       rem=np.asarray(0, dtype=np.int64))
+
     # ------------------------------------------------------------ slot admin
 
     def _assign_slots(self, keys: List[str], now_us: int) -> np.ndarray:

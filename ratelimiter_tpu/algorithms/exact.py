@@ -75,6 +75,23 @@ class ExactLimiter(RateLimiter):
         # token bucket: formatted key -> (tokens_micro, refill_remainder, last_us)
         self._tb: Dict[str, Tuple[int, int, int]] = {}
 
+    def _apply_config(self, new_cfg: Config) -> None:
+        """Dynamic limit. The cross-backend contract (pinned in
+        tests/test_dynamic_config.py): CONSUMPTION STANDS — available
+        quota becomes max(0, new_limit - consumed). For the token bucket
+        that means stored levels shift by the limit delta (clamped to
+        [0, new_cap]), matching the sketch backend's debt form exactly;
+        refill remainders reset (forfeits < 1 micro-token, toward
+        denying)."""
+        with self._lock:
+            delta = (new_cfg.limit - self.config.limit) * MICROS
+            cap = new_cfg.limit * MICROS
+            self._tb = {k: (min(max(t + delta, 0), cap), 0, last)
+                        for k, (t, _rem, last) in self._tb.items()}
+            g = math.gcd(new_cfg.limit * MICROS, self._window_us)
+            self._rate_num = new_cfg.limit * MICROS // g
+            self._rate_den = self._window_us // g
+
     # ------------------------------------------------------------------ allow
 
     def _allow_n(self, key: str, n: int, now: float) -> Result:

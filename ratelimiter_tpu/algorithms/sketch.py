@@ -191,6 +191,18 @@ class SketchLimiter(RateLimiter):
     def _close(self) -> None:
         self._state = {}
 
+    # ------------------------------------------------- dynamic config
+
+    def _apply_config(self, new_cfg: Config) -> None:
+        """Dynamic limit: geometry (window/sub-windows/depth/width) is
+        unchanged, so the state arrays carry over; only the compiled
+        steps (which bake the limit) are swapped."""
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        steps = sketch_kernels.build_steps(new_cfg)
+        with self._lock:
+            self._step, self._reset_step, self._rollover = steps
+
     # ------------------------------------------------- checkpoint/restore
 
     _CKPT_KIND = "sketch"
@@ -277,6 +289,20 @@ class SketchTokenBucketLimiter(SketchLimiter):
 
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
+
+    def _apply_config(self, new_cfg: Config) -> None:
+        """Dynamic limit: refill rate (limit/window) and capacity both
+        change; the debt slab carries over. The sub-micro-token decay
+        remainder is denominated in the old rate fraction, so it resets
+        (forfeits < 1 micro-token of accrued refill, toward denying)."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        steps = bucket_kernels.build_steps(new_cfg)
+        with self._lock:
+            self._step, self._reset_step = steps
+            self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
 
     def _finish(self, outs, b: int, now_us: int) -> BatchResult:
         """Token-bucket result assembly: retry-after = deficit / refill rate

@@ -27,5 +27,18 @@ meshes (jax.distributed); cadence over DCN is the accuracy/bandwidth knob.
 
 from ratelimiter_tpu.parallel.mesh import make_mesh, mesh_axis
 from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter, MeshTokenBucketLimiter
+from ratelimiter_tpu.parallel.dcn import (
+    DcnMirrorGroup,
+    export_completed,
+    merge_completed,
+)
 
-__all__ = ["make_mesh", "mesh_axis", "MeshSketchLimiter", "MeshTokenBucketLimiter"]
+__all__ = [
+    "DcnMirrorGroup",
+    "MeshSketchLimiter",
+    "MeshTokenBucketLimiter",
+    "export_completed",
+    "make_mesh",
+    "merge_completed",
+    "mesh_axis",
+]

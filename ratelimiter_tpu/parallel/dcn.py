@@ -1,0 +1,174 @@
+"""DCN tier: cross-pod merge of completed sub-window slabs.
+
+The mesh limiters (parallel/limiter.py) keep one pod coherent with a
+per-step ICI collective. Across pods (or regions) a per-step collective
+is unaffordable; the reference's analog is Redis Cluster spanning
+deployments, with NTP-skew-bounded inconsistency
+(reference ``docs/ALGORITHMS.md:162``). Here the unit of exchange is the
+**completed sub-window slab**: once the rollover kernel flushes a
+sub-window, that (d, w) slab is immutable local history — pods exchange
+those slabs over any transport and fold them into each other's rings.
+
+Consistency contract (tested in tests/test_dcn.py):
+
+* a key's traffic on pod A is invisible to pod B until the sub-window
+  containing it completes and a sync runs — cross-pod over-admission is
+  bounded by ``n_pods x limit`` per (sub-window + sync cadence), the
+  same envelope as the mesh delta mode one level up;
+* after a sync, every pod's window estimate includes all pods' completed
+  traffic, and expiry needs no coordination (slabs age out of each ring
+  by the same period arithmetic everywhere);
+* exports carry ONLY local traffic: a slab is captured at flush time
+  (before any foreign merge can land in it), so fan-out topologies never
+  double-count. The in-process ``DcnMirrorGroup`` enforces the
+  export-all-then-merge-all order; a real transport must do the same
+  per cycle.
+
+Windowed sketch algorithms only; the token bucket's DCN story (debt
+deltas) is ROADMAP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from ratelimiter_tpu.algorithms.sketch import SketchLimiter, SketchTokenBucketLimiter
+from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.ops import sketch_kernels
+
+
+def _check(lim: SketchLimiter) -> None:
+    if isinstance(lim, SketchTokenBucketLimiter):
+        raise InvalidConfigError(
+            "DCN slab exchange applies to windowed sketch limiters; the "
+            "token bucket's debt-delta exchange is not implemented yet")
+
+
+def export_completed(lim: SketchLimiter, after_period: int,
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """(periods int64[k], slabs int32[k, d, w]): every completed
+    sub-window with period > after_period still present in the ring.
+    Call before merging foreign data for those periods (module
+    docstring)."""
+    _check(lim)
+    _, _, SW, S, _ = sketch_kernels.sketch_geometry(lim.config)
+    with lim._lock:
+        sp = np.asarray(lim._state["slab_period"])
+        last = int(np.asarray(lim._state["last_period"]))
+        # In-window completed periods only: [last-SW, last-1]. This also
+        # excludes the _NEVER sentinel slab the first rollover flushes.
+        take = [(int(p), slot) for slot, p in enumerate(sp.tolist())
+                if after_period < p < last and p >= last - SW]
+        take.sort()
+        if not take:
+            d, w = lim.config.sketch.depth, lim.config.sketch.width
+            return (np.empty(0, np.int64), np.empty((0, d, w), np.int32))
+        periods = np.array([p for p, _ in take], dtype=np.int64)
+        slabs = np.stack([np.asarray(lim._state["slabs"][slot])
+                          for _, slot in take])
+    return periods, slabs
+
+
+def merge_completed(lim: SketchLimiter, periods: np.ndarray,
+                    slabs: np.ndarray) -> Tuple[int, int]:
+    """Fold foreign completed slabs into the local ring; returns
+    (applied_count, max_applied_period) — the second value is what a
+    sync driver feeds back into its export watermark: once foreign data
+    merges into a period, that period must not be exported again (its
+    local content already was, under the export-before-merge order), or
+    fan-out topologies double-count. Rules per period p (local
+    slot = p mod S):
+
+    * p >= local current period: dropped (not completed locally; the
+      next cycle re-delivers it — the exporter should lag one period);
+    * slot already holds p: slabs add (another pod's view of the same
+      sub-window);
+    * slot holds something older: the foreign slab replaces it (the old
+      content is out-of-window by ring geometry);
+    * slot holds something newer: dropped (foreign data already expired).
+
+    ``totals`` is rebuilt as (in-window slabs) + ``cur`` so estimates see
+    the merged history immediately.
+    """
+    import jax.numpy as jnp
+
+    _check(lim)
+    if periods.shape[0] == 0:
+        return 0, -(1 << 62)
+    W, sub_us, SW, S, _limit = sketch_kernels.sketch_geometry(lim.config)
+    applied = 0
+    max_applied = -(1 << 62)
+    with lim._lock:
+        sp = np.array(np.asarray(lim._state["slab_period"]))  # writable copy
+        last = int(np.asarray(lim._state["last_period"]))
+        new_slabs = lim._state["slabs"]
+        new_sp = lim._state["slab_period"]
+        for p_np, slab in zip(periods.tolist(), slabs):
+            p = int(p_np)
+            if p >= last:
+                continue
+            slot = p % S
+            cur_p = int(sp[slot])
+            if cur_p == p:
+                new_slabs = new_slabs.at[slot].add(jnp.asarray(slab))
+            elif cur_p < p:
+                new_slabs = new_slabs.at[slot].set(jnp.asarray(slab))
+                new_sp = new_sp.at[slot].set(p)
+                sp[slot] = p
+            else:
+                continue
+            applied += 1
+            max_applied = max(max_applied, p)
+        if applied:
+            in_window = ((new_sp >= last - SW + 1) &
+                         (new_sp <= last - 1)).astype(jnp.int32)
+            totals = (jnp.tensordot(in_window, new_slabs, axes=1)
+                      + lim._state["cur"])
+            lim._state = dict(lim._state, slabs=new_slabs,
+                              slab_period=new_sp, totals=totals)
+    return applied, max_applied
+
+
+class DcnMirrorGroup:
+    """In-process mirror of a multi-pod deployment: N windowed sketch
+    limiters (the 'pods'), synced by exchanging completed slabs. This is
+    the test/simulation harness — in production the same two calls wrap
+    any transport (the export payload is plain numpy arrays)."""
+
+    def __init__(self, pods: Sequence[SketchLimiter]):
+        if not pods:
+            raise InvalidConfigError("DcnMirrorGroup needs >= 1 pod")
+        for p in pods:
+            _check(p)
+        fp = {sketch_kernels.sketch_geometry(p.config) for p in pods}
+        if len(fp) != 1:
+            raise InvalidConfigError(
+                "all pods must share algorithm geometry (window, "
+                "sub-windows, depth, width, limit)")
+        self.pods: List[SketchLimiter] = list(pods)
+        self._exported_up_to: Dict[int, int] = {i: -(1 << 62)
+                                                for i in range(len(pods))}
+
+    def sync(self) -> int:
+        """One exchange cycle: export every pod's new completed slabs,
+        then merge everything into everyone else. Returns the number of
+        slab applications across the group."""
+        exports = []
+        for i, pod in enumerate(self.pods):
+            periods, slabs = export_completed(pod, self._exported_up_to[i])
+            if periods.shape[0]:
+                self._exported_up_to[i] = int(periods.max())
+            exports.append((periods, slabs))
+        applied = 0
+        for i, pod in enumerate(self.pods):
+            for j, (periods, slabs) in enumerate(exports):
+                if i == j or periods.shape[0] == 0:
+                    continue
+                n, max_p = merge_completed(pod, periods, slabs)
+                applied += n
+                # Foreign-merged periods must not re-export from pod i
+                # (their local content went out in THIS cycle's export).
+                self._exported_up_to[i] = max(self._exported_up_to[i], max_p)
+        return applied

@@ -93,6 +93,11 @@ class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
             mesh_kernels.build_mesh_steps(self.config, self.mesh, merge))
         self._state = mesh_kernels.replicate_state(self._state, self.mesh)
 
+    def _apply_config(self, new_cfg):
+        steps = mesh_kernels.build_mesh_steps(new_cfg, self.mesh, self.merge)
+        with self._lock:
+            self._step, self._reset_step, self._rollover = steps
+
 
 class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
     """Sketched token bucket spanning a mesh: replicated debt slab, batch
@@ -111,3 +116,13 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
         self._step, self._reset_step = mesh_kernels.build_mesh_bucket_steps(
             self.config, self.mesh, merge)
         self._state = mesh_kernels.replicate_state(self._state, self.mesh)
+
+    def _apply_config(self, new_cfg):
+        import jax.numpy as jnp
+
+        steps = mesh_kernels.build_mesh_bucket_steps(new_cfg, self.mesh,
+                                                     self.merge)
+        with self._lock:
+            self._step, self._reset_step = steps
+            self._state = dict(self._state, rem=self._place_replicated(
+                jnp.asarray(0, jnp.int64)))

@@ -1,0 +1,146 @@
+"""DCN tier: cross-pod completed-slab exchange (parallel/dcn.py)."""
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+from ratelimiter_tpu.parallel.dcn import (
+    DcnMirrorGroup,
+    export_completed,
+    merge_completed,
+)
+
+T0 = 1_700_000_000.0
+
+
+def pod(limit=10, window=6.0, sub_windows=6, width=4096, start=T0):
+    clock = ManualClock(start)
+    cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=limit, window=window,
+                 sketch=SketchParams(depth=4, width=width,
+                                     sub_windows=sub_windows))
+    return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+
+class TestExportMerge:
+    def test_export_only_completed_periods(self):
+        lim, clock = pod()
+        lim.allow_n("k", 3)                      # current sub-window: not done
+        periods, slabs = export_completed(lim, -(1 << 62))
+        assert periods.shape[0] == 0
+        clock.advance(1.0)
+        lim.allow("k")                           # rolls the period over
+        periods, slabs = export_completed(lim, -(1 << 62))
+        assert periods.shape[0] == 1
+        assert slabs[0].sum() >= 3 * 4           # 3 requests x depth cells
+        lim.close()
+
+    def test_merge_makes_foreign_traffic_visible(self):
+        a, ca = pod()
+        b, cb = pod()
+        assert a.allow_n("k", 10).allowed        # pod A: key exhausted
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")                          # roll A's period
+        b.allow("warm")                          # roll B's period too
+        assert b.allow_n("k", 10).allowed        # B hasn't heard about A yet
+        periods, slabs = export_completed(a, -(1 << 62))
+        assert merge_completed(b, periods, slabs)[0] == 1
+        # B now sees A's 10 on top of its own 10: hard deny.
+        assert not b.allow("k").allowed
+        a.close()
+        b.close()
+
+    def test_incomplete_foreign_periods_dropped(self):
+        a, ca = pod()
+        b, _cb = pod()
+        a.allow_n("k", 5)
+        ca.advance(1.0)
+        a.allow("warm")                          # A completed period; B did not
+        periods, slabs = export_completed(a, -(1 << 62))
+        assert merge_completed(b, periods, slabs)[0] == 0  # b still at period 0
+        a.close()
+        b.close()
+
+    def test_token_bucket_rejected(self):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0)
+        tb = create_limiter(cfg, backend="sketch", clock=clock)
+        with pytest.raises(InvalidConfigError):
+            export_completed(tb, 0)
+        tb.close()
+
+
+class TestMirrorGroup:
+    def test_cross_pod_convergence_and_envelope(self):
+        """Over-admission bounded by n_pods*limit per (sub-window+sync);
+        after sync every pod denies — the documented DCN contract."""
+        pods = [pod(limit=10) for _ in range(3)]
+        group = DcnMirrorGroup([p for p, _ in pods])
+        total = 0
+        for p, _ in pods:
+            out = p.allow_batch(["hot"] * 12)
+            total += out.allow_count
+        assert 10 <= total <= 3 * 10             # pre-sync envelope
+        for _, c in pods:
+            c.advance(1.0)
+        for p, _ in pods:
+            p.allow("warm")                      # complete the sub-window
+        group.sync()
+        for p, _ in pods:
+            assert not p.allow("hot").allowed    # global history visible
+        # Expiry needs no coordination: everything ages out everywhere.
+        for _, c in pods:
+            c.advance(15.0)                      # > 2 windows
+        for p, _ in pods:
+            assert p.allow("hot").allowed
+            p.close()
+
+    def test_no_double_counting_across_cycles(self):
+        """Repeated syncs must not re-apply the same slabs (exports carry
+        only local traffic, tracked per pod)."""
+        (a, ca), (b, cb) = pod(limit=10), pod(limit=10)
+        group = DcnMirrorGroup([a, b])
+        a.allow_n("k", 4)
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")
+        b.allow("warm")
+        assert group.sync() == 1
+        assert group.sync() == 0                 # nothing new: no re-apply
+        # b sees exactly 4 consumed: 6 remain under the global view.
+        assert b.allow_n("k", 6).allowed
+        assert not b.allow("k").allowed
+        a.close()
+        b.close()
+
+    def test_mixed_geometry_rejected(self):
+        (a, _), (b, _) = pod(limit=10), pod(limit=11)
+        with pytest.raises(InvalidConfigError):
+            DcnMirrorGroup([a, b])
+        a.close()
+        b.close()
+
+    def test_sync_during_stale_ring_replaces_expired_slots(self):
+        """A pod idle for a full ring wrap accepts fresh foreign slabs
+        into slots still holding ancient periods."""
+        (a, ca), (b, cb) = pod(limit=10), pod(limit=10)
+        group = DcnMirrorGroup([a, b])
+        # Both pods advance far (ring wraps), then traffic on A only.
+        for c in (ca, cb):
+            c.advance(100.0)
+        a.allow_n("k", 10)
+        b.allow("other")
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")
+        b.allow("warm")
+        group.sync()
+        assert not b.allow("k").allowed
+        a.close()
+        b.close()

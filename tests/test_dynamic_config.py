@@ -1,0 +1,147 @@
+"""Dynamic limit updates: state survives, new limit governs (the
+reference's 'dynamic configuration' roadmap item, realized)."""
+
+import jax
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+
+T0 = 1_700_000_000.0
+
+BACKEND_ALGOS = [
+    ("exact", Algorithm.FIXED_WINDOW),
+    ("exact", Algorithm.SLIDING_WINDOW),
+    ("exact", Algorithm.TOKEN_BUCKET),
+    ("dense", Algorithm.SLIDING_WINDOW),
+    ("dense", Algorithm.TOKEN_BUCKET),
+    ("sketch", Algorithm.TPU_SKETCH),
+    ("sketch", Algorithm.FIXED_WINDOW),
+    ("sketch", Algorithm.TOKEN_BUCKET),
+]
+
+
+@pytest.mark.parametrize("backend,algo", BACKEND_ALGOS, ids=str)
+def test_raise_limit_keeps_consumption(backend, algo):
+    clock = ManualClock(T0)
+    lim = create_limiter(Config(algorithm=algo, limit=5, window=60.0),
+                         backend=backend, clock=clock)
+    assert lim.allow_n("k", 5).allowed
+    assert not lim.allow("k").allowed
+    lim.update_limit(8)
+    # Consumption stands: 3 more, not 8.
+    assert lim.allow_n("k", 3).allowed
+    assert not lim.allow("k").allowed
+    assert lim.allow("k2").allowed  # other keys see the new limit too
+    lim.close()
+
+
+@pytest.mark.parametrize("backend,algo", BACKEND_ALGOS, ids=str)
+def test_lower_limit_denies_immediately(backend, algo):
+    clock = ManualClock(T0)
+    lim = create_limiter(Config(algorithm=algo, limit=10, window=60.0),
+                         backend=backend, clock=clock)
+    assert lim.allow_n("k", 4).allowed
+    lim.update_limit(4)
+    assert not lim.allow("k").allowed       # 4 of 4 used
+    assert lim.allow_n("fresh", 4).allowed  # new keys get the new limit
+    assert not lim.allow("fresh").allowed
+    lim.close()
+
+
+@pytest.mark.parametrize("backend", ["exact", "dense", "sketch"])
+def test_token_bucket_rate_and_capacity_change(backend):
+    # Consumption-stands contract: after spending 10 of 10, raising the
+    # limit to 20 leaves 10 immediately spendable (consumed 10 of 20),
+    # and the refill rate doubles (limit/window) from now on.
+    clock = ManualClock(T0)
+    lim = create_limiter(
+        Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0),
+        backend=backend, clock=clock)
+    assert lim.allow_n("k", 10).allowed
+    assert not lim.allow("k").allowed
+    lim.update_limit(20)  # rate 1/s -> 2/s; capacity 20
+    assert lim.allow_n("k", 10).allowed     # the raised headroom
+    assert not lim.allow("k").allowed
+    clock.advance(1.0)
+    assert lim.allow_n("k", 2).allowed      # 2 tokens in 1 s at the new rate
+    assert not lim.allow("k").allowed
+    lim.close()
+
+
+def test_result_limit_field_reflects_update():
+    lim = create_limiter(
+        Config(algorithm=Algorithm.SLIDING_WINDOW, limit=5, window=60.0),
+        backend="exact", clock=ManualClock(T0))
+    assert lim.allow("k").limit == 5
+    lim.update_limit(7)
+    assert lim.allow("k").limit == 7
+    lim.close()
+
+
+def test_invalid_limit_rejected_state_intact():
+    lim = create_limiter(
+        Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3, window=60.0),
+        backend="sketch", clock=ManualClock(T0))
+    assert lim.allow_n("k", 3).allowed
+    with pytest.raises(InvalidConfigError):
+        lim.update_limit(0)
+    with pytest.raises(InvalidConfigError):
+        lim.update_limit(1 << 24)  # sketch gate
+    assert lim.config.limit == 3
+    assert not lim.allow("k").allowed  # state untouched by failed updates
+    lim.close()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_mesh_update_limit():
+    from ratelimiter_tpu.parallel import MeshSketchLimiter, MeshTokenBucketLimiter, make_mesh
+
+    mesh = make_mesh(n_devices=8)
+    cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0,
+                 sketch=SketchParams(depth=2, width=256, sub_windows=6))
+    lim = MeshSketchLimiter(cfg, ManualClock(T0), mesh=mesh, merge="gather")
+    out = lim.allow_batch(["hot"] * 32)
+    assert out.allow_count == 10
+    lim.update_limit(20)
+    out = lim.allow_batch(["hot"] * 32)
+    assert out.allow_count == 10  # 10 more under the raised limit
+    lim.close()
+
+    cfg_tb = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0,
+                    sketch=SketchParams(depth=2, width=256))
+    lim = MeshTokenBucketLimiter(cfg_tb, ManualClock(T0), mesh=mesh)
+    assert lim.allow_batch(["k"] * 16).allow_count == 10
+    lim.update_limit(16)
+    assert lim.allow_batch(["k"] * 16).allow_count == 6
+    lim.close()
+
+
+def test_checkpoint_fingerprint_tracks_updated_limit(tmp_path):
+    # A snapshot taken after update_limit restores only into a limiter
+    # configured with the NEW limit.
+    path = str(tmp_path / "snap.npz")
+    cfg5 = Config(algorithm=Algorithm.TPU_SKETCH, limit=5, window=60.0)
+    lim = create_limiter(cfg5, backend="sketch", clock=ManualClock(T0))
+    lim.update_limit(9)
+    lim.allow_n("k", 9)
+    lim.save(path)
+    lim.close()
+
+    from ratelimiter_tpu import CheckpointError
+
+    wrong = create_limiter(cfg5, backend="sketch", clock=ManualClock(T0))
+    with pytest.raises(CheckpointError):
+        wrong.restore(path)
+    wrong.close()
+    cfg9 = Config(algorithm=Algorithm.TPU_SKETCH, limit=9, window=60.0)
+    right = create_limiter(cfg9, backend="sketch", clock=ManualClock(T0))
+    right.restore(path)
+    assert not right.allow("k").allowed
+    right.close()
