@@ -215,9 +215,11 @@ def main() -> None:
             proc, port = _spawn_server("sketch", platform="cpu",
                                        max_batch=4096, max_delay_us=500.0,
                                        native=True)
+            front_door = "native"
         except Exception:
             proc, port = _spawn_server("sketch", platform="cpu",
                                        max_batch=4096, max_delay_us=500.0)
+            front_door = "asyncio"
         try:
             e2e_out = asyncio.run(_drive(port, seconds=4.0, conns=4,
                                          window=2048, n_keys=100_000))
@@ -225,6 +227,9 @@ def main() -> None:
                 "e2e_server_decisions_per_sec": e2e_out["decisions_per_sec"],
                 "e2e_server_scalar_p50_ms": e2e_out["scalar_p50_ms"],
                 "e2e_server_scalar_p99_ms": e2e_out["scalar_p99_ms"],
+                # Which front door actually served (numbers are not
+                # comparable across the two implementations).
+                "e2e_server_front_door": front_door,
             }
         finally:
             proc.terminate()

@@ -105,9 +105,10 @@ class RateLimiter(abc.ABC):
         self.config = new_cfg
 
     def _apply_config(self, new_cfg: Config) -> None:
-        """Backend hook: rebuild compiled steps / derived constants for
-        the new config. Default covers host-state backends with no
-        compiled artifacts."""
+        """Backend hook: rebuild compiled steps / derived constants /
+        stored levels for the new config. Every backend must override
+        (even host-state ones derive rate fractions from the limit);
+        the base raises so an unimplemented backend fails loudly."""
         raise NotImplementedError(
             f"{type(self).__name__} does not support dynamic limit updates")
 

@@ -82,8 +82,8 @@ class DenseLimiter(RateLimiter):
         with self._lock:
             self._step = new_step
             if self.config.algorithm is Algorithm.TOKEN_BUCKET:
-                delta = (new_cfg.limit - self.config.limit) * 1_000_000
-                cap = new_cfg.limit * 1_000_000
+                delta = (new_cfg.limit - self.config.limit) * MICROS
+                cap = new_cfg.limit * MICROS
                 self._state = dict(
                     self._state,
                     tokens=jnp.clip(self._state["tokens"] + delta, 0, cap),

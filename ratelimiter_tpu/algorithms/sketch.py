@@ -292,17 +292,24 @@ class SketchTokenBucketLimiter(SketchLimiter):
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit: refill rate (limit/window) and capacity both
-        change; the debt slab carries over. The sub-micro-token decay
-        remainder is denominated in the old rate fraction, so it resets
-        (forfeits < 1 micro-token of accrued refill, toward denying)."""
+        change; the debt slab carries over, CLAMPED to the new capacity —
+        the exact mirror of the token-form backends clamping levels to
+        [0, new_cap], so lowering a limit recovers identically across
+        backends. The sub-micro-token decay remainder is denominated in
+        the old rate fraction, so it resets (forfeits < 1 micro-token of
+        accrued refill, toward denying)."""
         import jax.numpy as jnp
 
+        from ratelimiter_tpu.core.clock import MICROS as _MICROS
         from ratelimiter_tpu.ops import bucket_kernels
 
         steps = bucket_kernels.build_steps(new_cfg)
+        cap = new_cfg.limit * _MICROS
         with self._lock:
             self._step, self._reset_step = steps
-            self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
+            self._state = dict(self._state,
+                               debt=jnp.minimum(self._state["debt"], cap),
+                               rem=jnp.asarray(0, jnp.int64))
 
     def _finish(self, outs, b: int, now_us: int) -> BatchResult:
         """Token-bucket result assembly: retry-after = deficit / refill rate

@@ -105,6 +105,11 @@ class LimiterDecorator(RateLimiter):
         self._closed = True
         self.inner.close()
 
+    def update_limit(self, new_limit: int) -> None:
+        # Delegate wholesale (config lives on the inner limiter; the
+        # decorator's config property reflects it automatically).
+        self.inner.update_limit(new_limit)
+
     # Pass-through for backend extras (allow_hashed, inject_failure, ...) --
 
     def __getattr__(self, name: str):

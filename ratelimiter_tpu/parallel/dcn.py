@@ -47,11 +47,14 @@ def _check(lim: SketchLimiter) -> None:
 
 
 def export_completed(lim: SketchLimiter, after_period: int,
-                     ) -> Tuple[np.ndarray, np.ndarray]:
-    """(periods int64[k], slabs int32[k, d, w]): every completed
-    sub-window with period > after_period still present in the ring.
-    Call before merging foreign data for those periods (module
-    docstring)."""
+                     ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """(periods int64[k], slabs int32[k, d, w], last_period): every
+    completed sub-window with period > after_period still present in the
+    ring, plus the pod's current period. The caller's next watermark is
+    ``last_period - 1`` — NOT the max exported period — so periods that
+    complete (or receive foreign merges) after this snapshot still
+    export next cycle. Call before merging foreign data for the cycle
+    (module docstring)."""
     _check(lim)
     _, _, SW, S, _ = sketch_kernels.sketch_geometry(lim.config)
     with lim._lock:
@@ -64,21 +67,29 @@ def export_completed(lim: SketchLimiter, after_period: int,
         take.sort()
         if not take:
             d, w = lim.config.sketch.depth, lim.config.sketch.width
-            return (np.empty(0, np.int64), np.empty((0, d, w), np.int32))
+            return (np.empty(0, np.int64), np.empty((0, d, w), np.int32),
+                    last)
         periods = np.array([p for p, _ in take], dtype=np.int64)
         slabs = np.stack([np.asarray(lim._state["slabs"][slot])
                           for _, slot in take])
-    return periods, slabs
+    return periods, slabs, last
 
 
 def merge_completed(lim: SketchLimiter, periods: np.ndarray,
                     slabs: np.ndarray) -> Tuple[int, int]:
     """Fold foreign completed slabs into the local ring; returns
-    (applied_count, max_applied_period) — the second value is what a
-    sync driver feeds back into its export watermark: once foreign data
-    merges into a period, that period must not be exported again (its
-    local content already was, under the export-before-merge order), or
-    fan-out topologies double-count. Rules per period p (local
+    (applied_count, max_applied_period).
+
+    Double-count safety comes from the caller's watermark discipline
+    (export watermark = exporter's ``last_period - 1`` at export time,
+    export-before-merge each cycle): every period a merge can touch
+    (p < receiver's last) is already at-or-below the receiver's own
+    export watermark, so foreign data never re-exports. The one race —
+    a rollover landing between a pod's export and its merges in the same
+    cycle — can transiently DOUBLE-COUNT one sub-window (the receiver
+    re-exports a contaminated slab next cycle); the error direction is
+    over-counting, i.e. extra denies, never over-admission, and it ages
+    out of the ring with the period. Rules per period p (local
     slot = p mod S):
 
     * p >= local current period: dropped (not completed locally; the
@@ -142,11 +153,16 @@ class DcnMirrorGroup:
             raise InvalidConfigError("DcnMirrorGroup needs >= 1 pod")
         for p in pods:
             _check(p)
-        fp = {sketch_kernels.sketch_geometry(p.config) for p in pods}
+        fp = {sketch_kernels.sketch_geometry(p.config)
+              + (p.config.sketch.depth, p.config.sketch.width,
+                 p.config.sketch.seed, p.config.prefix)
+              for p in pods}
         if len(fp) != 1:
             raise InvalidConfigError(
-                "all pods must share algorithm geometry (window, "
-                "sub-windows, depth, width, limit)")
+                "all pods must share algorithm geometry AND hashing "
+                "(window, sub-windows, limit, depth, width, seed, "
+                "prefix) — mismatched seeds would merge counts into "
+                "other keys' cells")
         self.pods: List[SketchLimiter] = list(pods)
         self._exported_up_to: Dict[int, int] = {i: -(1 << 62)
                                                 for i in range(len(pods))}
@@ -157,18 +173,18 @@ class DcnMirrorGroup:
         slab applications across the group."""
         exports = []
         for i, pod in enumerate(self.pods):
-            periods, slabs = export_completed(pod, self._exported_up_to[i])
-            if periods.shape[0]:
-                self._exported_up_to[i] = int(periods.max())
+            periods, slabs, last = export_completed(
+                pod, self._exported_up_to[i])
+            # Watermark = everything completed as of this export; merges
+            # this cycle only touch periods <= the watermark, so foreign
+            # data never re-exports (see merge_completed's docstring).
+            self._exported_up_to[i] = max(self._exported_up_to[i], last - 1)
             exports.append((periods, slabs))
         applied = 0
         for i, pod in enumerate(self.pods):
             for j, (periods, slabs) in enumerate(exports):
                 if i == j or periods.shape[0] == 0:
                     continue
-                n, max_p = merge_completed(pod, periods, slabs)
+                n, _max_p = merge_completed(pod, periods, slabs)
                 applied += n
-                # Foreign-merged periods must not re-export from pod i
-                # (their local content went out in THIS cycle's export).
-                self._exported_up_to[i] = max(self._exported_up_to[i], max_p)
         return applied

@@ -120,9 +120,14 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
     def _apply_config(self, new_cfg):
         import jax.numpy as jnp
 
+        from ratelimiter_tpu.core.clock import MICROS as _MICROS
+
         steps = mesh_kernels.build_mesh_bucket_steps(new_cfg, self.mesh,
                                                      self.merge)
+        cap = new_cfg.limit * _MICROS
         with self._lock:
             self._step, self._reset_step = steps
-            self._state = dict(self._state, rem=self._place_replicated(
-                jnp.asarray(0, jnp.int64)))
+            self._state = dict(
+                self._state,
+                debt=jnp.minimum(self._state["debt"], cap),
+                rem=self._place_replicated(jnp.asarray(0, jnp.int64)))

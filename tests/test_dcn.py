@@ -31,11 +31,11 @@ class TestExportMerge:
     def test_export_only_completed_periods(self):
         lim, clock = pod()
         lim.allow_n("k", 3)                      # current sub-window: not done
-        periods, slabs = export_completed(lim, -(1 << 62))
+        periods, slabs, _last = export_completed(lim, -(1 << 62))
         assert periods.shape[0] == 0
         clock.advance(1.0)
         lim.allow("k")                           # rolls the period over
-        periods, slabs = export_completed(lim, -(1 << 62))
+        periods, slabs, _last = export_completed(lim, -(1 << 62))
         assert periods.shape[0] == 1
         assert slabs[0].sum() >= 3 * 4           # 3 requests x depth cells
         lim.close()
@@ -49,7 +49,7 @@ class TestExportMerge:
         a.allow("warm")                          # roll A's period
         b.allow("warm")                          # roll B's period too
         assert b.allow_n("k", 10).allowed        # B hasn't heard about A yet
-        periods, slabs = export_completed(a, -(1 << 62))
+        periods, slabs, _last = export_completed(a, -(1 << 62))
         assert merge_completed(b, periods, slabs)[0] == 1
         # B now sees A's 10 on top of its own 10: hard deny.
         assert not b.allow("k").allowed
@@ -62,7 +62,7 @@ class TestExportMerge:
         a.allow_n("k", 5)
         ca.advance(1.0)
         a.allow("warm")                          # A completed period; B did not
-        periods, slabs = export_completed(a, -(1 << 62))
+        periods, slabs, _last = export_completed(a, -(1 << 62))
         assert merge_completed(b, periods, slabs)[0] == 0  # b still at period 0
         a.close()
         b.close()

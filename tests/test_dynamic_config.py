@@ -75,6 +75,29 @@ def test_token_bucket_rate_and_capacity_change(backend):
     lim.close()
 
 
+def test_token_bucket_lower_below_consumption_recovers_identically():
+    """Lowering a TB limit BELOW already-spent consumption: every backend
+    must clamp to the new capacity (debt form == token form) so recovery
+    takes new_cap/new_rate seconds everywhere, not old-debt/new_rate."""
+    results = {}
+    for backend in ("exact", "dense", "sketch"):
+        clock = ManualClock(T0)
+        lim = create_limiter(
+            Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0),
+            backend=backend, clock=clock)
+        assert lim.allow_n("k", 10).allowed     # spend the full bucket
+        lim.update_limit(2)                     # rate 1/s -> 0.2/s; cap 2
+        trace = []
+        for _ in range(12):
+            clock.advance(1.0)
+            trace.append(lim.allow("k").allowed)
+        results[backend] = trace
+        lim.close()
+    assert results["exact"] == results["dense"] == results["sketch"]
+    # cap 2, rate 0.2/s from a clamped-empty bucket: first token at 5 s.
+    assert results["exact"][:5] == [False] * 4 + [True]
+
+
 def test_result_limit_field_reflects_update():
     lim = create_limiter(
         Config(algorithm=Algorithm.SLIDING_WINDOW, limit=5, window=60.0),
