@@ -68,9 +68,11 @@ def _render_md(doc: dict) -> str:
             if "error" in r:
                 lines.append(f"| {r['variant']} | error: {r['error']} | | | |")
             else:
+                p50 = r.get("scalar_p50_ms", r.get("frame_p50_ms", "-"))
+                p99 = r.get("scalar_p99_ms", r.get("frame_p99_ms", "-"))
                 lines.append(
                     f"| {r['variant']} | {r['decisions_per_sec']:,} | "
-                    f"{r['scalar_p50_ms']} | {r['scalar_p99_ms']} | "
+                    f"{p50} | {p99} | "
                     f"{r['connections']}×{r['inflight_per_conn']} |")
         lines.append("")
     return "\n".join(lines)

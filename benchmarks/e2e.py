@@ -159,6 +159,47 @@ def _run_variant(name: str, backend: str, *, platform=None, seconds=6.0,
     return out
 
 
+def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
+    """Native server driven by the native C++ load generator
+    (clients/cpp/loadgen.cpp) — removes the Python client from the loop,
+    so this is the true server+decide ceiling."""
+    import json
+    import shutil
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return {"variant": "native server + native loadgen",
+                "error": "no g++"}
+    with tempfile.TemporaryDirectory() as td:
+        binary = os.path.join(td, "rltpu_loadgen")
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17",
+             os.path.join(REPO, "clients", "cpp", "loadgen.cpp"),
+             "-o", binary, "-pthread"],
+            check=True, capture_output=True, timeout=180)
+        proc, port = _spawn_server("sketch", platform="cpu", native=True)
+        try:
+            out = subprocess.run(
+                [binary, "127.0.0.1", str(port), str(seconds), "4", "8",
+                 "512", "100000"],
+                capture_output=True, text=True, timeout=seconds + 60)
+            row = json.loads(out.stdout.strip())
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    row["variant"] = ("NATIVE server + NATIVE loadgen, sketch on cpu "
+                      "(no Python in the client loop; latency is per "
+                      "512-key frame, not per scalar request)")
+    row["connections"] = row.pop("threads")
+    row["inflight_per_conn"] = (row.pop("inflight_frames")
+                                * row["keys_per_frame"])
+    log(f"e2e native+native: {row['decisions_per_sec']:.0f}/s")
+    return row
+
+
 def run_e2e(quick: bool = False, log=print) -> List[Dict]:
     seconds = 2.0 if quick else 6.0
     window = 512 if quick else 2048
@@ -176,6 +217,7 @@ def run_e2e(quick: bool = False, log=print) -> List[Dict]:
             "NATIVE server, sketch on cpu device", "sketch",
             platform="cpu", seconds=seconds, window=window, native=True,
             log=log))
+        rows.append(_run_native_loadgen(seconds=seconds, log=log))
     except Exception as exc:  # no compiler -> skip, never fail the suite
         rows.append({"variant": "native server", "error": str(exc)})
     if not quick:

@@ -1,0 +1,205 @@
+// Closed-loop load generator for the rate-limit service.
+//
+// The Python e2e driver saturates its own asyncio loop before it
+// saturates the native server; this native driver finds the server's
+// real ceiling. N threads, one connection each, K pipelined ALLOW_BATCH
+// frames of F keys in flight per connection; measures completed
+// decisions/s over the timed window (after warmup) and per-frame RTT
+// percentiles.
+//
+// Usage: rltpu_loadgen <host> <port> <seconds> <threads> <inflight>
+//                      <keys_per_frame> <n_keys>
+// Output: one JSON line.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ratelimiter_client.hpp"
+
+namespace {
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Shared {
+  std::atomic<uint64_t> completed{0};
+  std::atomic<uint64_t> allowed{0};
+  double t_measure = 0, t_stop = 0;
+  std::mutex lat_mx;
+  std::vector<double> latencies;  // frame RTTs inside the window
+};
+
+// Raw pipelined driver: hand-rolled frames on one socket (the Client
+// class is strictly request/response; pipelining needs direct IO).
+void worker(const char* host, int port, int inflight, int frame_keys,
+            int n_keys, int wid, Shared* sh) {
+  // The Client class is strictly request/response; pipelining needs
+  // direct socket IO, so the frames are hand-rolled here.
+  struct addrinfo hints {
+  }, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string ps = std::to_string(port);
+  if (getaddrinfo(host, ps.c_str(), &hints, &res) != 0) return;
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    return;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+
+  auto send_all = [&](const std::string& b) {
+    size_t off = 0;
+    while (off < b.size()) {
+      ssize_t w = send(fd, b.data() + off, b.size() - off, MSG_NOSIGNAL);
+      if (w <= 0) return false;
+      off += (size_t)w;
+    }
+    return true;
+  };
+
+  // Pre-encode a rotating set of ALLOW_BATCH frames.
+  uint64_t req_id = 1;
+  unsigned rng = 12345u + (unsigned)wid * 2654435761u;
+  auto make_frame = [&](double* sent_at) {
+    std::string body;
+    uint32_t count = (uint32_t)frame_keys;
+    body.append((char*)&count, 4);
+    for (int i = 0; i < frame_keys; ++i) {
+      rng = rng * 1664525u + 1013904223u;
+      char key[32];
+      int klen = snprintf(key, sizeof(key), "user:%u", rng % (unsigned)n_keys);
+      uint32_t n = 1;
+      uint16_t kl = (uint16_t)klen;
+      body.append((char*)&n, 4);
+      body.append((char*)&kl, 2);
+      body.append(key, klen);
+    }
+    std::string frame;
+    uint32_t length = (uint32_t)(1 + 8 + body.size());
+    frame.append((char*)&length, 4);
+    frame.push_back((char)rltpu::T_ALLOW_BATCH);
+    uint64_t id = req_id++;
+    frame.append((char*)&id, 8);
+    frame += body;
+    *sent_at = now_s();
+    return frame;
+  };
+
+  std::vector<double> sent_at((size_t)inflight + 8, 0.0);
+  for (int i = 0; i < inflight; ++i) {
+    double t;
+    std::string f = make_frame(&t);
+    sent_at[(req_id - 1) % sent_at.size()] = t;
+    if (!send_all(f)) {
+      close(fd);
+      return;
+    }
+  }
+
+  std::string rbuf;
+  char tmp[65536];
+  std::vector<double> local_lat;
+  uint64_t local_completed = 0, local_allowed = 0;
+  while (now_s() < sh->t_stop) {
+    ssize_t r = recv(fd, tmp, sizeof(tmp), 0);
+    if (r <= 0) break;
+    rbuf.append(tmp, (size_t)r);
+    size_t off = 0;
+    while (rbuf.size() - off >= 13) {
+      uint32_t length;
+      memcpy(&length, rbuf.data() + off, 4);
+      if (rbuf.size() - off < 4 + length) break;
+      uint8_t type = (uint8_t)rbuf[off + 4];
+      uint64_t rid;
+      memcpy(&rid, rbuf.data() + off + 5, 8);
+      if (type == rltpu::T_RESULT_BATCH) {
+        const char* body = rbuf.data() + off + 13;
+        uint32_t count;
+        memcpy(&count, body + 8, 4);
+        double t1 = now_s();
+        if (t1 >= sh->t_measure) {
+          local_completed += count;
+          const char* items = body + 12;
+          for (uint32_t i = 0; i < count; ++i)
+            local_allowed += (uint8_t)items[i * 25] & 1;
+          double t0 = sent_at[rid % sent_at.size()];
+          if (t0 > 0) local_lat.push_back(t1 - t0);
+        }
+        if (now_s() < sh->t_stop) {
+          double t;
+          std::string f = make_frame(&t);
+          sent_at[(req_id - 1) % sent_at.size()] = t;
+          if (!send_all(f)) break;
+        }
+      }
+      off += 4 + length;
+    }
+    if (off) rbuf.erase(0, off);
+  }
+  close(fd);
+  sh->completed.fetch_add(local_completed);
+  sh->allowed.fetch_add(local_allowed);
+  std::lock_guard<std::mutex> g(sh->lat_mx);
+  sh->latencies.insert(sh->latencies.end(), local_lat.begin(),
+                       local_lat.end());
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 8) {
+    std::fprintf(stderr,
+                 "usage: %s <host> <port> <seconds> <threads> <inflight> "
+                 "<keys_per_frame> <n_keys>\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* host = argv[1];
+  int port = atoi(argv[2]);
+  double seconds = atof(argv[3]);
+  int threads = atoi(argv[4]);
+  int inflight = atoi(argv[5]);
+  int frame_keys = atoi(argv[6]);
+  int n_keys = atoi(argv[7]);
+
+  Shared sh;
+  double warmup = 1.0;
+  sh.t_measure = now_s() + warmup;
+  sh.t_stop = sh.t_measure + seconds;
+
+  std::vector<std::thread> ts;
+  for (int i = 0; i < threads; ++i)
+    ts.emplace_back(worker, host, port, inflight, frame_keys, n_keys, i, &sh);
+  for (auto& t : ts) t.join();
+
+  double span = seconds;
+  std::vector<double>& lat = sh.latencies;
+  std::sort(lat.begin(), lat.end());
+  auto pct = [&](double p) {
+    if (lat.empty()) return 0.0;
+    return lat[std::min(lat.size() - 1, (size_t)(p * lat.size()))] * 1e3;
+  };
+  std::printf(
+      "{\"decisions_per_sec\": %.1f, \"completed\": %llu, "
+      "\"allowed\": %llu, \"frame_p50_ms\": %.2f, \"frame_p99_ms\": %.2f, "
+      "\"threads\": %d, \"inflight_frames\": %d, \"keys_per_frame\": %d}\n",
+      (double)sh.completed.load() / span,
+      (unsigned long long)sh.completed.load(),
+      (unsigned long long)sh.allowed.load(), pct(0.50), pct(0.99), threads,
+      inflight, frame_keys);
+  return 0;
+}

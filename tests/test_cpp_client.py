@@ -20,6 +20,42 @@ CPP_DIR = os.path.join(REPO, "clients", "cpp")
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
+def test_cpp_loadgen_builds_and_drives(tmp_path):
+    """The native load generator compiles and completes a short run
+    against a live native server."""
+    from ratelimiter_tpu import Algorithm, Config, create_limiter
+    from ratelimiter_tpu.serving.native_server import (
+        NativeRateLimitServer,
+        native_server_available,
+    )
+
+    if not native_server_available():
+        pytest.skip("native server extension unavailable")
+    binary = str(tmp_path / "rltpu_loadgen")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-Wall", "-Werror",
+         os.path.join(CPP_DIR, "loadgen.cpp"), "-o", binary, "-pthread"],
+        check=True, capture_output=True, timeout=120)
+    lim = create_limiter(Config(algorithm=Algorithm.SLIDING_WINDOW,
+                                limit=10_000, window=60.0), backend="exact")
+    srv = NativeRateLimitServer(lim, "127.0.0.1", 0)
+    srv.start()
+    try:
+        out = subprocess.run(
+            [binary, "127.0.0.1", str(srv.port), "1", "2", "4", "64", "1000"],
+            capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0, out.stderr
+        import json
+
+        row = json.loads(out.stdout.strip())
+        assert row["completed"] > 0
+        assert row["decisions_per_sec"] > 0
+    finally:
+        srv.shutdown()
+        lim.close()
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="needs g++")
 def test_cpp_client_conformance(tmp_path):
     binary = str(tmp_path / "rltpu_demo")
     subprocess.run(
