@@ -109,23 +109,45 @@ struct Pending {
   std::vector<int64_t> ns;
 };
 
+// The dispatch currently being decided, shared between the dispatcher
+// and the SLO watcher. Whoever flips `answered` first owns the response.
+struct InFlight {
+  std::vector<Pending> items;
+  std::atomic<bool> answered{false};
+  std::chrono::steady_clock::time_point deadline;
+  bool active = false;
+};
+
 struct Server {
   int listen_fd = -1, epoll_fd = -1, event_fd = -1;
   uint16_t port = 0;
   uint32_t max_batch = 4096;
   uint32_t max_delay_us = 200;
+  // Dispatch SLO (0 = disabled): when one batched decide exceeds this,
+  // waiters are answered immediately per fail_open policy while the
+  // Python call completes in the background (state still converges) —
+  // parity with the asyncio batcher's dispatch_timeout (ADR-003).
+  uint32_t slo_us = 0;
+  bool fail_open = false;
+  int64_t limit = 0;      // for fail-open RESULT frames (may lag
+  double window_s = 60.0;  // update_limit; cosmetic fields only)
   std::atomic<bool> stop{false};
   std::atomic<bool> draining{false};
   std::atomic<uint64_t> decisions{0};
+  std::atomic<uint64_t> slo_breaches{0};
   double started_at = 0.0;
 
-  std::thread io_thread, dispatch_thread;
+  std::thread io_thread, dispatch_thread, slo_thread;
   std::map<int, ConnPtr> conns;  // io thread only
 
   std::mutex qmx;
   std::condition_variable qcv;
   std::deque<Pending> queue;
   size_t queued_keys = 0;
+
+  std::mutex ifmx;
+  std::condition_variable ifcv;
+  InFlight inflight;
 
   PyObject* cb_decide = nullptr;
   PyObject* cb_reset = nullptr;
@@ -149,11 +171,77 @@ void conn_send(Server* s, const ConnPtr& c, std::string frame) {
   (void)r;
 }
 
+// ---- SLO watcher ---------------------------------------------------------
+
+void send_policy_answers(Server* s, const std::vector<Pending>& items) {
+  // Fail-open: allowed Result with the fail_open flag; fail-closed:
+  // typed storage_unavailable error — ADR-003's SLO-breach policy.
+  for (const auto& p : items) {
+    if (s->fail_open) {
+      double reset_at = now_s() + s->window_s;
+      if (!p.is_batch) {
+        std::string out;
+        frame_header(out, T_RESULT, p.req_id, 33);
+        out.push_back((char)3);  // allowed | fail_open
+        put_i64(out, s->limit);
+        put_i64(out, 0);
+        put_f64(out, 0.0);
+        put_f64(out, reset_at);
+        conn_send(s, p.conn, std::move(out));
+      } else {
+        uint32_t count = (uint32_t)p.keys.size();
+        std::string out;
+        frame_header(out, T_RESULT_BATCH, p.req_id, 12 + 25 * count);
+        put_i64(out, s->limit);
+        put_u32(out, count);
+        for (uint32_t i = 0; i < count; ++i) {
+          out.push_back((char)3);
+          put_i64(out, 0);
+          put_f64(out, 0.0);
+          put_f64(out, reset_at);
+        }
+        conn_send(s, p.conn, std::move(out));
+      }
+      s->decisions.fetch_add(p.keys.size());
+    } else {
+      conn_send(s, p.conn,
+                make_error(p.req_id, E_STORAGE_UNAVAILABLE,
+                           "dispatch exceeded SLO"));
+    }
+  }
+}
+
+void slo_main(Server* s) {
+  std::unique_lock<std::mutex> lk(s->ifmx);
+  while (!s->stop.load()) {
+    s->ifcv.wait(lk, [&] { return s->stop.load() || s->inflight.active; });
+    if (s->stop.load()) return;
+    // Wait until the deadline or until the dispatcher deactivates.
+    s->ifcv.wait_until(lk, s->inflight.deadline,
+                       [&] { return s->stop.load() || !s->inflight.active; });
+    if (s->stop.load()) return;
+    if (s->inflight.active &&
+        std::chrono::steady_clock::now() >= s->inflight.deadline &&
+        !s->inflight.answered.exchange(true)) {
+      s->slo_breaches.fetch_add(1);
+      send_policy_answers(s, s->inflight.items);
+      // Leave `active` set: the dispatcher clears it when the (late)
+      // decide lands; its responses are discarded via `answered`.
+    }
+    // Avoid a hot loop while the late dispatch is still running.
+    if (s->inflight.active)
+      s->ifcv.wait(lk, [&] { return s->stop.load() || !s->inflight.active; });
+  }
+}
+
 // ---- dispatcher ----------------------------------------------------------
 
 // Calls the Python decide callback for a drained run of Pending items.
 // Returns false if the callback raised (all items get ERROR frames).
-bool run_decide(Server* s, std::vector<Pending>& items) {
+// When `gate` is non-null, responses are sent only if the SLO watcher
+// has not already answered for this batch.
+bool run_decide(Server* s, std::vector<Pending>& items,
+                std::atomic<bool>* gate) {
   size_t total = 0;
   for (auto& p : items) total += p.keys.size();
 
@@ -247,6 +335,11 @@ bool run_decide(Server* s, std::vector<Pending>& items) {
     PyGILState_Release(g);
   }
 
+  if (gate != nullptr && gate->exchange(true)) {
+    // SLO watcher already answered these waiters; the (late) state
+    // update above still landed in the limiter — drop the responses.
+    return err_code == 0;
+  }
   if (err_code != 0) {
     for (auto& p : items)
       conn_send(s, p.conn, make_error(p.req_id, err_code, err_msg));
@@ -387,7 +480,27 @@ void dispatcher_main(Server* s) {
         decisions.push_back(std::move(p));
       }
     }
-    if (!decisions.empty()) run_decide(s, decisions);
+    if (decisions.empty()) continue;
+    if (s->slo_us == 0) {
+      run_decide(s, decisions, nullptr);
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> g(s->ifmx);
+      s->inflight.items = std::move(decisions);
+      s->inflight.answered.store(false);
+      s->inflight.deadline = std::chrono::steady_clock::now() +
+                             std::chrono::microseconds(s->slo_us);
+      s->inflight.active = true;
+    }
+    s->ifcv.notify_all();
+    run_decide(s, s->inflight.items, &s->inflight.answered);
+    {
+      std::lock_guard<std::mutex> g(s->ifmx);
+      s->inflight.active = false;
+      s->inflight.items.clear();
+    }
+    s->ifcv.notify_all();
   }
 }
 
@@ -648,6 +761,7 @@ PyObject* server_start(PyObject* self, PyObject* args) {
   s->started_at = now_s();
   s->io_thread = std::thread(io_main, s);
   s->dispatch_thread = std::thread(dispatcher_main, s);
+  if (s->slo_us > 0) s->slo_thread = std::thread(slo_main, s);
   return PyLong_FromLong(s->port);
 }
 
@@ -668,11 +782,13 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     usleep(20000);  // let final responses flush
     s->stop.store(true);
     s->qcv.notify_all();
+    s->ifcv.notify_all();
     uint64_t one_ = 1;
     ssize_t r = write(s->event_fd, &one_, 8);
     (void)r;
     if (s->io_thread.joinable()) s->io_thread.join();
     if (s->dispatch_thread.joinable()) s->dispatch_thread.join();
+    if (s->slo_thread.joinable()) s->slo_thread.join();
     Py_END_ALLOW_THREADS;
     close(s->listen_fd);
     close(s->epoll_fd);
@@ -684,9 +800,11 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
 
 PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
   PyServer* ps = (PyServer*)self;
-  return Py_BuildValue("{s:K,s:d}", "decisions_total",
-                       (unsigned long long)ps->s->decisions.load(), "uptime_s",
-                       now_s() - ps->s->started_at);
+  return Py_BuildValue(
+      "{s:K,s:K,s:d}", "decisions_total",
+      (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
+      (unsigned long long)ps->s->slo_breaches.load(), "uptime_s",
+      now_s() - ps->s->started_at);
 }
 
 void server_dealloc(PyObject* self) {
@@ -695,11 +813,13 @@ void server_dealloc(PyObject* self) {
     if (ps->s->listen_fd >= 0) {
       ps->s->stop.store(true);
       ps->s->qcv.notify_all();
+      ps->s->ifcv.notify_all();
       uint64_t one = 1;
       ssize_t r = write(ps->s->event_fd, &one, 8);
       (void)r;
       if (ps->s->io_thread.joinable()) ps->s->io_thread.join();
       if (ps->s->dispatch_thread.joinable()) ps->s->dispatch_thread.join();
+      if (ps->s->slo_thread.joinable()) ps->s->slo_thread.join();
       close(ps->s->listen_fd);
       close(ps->s->epoll_fd);
       close(ps->s->event_fd);
@@ -725,19 +845,29 @@ PyTypeObject PyServerType = {
 
 PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                         PyObject* kwargs) {
-  static const char* kwlist[] = {"decide",      "reset",     "metrics",
-                                 "max_batch",   "max_delay_us", nullptr};
+  static const char* kwlist[] = {"decide",    "reset",        "metrics",
+                                 "max_batch", "max_delay_us", "slo_us",
+                                 "fail_open", "limit",        "window_s",
+                                 nullptr};
   PyObject *decide, *reset, *metrics = Py_None;
-  unsigned int max_batch = 4096, max_delay_us = 200;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OII", (char**)kwlist,
+  unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
+  int fail_open = 0;
+  long long limit = 0;
+  double window_s = 60.0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLd", (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
-                                   &max_delay_us))
+                                   &max_delay_us, &slo_us, &fail_open, &limit,
+                                   &window_s))
     return nullptr;
   PyServer* ps = PyObject_New(PyServer, &PyServerType);
   if (ps == nullptr) return nullptr;
   ps->s = new Server();
   ps->s->max_batch = max_batch;
   ps->s->max_delay_us = max_delay_us;
+  ps->s->slo_us = slo_us;
+  ps->s->fail_open = fail_open != 0;
+  ps->s->limit = (int64_t)limit;
+  ps->s->window_s = window_s;
   Py_INCREF(decide);
   Py_INCREF(reset);
   Py_INCREF(metrics);
@@ -765,7 +895,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 1; }
+int64_t rl_server_abi_version() { return 2; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

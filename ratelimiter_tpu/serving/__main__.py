@@ -44,7 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SLO per dispatch; breach triggers fail-open/closed")
     ap.add_argument("--native", action="store_true",
                     help="use the C++ epoll front door (native/server.cpp) "
-                         "instead of the asyncio server; no dispatch SLO")
+                         "instead of the asyncio server")
     ap.add_argument("--no-prewarm", action="store_true",
                     help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
@@ -109,7 +109,9 @@ async def amain(args) -> None:
 
         server = NativeRateLimitServer(
             limiter, args.host, args.port,
-            max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6)
+            max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6,
+            dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
+                              if args.dispatch_timeout_ms else None))
         server.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

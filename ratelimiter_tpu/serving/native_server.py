@@ -31,9 +31,12 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
+_ABI = 2
+
+
 def _load_extension():
-    """Build/load native/_server.so (same auto-build pattern as the
-    hasher; returns None when no compiler is available)."""
+    """Build/load native/_server.so (same auto-build + stale-rebuild
+    pattern as the hasher; returns None when no compiler is available)."""
     import ctypes
     import os
     import subprocess
@@ -42,27 +45,45 @@ def _load_extension():
     d = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     so = os.path.join(d, "native", "_server.so")
     src = os.path.join(d, "native", "server.cpp")
-    if not os.path.exists(so) and os.environ.get(
-            "RATELIMITER_TPU_NO_BUILD") != "1":
+
+    def build() -> bool:
+        if os.environ.get("RATELIMITER_TPU_NO_BUILD") == "1":
+            return False
         try:
             inc = sysconfig.get_paths()["include"]
             subprocess.run(
                 ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", f"-I{inc}",
                  "-o", so, src],
                 check=True, capture_output=True, timeout=180)
+            return True
         except Exception:
-            return None
+            return False
+
+    if not os.path.exists(so) and not build():
+        return None
     if not os.path.exists(so):
         return None
     try:
         lib = ctypes.CDLL(so)
         lib.rl_server_abi_version.restype = ctypes.c_int64
-        if lib.rl_server_abi_version() != 1:
-            return None
+        mod_path = so
+        if lib.rl_server_abi_version() != _ABI:
+            # Stale build: rebuild and load under a per-process name
+            # (dlopen caches by pathname — see native/__init__.py).
+            os.remove(so)
+            if not build():
+                return None
+            import shutil
+
+            mod_path = os.path.join(d, "native", f"_server_r{os.getpid()}.so")
+            shutil.copy2(so, mod_path)
+            lib = ctypes.CDLL(mod_path)
+            if lib.rl_server_abi_version() != _ABI:
+                return None
         import importlib.util
 
         spec = importlib.util.spec_from_file_location(
-            "ratelimiter_tpu.native._server", so)
+            "ratelimiter_tpu.native._server", mod_path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         return mod
@@ -86,14 +107,20 @@ class _BridgeError(Exception):
 class NativeRateLimitServer:
     """Drop-in sibling of RateLimitServer backed by the C++ front door.
 
-    Args mirror RateLimitServer; ``dispatch_timeout`` is not supported
-    (the native dispatcher is synchronous per batch — an SLO would need
-    a second dispatch thread; ROADMAP).
+    Args mirror RateLimitServer, including ``dispatch_timeout``: a C++
+    watcher thread answers waiters per the limiter's fail-open/closed
+    policy when one batched dispatch exceeds the SLO, while the Python
+    decide completes in the background (state still converges). One
+    caveat vs the asyncio server: the ``limit`` field stamped into
+    fail-open responses is captured at server construction, so it can
+    lag a later ``update_limit`` (cosmetic — the decision fields are
+    policy-driven either way).
     """
 
     def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
                  port: int = 0, *, max_batch: int = 4096,
                  max_delay: float = 200e-6,
+                 dispatch_timeout: Optional[float] = None,
                  registry: Optional[m.Registry] = None):
         ext = _load_extension()
         if ext is None:
@@ -116,7 +143,11 @@ class NativeRateLimitServer:
 
         self._server = ext.create_server(
             decide=self._decide, reset=self._reset, metrics=self._metrics,
-            max_batch=max_batch, max_delay_us=int(max_delay * 1e6))
+            max_batch=max_batch, max_delay_us=int(max_delay * 1e6),
+            slo_us=int(dispatch_timeout * 1e6) if dispatch_timeout else 0,
+            fail_open=bool(limiter.config.fail_open),
+            limit=int(limiter.config.limit),
+            window_s=float(limiter.config.window))
 
     # ------------------------------------------------------------ callbacks
 

@@ -200,6 +200,50 @@ class TestNativeServer:
         assert h.count() < 400 and h.sum() == 400.0
         lim.close()
 
+    def test_slo_breach_fail_open(self):
+        """Dispatch exceeding the SLO answers waiters fail-open while the
+        Python decide completes; the breach is counted; the server keeps
+        serving afterward."""
+        import time
+
+        lim, _ = _mk_limiter(limit=5, fail_open=True)
+        slow = _SlowOnce(lim, delay=0.3)
+        srv = NativeRateLimitServer(slow, "127.0.0.1", 0,
+                                    max_delay=1e-4, dispatch_timeout=0.03)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                t0 = time.perf_counter()
+                res = c.allow("k")
+                dt = time.perf_counter() - t0
+                assert res.allowed and res.fail_open
+                assert dt < 0.25  # answered at the SLO, not at 0.3 s
+                assert srv.stats()["slo_breaches_total"] == 1
+                time.sleep(0.35)  # let the late dispatch land
+                res2 = c.allow("k2")  # fast path again, normal result
+                assert res2.allowed and not res2.fail_open
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_slo_breach_fail_closed(self):
+        import time
+
+        lim, _ = _mk_limiter(limit=5, fail_open=False)
+        slow = _SlowOnce(lim, delay=0.3)
+        srv = NativeRateLimitServer(slow, "127.0.0.1", 0,
+                                    max_delay=1e-4, dispatch_timeout=0.03)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                with pytest.raises(StorageUnavailableError):
+                    c.allow("k")
+                time.sleep(0.35)
+                assert c.allow("k2").allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
     def test_graceful_shutdown_drains(self):
         lim, _ = _mk_limiter(limit=10000)
         srv = NativeRateLimitServer(lim, "127.0.0.1", 0, max_delay=20e-3)
@@ -224,6 +268,29 @@ class TestNativeServer:
         assert not t.is_alive()
         assert all(results)
         lim.close()
+
+
+class _SlowOnce:
+    """Delays only the FIRST allow_batch (the SLO-breach fixture; later
+    dispatches run fast so the server's recovery is observable)."""
+
+    def __init__(self, inner, delay: float):
+        self._inner = inner
+        self._delay = delay
+        self._fired = False
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def allow_batch(self, keys, ns=None, *, now=None):
+        import time
+
+        if not self._fired:
+            self._fired = True
+            time.sleep(self._delay)
+        return self._inner.allow_batch(keys, ns, now=now)
+    # allow_hashed intentionally NOT defined: __getattr__ delegation keeps
+    # hasattr() capability sniffing truthful for the wrapped backend.
 
 
 class TestPrefixPack:
