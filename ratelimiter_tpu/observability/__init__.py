@@ -11,6 +11,7 @@ from ratelimiter_tpu.observability.metrics import (
     Registry,
 )
 from ratelimiter_tpu.observability.decorators import (
+    CircuitBreakerDecorator,
     LimiterDecorator,
     LoggingDecorator,
     MetricsDecorator,
@@ -19,6 +20,7 @@ from ratelimiter_tpu.observability.decorators import (
 
 __all__ = [
     "BATCH_BUCKETS",
+    "CircuitBreakerDecorator",
     "Counter",
     "DEFAULT",
     "Gauge",

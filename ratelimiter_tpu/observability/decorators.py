@@ -25,6 +25,7 @@ Metric names follow the reference's spec (``docs/ARCHITECTURE.md:550-566``):
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from contextlib import contextmanager
 from typing import Optional, Sequence
@@ -263,6 +264,124 @@ class TracingDecorator(LimiterDecorator):
             yield self
         finally:
             jax.profiler.stop_trace()
+
+
+class CircuitBreakerDecorator(LimiterDecorator):
+    """Circuit breaker around a limiter backend — the reference's planned
+    resilience layer (``docs/ADR/002:170-197``, ``ROADMAP.md:104-108``:
+    closed / open / half-open states), realized as a decorator.
+
+    * closed: calls pass through; ``failure_threshold`` CONSECUTIVE
+      backend failures (StorageUnavailableError raised, or a fail-open
+      allowance — both mean the backend is down) trip the breaker;
+    * open: for ``cooldown`` seconds the backend is not touched at all —
+      decisions short-circuit per the limiter's fail-open/fail-closed
+      policy (the point: a dead backend stops eating a dispatch timeout
+      per request);
+    * half-open: after the cooldown, exactly one probe call reaches the
+      backend; success closes the breaker, failure re-opens it with a
+      fresh cooldown.
+
+    Time comes from the wrapped limiter's clock, so breaker tests use
+    virtual time like everything else.
+    """
+
+    def __init__(self, inner: RateLimiter, *, failure_threshold: int = 5,
+                 cooldown: float = 10.0,
+                 registry: Optional[m.Registry] = None):
+        super().__init__(inner)
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = failure_threshold
+        self.cooldown = float(cooldown)
+        self._state = "closed"
+        self._consecutive = 0
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self._cb_lock = threading.Lock()
+        reg = registry if registry is not None else m.DEFAULT
+        self._transitions = reg.counter(
+            "rate_limiter_breaker_transitions_total",
+            "Circuit breaker state transitions")
+        self._short_circuits = reg.counter(
+            "rate_limiter_breaker_short_circuits_total",
+            "Decisions answered without touching the backend")
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _trip(self, now: float) -> None:
+        self._state = "open"
+        self._open_until = now + self.cooldown
+        self._transitions.inc(to="open")
+
+    def _note_result(self, failed: bool, now: float) -> None:
+        with self._cb_lock:
+            self._probe_inflight = False
+            if failed:
+                self._consecutive += 1
+                if (self._state == "half-open"
+                        or self._consecutive >= self.failure_threshold):
+                    self._trip(now)
+            else:
+                self._consecutive = 0
+                if self._state != "closed":
+                    self._state = "closed"
+                    self._transitions.inc(to="closed")
+
+    def _admit_call(self, now: float) -> bool:
+        """True if this call may reach the backend."""
+        with self._cb_lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open" and now >= self._open_until:
+                self._state = "half-open"
+                self._transitions.inc(to="half-open")
+            if self._state == "half-open" and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def _short_circuit(self, b: int, now: float):
+        self._short_circuits.inc(b)
+        cfg = self.inner.config
+        reset_at = now + float(cfg.window)
+        if not cfg.fail_open:
+            raise StorageUnavailableError(
+                f"circuit breaker open (cooldown {self.cooldown:g}s)")
+        if b == 1:
+            from ratelimiter_tpu.core.types import fail_open_result
+
+            return fail_open_result(cfg.limit, reset_at)
+        from ratelimiter_tpu.core.types import batch_fail_open
+
+        return batch_fail_open(b, cfg.limit, reset_at)
+
+    def allow_n(self, key: str, n: int, *, now: Optional[float] = None) -> Result:
+        t = self.inner.clock.now() if now is None else float(now)
+        if not self._admit_call(t):
+            return self._short_circuit(1, t)
+        try:
+            res = self.inner.allow_n(key, n, now=now)
+        except StorageUnavailableError:
+            self._note_result(True, t)
+            raise
+        self._note_result(res.fail_open, t)
+        return res
+
+    def allow_batch(self, keys: Sequence[str], ns=None, *,
+                    now: Optional[float] = None) -> BatchResult:
+        t = self.inner.clock.now() if now is None else float(now)
+        if not self._admit_call(t):
+            return self._short_circuit(len(keys), t)
+        try:
+            out = self.inner.allow_batch(keys, ns, now=now)
+        except StorageUnavailableError:
+            self._note_result(True, t)
+            raise
+        self._note_result(out.fail_open, t)
+        return out
 
 
 class LoggingDecorator(LimiterDecorator):

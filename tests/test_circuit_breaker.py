@@ -1,0 +1,141 @@
+"""Circuit breaker decorator: closed -> open -> half-open -> closed, with
+virtual time (reference docs/ADR/002:170-197's planned state machine)."""
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    StorageUnavailableError,
+    create_limiter,
+)
+from ratelimiter_tpu.observability import CircuitBreakerDecorator, Registry
+
+
+class _CountingLimiter:
+    """Wraps a limiter counting backend touches (to prove the open state
+    short-circuits)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def allow_n(self, key, n, *, now=None):
+        self.calls += 1
+        return self._inner.allow_n(key, n, now=now)
+
+    def allow_batch(self, keys, ns=None, *, now=None):
+        self.calls += 1
+        return self._inner.allow_batch(keys, ns, now=now)
+
+
+def make(fail_open: bool):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=100, window=60.0,
+                 fail_open=fail_open)
+    inner = create_limiter(cfg, backend="sketch", clock=clock)
+    counting = _CountingLimiter(inner)
+    cb = CircuitBreakerDecorator(counting, failure_threshold=3, cooldown=5.0,
+                                 registry=Registry())
+    return cb, counting, inner, clock
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_short_circuits(self):
+        cb, counting, inner, clock = make(fail_open=True)
+        assert cb.allow("k").allowed and cb.state == "closed"
+        inner.inject_failure()
+        for _ in range(3):  # consecutive fail-open allowances trip it
+            res = cb.allow("k")
+            assert res.allowed and res.fail_open
+        assert cb.state == "open"
+        before = counting.calls
+        for _ in range(10):  # open: backend untouched
+            res = cb.allow("k")
+            assert res.allowed and res.fail_open
+        assert counting.calls == before
+        cb.close()
+
+    def test_half_open_probe_recovers(self):
+        cb, counting, inner, clock = make(fail_open=True)
+        inner.inject_failure()
+        for _ in range(3):
+            cb.allow("k")
+        assert cb.state == "open"
+        inner.heal()
+        clock.advance(5.1)          # past the cooldown -> half-open probe
+        res = cb.allow("k")
+        assert res.allowed and not res.fail_open
+        assert cb.state == "closed"
+        # Fully back to normal: backend reached again.
+        before = counting.calls
+        cb.allow("k2")
+        assert counting.calls == before + 1
+        cb.close()
+
+    def test_half_open_failure_reopens(self):
+        cb, counting, inner, clock = make(fail_open=True)
+        inner.inject_failure()
+        for _ in range(3):
+            cb.allow("k")
+        clock.advance(5.1)
+        res = cb.allow("k")          # probe fails (still injected)
+        assert res.fail_open
+        assert cb.state == "open"
+        before = counting.calls
+        cb.allow("k")                # short-circuited again
+        assert counting.calls == before
+        cb.close()
+
+    def test_fail_closed_raises_without_backend(self):
+        cb, counting, inner, clock = make(fail_open=False)
+        inner.inject_failure()
+        for _ in range(3):
+            with pytest.raises(StorageUnavailableError):
+                cb.allow("k")
+        assert cb.state == "open"
+        before = counting.calls
+        with pytest.raises(StorageUnavailableError, match="circuit"):
+            cb.allow("k")
+        assert counting.calls == before
+        cb.close()
+
+    def test_batch_path_counts_and_short_circuits(self):
+        cb, counting, inner, clock = make(fail_open=True)
+        inner.inject_failure()
+        for _ in range(3):
+            out = cb.allow_batch(["a", "b"])
+            assert out.fail_open
+        assert cb.state == "open"
+        out = cb.allow_batch(["a", "b", "c"])
+        assert out.fail_open and len(out) == 3
+        cb.close()
+
+    def test_success_resets_consecutive_count(self):
+        cb, counting, inner, clock = make(fail_open=True)
+        inner.inject_failure()
+        cb.allow("k")
+        cb.allow("k")
+        inner.heal()
+        assert not cb.allow("k").fail_open   # success: streak broken
+        inner.inject_failure()
+        cb.allow("k")
+        cb.allow("k")
+        assert cb.state == "closed"          # 2 < threshold again
+        cb.allow("k")
+        assert cb.state == "open"
+        cb.close()
+
+    def test_composes_with_contract_surface(self):
+        # Breaker is transparent when the backend is healthy.
+        cb, counting, inner, clock = make(fail_open=True)
+        cfg_lim = 100
+        allowed = sum(cb.allow("hot").allowed for _ in range(120))
+        assert allowed == cfg_lim
+        cb.reset("hot")
+        assert cb.allow("hot").allowed
+        cb.close()
