@@ -146,7 +146,7 @@ def _columns(h1, h2, d: int, w: int):
 
 
 def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
-              weighted: bool = True):
+              weighted: bool = True, pre=None):
     """Min-over-rows window estimate at the given (B, d) columns, via
     sort-merge reads (ops/sortmerge.py — no gathers on the hot path).
     ``weighted`` adds the boundary sub-window scaled by its remaining
@@ -156,23 +156,54 @@ def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
     Returns (est, frac, boundary): the (B,) min-estimate plus the scalar
     boundary weight and the dense (d, w) boundary slab (None when not
     weighted) so the conservative-update write path can reuse them."""
+    from ratelimiter_tpu.ops.sortmerge import _use_sortmerge
+
     d = cols.shape[1]
+    B = cols.shape[0]
+    w = state["totals"].shape[1]
     if weighted:
-        # Ring size S == SW, so the boundary period p-SW lives at slot p % S
-        # (the very slot the next rollover will overwrite).
-        b_idx = (p % S).astype(jnp.int32)
-        boundary_valid = state["slab_period"][b_idx] == p - SW
-        elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
-        frac = jnp.where(boundary_valid,
-                         jnp.clip(1.0 - elapsed_in / jnp.float32(sub_us), 0.0, 1.0),
-                         0.0)
-        boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
-                                                keepdims=False)
-        est = None
-        for r in range(d):
-            t_r, b_r = row_gather((state["totals"][r], boundary[r]), cols[:, r])
-            e_r = t_r.astype(jnp.float32) + frac * b_r.astype(jnp.float32)
-            est = e_r if est is None else jnp.minimum(est, e_r)
+        if pre is not None:
+            # Scan path: (frac, boundary) precomputed OUTSIDE the loop
+            # body. Scalars derived from the loop carry defeat XLA's
+            # invariant hoisting, making the dynamic ring slice + dense
+            # combine re-run per iteration (measured 2 us -> 500+ us per
+            # step); the chunk precondition (one sub-window per chunk)
+            # makes the hoist exact. See _sketch_scan.
+            frac, boundary = pre
+        else:
+            # Ring size S == SW, so the boundary period p-SW lives at
+            # slot p % S (the very slot the next rollover overwrites).
+            b_idx = (p % S).astype(jnp.int32)
+            boundary_valid = state["slab_period"][b_idx] == p - SW
+            elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
+            frac = jnp.where(
+                boundary_valid,
+                jnp.clip(1.0 - elapsed_in / jnp.float32(sub_us), 0.0, 1.0),
+                0.0)
+            boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
+                                                    keepdims=False)
+        if not _use_sortmerge(B, w):
+            # Direct-indexing regime: pre-combine the two tables DENSELY
+            # (frac is a scalar) and gather once per row. Numerically
+            # identical to gathering both and combining per element, but
+            # measured ~100x faster on the tunnel TPU at the serving
+            # shape (B=4096, w=65536: 550 us -> ~5 us per step) — XLA
+            # lowers the fused two-gather combine pathologically.
+            combined = (state["totals"].astype(jnp.float32)
+                        + frac * boundary.astype(jnp.float32))
+            est = None
+            for r in range(d):
+                e_r = combined[r][cols[:, r]]
+                est = e_r if est is None else jnp.minimum(est, e_r)
+        else:
+            # Sort-merge regime (B >= w/2): delta encoding needs integer
+            # rows for exactness, so gather both and combine after.
+            est = None
+            for r in range(d):
+                t_r, b_r = row_gather((state["totals"][r], boundary[r]),
+                                      cols[:, r])
+                e_r = t_r.astype(jnp.float32) + frac * b_r.astype(jnp.float32)
+                est = e_r if est is None else jnp.minimum(est, e_r)
     else:
         frac, boundary = jnp.float32(0.0), None
         est = None
@@ -186,7 +217,7 @@ def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
 def _sketch_step(state: State, h1, h2, n, now_us, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
                  iters: int, weighted: bool, conservative: bool,
-                 axis_name: str | None = None):
+                 axis_name: str | None = None, pre=None):
     # Precondition (host-enforced via _sync_period): state.last_period is
     # the period of now_us. Clamp defends against clock skew backwards —
     # the reference has the same NTP caveat (``docs/ALGORITHMS.md:162``).
@@ -195,7 +226,7 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
 
     cols = _columns(h1, h2, d, w)                            # (B, d)
     est, frac, boundary = _estimate(state, cols, p, now_us, sub_us=sub_us,
-                                    SW=SW, S=S, weighted=weighted)
+                                    SW=SW, S=S, weighted=weighted, pre=pre)
 
     avail = jnp.maximum(jnp.float32(limit) - est, 0.0)
     n_f = n.astype(jnp.float32)
@@ -288,20 +319,45 @@ def _sketch_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
     span tens of ms, sub-windows are ~1 s; callers split chunks at period
     boundaries and dispatch the rollover kernel between them.
 
-    (Perf note, measured at the config-3 geometry: carrying the full
-    state dict — including the loop-invariant ring — is FASTER than
-    hoisting the ring into a closure constant; XLA keeps invariant
-    carries aliased in place, while the hoisted form lost ~25%.)"""
+    That precondition also makes the boundary slab and its validity
+    loop-invariant, so they are computed HERE, outside the scan body,
+    with only the per-step boundary weight riding the xs. This matters
+    enormously: scalars derived from the loop carry defeat XLA's
+    invariant hoisting and force the 64 MB dynamic ring slice + dense
+    combine to re-run every iteration (measured ~500 us/step at the
+    config-3 serving shape; hoisted: single-digit us)."""
     T = h1s.shape[0]
+    weighted = step_kw.get("weighted", True)
+    sub_us = step_kw["sub_us"]
+    S, SW = step_kw["S"], step_kw["SW"]
+
+    if weighted:
+        p = state["last_period"]
+        b_idx = (p % S).astype(jnp.int32)
+        boundary_valid = state["slab_period"][b_idx] == p - SW
+        boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
+                                                keepdims=False)
+        ts = now0_us + jnp.arange(T, dtype=jnp.int64) * dt_us
+        ts = jnp.maximum(ts, p * sub_us)  # same skew clamp as the step
+        elapsed = (ts - p * sub_us).astype(jnp.float32)
+        fracs = jnp.where(boundary_valid,
+                          jnp.clip(1.0 - elapsed / jnp.float32(sub_us),
+                                   0.0, 1.0),
+                          0.0)
+    else:
+        boundary = None
+        fracs = jnp.zeros((T,), jnp.float32)
 
     def body(st, xs):
-        h1, h2, n, i = xs
+        h1, h2, n, i, frac_t = xs
+        pre = (frac_t, boundary) if weighted else None
         st, (allowed, _rem, _est) = _sketch_step(
-            st, h1, h2, n, now0_us + i * dt_us, **step_kw)
+            st, h1, h2, n, now0_us + i * dt_us, pre=pre, **step_kw)
         return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
 
     idx = jnp.arange(T, dtype=jnp.int64)
-    state, (packed, denies) = jax.lax.scan(body, state, (h1s, h2s, ns, idx))
+    state, (packed, denies) = jax.lax.scan(
+        body, state, (h1s, h2s, ns, idx, fracs))
     return state, packed, denies
 
 
