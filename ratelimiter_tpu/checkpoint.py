@@ -30,6 +30,7 @@ tests/test_checkpoint.py):
 from __future__ import annotations
 
 import hashlib
+import itertools
 import io
 import json
 import os
@@ -43,6 +44,7 @@ from ratelimiter_tpu.core.errors import CheckpointError
 
 FORMAT_VERSION = 1
 _META_KEY = "__ratelimiter_tpu_meta__"
+_tmp_counter = itertools.count()
 
 
 def config_fingerprint(config: Config) -> str:
@@ -70,7 +72,11 @@ def save_state(path: str, kind: str, config: Config,
     np.savez(buf, **arrays,
              **{_META_KEY: np.frombuffer(
                  json.dumps(meta).encode(), dtype=np.uint8)})
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # Unique per call, not just per process: concurrent save() calls to
+    # the same path would otherwise share one tmp name and steal each
+    # other's file out from under os.replace (last replace wins either
+    # way; both must survive).
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
     with open(tmp, "wb") as f:
         f.write(buf.getvalue())
     os.replace(tmp, path)
