@@ -429,7 +429,8 @@ void handle_metrics(Server* s, const Pending& p) {
       else if (PyUnicode_Check(res)) {
         Py_ssize_t n = 0;
         const char* u = PyUnicode_AsUTF8AndSize(res, &n);
-        text.assign(u, n);
+        if (u != nullptr) text.assign(u, n);
+        else PyErr_Clear();
       }
       Py_DECREF(res);
     } else if (PyErr_Occurred()) {
@@ -586,6 +587,9 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       if (blen < 4) return false;
       uint32_t count;
       memcpy(&count, body, 4);
+      // Untrusted count: every item needs >= 6 body bytes, so anything
+      // larger is malformed — reject BEFORE reserving (alloc bound).
+      if (count > (blen - 4) / 6) return false;
       Pending p{c, req_id, true, {}, {}};
       p.keys.reserve(count);
       p.ns.reserve(count);
@@ -817,9 +821,13 @@ void server_dealloc(PyObject* self) {
       uint64_t one = 1;
       ssize_t r = write(ps->s->event_fd, &one, 8);
       (void)r;
+      // The dispatcher may be blocked in PyGILState_Ensure for a decide;
+      // joining while holding the GIL would deadlock.
+      Py_BEGIN_ALLOW_THREADS;
       if (ps->s->io_thread.joinable()) ps->s->io_thread.join();
       if (ps->s->dispatch_thread.joinable()) ps->s->dispatch_thread.join();
       if (ps->s->slo_thread.joinable()) ps->s->slo_thread.join();
+      Py_END_ALLOW_THREADS;
       close(ps->s->listen_fd);
       close(ps->s->epoll_fd);
       close(ps->s->event_fd);
