@@ -1,0 +1,37 @@
+"""Dynamic limit updates + cross-region (DCN) slab exchange."""
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, SketchParams, create_limiter
+from ratelimiter_tpu.parallel import DcnMirrorGroup
+
+cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+             sketch=SketchParams(depth=4, width=4096, sub_windows=6))
+
+# -- dynamic limits: consumption stands, new limit governs ------------
+clock = ManualClock(1_700_000_000.0)
+lim = create_limiter(cfg, backend="sketch", clock=clock)
+assert lim.allow_n("k", 10).allowed
+assert not lim.allow("k").allowed
+lim.update_limit(15)
+res = lim.allow_n("k", 5)
+print(f"after raise to 15: 5 more allowed={res.allowed} "
+      f"(consumed 10 stands)")
+lim.close()
+
+# -- DCN: two 'regions' exchanging completed sub-window slabs ---------
+clocks = [ManualClock(1_700_000_000.0) for _ in range(2)]
+pods = [create_limiter(cfg, backend="sketch", clock=c) for c in clocks]
+group = DcnMirrorGroup(pods)
+
+print(f"region A admits: {pods[0].allow_batch(['hot'] * 12).allow_count}")
+print(f"region B admits: {pods[1].allow_batch(['hot'] * 12).allow_count} "
+      "(hasn't heard from A yet — bounded staleness)")
+for c in clocks:
+    c.advance(1.0)             # complete the sub-window
+for p in pods:
+    p.allow("tick")
+group.sync()                   # any transport works; here in-process
+print(f"after sync, region B: allowed={pods[1].allow('hot').allowed} "
+      "(global history visible)")
+for p in pods:
+    p.close()
+print("OK")

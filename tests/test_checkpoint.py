@@ -114,6 +114,37 @@ class TestCrashEquivalence:
         lim2.close()
 
 
+class TestMeshCheckpoint:
+    def test_mesh_save_restore_preserves_replication(self, tmp_path):
+        """Sharding-preserving restore on the mesh: snapshot a replicated
+        state, restore into a fresh mesh limiter, decisions continue with
+        the global invariant intact."""
+        import jax
+        import pytest as _pytest
+
+        if len(jax.devices()) < 8:
+            _pytest.skip("needs 8 virtual devices")
+        from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
+
+        mesh = make_mesh(n_devices=8)
+        path = str(tmp_path / "mesh.npz")
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=60.0,
+                     sketch=SketchParams(depth=2, width=256, sub_windows=6))
+        lim = MeshSketchLimiter(cfg, ManualClock(T0), mesh=mesh,
+                                merge="gather")
+        assert lim.allow_batch(["hot"] * 16).allow_count == 10
+        lim.save(path)
+        lim.close()
+
+        lim2 = MeshSketchLimiter(cfg, ManualClock(T0), mesh=mesh,
+                                 merge="gather")
+        lim2.restore(path)
+        out = lim2.allow_batch(["hot"] * 16)
+        assert out.allow_count == 0          # global history restored
+        assert lim2.allow_batch(["cold"] * 4).allow_count == 4
+        lim2.close()
+
+
 class TestValidation:
     def test_config_fingerprint_mismatch(self, tmp_path):
         path = str(tmp_path / "snap.npz")
