@@ -92,10 +92,33 @@ class ExactLimiter(RateLimiter):
             self._rate_num = new_cfg.limit * MICROS // g
             self._rate_den = self._window_us // g
 
+    # ---------------------------------------------------- fault injection
+
+    def inject_failure(self, exc: Optional[Exception] = None) -> None:
+        """Test hook: fail every subsequent decision (the miniredis
+        ``mr.Close()`` analog, SURVEY.md §4.2.3) so fail-open/fail-closed
+        paths are exercisable on the oracle exactly like on the device
+        backends. Pass None via heal() to recover."""
+        self._injected_failure = exc if exc is not None else RuntimeError(
+            "injected backend failure")
+
+    def heal(self) -> None:
+        self._injected_failure = None
+
     # ------------------------------------------------------------------ allow
 
     def _allow_n(self, key: str, n: int, now: float) -> Result:
         algo = self.config.algorithm
+        if getattr(self, "_injected_failure", None) is not None:
+            if self.config.fail_open:
+                from ratelimiter_tpu.core.types import fail_open_result
+
+                return fail_open_result(self.config.limit,
+                                        now + float(self.config.window))
+            from ratelimiter_tpu.core.errors import StorageUnavailableError
+
+            raise StorageUnavailableError(
+                f"exact store failure: {self._injected_failure}")
         now_us = to_micros(now)
         with self._lock:
             if algo is Algorithm.FIXED_WINDOW:
