@@ -66,6 +66,35 @@ constexpr uint16_t E_INVALID_N = 1, E_INVALID_KEY = 2,
 constexpr uint32_t MAX_FRAME = 1u << 20;
 constexpr uint32_t MAX_KEY_LEN = 4096;
 
+// Keys are UTF-8 strings at the protocol level (the asyncio server
+// decodes them and rejects invalid byte sequences); validate here so
+// both front doors accept exactly the same key space instead of the
+// native path silently hashing raw bytes reset() could never name.
+bool utf8_valid(const char* s, size_t n) {
+  const unsigned char* p = (const unsigned char*)s;
+  const unsigned char* end = p + n;
+  while (p < end) {
+    if (*p < 0x80) { ++p; continue; }
+    int len;
+    uint32_t cp;
+    if ((*p & 0xE0) == 0xC0) { len = 2; cp = *p & 0x1Fu; }
+    else if ((*p & 0xF0) == 0xE0) { len = 3; cp = *p & 0x0Fu; }
+    else if ((*p & 0xF8) == 0xF0) { len = 4; cp = *p & 0x07u; }
+    else return false;
+    if (end - p < len) return false;
+    for (int i = 1; i < len; ++i) {
+      if ((p[i] & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (p[i] & 0x3Fu);
+    }
+    if (len == 2 && cp < 0x80) return false;                  // overlong
+    if (len == 3 && (cp < 0x800 || (cp >= 0xD800 && cp <= 0xDFFF)))
+      return false;                                           // overlong/surrogate
+    if (len == 4 && (cp < 0x10000 || cp > 0x10FFFF)) return false;
+    p += len;
+  }
+  return true;
+}
+
 void put_u32(std::string& b, uint32_t v) { b.append((char*)&v, 4); }
 void put_u16(std::string& b, uint16_t v) { b.append((char*)&v, 2); }
 void put_u64(std::string& b, uint64_t v) { b.append((char*)&v, 8); }
@@ -307,28 +336,28 @@ bool run_decide(Server* s, std::vector<Pending>& items,
         PyErr_Clear();
       } else {
         limit = (int64_t)o_lim;
-        Py_buffer b_fl, b_rem, b_ret, b_rst;
-        bool ok = PyObject_GetBuffer(o_fl, &b_fl, PyBUF_SIMPLE) == 0;
-        ok = ok && PyObject_GetBuffer(o_rem, &b_rem, PyBUF_SIMPLE) == 0;
-        ok = ok && PyObject_GetBuffer(o_ret, &b_ret, PyBUF_SIMPLE) == 0;
-        ok = ok && PyObject_GetBuffer(o_rst, &b_rst, PyBUF_SIMPLE) == 0;
-        if (!ok || (size_t)b_fl.len < total || (size_t)b_rem.len < total * 8 ||
-            (size_t)b_ret.len < total * 8 || (size_t)b_rst.len < total * 8) {
+        Py_buffer bufs[4];
+        PyObject* objs[4] = {o_fl, o_rem, o_ret, o_rst};
+        int acquired = 0;  // bufs[0..acquired) hold views needing release
+        while (acquired < 4 &&
+               PyObject_GetBuffer(objs[acquired], &bufs[acquired],
+                                  PyBUF_SIMPLE) == 0)
+          ++acquired;
+        bool ok = acquired == 4;
+        if (!ok || (size_t)bufs[0].len < total ||
+            (size_t)bufs[1].len < total * 8 ||
+            (size_t)bufs[2].len < total * 8 ||
+            (size_t)bufs[3].len < total * 8) {
           err_code = E_INTERNAL;
           err_msg = "decide returned short buffers";
           PyErr_Clear();
         } else {
-          memcpy(flags.data(), b_fl.buf, total);
-          memcpy(remaining.data(), b_rem.buf, total * 8);
-          memcpy(retry.data(), b_ret.buf, total * 8);
-          memcpy(reset_at.data(), b_rst.buf, total * 8);
+          memcpy(flags.data(), bufs[0].buf, total);
+          memcpy(remaining.data(), bufs[1].buf, total * 8);
+          memcpy(retry.data(), bufs[2].buf, total * 8);
+          memcpy(reset_at.data(), bufs[3].buf, total * 8);
         }
-        if (ok) {
-          PyBuffer_Release(&b_fl);
-          PyBuffer_Release(&b_rem);
-          PyBuffer_Release(&b_ret);
-          PyBuffer_Release(&b_rst);
-        }
+        for (int i = 0; i < acquired; ++i) PyBuffer_Release(&bufs[i]);
       }
       Py_DECREF(res);
     }
@@ -576,9 +605,9 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       } else if (n == 0) {
         conn_send(s, c, make_error(req_id, E_INVALID_N,
                                    "n must be a positive integer, got 0"));
-      } else if (klen == 0) {
+      } else if (klen == 0 || !utf8_valid(body + 6, klen)) {
         conn_send(s, c, make_error(req_id, E_INVALID_KEY,
-                                   "key must be a non-empty string"));
+                                   "key must be a non-empty UTF-8 string"));
       } else {
         Pending p{c, req_id, false, {std::string(body + 6, klen)}, {(int64_t)n}};
         enqueue(std::move(p), 1);
@@ -604,7 +633,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         pos += 6;
         if (klen > MAX_KEY_LEN || pos + klen > blen) return false;
         if (n == 0) bad_n = true;
-        if (klen == 0) bad_key = true;
+        if (klen == 0 || !utf8_valid(body + pos, klen)) bad_key = true;
         p.keys.emplace_back(body + pos, klen);
         p.ns.push_back((int64_t)n);
         pos += klen;
@@ -618,7 +647,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
                                    "n must be a positive integer"));
       } else if (bad_key) {
         conn_send(s, c, make_error(req_id, E_INVALID_KEY,
-                                   "key must be a non-empty string"));
+                                   "key must be a non-empty UTF-8 string"));
       } else {
         size_t nk = p.keys.size();
         enqueue(std::move(p), nk);
@@ -628,9 +657,9 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       uint16_t klen;
       memcpy(&klen, body, 2);
       if (blen != 2u + klen || klen > MAX_KEY_LEN) return false;
-      if (klen == 0) {
+      if (klen == 0 || !utf8_valid(body + 2, klen)) {
         conn_send(s, c, make_error(req_id, E_INVALID_KEY,
-                                   "key must be a non-empty string"));
+                                   "key must be a non-empty UTF-8 string"));
       } else {
         Pending p{c, req_id, false, {std::string(body + 2, klen)}, {-1}};
         enqueue(std::move(p), 0);

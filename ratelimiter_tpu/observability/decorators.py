@@ -316,9 +316,23 @@ class CircuitBreakerDecorator(LimiterDecorator):
         self._open_until = now + self.cooldown
         self._transitions.inc(to="open")
 
-    def _note_result(self, failed: bool, now: float) -> None:
+    def _clear_probe(self) -> None:
+        """Release the half-open probe slot without judging backend health.
+
+        Non-storage exceptions (key/N validation, a closed limiter, bugs)
+        say nothing about whether the backend recovered; counting them as
+        failures would re-open the breaker on caller mistakes, and not
+        clearing the slot would wedge the breaker permanently (every later
+        call short-circuits because the probe "never returned").
+        Only the call that OWNS the slot may release it.
+        """
         with self._cb_lock:
             self._probe_inflight = False
+
+    def _note_result(self, failed: bool, now: float, probe: bool) -> None:
+        with self._cb_lock:
+            if probe:
+                self._probe_inflight = False
             if failed:
                 self._consecutive += 1
                 if (self._state == "half-open"
@@ -330,18 +344,22 @@ class CircuitBreakerDecorator(LimiterDecorator):
                     self._state = "closed"
                     self._transitions.inc(to="closed")
 
-    def _admit_call(self, now: float) -> bool:
-        """True if this call may reach the backend."""
+    def _admit_call(self, now: float) -> Optional[bool]:
+        """None = short-circuit; False = admitted (breaker closed);
+        True = admitted as THE half-open probe (this call owns the slot
+        and is the only one allowed to release it — a concurrent
+        closed-state call that later fails must not free a slot it never
+        held, or two probes could run at once)."""
         with self._cb_lock:
             if self._state == "closed":
-                return True
+                return False
             if self._state == "open" and now >= self._open_until:
                 self._state = "half-open"
                 self._transitions.inc(to="half-open")
             if self._state == "half-open" and not self._probe_inflight:
                 self._probe_inflight = True
                 return True
-            return False
+            return None
 
     def _short_circuit(self, b: int, now: float):
         self._short_circuits.inc(b)
@@ -360,27 +378,37 @@ class CircuitBreakerDecorator(LimiterDecorator):
 
     def allow_n(self, key: str, n: int, *, now: Optional[float] = None) -> Result:
         t = self.inner.clock.now() if now is None else float(now)
-        if not self._admit_call(t):
+        probe = self._admit_call(t)
+        if probe is None:
             return self._short_circuit(1, t)
         try:
             res = self.inner.allow_n(key, n, now=now)
         except StorageUnavailableError:
-            self._note_result(True, t)
+            self._note_result(True, t, probe)
             raise
-        self._note_result(res.fail_open, t)
+        except BaseException:
+            if probe:
+                self._clear_probe()
+            raise
+        self._note_result(res.fail_open, t, probe)
         return res
 
     def allow_batch(self, keys: Sequence[str], ns=None, *,
                     now: Optional[float] = None) -> BatchResult:
         t = self.inner.clock.now() if now is None else float(now)
-        if not self._admit_call(t):
+        probe = self._admit_call(t)
+        if probe is None:
             return self._short_circuit(len(keys), t)
         try:
             out = self.inner.allow_batch(keys, ns, now=now)
         except StorageUnavailableError:
-            self._note_result(True, t)
+            self._note_result(True, t, probe)
             raise
-        self._note_result(out.fail_open, t)
+        except BaseException:
+            if probe:
+                self._clear_probe()
+            raise
+        self._note_result(out.fail_open, t, probe)
         return out
 
 

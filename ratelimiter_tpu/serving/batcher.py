@@ -85,6 +85,20 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ submit
 
+    def _enqueue(self, loop: asyncio.AbstractEventLoop, key: str,
+                 n: int) -> asyncio.Future:
+        fut: asyncio.Future = loop.create_future()
+        self._pending.append((key, n, fut))
+        if len(self._pending) >= self.max_batch:
+            self._flush()
+        return fut
+
+    def _arm_timer(self, loop: asyncio.AbstractEventLoop) -> None:
+        depth = len(self._pending)
+        self._queue_depth.set(depth)
+        if depth and self._timer is None:
+            self._timer = loop.call_later(self.max_delay, self._flush)
+
     def submit_nowait(self, key: str, n: int = 1) -> asyncio.Future:
         """Queue one decision and return its future WITHOUT awaiting —
         the zero-task fast path the server's reader loop uses (a done
@@ -98,15 +112,26 @@ class MicroBatcher:
         check_n(n)
         loop = asyncio.get_running_loop()
         self._loop = loop
-        fut: asyncio.Future = loop.create_future()
-        self._pending.append((key, n, fut))
-        depth = len(self._pending)
-        self._queue_depth.set(depth)
-        if depth >= self.max_batch:
-            self._flush()
-        elif self._timer is None:
-            self._timer = loop.call_later(self.max_delay, self._flush)
+        fut = self._enqueue(loop, key, n)
+        self._arm_timer(loop)
         return fut
+
+    def submit_many_nowait(self, pairs) -> List[asyncio.Future]:
+        """Queue a whole frame of (key, n) decisions atomically: every
+        pair is validated BEFORE any is queued, so a bad pair mid-frame
+        cannot leave earlier pairs consuming quota with nobody reading
+        their futures. Must run on the event loop thread."""
+        pairs = list(pairs)
+        if self._draining:
+            raise StorageUnavailableError("server is shutting down")
+        for key, n in pairs:
+            check_key(key)
+            check_n(n)
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        futs = [self._enqueue(loop, key, n) for key, n in pairs]
+        self._arm_timer(loop)
+        return futs
 
     async def submit(self, key: str, n: int = 1) -> Result:
         """Queue one decision; resolves when its batch's dispatch lands."""

@@ -97,7 +97,10 @@ _CODE_TO_EXC = {
 def code_for(exc: Exception) -> int:
     if isinstance(exc, InvalidNError):
         return E_INVALID_N
-    if isinstance(exc, InvalidKeyError):
+    if isinstance(exc, (InvalidKeyError, UnicodeDecodeError)):
+        # Keys are UTF-8 on the wire; undecodable bytes are a bad KEY,
+        # not a server fault (native front door answers E_INVALID_KEY
+        # for the same frame — the two servers must agree).
         return E_INVALID_KEY
     if isinstance(exc, StorageUnavailableError):
         return E_STORAGE_UNAVAILABLE

@@ -33,6 +33,11 @@ from ratelimiter_tpu.serving.batcher import MicroBatcher
 
 log = logging.getLogger("ratelimiter_tpu.serving")
 
+# A connection whose transport write buffer grows past this is a slow
+# reader that keeps pipelining: drop it rather than buffer without bound
+# (the read side is already frame-capped by the protocol).
+WRITE_BUFFER_LIMIT = 8 * 1024 * 1024
+
 
 class RateLimitServer:
     def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
@@ -91,11 +96,20 @@ class RateLimitServer:
             self._conn_tasks.add(task)
 
         def write_out(frame: bytes) -> None:
-            # Done-callback writer: transport buffering handles
-            # backpressure (writes never block the loop); broken pipes
-            # surface in the reader loop, which owns teardown.
+            # Done-callback writer: writes never block the loop; broken
+            # pipes surface in the reader loop, which owns teardown. A
+            # client that pipelines but reads slowly is cut off once the
+            # transport buffer passes WRITE_BUFFER_LIMIT — done-callbacks
+            # cannot await drain(), so the bound is enforced by closing.
             try:
                 writer.write(frame)
+                transport = writer.transport
+                if (transport is not None and
+                        transport.get_write_buffer_size() > WRITE_BUFFER_LIMIT):
+                    log.warning(
+                        "dropping slow-reader connection (%d bytes buffered)",
+                        transport.get_write_buffer_size())
+                    transport.abort()
             except (ConnectionResetError, BrokenPipeError, RuntimeError):
                 pass
 
@@ -133,8 +147,7 @@ class RateLimitServer:
                 if type_ == p.T_ALLOW_BATCH:
                     try:
                         keys, ns = p.parse_allow_batch(body)
-                        futs = [self.batcher.submit_nowait(k, n)
-                                for k, n in zip(keys, ns)]
+                        futs = self.batcher.submit_many_nowait(zip(keys, ns))
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
@@ -191,8 +204,8 @@ class RateLimitServer:
             else:
                 out = p.encode_error(req_id, p.E_INTERNAL,
                                      f"unknown request type {type_}")
-        except p.ProtocolError as exc:
-            out = p.encode_error(req_id, p.E_INTERNAL, str(exc))
+        except (p.ProtocolError, UnicodeDecodeError) as exc:
+            out = p.encode_error(req_id, p.code_for(exc), str(exc))
         async with write_lock:
             try:
                 writer.write(out)

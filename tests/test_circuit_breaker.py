@@ -130,6 +130,28 @@ class TestCircuitBreaker:
         assert cb.state == "open"
         cb.close()
 
+    def test_half_open_probe_not_wedged_by_validation_error(self):
+        # A probe that dies on a *non-storage* error (bad key, bad N) must
+        # release the probe slot: the error says nothing about backend
+        # health, and holding the slot would short-circuit every later call
+        # until process restart.
+        cb, counting, inner, clock = make(fail_open=True)
+        inner.inject_failure()
+        for _ in range(3):
+            cb.allow("k")
+        assert cb.state == "open"
+        inner.heal()
+        clock.advance(5.1)           # half-open; next call is the probe
+        with pytest.raises(Exception):
+            cb.allow_n("k", 0)       # InvalidNError from inner validation
+        assert cb.state == "half-open"
+        # The slot is free: a well-formed probe reaches the backend and
+        # closes the breaker instead of being short-circuited forever.
+        res = cb.allow("k")
+        assert res.allowed and not res.fail_open
+        assert cb.state == "closed"
+        cb.close()
+
     def test_composes_with_contract_surface(self):
         # Breaker is transparent when the backend is healthy.
         cb, counting, inner, clock = make(fail_open=True)

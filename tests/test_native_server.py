@@ -175,6 +175,35 @@ class TestNativeServer:
                 assert not c.allow("ключ:héllo").allowed
         lim.close()
 
+    def test_invalid_utf8_key_rejected(self):
+        """The native frame parser validates UTF-8 so both front doors
+        accept the same key space (the asyncio server decodes keys; a raw
+        bytes key that can't decode must not be silently hashed here,
+        since reset() could never name it)."""
+        import socket
+        import struct
+
+        from ratelimiter_tpu.serving import protocol as p
+
+        lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        with running(lim) as (_, port):
+            with socket.create_connection(("127.0.0.1", port)) as sk:
+                bad = b"\xff\xfekey"          # invalid UTF-8
+                body = struct.pack("<IH", 1, len(bad)) + bad
+                sk.sendall(struct.pack("<IBQ", 1 + 8 + len(body),
+                                       p.T_ALLOW_N, 7) + body)
+                hdr = sk.recv(13, socket.MSG_WAITALL)
+                length, type_, req_id = p.parse_header(hdr)
+                assert type_ == p.T_ERROR and req_id == 7
+                rest = sk.recv(length - 9, socket.MSG_WAITALL)
+                code, mlen = struct.unpack_from("<HH", rest)
+                assert code == p.E_INVALID_KEY
+            # Well-formed keys still work on a fresh connection.
+            with Client(port=port) as c:
+                assert c.allow("ok").allowed
+        lim.close()
+
     def test_pipelined_coalescing(self):
         """Many concurrent scalar requests share dispatches (batch-size
         histogram must show multi-request batches)."""

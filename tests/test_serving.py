@@ -331,6 +331,47 @@ class TestServerIntegration:
                 assert c.allow("a").allowed  # connection survives
         lim.close()
 
+    def test_allow_batch_invalid_frame_consumes_no_quota(self):
+        """A frame rejected mid-validation must queue NOTHING: earlier
+        pairs in the frame would otherwise consume quota with no reader
+        of their futures (whole-frame atomicity of validation)."""
+        lim, _ = _mk_limiter(limit=2)
+        with running_server(lim) as (_, port, _loop):
+            with Client(port=port) as c:
+                with pytest.raises(InvalidNError):
+                    c.allow_batch(["a", "a", "b"], [1, 1, 0])
+                # "a" was listed twice before the invalid pair; if those
+                # had been queued, only 0 allowances would remain here.
+                res = c.allow_batch(["a", "a"])
+                assert [r.allowed for r in res] == [True, True]
+        lim.close()
+
+    def test_invalid_utf8_key_rejected_same_as_native(self):
+        """Parity with the native front door: undecodable key bytes on
+        ALLOW_N and RESET come back as E_INVALID_KEY error frames (never
+        E_INTERNAL, never a silent hang)."""
+        import socket
+        import struct
+
+        lim, _ = _mk_limiter()
+        with running_server(lim) as (_, port, _loop):
+            with socket.create_connection(("127.0.0.1", port)) as sk:
+                bad = b"\xff\xfekey"
+                body = struct.pack("<IH", 1, len(bad)) + bad
+                sk.sendall(struct.pack("<IBQ", 1 + 8 + len(body),
+                                       p.T_ALLOW_N, 3) + body)
+                body = struct.pack("<H", len(bad)) + bad
+                sk.sendall(struct.pack("<IBQ", 1 + 8 + len(body),
+                                       p.T_RESET, 4) + body)
+                for _ in range(2):
+                    hdr = sk.recv(13, socket.MSG_WAITALL)
+                    length, type_, req_id = p.parse_header(hdr)
+                    rest = sk.recv(length - 9, socket.MSG_WAITALL)
+                    assert type_ == p.T_ERROR and req_id in (3, 4)
+                    code, _ = struct.unpack_from("<HH", rest)
+                    assert code == p.E_INVALID_KEY, (req_id, code)
+        lim.close()
+
     def test_fail_open_through_the_server(self):
         lim, _ = _mk_limiter(limit=5, algo=Algorithm.TPU_SKETCH,
                              backend="sketch", fail_open=True)
