@@ -602,12 +602,15 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       if (s->draining.load()) {
         conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
                                    "server is shutting down"));
+      } else if (klen == 0 || !utf8_valid(body + 6, klen)) {
+        // Key before n: the asyncio server decodes the key during frame
+        // parsing, so a frame bad in both ways answers E_INVALID_KEY
+        // there — the two front doors must agree on the code.
+        conn_send(s, c, make_error(req_id, E_INVALID_KEY,
+                                   "key must be a non-empty UTF-8 string"));
       } else if (n == 0) {
         conn_send(s, c, make_error(req_id, E_INVALID_N,
                                    "n must be a positive integer, got 0"));
-      } else if (klen == 0 || !utf8_valid(body + 6, klen)) {
-        conn_send(s, c, make_error(req_id, E_INVALID_KEY,
-                                   "key must be a non-empty UTF-8 string"));
       } else {
         Pending p{c, req_id, false, {std::string(body + 6, klen)}, {(int64_t)n}};
         enqueue(std::move(p), 1);
@@ -623,7 +626,11 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       p.keys.reserve(count);
       p.ns.reserve(count);
       size_t pos = 4;
-      bool bad_n = false, bad_key = false;
+      // Error precedence mirrors the asyncio server exactly: it decodes
+      // every key at parse time (any undecodable key anywhere answers
+      // E_INVALID_KEY), then validates pairs in order, key before n.
+      bool bad_utf8 = false;
+      uint16_t first_err = 0;
       for (uint32_t i = 0; i < count; ++i) {
         if (pos + 6 > blen) return false;
         uint32_t n;
@@ -632,8 +639,11 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         memcpy(&klen, body + pos + 4, 2);
         pos += 6;
         if (klen > MAX_KEY_LEN || pos + klen > blen) return false;
-        if (n == 0) bad_n = true;
-        if (klen == 0 || !utf8_valid(body + pos, klen)) bad_key = true;
+        if (klen != 0 && !utf8_valid(body + pos, klen)) bad_utf8 = true;
+        if (first_err == 0) {
+          if (klen == 0) first_err = E_INVALID_KEY;
+          else if (n == 0) first_err = E_INVALID_N;
+        }
         p.keys.emplace_back(body + pos, klen);
         p.ns.push_back((int64_t)n);
         pos += klen;
@@ -642,12 +652,12 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       if (s->draining.load()) {
         conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
                                    "server is shutting down"));
-      } else if (bad_n) {
-        conn_send(s, c, make_error(req_id, E_INVALID_N,
-                                   "n must be a positive integer"));
-      } else if (bad_key) {
+      } else if (bad_utf8 || first_err == E_INVALID_KEY) {
         conn_send(s, c, make_error(req_id, E_INVALID_KEY,
                                    "key must be a non-empty UTF-8 string"));
+      } else if (first_err == E_INVALID_N) {
+        conn_send(s, c, make_error(req_id, E_INVALID_N,
+                                   "n must be a positive integer"));
       } else {
         size_t nk = p.keys.size();
         enqueue(std::move(p), nk);

@@ -15,7 +15,12 @@ import signal
 import time
 
 from ratelimiter_tpu import Algorithm, Config, SketchParams, create_limiter
-from ratelimiter_tpu.observability import MetricsDecorator
+from ratelimiter_tpu.observability import (
+    CircuitBreakerDecorator,
+    LoggingDecorator,
+    MetricsDecorator,
+    TracingDecorator,
+)
 from ratelimiter_tpu.serving.server import RateLimitServer
 
 
@@ -48,7 +53,44 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--no-prewarm", action="store_true",
                     help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
+    # Decorator stack (ADR-003 analog; reference docs/ADR/002:170-197 and
+    # docs/ADR/003:28-125 plan exactly these wrappers around the limiter).
+    ap.add_argument("--circuit-breaker", action="store_true",
+                    help="wrap the limiter in CircuitBreakerDecorator "
+                         "(trips after --breaker-threshold consecutive "
+                         "backend failures; probes after --breaker-cooldown)")
+    ap.add_argument("--breaker-threshold", type=int, default=5)
+    ap.add_argument("--breaker-cooldown", type=float, default=10.0,
+                    help="seconds the breaker stays open before probing")
+    ap.add_argument("--log-decisions", action="store_true",
+                    help="wrap in LoggingDecorator (decisions at DEBUG, "
+                         "fail-open at WARNING)")
+    ap.add_argument("--trace", action="store_true",
+                    help="wrap in TracingDecorator (jax.profiler "
+                         "annotations on every dispatch)")
+    ap.add_argument("--no-metrics", action="store_true",
+                    help="skip the MetricsDecorator (on by default)")
     return ap
+
+
+def build_limiter_stack(limiter, args):
+    """Apply the configured decorator stack, innermost first.
+
+    Order (inner -> outer): Tracing (annotates the real device dispatch),
+    CircuitBreaker (judges backend health from real calls), Metrics
+    (observes everything, including breaker short-circuits), Logging
+    (outermost, sees final outcomes)."""
+    if args.trace:
+        limiter = TracingDecorator(limiter)
+    if args.circuit_breaker:
+        limiter = CircuitBreakerDecorator(
+            limiter, failure_threshold=args.breaker_threshold,
+            cooldown=args.breaker_cooldown)
+    if not args.no_metrics:
+        limiter = MetricsDecorator(limiter)
+    if args.log_decisions:
+        limiter = LoggingDecorator(limiter)
+    return limiter
 
 
 def _prewarm(limiter, max_batch: int) -> None:
@@ -101,7 +143,8 @@ async def amain(args) -> None:
         sketch=SketchParams(depth=args.sketch_depth, width=args.sketch_width,
                             sub_windows=args.sub_windows),
     )
-    limiter = MetricsDecorator(create_limiter(cfg, backend=args.backend))
+    limiter = build_limiter_stack(create_limiter(cfg, backend=args.backend),
+                                  args)
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
     if args.native:

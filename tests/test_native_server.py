@@ -199,6 +199,16 @@ class TestNativeServer:
                 rest = sk.recv(length - 9, socket.MSG_WAITALL)
                 code, mlen = struct.unpack_from("<HH", rest)
                 assert code == p.E_INVALID_KEY
+                # Bad in both ways (n=0 AND undecodable key): the key
+                # error wins, matching the asyncio server's parse order.
+                body = struct.pack("<IH", 0, len(bad)) + bad
+                sk.sendall(struct.pack("<IBQ", 1 + 8 + len(body),
+                                       p.T_ALLOW_N, 8) + body)
+                hdr = sk.recv(13, socket.MSG_WAITALL)
+                length, type_, req_id = p.parse_header(hdr)
+                rest = sk.recv(length - 9, socket.MSG_WAITALL)
+                code, _ = struct.unpack_from("<HH", rest)
+                assert req_id == 8 and code == p.E_INVALID_KEY
             # Well-formed keys still work on a fresh connection.
             with Client(port=port) as c:
                 assert c.allow("ok").allowed

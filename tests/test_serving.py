@@ -466,3 +466,79 @@ class TestServerBinary:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+    def test_decorator_stack_flags(self):
+        """--circuit-breaker / --log-decisions / --trace / --no-metrics
+        build the documented stack (breaker judged from real calls,
+        metrics outside it, logging outermost)."""
+        from ratelimiter_tpu import Algorithm, Config, create_limiter
+        from ratelimiter_tpu.observability import (
+            CircuitBreakerDecorator,
+            LoggingDecorator,
+            MetricsDecorator,
+            TracingDecorator,
+        )
+        from ratelimiter_tpu.serving.__main__ import (
+            build_limiter_stack,
+            build_parser,
+        )
+
+        ap = build_parser()
+        cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=5, window=60.0)
+
+        args = ap.parse_args(["--circuit-breaker", "--log-decisions",
+                              "--trace", "--breaker-threshold", "2",
+                              "--breaker-cooldown", "3.5"])
+        stack = build_limiter_stack(create_limiter(cfg, backend="exact"), args)
+        assert isinstance(stack, LoggingDecorator)
+        assert isinstance(stack.inner, MetricsDecorator)
+        assert isinstance(stack.inner.inner, CircuitBreakerDecorator)
+        assert stack.inner.inner.failure_threshold == 2
+        assert stack.inner.inner.cooldown == 3.5
+        assert isinstance(stack.inner.inner.inner, TracingDecorator)
+        assert stack.allow("k").allowed  # stack actually serves decisions
+        stack.close()
+
+        from ratelimiter_tpu.observability.decorators import LimiterDecorator
+
+        args = ap.parse_args(["--no-metrics"])
+        bare = build_limiter_stack(create_limiter(cfg, backend="exact"), args)
+        assert not isinstance(bare, LimiterDecorator)
+        bare.close()
+
+    def test_cli_circuit_breaker_flag_serves(self):
+        """The shipped binary accepts --circuit-breaker and still answers
+        decisions (the breaker is transparent on a healthy backend)."""
+        import os
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "exact", "--algorithm", "sliding_window",
+             "--limit", "3", "--window", "60", "--port", str(port),
+             "--circuit-breaker", "--breaker-threshold", "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            line = proc.stdout.readline()
+            assert "serving" in line, line
+            with Client(port=port, timeout=10.0) as c:
+                assert c.allow("k").allowed
+                assert not c.allow_n("k", 5).allowed
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
