@@ -11,9 +11,9 @@ B. Accuracy at the benched operating point: the SAME trace stream is decided
    (evaluation/oracle_device.py), at the rate measured in phase A.
    false_deny_rate / false_allow_rate are measured in-run, not quoted —
    window_coverage says how much of a full 60 s window the accuracy phase
-   filled (1.0 = steady state; error grows as the window fills, so partial
-   coverage understates steady-state error; benchmarks/ holds a full-window
-   run).
+   filled (defaults to 1.25 on a real chip, i.e. past steady state; error
+   grows as the window fills, so partial coverage would understate
+   steady-state error).
 C. Serving shape: ingest batches of 4096 (BASELINE config 3) coalesced
    64-at-a-time into one device dispatch via the lax.scan runner
    (ops/sketch_kernels.build_scan). Reports on-chip per-ingest-batch step
@@ -31,7 +31,7 @@ Baseline: the reference's own single-instance sliding-window estimate,
 10M decisions/s (BASELINE.json).
 
 Run: python bench.py                 (real chip; CPU fallback uses tiny shapes)
-     BENCH_ACC_WINDOWS=1.25 python bench.py    (full steady-state accuracy)
+     BENCH_ACC_WINDOWS=0.25 python bench.py    (quicker, partial coverage)
 """
 
 import json
@@ -87,8 +87,12 @@ def main() -> None:
     on_accel = platform != "cpu"
     B = (1 << 22) if on_accel else (1 << 16)
     n_keys = N_KEYS if on_accel else 50_000
+    # Default >= 1.0 window of coverage on a real chip: steady-state error
+    # is reached once the full 60 s window has filled, so partial coverage
+    # understates false-deny (VERDICT r3 weak item 4). CPU fallback keeps a
+    # tiny default so the suite smoke stays fast.
     acc_windows = float(os.environ.get("BENCH_ACC_WINDOWS",
-                                       "0.25" if on_accel else "0.02"))
+                                       "1.25" if on_accel else "0.02"))
     bench_seconds = float(os.environ.get("BENCH_SECONDS", "6"))
 
     cfg = Config(

@@ -42,12 +42,20 @@ def _render_md(doc: dict) -> str:
     ]
     if "matrix" in doc:
         lines += ["## Matrix (reference 31-benchmark analog)", "",
-                  "| group | algorithm | backend | shape | µs/call | decisions/s |",
-                  "|---|---|---|---|---:|---:|"]
+                  "µs/call is wall clock and pays the full host↔device "
+                  "round trip per dispatch (~100+ ms through the dev "
+                  "tunnel); device µs/step is the scan-amortized on-device "
+                  "compute for the same batch shape (blank for host "
+                  "backends and scalar shapes).", "",
+                  "| group | algorithm | backend | shape | µs/call "
+                  "| device µs/step | decisions/s |",
+                  "|---|---|---|---|---:|---:|---:|"]
         for r in doc["matrix"]:
+            dev = r.get("device_us")
             lines.append(
                 f"| {r['group']} | {r['algorithm']} | {r['backend']} | "
                 f"{r['shape']} | {r['us_per_call']} | "
+                f"{dev if dev is not None else ''} | "
                 f"{r['decisions_per_sec']:,} |")
         lines.append("")
     if "configs" in doc:

@@ -199,24 +199,65 @@ def init_state(algorithm: Algorithm, capacity: int, limit: int) -> State:
 _STEP_CACHE: Dict[tuple, Callable] = {}
 
 
+def _step_fn(cfg: Config) -> Callable:
+    """The (un-jitted) step function for cfg's algorithm, statics bound."""
+    W, num, den = _check_gates(cfg)
+    common = dict(limit=cfg.limit, window_us=W, iters=cfg.max_batch_admission_iters)
+    if cfg.algorithm is Algorithm.FIXED_WINDOW:
+        return partial(_fixed_window_step, **common)
+    if cfg.algorithm in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
+        return partial(_sliding_window_step, **common)
+    if cfg.algorithm is Algorithm.TOKEN_BUCKET:
+        return partial(_token_bucket_step, **common, rate_num=num, rate_den=den)
+    raise InvalidConfigError(f"unsupported algorithm {cfg.algorithm}")
+
+
 def build_step(cfg: Config) -> Callable[[State, jnp.ndarray, jnp.ndarray, jnp.ndarray],
                                         Tuple[State, Outputs]]:
     """Returns the jitted batched step for cfg's algorithm. State buffers are
     donated: the caller must treat the passed-in state as consumed."""
-    W, num, den = _check_gates(cfg)
+    W, _, _ = _check_gates(cfg)
     cache_key = (cfg.algorithm, cfg.limit, W, cfg.max_batch_admission_iters)
     cached = _STEP_CACHE.get(cache_key)
     if cached is not None:
         return cached
-    common = dict(limit=cfg.limit, window_us=W, iters=cfg.max_batch_admission_iters)
-    if cfg.algorithm is Algorithm.FIXED_WINDOW:
-        fn = partial(_fixed_window_step, **common)
-    elif cfg.algorithm in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
-        fn = partial(_sliding_window_step, **common)
-    elif cfg.algorithm is Algorithm.TOKEN_BUCKET:
-        fn = partial(_token_bucket_step, **common, rate_num=num, rate_den=den)
-    else:
-        raise InvalidConfigError(f"unsupported algorithm {cfg.algorithm}")
-    step = jax.jit(fn, donate_argnums=(0,))
+    step = jax.jit(_step_fn(cfg), donate_argnums=(0,))
     _STEP_CACHE[cache_key] = step
     return step
+
+
+def _dense_scan(state: State, sids, ns, now0_us, dt_us, *, fn):
+    """T sequential dense steps on device (lax.scan), one dispatch —
+    sketch_kernels._sketch_scan's shape for slot-addressed state. The
+    leading axis of sids/ns is time; timestamps advance dt_us per step.
+    Slot assignment (the host half of the dense backend) happens before
+    this: sids are already resolved slot ids."""
+    from ratelimiter_tpu.ops.sketch_kernels import _pack_bits
+
+    def body(st, xs):
+        sid, n, i = xs
+        st, (allowed, _rem, _retry) = fn(st, sid, n, now0_us + i * dt_us)
+        return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
+
+    T = sids.shape[0]
+    idx = jnp.arange(T, dtype=jnp.int64)
+    state, (packed, denies) = jax.lax.scan(body, state, (sids, ns, idx))
+    return state, packed, denies
+
+
+_SCAN_CACHE: Dict[tuple, Callable] = {}
+
+
+def build_scan(cfg: Config) -> Callable:
+    """Jitted multi-step runner: ``scan(state, sids, ns, now0_us, dt_us)
+    -> (state, packed_masks, deny_counts)``. One device dispatch for T
+    batches — the amortized shape benchmarks use to see device time
+    instead of per-dispatch host round-trips."""
+    W, _, _ = _check_gates(cfg)
+    key = (cfg.algorithm, cfg.limit, W, cfg.max_batch_admission_iters)
+    cached = _SCAN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    scan = jax.jit(partial(_dense_scan, fn=_step_fn(cfg)), donate_argnums=(0,))
+    _SCAN_CACHE[key] = scan
+    return scan

@@ -214,6 +214,47 @@ class TestNativeServer:
                 assert c.allow("ok").allowed
         lim.close()
 
+    def test_batch_error_precedence_matches_asyncio(self):
+        """Cross-pair error precedence parity: a batch frame bad in two
+        ways answers the same typed error from either front door (the
+        asyncio path validates per pair, key before n, after decoding
+        every key at parse time — the native parser mirrors that)."""
+        cases = [
+            ((["a", ""], [0, 1]), InvalidNError),    # early n=0 beats later empty key
+            ((["", "a"], [0, 1]), InvalidKeyError),  # early empty key wins
+            ((["a", ""], [1, 0]), InvalidKeyError),  # early empty key beats later n=0
+        ]
+        lim, _ = _mk_limiter()
+        with running(lim) as (_, port):
+            with Client(port=port) as c:
+                for (keys, ns), exc in cases:
+                    with pytest.raises(exc):
+                        c.allow_batch(keys, ns)
+        lim.close()
+        # Same frames through the asyncio server (imported lazily to keep
+        # this module native-focused).
+        import asyncio as aio
+
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        lim2, _ = _mk_limiter()
+        loop = aio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        srv = RateLimitServer(lim2, "127.0.0.1", 0)
+        aio.run_coroutine_threadsafe(srv.start(), loop).result(10)
+        try:
+            with Client(port=srv.port) as c:
+                for (keys, ns), exc in cases:
+                    with pytest.raises(exc):
+                        c.allow_batch(keys, ns)
+        finally:
+            aio.run_coroutine_threadsafe(srv.shutdown(), loop).result(10)
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=10)
+            loop.close()
+        lim2.close()
+
     def test_pipelined_coalescing(self):
         """Many concurrent scalar requests share dispatches (batch-size
         histogram must show multi-request batches)."""
