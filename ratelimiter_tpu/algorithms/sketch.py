@@ -20,6 +20,7 @@ fail-closed on dispatch failure, ADR-002 parity).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List, Optional
 
@@ -37,6 +38,8 @@ from ratelimiter_tpu.core.types import (
 from ratelimiter_tpu.ops.hashing import hash_strings_u64, split_hash
 
 _MIN_PAD = 8
+
+log = logging.getLogger("ratelimiter_tpu")
 
 
 def _pad_size(n: int) -> int:
@@ -62,6 +65,14 @@ class SketchLimiter(RateLimiter):
         # (sketch_kernels._rollover explains why this is host-side).
         self._host_period = sketch_kernels._NEVER
         self._injected_failure: Optional[Exception] = None
+        # Accuracy-envelope watchdog: admitted in-window mass vs the
+        # geometry's calibrated budget (SketchParams.mass_budget). Host
+        # integers only — no device cost.
+        self._ring_sw = sketch_kernels.sketch_geometry(self.config)[2]
+        self._mass_budget = self.config.sketch.mass_budget(self.config.limit)
+        self._period_mass: dict = {}
+        self._warned_period = -1
+        self.overload_periods = 0
 
     def _sync_period(self, now_us: int) -> None:
         """Dispatch the rollover kernel if now_us entered a new sub-window.
@@ -115,7 +126,48 @@ class SketchLimiter(RateLimiter):
             self._state, outs = self._step(
                 self._state, self._place(h1p), self._place(h2p),
                 self._place(np_ns), jnp.int64(now_us))
-        return self._finish(outs, b, now_us)
+        res = self._finish(outs, b, now_us)
+        self._note_mass(int(np_ns[:b][res.allowed].sum()), now_us)
+        return res
+
+    # ------------------------------------------------- accuracy envelope
+
+    def _note_mass(self, admitted: int, now_us: int) -> None:
+        """Track admitted in-window mass against the geometry's calibrated
+        budget (SketchParams.mass_budget): collision error — and with it
+        the false-deny rate — scales with this mass, so exceeding the
+        budget means the geometry is undersized for the offered load.
+        Warns loudly once per sub-window while overloaded."""
+        p = now_us // self._sub_us
+        with self._lock:
+            self._period_mass[p] = self._period_mass.get(p, 0) + admitted
+            low = p - self._ring_sw
+            for q in [q for q in self._period_mass if q <= low]:
+                del self._period_mass[q]
+            mass = sum(self._period_mass.values())
+            if mass > self._mass_budget and p > self._warned_period:
+                self._warned_period = p
+                self.overload_periods += 1
+                log.warning(
+                    "sketch geometry undersized: admitted in-window mass "
+                    "%d exceeds the d=%d w=%d budget of %d at limit=%d — "
+                    "collision error is at the ~1%% false-deny level and "
+                    "grows with load; size the geometry with "
+                    "SketchParams.for_load(limit=%d, "
+                    "expected_window_mass=%d)",
+                    mass, self.config.sketch.depth, self.config.sketch.width,
+                    self._mass_budget, self.config.limit, self.config.limit,
+                    mass)
+
+    def in_window_admitted_mass(self) -> int:
+        """Admitted requests currently counted inside the sliding window
+        (the quantity SketchParams.mass_budget bounds)."""
+        with self._lock:
+            return sum(self._period_mass.values())
+
+    @property
+    def mass_budget(self) -> int:
+        return self._mass_budget
 
     def _finish(self, outs, b: int, now_us: int) -> BatchResult:
         """Window-algorithm result assembly: retry-after is time to window
@@ -202,6 +254,7 @@ class SketchLimiter(RateLimiter):
         steps = sketch_kernels.build_steps(new_cfg)
         with self._lock:
             self._step, self._reset_step, self._rollover = steps
+            self._mass_budget = new_cfg.sketch.mass_budget(new_cfg.limit)
 
     # ------------------------------------------------- checkpoint/restore
 
@@ -289,6 +342,23 @@ class SketchTokenBucketLimiter(SketchLimiter):
 
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
+
+    def _note_mass(self, admitted: int, now_us: int) -> None:
+        """No mass watchdog for the debt sketch: debt decays continuously
+        (no sub-window ring to bucket mass into) and overestimated debt
+        self-corrects as it drains; the windowed calibration does not
+        transfer. Geometry sizing guidance lives in docs/ALGORITHMS.md."""
+
+    def in_window_admitted_mass(self) -> int:
+        raise NotImplementedError(
+            "the admitted-mass watchdog applies to windowed sketches "
+            "only (debt decays continuously; see _note_mass)")
+
+    @property
+    def mass_budget(self) -> int:
+        raise NotImplementedError(
+            "the admitted-mass watchdog applies to windowed sketches "
+            "only (debt decays continuously; see _note_mass)")
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit: refill rate (limit/window) and capacity both

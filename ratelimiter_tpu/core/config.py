@@ -47,6 +47,14 @@ class SketchParams:
     #: (SURVEY.md hard part #3).
     conservative_update: bool = True
     seed: int = 0x5bd1e995
+    #: Heavy-hitter exact side table: keys whose in-window estimate crosses
+    #: ``hh_promote_fraction * limit`` are promoted into a direct-mapped
+    #: table of ``hh_slots`` private per-key ring cells (exact counts, no
+    #: collision error) and stop feeding the shared sketch. 0 disables.
+    #: Helps the moderate-skew regime where a few keys carry most of the
+    #: admitted mass (ROADMAP v0.2; ops/sketch_kernels.py docstring).
+    hh_slots: int = 0
+    hh_promote_fraction: float = 0.5
 
     def validate(self) -> None:
         if self.depth < 1 or self.depth > 16:
@@ -57,6 +65,111 @@ class SketchParams:
         if self.sub_windows < 1 or self.sub_windows > 4096:
             raise InvalidConfigError(
                 f"sketch sub_windows must be in [1, 4096], got {self.sub_windows}")
+        if self.hh_slots != 0 and (
+                self.hh_slots < 16 or self.hh_slots > (1 << 22)
+                or (self.hh_slots & (self.hh_slots - 1)) != 0):
+            raise InvalidConfigError(
+                f"hh_slots must be 0 or a power of two in [16, 2^22], "
+                f"got {self.hh_slots}")
+        if not (0.0 < self.hh_promote_fraction <= 1.0):
+            raise InvalidConfigError(
+                f"hh_promote_fraction must be in (0, 1], "
+                f"got {self.hh_promote_fraction}")
+
+    # ------------------------------------------------- load-aware sizing
+    #
+    # CMS collision error scales with the total ADMITTED in-window mass
+    # divided by width. The classic Markov bound (err <= e*M/w w.p.
+    # 1-e^-d) is orders of magnitude loose for skewed traffic under
+    # conservative update, so sizing here uses the calibrated operating
+    # curve measured against the on-device exact oracle (bench.py /
+    # benchmarks config 3, Zipf(1.1), conservative_update, depth >= 3):
+    #
+    #   mean cell load M/w = 2.0 * limit   ->  ~0.8%  false denies
+    #   mean cell load M/w = 0.27 * limit  ->  ~0.006% false denies
+    #
+    # i.e. false_deny ~ (M/(w*limit))^2.5 about the 1% anchor; inverting
+    # gives the multiplier k below. Uniform (non-skewed) key traffic has
+    # less cell-load variance and needs more width for the same target —
+    # pass ``safety > 1`` for such loads.
+
+    @classmethod
+    def for_load(cls, limit: int, expected_window_mass: float, *,
+                 active_keys: Optional[int] = None,
+                 target_false_deny: float = 0.01, depth: int = 4,
+                 sub_windows: int = 60, safety: float = 1.0,
+                 conservative_update: bool = True,
+                 max_state_bytes: int = 4 << 30,
+                 seed: int = 0x5bd1e995) -> "SketchParams":
+        """Size a sketch geometry for an expected operating point.
+
+        Two error regimes bound the width (both measured on-chip against
+        the exact oracle, benchmarks config 3 round 4):
+
+        * mass: collision error grows with admitted in-window mass per
+          cell (the curve in the class comment above);
+        * occupancy: once active keys outnumber cells, conservative-update
+          estimates compound across co-resident keys regardless of mass
+          (1M keys on a 2^19-cell d=4 sketch measured 1.7% false denies
+          at a mass/cell the mass curve alone prices at <1%; the same
+          mass at 1 key/cell measured 0.8%).
+
+        Args:
+            limit: the per-key limit the geometry will serve.
+            expected_window_mass: expected total ADMITTED requests per
+                window across all keys (offered load capped by limits:
+                roughly ``min(offered_per_window, active_keys * limit)``).
+            active_keys: expected in-window distinct keys; when given,
+                width is floored at one cell per active key (the
+                occupancy regime above).
+            target_false_deny: acceptable steady-state false-deny rate
+                vs an exact oracle at that mass (default 1%, the
+                BASELINE budget).
+            depth: CMS rows (>= 3 for the calibration to hold).
+            safety: extra width multiplier for low-skew traffic.
+            max_state_bytes: refuse geometries whose ring state would
+                exceed this (the full ring is (sub_windows+1) slabs of
+                depth x width int32 counters).
+
+        Raises InvalidConfigError if no affordable geometry meets the
+        target — undersizing silently is exactly the failure mode this
+        exists to prevent (reference sizes its backend explicitly,
+        ``docs/ADR/001-redis-as-storage-backend.md:183-187``).
+        """
+        if limit <= 0:
+            raise InvalidConfigError(f"limit must be positive, got {limit}")
+        if expected_window_mass <= 0:
+            raise InvalidConfigError(
+                f"expected_window_mass must be positive, got {expected_window_mass}")
+        if not (0.0 < target_false_deny <= 0.5):
+            raise InvalidConfigError(
+                f"target_false_deny must be in (0, 0.5], got {target_false_deny}")
+        if depth < 3:
+            raise InvalidConfigError(
+                f"for_load calibration requires depth >= 3, got {depth}")
+        k = 2.0 * (100.0 * target_false_deny) ** 0.4 / max(safety, 1e-9)
+        floor = max(expected_window_mass / (limit * k),
+                    float(active_keys or 0))
+        width = 16
+        while width < floor:
+            width *= 2
+        state_bytes = (sub_windows + 1) * depth * width * 4
+        if state_bytes > max_state_bytes:
+            raise InvalidConfigError(
+                f"no geometry within max_state_bytes={max_state_bytes}: "
+                f"mass {expected_window_mass:g} at limit {limit} and "
+                f"target {target_false_deny:g} needs width {width} "
+                f"({state_bytes / 2 ** 30:.1f} GiB of ring state); raise "
+                f"max_state_bytes, relax the target, or shard the keyspace")
+        return cls(depth=depth, width=width, sub_windows=sub_windows,
+                   conservative_update=conservative_update, seed=seed)
+
+    def mass_budget(self, limit: int) -> int:
+        """In-window admitted mass this geometry absorbs before collision
+        error reaches ~1% false denies (the calibrated 1% anchor:
+        mean cell load of 2x limit). The sketch limiter tracks admitted
+        mass at runtime and warns loudly past this."""
+        return int(2.0 * limit * self.width)
 
 
 @dataclass(frozen=True)

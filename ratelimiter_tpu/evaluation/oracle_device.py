@@ -72,9 +72,11 @@ def build_eval_chunk(cfg: Config, B: int, n_keys: int, alpha: float) -> Callable
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     seed = cfg.sketch.seed
+    hh, hh_thresh = sketch_kernels._hh_params(cfg)
     sk_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                  iters=cfg.max_batch_admission_iters, weighted=weighted,
-                 conservative=cfg.sketch.conservative_update)
+                 conservative=cfg.sketch.conservative_update,
+                 hh=hh, hh_thresh=hh_thresh)
     or_kw = oracle_geometry(cfg, n_keys)
 
     def chunk(states, counter0, now_us):

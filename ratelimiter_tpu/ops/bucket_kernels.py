@@ -70,10 +70,17 @@ _DEBT_CAP = 1 << 61
 def init_state(cfg: Config) -> State:
     """All-zero debt == every bucket full (the reference's absent-key
     default, ``tokenbucket.go:31-33``); last=0 makes the first step see a
-    huge elapsed whose decay is a no-op on zero debt."""
+    huge elapsed whose decay is a no-op on zero debt.
+
+    ``acc`` accumulates LOCAL debt increments since the last DCN export
+    (parallel/dcn.py): the step adds its write histogram there too, the
+    export snapshots-and-zeroes it, and foreign merges add to ``debt``
+    only — so exports can never re-ship foreign traffic (the bucket
+    analog of the windowed tier's completed-slab watermark)."""
     d, w = cfg.sketch.depth, cfg.sketch.width
     return {
         "debt": jnp.zeros((d, w), jnp.int64),
+        "acc": jnp.zeros((d, w), jnp.int64),
         "rem": jnp.asarray(0, jnp.int64),
         "last": jnp.asarray(0, jnp.int64),
     }
@@ -120,11 +127,15 @@ def _bucket_step(state: State, h1, h2, n, now_us, *,
                        for r in range(d)])
     if axis_name is not None:
         # Multi-chip delta merge: replicated debt, psum of increments over
-        # ICI (same invariant as sketch_kernels' delta mode).
+        # ICI (same invariant as sketch_kernels' delta mode). The psum'd
+        # histogram IS the pod's local traffic, so `acc` stays
+        # export-correct on meshes too.
         hists = jax.lax.psum(hists, axis_name)
     debt = jnp.minimum(debt + hists, _DEBT_CAP)
 
-    new_state = {"debt": debt, "rem": rem,
+    new_state = {"debt": debt,
+                 "acc": jnp.minimum(state["acc"] + hists, _DEBT_CAP),
+                 "rem": rem,
                  "last": jnp.maximum(state["last"], now_us)}
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
     # Reference retry semantics (``tokenbucket.go:122-130``): time to refill
@@ -149,7 +160,11 @@ def _bucket_reset(state: State, h1, h2, now_us, *,
         est = e_r if est is None else jnp.minimum(est, e_r)
     hists = jnp.stack([row_histogram(cols[:, r], est, w) for r in range(d)])
     debt = jnp.maximum(jnp.int64(0), debt - hists)
-    return {"debt": debt, "rem": rem,
+    # Reset is deliberately NOT subtracted from `acc`: the consumed debt
+    # it forgives was already exported (or will be) as real local traffic,
+    # and a negative export could under-count remotely (over-admission).
+    # Cross-pod, a reset key simply recovers locally first.
+    return {"debt": debt, "acc": state["acc"], "rem": rem,
             "last": jnp.maximum(state["last"], now_us)}
 
 

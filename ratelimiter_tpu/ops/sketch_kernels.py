@@ -99,13 +99,27 @@ def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
 def init_state(cfg: Config) -> State:
     _, _, _, S, _ = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width
-    return {
+    state = {
         "cur": jnp.zeros((d, w), jnp.int32),
         "slabs": jnp.zeros((S, d, w), jnp.int32),
         "totals": jnp.zeros((d, w), jnp.int32),
         "slab_period": jnp.full((S,), _NEVER, jnp.int64),
         "last_period": jnp.asarray(_NEVER, jnp.int64),
     }
+    K = cfg.sketch.hh_slots
+    if K:
+        # Heavy-hitter side table: direct-mapped (slot = h1 mod K) private
+        # ring cells for promoted keys, sharing the sketch's period clock.
+        # A key with h1 == 0 can never own a slot (0 marks free) — a
+        # 2^-32 event whose only effect is staying on the sketch path.
+        state.update({
+            "hh_owner": jnp.zeros((K,), jnp.uint32),
+            "hh_cur": jnp.zeros((K,), jnp.int32),
+            "hh_slabs": jnp.zeros((S, K), jnp.int32),
+            "hh_totals": jnp.zeros((K,), jnp.int32),
+            "hh_last": jnp.full((K,), _NEVER, jnp.int64),
+        })
+    return state
 
 
 def _rollover(state: State, p, *, SW: int, S: int) -> State:
@@ -132,9 +146,26 @@ def _rollover(state: State, p, *, SW: int, S: int) -> State:
     # p-SW is read weighted at estimate time; period p is `cur`.)
     in_window = (periods >= p - SW + 1) & (periods <= p - 1)
     totals = jnp.tensordot(in_window.astype(jnp.int32), slabs, axes=1)
-    return {"cur": jnp.zeros_like(state["cur"]), "slabs": slabs,
-            "totals": totals, "slab_period": periods,
-            "last_period": jnp.asarray(p, jnp.int64)}
+    out = {"cur": jnp.zeros_like(state["cur"]), "slabs": slabs,
+           "totals": totals, "slab_period": periods,
+           "last_period": jnp.asarray(p, jnp.int64)}
+    if "hh_owner" in state:
+        # The side table rides the same period clock: flush, recompute,
+        # and reclaim slots idle a full window (their in-window counts are
+        # provably zero — every write at period q lives in slab q, and
+        # idleness means no q > p - SW).
+        hh_slabs = state["hh_slabs"].at[slot].set(state["hh_cur"])
+        hh_totals = jnp.tensordot(in_window.astype(jnp.int32), hh_slabs,
+                                  axes=1)
+        idle = state["hh_last"] <= p - SW
+        out.update({
+            "hh_owner": jnp.where(idle, jnp.uint32(0), state["hh_owner"]),
+            "hh_cur": jnp.zeros_like(state["hh_cur"]),
+            "hh_slabs": hh_slabs,
+            "hh_totals": hh_totals,
+            "hh_last": state["hh_last"],
+        })
+    return out
 
 
 def _columns(h1, h2, d: int, w: int):
@@ -214,10 +245,20 @@ def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
     return jnp.maximum(est, 0.0), frac, boundary  # (B,), scalar, (d, w)|None
 
 
+def _hh_boundary_slab(state: State, p, *, SW: int, S: int):
+    """The side table's boundary sub-window column vector (K,). Validity is
+    carried by ``frac`` (0 when the boundary period is absent), exactly as
+    for the CMS boundary slab."""
+    b_idx = (p % S).astype(jnp.int32)
+    return jax.lax.dynamic_index_in_dim(state["hh_slabs"], b_idx,
+                                        keepdims=False)
+
+
 def _sketch_step(state: State, h1, h2, n, now_us, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
                  iters: int, weighted: bool, conservative: bool,
-                 axis_name: str | None = None, pre=None):
+                 hh: int = 0, hh_thresh: float = 0.0,
+                 axis_name: str | None = None, pre=None, pre_hh=None):
     # Precondition (host-enforced via _sync_period): state.last_period is
     # the period of now_us. Clamp defends against clock skew backwards —
     # the reference has the same NTP caveat (``docs/ALGORITHMS.md:162``).
@@ -228,10 +269,37 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
     est, frac, boundary = _estimate(state, cols, p, now_us, sub_us=sub_us,
                                     SW=SW, S=S, weighted=weighted, pre=pre)
 
+    if hh:
+        # Heavy-hitter side table (ROADMAP v0.2): a promoted key's NEW
+        # traffic is counted exactly in its private ring cell while its
+        # pre-promotion history stays in the sketch and expires on the
+        # normal window schedule — the estimate is the SUM of the two.
+        # Nothing is copied at promotion (a copied estimate would freeze
+        # the key's most-inflated moment — promotion fires exactly when
+        # est crosses the threshold — into a window-long sentence), and
+        # nothing is counted twice (each request lives either in the
+        # sketch or in the private cell, never both). Direct-mapped:
+        # slot = h1 mod K, identity = h1 (a 32-bit identity collision
+        # merges two keys into one exact cell — same direction as a CMS
+        # collision: over-count, false denies only).
+        sid_hh = jax.lax.bitcast_convert_type(
+            h1 & jnp.uint32(hh - 1), jnp.int32)
+        owner = state["hh_owner"][sid_hh]                    # (B,)
+        mine = owner == h1
+        est_hh = state["hh_totals"][sid_hh].astype(jnp.float32)
+        if weighted:
+            hh_b = pre_hh if pre_hh is not None else _hh_boundary_slab(
+                state, p, SW=SW, S=S)
+            est_hh = est_hh + frac * hh_b[sid_hh].astype(jnp.float32)
+        est = est + jnp.where(mine, jnp.maximum(est_hh, 0.0), 0.0)
+    else:
+        mine = None
+
     avail = jnp.maximum(jnp.float32(limit) - est, 0.0)
     n_f = n.astype(jnp.float32)
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, _ = admit(sid, n_f, avail, iters)
+    not_mine = True if mine is None else ~mine
 
     if conservative and axis_name is None:
         # Conservative update (SURVEY.md hard part #3): raise each touched
@@ -248,7 +316,7 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
         # (true counts add across chips) and a psum of per-chip CU deltas
         # can undercount rows whose dense read exceeds the min-estimate —
         # both break the never-over-admit direction. Vanilla sums never do.
-        target = jnp.where(allowed, est + (avail - seen) + n_f, 0.0)
+        target = jnp.where(allowed & not_mine, est + (avail - seen) + n_f, 0.0)
         deltas = []
         for r in range(d):
             m_r = row_histogram_max(cols[:, r], target, w)
@@ -258,7 +326,7 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
             deltas.append(jnp.ceil(jnp.maximum(m_r - read_r, 0.0)))
         hists = jnp.stack(deltas).astype(jnp.int32)
     else:
-        add = jnp.where(allowed, n, 0).astype(jnp.int32)     # (B,)
+        add = jnp.where(allowed & not_mine, n, 0).astype(jnp.int32)  # (B,)
         hists = jnp.stack([row_histogram(cols[:, r], add, w) for r in range(d)])
         if axis_name is not None:
             # Multi-chip delta merge: every chip adds the summed histogram,
@@ -273,30 +341,94 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
     new_state = {"cur": cur, "slabs": state["slabs"], "totals": totals,
                  "slab_period": state["slab_period"],
                  "last_period": state["last_period"]}
+
+    if hh:
+        # Owned-key consumption goes to the private cells (exact counts).
+        n_add = jnp.where(allowed & mine, n, 0).astype(jnp.int32)
+        hh_hist = row_histogram(sid_hh, n_add, hh)
+        # Promotion: unowned keys whose post-batch target crosses the
+        # threshold claim their (free) slot — ownership only, no mass
+        # (see the estimate comment above). Winner selection packs
+        # (target, h1) into one int64 scatter-max so the slot goes to the
+        # HOTTEST candidate deterministically (incl. across chips).
+        target_pr = jnp.where(allowed, est + (avail - seen) + n_f, est)
+        free = owner == jnp.uint32(0)
+        cand = not_mine & free & (target_pr >= jnp.float32(hh_thresh))
+        mass_i = jnp.ceil(jnp.clip(target_pr, 0.0, float(1 << 30))
+                          ).astype(jnp.int64)
+        packed = jnp.where(cand,
+                           (mass_i << 32) | h1.astype(jnp.int64),
+                           jnp.int64(0))
+        touched = row_histogram(sid_hh, (mine | cand).astype(jnp.int32),
+                                hh) > 0
+        claims = jnp.zeros((hh,), jnp.int64).at[sid_hh].max(packed)
+        if axis_name is not None:
+            hh_hist = jax.lax.psum(hh_hist, axis_name)
+            # Packed max is order-consistent across chips: the global max
+            # target (ties broken by h1) wins everywhere.
+            claims = jax.lax.pmax(claims, axis_name)
+            touched = jax.lax.pmax(touched, axis_name)
+        claim_owner = (claims & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        newly = (state["hh_owner"] == jnp.uint32(0)) & (
+            claim_owner != jnp.uint32(0))
+        new_state.update({
+            "hh_owner": jnp.where(newly, claim_owner, state["hh_owner"]),
+            "hh_cur": state["hh_cur"] + hh_hist,
+            "hh_slabs": state["hh_slabs"],
+            "hh_totals": state["hh_totals"] + hh_hist,
+            "hh_last": jnp.where(touched, p, state["hh_last"]),
+        })
+
     remaining = jnp.maximum(
         jnp.floor(seen - jnp.where(allowed, n_f, 0.0)), 0.0).astype(jnp.int32)
     return new_state, (allowed, remaining, est)
 
 
 def _sketch_reset(state: State, h1, h2, now_us, *,
-                  sub_us: int, SW: int, S: int, d: int, w: int, weighted: bool):
+                  sub_us: int, SW: int, S: int, d: int, w: int,
+                  weighted: bool, hh: int = 0):
     """Per-key reset: subtract the key's current min-estimate from all its
     cells in both ``cur`` and ``totals`` (equal amounts; cells may go
     transiently negative, reads clamp at 0 and the next rollover's totals
     recompute self-heals). Colliding keys gain allowance — errors toward
-    allowing, never toward false denial."""
+    allowing, never toward false denial. Promoted keys subtract from their
+    private side-table cells instead."""
     now_us = jnp.maximum(now_us, state["last_period"] * sub_us)
     p = state["last_period"]
     cols = _columns(h1, h2, d, w)
-    est, _, _ = _estimate(state, cols, p, now_us, sub_us=sub_us, SW=SW, S=S,
-                          weighted=weighted)
-    sub = jnp.floor(est).astype(jnp.int32)
+    est, frac, _ = _estimate(state, cols, p, now_us, sub_us=sub_us, SW=SW,
+                             S=S, weighted=weighted)
+    if hh:
+        # A promoted key's estimate is CMS remnant + private count
+        # (_sketch_step): reset subtracts each part from its own table.
+        sid_hh = jax.lax.bitcast_convert_type(
+            h1 & jnp.uint32(hh - 1), jnp.int32)
+        mine = state["hh_owner"][sid_hh] == h1
+        est_hh = state["hh_totals"][sid_hh].astype(jnp.float32)
+        if weighted:
+            hh_b = _hh_boundary_slab(state, p, SW=SW, S=S)
+            est_hh = est_hh + frac * hh_b[sid_hh].astype(jnp.float32)
+        sub_hh = jnp.where(mine, jnp.floor(jnp.maximum(est_hh, 0.0)),
+                           0.0).astype(jnp.int32)
+        hh_hist = row_histogram(sid_hh, sub_hh, hh)
+        sub = jnp.floor(est).astype(jnp.int32)
+    else:
+        hh_hist = None
+        sub = jnp.floor(est).astype(jnp.int32)
     hists = jnp.stack([row_histogram(cols[:, r], sub, w) for r in range(d)])
-    totals = state["totals"] - hists
-    cur = state["cur"] - hists
-    return {"cur": cur, "slabs": state["slabs"], "totals": totals,
-            "slab_period": state["slab_period"],
-            "last_period": state["last_period"]}
+    out = {"cur": state["cur"] - hists, "slabs": state["slabs"],
+           "totals": state["totals"] - hists,
+           "slab_period": state["slab_period"],
+           "last_period": state["last_period"]}
+    if hh:
+        out.update({
+            "hh_owner": state["hh_owner"],
+            "hh_cur": state["hh_cur"] - hh_hist,
+            "hh_slabs": state["hh_slabs"],
+            "hh_totals": state["hh_totals"] - hh_hist,
+            "hh_last": state["hh_last"],
+        })
+    return out
 
 
 def _pack_bits(mask):
@@ -330,6 +462,7 @@ def _sketch_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
     weighted = step_kw.get("weighted", True)
     sub_us = step_kw["sub_us"]
     S, SW = step_kw["S"], step_kw["SW"]
+    hh = step_kw.get("hh", 0)
 
     if weighted:
         p = state["last_period"]
@@ -344,15 +477,20 @@ def _sketch_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
                           jnp.clip(1.0 - elapsed / jnp.float32(sub_us),
                                    0.0, 1.0),
                           0.0)
+        # Same hoist for the side table's boundary column (loop-invariant
+        # under the one-sub-window-per-chunk precondition).
+        hh_b = (_hh_boundary_slab(state, p, SW=SW, S=S) if hh else None)
     else:
         boundary = None
         fracs = jnp.zeros((T,), jnp.float32)
+        hh_b = None
 
     def body(st, xs):
         h1, h2, n, i, frac_t = xs
         pre = (frac_t, boundary) if weighted else None
         st, (allowed, _rem, _est) = _sketch_step(
-            st, h1, h2, n, now0_us + i * dt_us, pre=pre, **step_kw)
+            st, h1, h2, n, now0_us + i * dt_us, pre=pre, pre_hh=hh_b,
+            **step_kw)
         return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
 
     idx = jnp.arange(T, dtype=jnp.int64)
@@ -362,6 +500,15 @@ def _sketch_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
 
 
 _STEP_CACHE: Dict[tuple, Callable] = {}
+
+
+def _hh_params(cfg: Config) -> tuple[int, float]:
+    """(hh_slots, promotion threshold in requests) for cfg; (0, 0) when the
+    side table is disabled."""
+    K = cfg.sketch.hh_slots
+    if not K:
+        return 0, 0.0
+    return K, max(1.0, float(cfg.limit) * cfg.sketch.hh_promote_fraction)
 
 
 def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
@@ -375,18 +522,20 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
-    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu)
+    hh, hh_thresh = _hh_params(cfg)
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
+           hh, hh_thresh)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_sketch_step, limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                 iters=cfg.max_batch_admission_iters, weighted=weighted,
-                conservative=cu),
+                conservative=cu, hh=hh, hh_thresh=hh_thresh),
         donate_argnums=(0,))
     reset = jax.jit(
         partial(_sketch_reset, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
-                weighted=weighted),
+                weighted=weighted, hh=hh),
         donate_argnums=(0,))
     rollover = jax.jit(
         partial(_rollover, SW=SW, S=S), donate_argnums=(0,))
@@ -407,13 +556,15 @@ def build_scan(cfg: Config) -> Callable:
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
-    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu)
+    hh, hh_thresh = _hh_params(cfg)
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
+           hh, hh_thresh)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
         return cached
     step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                    iters=cfg.max_batch_admission_iters, weighted=weighted,
-                   conservative=cu)
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
     scan = jax.jit(partial(_sketch_scan, step_kw=step_kw), donate_argnums=(0,))
     _SCAN_CACHE[key] = scan
     return scan

@@ -30,6 +30,8 @@ from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter, MeshTokenBucketL
 from ratelimiter_tpu.parallel.dcn import (
     DcnMirrorGroup,
     export_completed,
+    export_debt,
+    merge_debt,
     merge_completed,
 )
 
@@ -38,7 +40,9 @@ __all__ = [
     "MeshSketchLimiter",
     "MeshTokenBucketLimiter",
     "export_completed",
+    "export_debt",
     "make_mesh",
     "merge_completed",
+    "merge_debt",
     "mesh_axis",
 ]

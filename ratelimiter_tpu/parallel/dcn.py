@@ -24,8 +24,19 @@ Consistency contract (tested in tests/test_dcn.py):
   export-all-then-merge-all order; a real transport must do the same
   per cycle.
 
-Windowed sketch algorithms only; the token bucket's DCN story (debt
-deltas) is ROADMAP.
+The token bucket exchanges **debt deltas** instead of slabs: every step
+accumulates its local debt increments into a second ``acc`` slab
+(ops/bucket_kernels.init_state), export snapshots-and-zeroes it, and
+merges add foreign deltas to ``debt`` only — foreign traffic can never
+re-export, the same no-double-count discipline as the watermark above.
+Staleness envelope (tested in tests/test_dcn.py):
+
+* pre-sync: pod-local admission — cross-pod over-admission bounded by
+  ``n_pods x limit`` per sync interval (burst capacity is per-pod until
+  the deltas land);
+* post-sync: a delta applied after transit time ``dt`` missed ``dt`` of
+  refill decay — over-counting, i.e. extra denies, bounded by
+  ``rate x dt``; it drains at the refill rate like any debt.
 """
 
 from __future__ import annotations
@@ -42,8 +53,75 @@ from ratelimiter_tpu.ops import sketch_kernels
 def _check(lim: SketchLimiter) -> None:
     if isinstance(lim, SketchTokenBucketLimiter):
         raise InvalidConfigError(
-            "DCN slab exchange applies to windowed sketch limiters; the "
-            "token bucket's debt-delta exchange is not implemented yet")
+            "slab exchange applies to windowed sketch limiters; token "
+            "buckets exchange debt deltas (export_debt/merge_debt)")
+    if lim.config.sketch.hh_slots:
+        # Promoted keys' traffic lives in the side table, not the slabs;
+        # exporting slabs alone would make exactly the heavy hitters
+        # invisible cross-pod (unbounded over-admission for the hottest
+        # keys). Side-table exchange is future work — refuse loudly.
+        raise InvalidConfigError(
+            "DCN slab exchange does not cover the heavy-hitter side "
+            "table (hh_slots > 0): promoted keys' counts would be "
+            "invisible to peers; disable hh_slots on DCN pods")
+
+
+def export_debt(lim: SketchTokenBucketLimiter) -> np.ndarray:
+    """Snapshot-and-zero the pod's accumulated local debt increments:
+    int64[d, w] micro-token deltas since the previous export. Exports
+    carry ONLY local traffic (merges add to ``debt``, never ``acc``), so
+    any fan-out topology is double-count-free by construction."""
+    if not isinstance(lim, SketchTokenBucketLimiter):
+        raise InvalidConfigError(
+            "export_debt needs a SketchTokenBucketLimiter; windowed "
+            "limiters exchange completed slabs (export_completed)")
+    import jax.numpy as jnp
+
+    with lim._lock:
+        acc = np.asarray(lim._state["acc"])
+        lim._state = dict(lim._state, acc=jnp.zeros_like(lim._state["acc"]))
+    return acc
+
+
+def merge_debt(lim: SketchTokenBucketLimiter, delta: np.ndarray) -> int:
+    """Add a foreign pod's debt delta to the local slab (clamped to the
+    overflow cap). The delta missed refill decay in transit — an
+    over-count that drains at the refill rate (module docstring error
+    envelope). Returns the number of nonzero cells applied."""
+    if not isinstance(lim, SketchTokenBucketLimiter):
+        raise InvalidConfigError(
+            "merge_debt needs a SketchTokenBucketLimiter; windowed "
+            "limiters exchange completed slabs (merge_completed)")
+    import jax.numpy as jnp
+
+    from ratelimiter_tpu.ops.bucket_kernels import _DEBT_CAP
+
+    if delta.shape != tuple(lim._state["debt"].shape):
+        raise InvalidConfigError(
+            f"debt delta shape {delta.shape} != sketch geometry "
+            f"{tuple(lim._state['debt'].shape)}")
+    # Clamp negative cells: exports are non-negative by construction
+    # (acc only ever accumulates consumption), so negatives can only be
+    # wire corruption or a malicious frame — and a negative merge would
+    # erase real debt (fleet-wide limit bypass). Clamping errs safe.
+    delta = np.maximum(delta, 0)
+    nz = int(np.count_nonzero(delta))
+    if nz == 0:
+        return 0
+    from ratelimiter_tpu.core.clock import to_micros
+
+    now_us = to_micros(lim.clock.now())
+    with lim._lock:
+        # Advance `last` to the receiver's now: a pod that never (or long
+        # ago) dispatched would otherwise decay the merged debt over the
+        # whole idle gap on its next step, silently forgiving foreign
+        # traffic. Forward `last` means less decay — the deny direction.
+        lim._state = dict(
+            lim._state,
+            debt=jnp.minimum(lim._state["debt"] + jnp.asarray(delta),
+                             _DEBT_CAP),
+            last=jnp.maximum(lim._state["last"], now_us))
+    return nz
 
 
 def export_completed(lim: SketchLimiter, after_period: int,
@@ -120,6 +198,12 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
             p = int(p_np)
             if p >= last:
                 continue
+            # Clamp negative cells: a local reset can legitimately leave
+            # transient negatives in an exporter's ring (they self-heal
+            # there), but accepting them from the wire would let a bad
+            # peer subtract history (over-admission). Reset forgiveness
+            # is local-only by design; clamping errs toward denying.
+            slab = np.maximum(slab, 0)
             slot = p % S
             cur_p = int(sp[slot])
             if cur_p == p:
@@ -143,20 +227,33 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
 
 
 class DcnMirrorGroup:
-    """In-process mirror of a multi-pod deployment: N windowed sketch
-    limiters (the 'pods'), synced by exchanging completed slabs. This is
-    the test/simulation harness — in production the same two calls wrap
-    any transport (the export payload is plain numpy arrays)."""
+    """In-process mirror of a multi-pod deployment: N sketch limiters
+    (the 'pods'), synced by exchanging completed slabs (windowed) or
+    debt deltas (token bucket). This is the test/simulation harness — in
+    production the same calls wrap any transport (the export payloads
+    are plain numpy arrays); serving/dcn_peer.py runs them over the
+    binary protocol between OS processes."""
 
     def __init__(self, pods: Sequence[SketchLimiter]):
         if not pods:
             raise InvalidConfigError("DcnMirrorGroup needs >= 1 pod")
-        for p in pods:
-            _check(p)
-        fp = {sketch_kernels.sketch_geometry(p.config)
-              + (p.config.sketch.depth, p.config.sketch.width,
-                 p.config.sketch.seed, p.config.prefix)
-              for p in pods}
+        kinds = {isinstance(p, SketchTokenBucketLimiter) for p in pods}
+        if len(kinds) != 1:
+            raise InvalidConfigError(
+                "all pods must run the same algorithm family (all "
+                "windowed or all token bucket)")
+        self._bucket = kinds.pop()
+        if self._bucket:
+            fp = {(p.config.limit, float(p.config.window),
+                   p.config.sketch.depth, p.config.sketch.width,
+                   p.config.sketch.seed, p.config.prefix) for p in pods}
+        else:
+            for p in pods:
+                _check(p)
+            fp = {sketch_kernels.sketch_geometry(p.config)
+                  + (p.config.sketch.depth, p.config.sketch.width,
+                     p.config.sketch.seed, p.config.prefix)
+                  for p in pods}
         if len(fp) != 1:
             raise InvalidConfigError(
                 "all pods must share algorithm geometry AND hashing "
@@ -168,9 +265,17 @@ class DcnMirrorGroup:
                                                 for i in range(len(pods))}
 
     def sync(self) -> int:
-        """One exchange cycle: export every pod's new completed slabs,
-        then merge everything into everyone else. Returns the number of
-        slab applications across the group."""
+        """One exchange cycle: export every pod's new local history, then
+        merge everything into everyone else. Returns the number of
+        applications (slabs or nonzero delta cells) across the group."""
+        if self._bucket:
+            deltas = [export_debt(p) for p in self.pods]
+            applied = 0
+            for i, pod in enumerate(self.pods):
+                for j, delta in enumerate(deltas):
+                    if i != j:
+                        applied += merge_debt(pod, delta)
+            return applied
         exports = []
         for i, pod in enumerate(self.pods):
             periods, slabs, last = export_completed(

@@ -80,23 +80,30 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     d, w = cfg.sketch.depth, cfg.sketch.width
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
+    hh, hh_thresh = sketch_kernels._hh_params(cfg)
     # Key on the mesh's *identity-bearing contents* (device objects + axis
     # names), not id(mesh): a GC'd mesh's id can be reused by a new mesh,
     # which would receive a stale compiled step bound to dead devices.
     mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
     key = (mesh_key, merge, limit, W, SW, d, w,
-           cfg.max_batch_admission_iters, weighted, cu)
+           cfg.max_batch_admission_iters, weighted, cu, hh, hh_thresh)
     cached = _MESH_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                    iters=cfg.max_batch_admission_iters, weighted=weighted,
-                   conservative=cu)
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
     body = _gather_step if merge == "gather" else _delta_step
 
-    state_spec = {k: P() for k in ("cur", "slabs", "totals",
-                                   "slab_period", "last_period")}
+    state_keys = ["cur", "slabs", "totals", "slab_period", "last_period"]
+    if hh:
+        # Side-table state is replicated like the sketch: gather mode
+        # updates it with a replicated computation; delta mode psums the
+        # write histogram and pmaxes the promotion claims (_sketch_step).
+        state_keys += ["hh_owner", "hh_cur", "hh_slabs", "hh_totals",
+                       "hh_last"]
+    state_spec = {k: P() for k in state_keys}
     # check_vma=False: the state outputs ARE replicated — they are a
     # deterministic function of replicated state and all_gathered/psum'd
     # batch data — but the static checker cannot prove that through
@@ -165,7 +172,7 @@ def build_mesh_bucket_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
                    iters=iters)
     body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
-    state_spec = {k: P() for k in ("debt", "rem", "last")}
+    state_spec = {k: P() for k in ("debt", "acc", "rem", "last")}
     mapped = shard_map(
         partial(body, step_kw=step_kw),
         mesh=mesh,

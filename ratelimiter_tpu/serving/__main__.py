@@ -70,6 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "annotations on every dispatch)")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the MetricsDecorator (on by default)")
+    # Cross-pod DCN exchange (parallel/dcn.py over serving/dcn_peer.py).
+    ap.add_argument("--dcn-peer", action="append", default=[],
+                    metavar="HOST:PORT",
+                    help="push completed slabs / debt deltas to this peer "
+                         "server (repeatable); receiving needs the asyncio "
+                         "front door")
+    ap.add_argument("--dcn-interval", type=float, default=1.0,
+                    help="seconds between DCN export+push cycles")
     return ap
 
 
@@ -147,6 +155,18 @@ async def amain(args) -> None:
                                   args)
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
+    pusher = None
+    if args.dcn_peer:
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher, parse_peer
+
+        if args.backend != "sketch":
+            raise SystemExit("--dcn-peer needs --backend sketch")
+        inner = limiter
+        while hasattr(inner, "inner"):
+            inner = inner.inner
+        pusher = DcnPusher(inner, [parse_peer(s) for s in args.dcn_peer],
+                           interval=args.dcn_interval)
+        pusher.start()
     if args.native:
         from ratelimiter_tpu.serving.native_server import NativeRateLimitServer
 
@@ -164,6 +184,8 @@ async def amain(args) -> None:
               f"limit={args.limit}/{args.window:g}s on "
               f"{args.host}:{server.port}", flush=True)
         await stop.wait()
+        if pusher is not None:
+            pusher.stop()
         server.shutdown()
         limiter.close()
         return
@@ -183,6 +205,8 @@ async def amain(args) -> None:
           f"limit={args.limit}/{args.window:g}s on "
           f"{args.host}:{server.port}", flush=True)
     await stop.wait()
+    if pusher is not None:
+        pusher.stop()
     await server.shutdown()
     limiter.close()
 

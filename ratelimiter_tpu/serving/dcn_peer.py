@@ -1,0 +1,194 @@
+"""Cross-process DCN exchange over the serving protocol.
+
+`parallel/dcn.py` defines the exchange semantics (completed sub-window
+slabs for windowed limiters, accumulated debt deltas for token buckets)
+over plain numpy payloads; `DcnMirrorGroup` runs them in-process. This
+module is the real transport: each server process runs a ``DcnPusher``
+that periodically exports its limiter's NEW local history and pushes it
+to every peer server as a ``T_DCN_PUSH`` frame; the receiving server
+merges it into its own limiter (serving/server.py ``_handle_dcn``).
+
+Push-only and symmetric: every pod pushes to every peer on its own
+cadence, nobody pulls, and the no-double-count discipline is carried by
+the payloads themselves (the slab watermark lives with the exporter; the
+debt accumulator zeroes at export). A missed push is retried implicitly
+by the next cycle for slabs (the watermark only advances on successful
+export capture, and unacked periods stay in the ring for a full window);
+a LOST debt delta is traffic the peers never hear about — the same
+availability-over-global-accuracy tradeoff the reference accepts for
+cross-region Redis (``docs/ALGORITHMS.md:162`` NTP-skew bound), erring
+toward over-admission, bounded by one export interval of traffic.
+
+Wire shape: serving/protocol.py T_DCN_PUSH (kind + payload); responses
+are T_OK / T_ERROR. The asyncio front door handles these frames; the
+native (C++) front door does not — run the asyncio server (optionally
+behind the native one on a different port) for cross-pod deployments.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from ratelimiter_tpu.algorithms.sketch import (
+    SketchLimiter,
+    SketchTokenBucketLimiter,
+)
+from ratelimiter_tpu.serving import protocol as p
+
+log = logging.getLogger("ratelimiter_tpu.serving.dcn")
+
+
+class _PeerConn:
+    """One lazy, auto-reconnecting frame connection to a peer server."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def push(self, frame: bytes, req_id: int) -> None:
+        """Send one frame, wait for T_OK; raises on error/disconnect
+        (the caller decides whether the payload can be dropped)."""
+        try:
+            sk = self._connect()
+            sk.sendall(frame)
+            buf = b""
+            while len(buf) < p.HEADER_SIZE:
+                chunk = sk.recv(65536)
+                if not chunk:
+                    raise ConnectionError("peer closed the connection")
+                buf += chunk
+            length, type_, rid = p.parse_header(buf[:p.HEADER_SIZE])
+            body = buf[p.HEADER_SIZE:]
+            while len(body) < length - 9:
+                chunk = sk.recv(65536)
+                if not chunk:
+                    raise ConnectionError("peer closed the connection")
+                body += chunk
+            if rid != req_id:
+                raise p.ProtocolError(f"response id {rid} != {req_id}")
+            if type_ == p.T_ERROR:
+                code, msg = p.parse_error(body)
+                raise p.exception_for(code, msg)
+        except Exception:
+            self.close()   # reconnect next cycle
+            raise
+
+
+class DcnPusher:
+    """Periodically export the limiter's new local history and push it to
+    every peer (host, port). Thread-based so it composes with both the
+    asyncio and native front doors' processes."""
+
+    def __init__(self, limiter: SketchLimiter,
+                 peers: Sequence[Tuple[str, int]], *,
+                 interval: float = 1.0):
+        self.limiter = limiter
+        self.peers: List[_PeerConn] = [_PeerConn(h, pt) for h, pt in peers]
+        self.interval = float(interval)
+        self._bucket = isinstance(limiter, SketchTokenBucketLimiter)
+        # Slab watermarks are PER PEER and advance only on a successful
+        # push: a peer that misses a cycle is re-sent the same periods
+        # next time (they stay in the ring a full window), and a peer
+        # that already merged them is never re-sent (re-merging the same
+        # period double-counts by design of the add-merge).
+        self._watermarks: List[int] = [-(1 << 62)] * len(self.peers)
+        self._ids = itertools.count(1)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.pushes_ok = 0
+        self.pushes_failed = 0
+
+    # ------------------------------------------------------------- cycle
+
+    def sync_once(self) -> int:
+        """One export+push cycle; returns frames delivered. Never raises:
+        per-peer failures are counted and logged. Slabs are retried for
+        the failing peer next cycle (per-peer watermarks); a lost DEBT
+        delta is the documented one-interval loss (module docstring)."""
+        from ratelimiter_tpu.parallel import dcn
+
+        req_id = next(self._ids)
+        delivered = 0
+        if self._bucket:
+            delta = dcn.export_debt(self.limiter)
+            if not delta.any():
+                return 0
+            frame = p.encode_dcn_debt(req_id, delta)
+            for peer in self.peers:
+                try:
+                    peer.push(frame, req_id)
+                    delivered += 1
+                    self.pushes_ok += 1
+                except Exception as exc:
+                    self.pushes_failed += 1
+                    log.warning("DCN push to %s:%d failed: %s",
+                                peer.host, peer.port, exc)
+            return delivered
+        for i, peer in enumerate(self.peers):
+            periods, slabs, last = dcn.export_completed(
+                self.limiter, self._watermarks[i])
+            if periods.shape[0] == 0:
+                continue
+            frame = p.encode_dcn_slabs(req_id, periods, slabs)
+            try:
+                peer.push(frame, req_id)
+                delivered += 1
+                self.pushes_ok += 1
+                self._watermarks[i] = max(self._watermarks[i], last - 1)
+            except Exception as exc:
+                self.pushes_failed += 1
+                log.warning("DCN push to %s:%d failed: %s",
+                            peer.host, peer.port, exc)
+        return delivered
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                try:
+                    self.sync_once()
+                except Exception as exc:  # export itself must never kill it
+                    log.error("DCN cycle failed: %s", exc)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="rl-dcn-pusher")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for peer in self.peers:
+            peer.close()
+
+
+def parse_peer(spec: str) -> Tuple[str, int]:
+    """'host:port' -> (host, port) with a loud error."""
+    host, sep, port = spec.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"peer must be host:port, got {spec!r}")
+    return host or "127.0.0.1", int(port)

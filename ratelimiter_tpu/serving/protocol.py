@@ -58,6 +58,9 @@ from ratelimiter_tpu.core.errors import (
 from ratelimiter_tpu.core.types import Result
 
 MAX_FRAME = 1 << 20  # 1 MiB: far above any legal request, bounds bad input
+#: DCN push frames carry whole slabs / debt deltas (d x w counters), so
+#: they get their own, larger bound. d=8 w=2^20 int64 is 64 MiB.
+MAX_DCN_FRAME = 96 << 20
 MAX_KEY_LEN = 4096
 
 # Request types
@@ -66,6 +69,11 @@ T_RESET = 2
 T_HEALTH = 3
 T_METRICS = 4
 T_ALLOW_BATCH = 5
+T_DCN_PUSH = 6
+
+# DCN payload kinds (parallel/dcn.py exchange families)
+DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
+DCN_KIND_DEBT = 2    # token bucket: accumulated debt delta
 # Response types
 T_RESULT = 129
 T_OK = 130
@@ -241,7 +249,8 @@ class ProtocolError(RateLimiterError):
 def parse_header(buf: bytes) -> Tuple[int, int, int]:
     """(payload_length, type, req_id) from the 13 header bytes."""
     length, type_, req_id = _HDR.unpack_from(buf)
-    if length < 9 or length > MAX_FRAME:
+    cap = MAX_DCN_FRAME if type_ == T_DCN_PUSH else MAX_FRAME
+    if length < 9 or length > cap:
         raise ProtocolError(f"bad frame length {length}")
     return length, type_, req_id
 
@@ -283,3 +292,70 @@ def parse_metrics(body: bytes) -> str:
 def parse_error(body: bytes) -> Tuple[int, str]:
     code, msg_len = _ERROR_HEAD.unpack_from(body)
     return code, body[_ERROR_HEAD.size:_ERROR_HEAD.size + msg_len].decode("utf-8")
+
+
+# ----------------------------------------------------------- DCN frames
+#
+# T_DCN_PUSH body:
+#   u8 kind
+#   kind=DCN_KIND_SLABS: u32 count | s64 periods[count] |
+#                        count * d*w int32 slabs (C order)
+#   kind=DCN_KIND_DEBT:  d*w int64 delta (C order)
+# The receiver validates payload size against ITS OWN (d, w) geometry —
+# a mismatched peer gets E_INVALID_CONFIG, never a reshaped merge.
+
+_DCN_HEAD = struct.Struct("<B")
+_S64 = struct.Struct("<q")
+
+
+def encode_dcn_slabs(req_id: int, periods, slabs) -> bytes:
+    """periods int64[k], slabs int32[k, d, w] (export_completed output)."""
+    import numpy as np
+
+    k = int(periods.shape[0])
+    body = (_DCN_HEAD.pack(DCN_KIND_SLABS) + _U32.pack(k)
+            + np.ascontiguousarray(periods, dtype=np.int64).tobytes()
+            + np.ascontiguousarray(slabs, dtype=np.int32).tobytes())
+    return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+
+
+def encode_dcn_debt(req_id: int, delta) -> bytes:
+    """delta int64[d, w] (export_debt output)."""
+    import numpy as np
+
+    body = (_DCN_HEAD.pack(DCN_KIND_DEBT)
+            + np.ascontiguousarray(delta, dtype=np.int64).tobytes())
+    return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+
+
+def parse_dcn(body: bytes, d: int, w: int):
+    """-> (DCN_KIND_SLABS, periods int64[k], slabs int32[k,d,w]) or
+    (DCN_KIND_DEBT, delta int64[d,w], None), validated against the
+    receiver's geometry."""
+    import numpy as np
+
+    if len(body) < 1:
+        raise ProtocolError("empty DCN body")
+    (kind,) = _DCN_HEAD.unpack_from(body)
+    payload = body[1:]
+    if kind == DCN_KIND_SLABS:
+        if len(payload) < 4:
+            raise ProtocolError("short DCN slabs body")
+        (k,) = _U32.unpack_from(payload)
+        want = 4 + k * 8 + k * d * w * 4
+        if len(payload) != want:
+            raise ProtocolError(
+                f"DCN slabs payload {len(payload)}B != {want}B for "
+                f"k={k} d={d} w={w} (geometry mismatch?)")
+        periods = np.frombuffer(payload, dtype=np.int64, count=k, offset=4)
+        slabs = np.frombuffer(payload, dtype=np.int32,
+                              offset=4 + k * 8).reshape(k, d, w)
+        return kind, periods, slabs
+    if kind == DCN_KIND_DEBT:
+        want = d * w * 8
+        if len(payload) != want:
+            raise ProtocolError(
+                f"DCN debt payload {len(payload)}B != {want}B for "
+                f"d={d} w={w} (geometry mismatch?)")
+        return kind, np.frombuffer(payload, dtype=np.int64).reshape(d, w), None
+    raise ProtocolError(f"unknown DCN kind {kind}")

@@ -182,6 +182,32 @@ class RateLimitServer:
             if task is not None:
                 self._conn_tasks.discard(task)
 
+    def _dcn_target(self):
+        """The undecorated limiter the DCN merge functions operate on."""
+        lim = self.limiter
+        while hasattr(lim, "inner"):
+            lim = lim.inner
+        return lim
+
+    async def _handle_dcn(self, req_id: int, body: bytes) -> bytes:
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
+
+        lim = self._dcn_target()
+        if not isinstance(lim, SketchLimiter):
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                "DCN exchange needs a sketch-family backend")
+        d, w = lim.config.sketch.depth, lim.config.sketch.width
+        kind, a, b = p.parse_dcn(body, d, w)
+        loop = asyncio.get_running_loop()
+        if kind == p.DCN_KIND_SLABS:
+            await loop.run_in_executor(None, merge_completed, lim, a, b)
+        else:
+            await loop.run_in_executor(None, merge_debt, lim, a)
+        return p.encode_ok(req_id)
+
     async def _handle_frame(self, type_: int, req_id: int, body: bytes,
                             writer: asyncio.StreamWriter,
                             write_lock: asyncio.Lock) -> None:
@@ -201,6 +227,11 @@ class RateLimitServer:
                     self.batcher.decisions_total)
             elif type_ == p.T_METRICS:
                 out = p.encode_metrics(req_id, self.registry.render())
+            elif type_ == p.T_DCN_PUSH:
+                try:
+                    out = await self._handle_dcn(req_id, body)
+                except Exception as exc:
+                    out = p.encode_error(req_id, p.code_for(exc), str(exc))
             else:
                 out = p.encode_error(req_id, p.E_INTERNAL,
                                      f"unknown request type {type_}")

@@ -75,6 +75,156 @@ class TestExportMerge:
             export_completed(tb, 0)
         tb.close()
 
+    def test_hh_pods_rejected(self):
+        """Promoted keys' counts live outside the slabs; shipping slabs
+        alone would hide exactly the heavy hitters from peers."""
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+                     sketch=SketchParams(depth=3, width=256, sub_windows=6,
+                                         hh_slots=16))
+        lim = create_limiter(cfg, backend="sketch", clock=clock)
+        with pytest.raises(InvalidConfigError, match="hh_slots"):
+            export_completed(lim, 0)
+        lim.close()
+
+    def test_negative_foreign_cells_clamped(self):
+        """A corrupt/malicious payload with negative cells must not erase
+        local history (limit bypass); negatives clamp to 0 on merge."""
+        import numpy as np
+
+        a, ca = pod(limit=10)
+        b, cb = pod(limit=10)
+        b.allow_n("k", 10)
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")
+        b.allow("warm")
+        periods, slabs, _ = export_completed(a, -(1 << 62))
+        evil = -np.abs(slabs) - 1_000_000        # all-negative forgery
+        merge_completed(b, periods, evil)
+        assert not b.allow("k").allowed          # history intact
+        a.close()
+        b.close()
+
+
+def bucket_pod(limit=10, window=10.0, width=4096, start=T0):
+    clock = ManualClock(start)
+    cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=limit,
+                 window=window,
+                 sketch=SketchParams(depth=4, width=width))
+    return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+
+class TestBucketExchange:
+    def test_export_carries_local_increments_once(self):
+        from ratelimiter_tpu.parallel.dcn import export_debt, merge_debt
+
+        a, _ca = bucket_pod()
+        a.allow_n("k", 4)
+        delta = export_debt(a)
+        assert delta.sum() == 4 * 1_000_000 * 4  # 4 tokens x depth rows
+        # Snapshot-and-zero: nothing new -> empty export.
+        assert export_debt(a).sum() == 0
+        a.close()
+
+    def test_merge_makes_foreign_debt_visible(self):
+        from ratelimiter_tpu.parallel.dcn import export_debt, merge_debt
+
+        a, _ca = bucket_pod()
+        b, _cb = bucket_pod()
+        assert a.allow_n("k", 10).allowed        # A: bucket drained
+        assert merge_debt(b, export_debt(a)) > 0
+        res = b.allow("k")                       # B sees the full debt
+        assert not res.allowed and res.retry_after > 0
+        a.close()
+        b.close()
+
+    def test_merged_debt_drains_at_refill_rate(self):
+        from ratelimiter_tpu.parallel.dcn import export_debt, merge_debt
+
+        a, _ca = bucket_pod(limit=10, window=10.0)   # 1 token/s refill
+        b, cb = bucket_pod(limit=10, window=10.0)
+        a.allow_n("k", 10)
+        merge_debt(b, export_debt(a))
+        assert not b.allow("k").allowed
+        cb.advance(2.1)                          # ~2 tokens refilled
+        assert b.allow_n("k", 2).allowed
+        assert not b.allow("k").allowed
+        a.close()
+        b.close()
+
+    def test_error_direction_never_over_admits_globally_after_sync(self):
+        """Post-sync, the group's total admission for one key cannot
+        exceed limit + what each pod admitted pre-sync (the documented
+        envelope); once synced, everyone denies."""
+        from ratelimiter_tpu.parallel.dcn import export_debt, merge_debt
+
+        pods = [bucket_pod(limit=10) for _ in range(3)]
+        total = sum(p.allow_batch(["hot"] * 12).allow_count
+                    for p, _ in pods)
+        assert 10 <= total <= 30                 # pre-sync envelope
+        deltas = [export_debt(p) for p, _ in pods]
+        for i, (p, _) in enumerate(pods):
+            for j, d in enumerate(deltas):
+                if i != j:
+                    merge_debt(p, d)
+        for p, _ in pods:
+            assert not p.allow("hot").allowed
+            p.close()
+
+    def test_negative_debt_delta_clamped(self):
+        """A forged negative delta must not erase real debt."""
+        import numpy as np
+
+        from ratelimiter_tpu.parallel.dcn import merge_debt
+
+        a, _ = bucket_pod(limit=10)
+        a.allow_n("k", 10)
+        evil = np.full(tuple(a._state["debt"].shape), -(1 << 60),
+                       dtype=np.int64)
+        assert merge_debt(a, evil) == 0          # clamps to all-zero
+        assert not a.allow("k").allowed
+        a.close()
+
+    def test_reset_not_exported(self):
+        """Reset forgives local debt but must not emit a negative delta
+        (which could over-admit remotely)."""
+        from ratelimiter_tpu.parallel.dcn import export_debt
+
+        a, _ca = bucket_pod()
+        a.allow_n("k", 10)
+        a.reset("k")
+        assert a.allow("k").allowed              # local recovery
+        delta = export_debt(a)
+        assert (delta >= 0).all()
+        # The original 10 + the post-reset 1 are both real local traffic.
+        assert delta.sum() >= 10 * 1_000_000 * 4
+        a.close()
+
+    def test_mirror_group_bucket_mode(self):
+        from ratelimiter_tpu.parallel.dcn import DcnMirrorGroup
+
+        (a, _ca), (b, _cb) = bucket_pod(), bucket_pod()
+        group = DcnMirrorGroup([a, b])
+        a.allow_n("k", 6)
+        b.allow_n("k", 4)
+        assert group.sync() > 0
+        # Global view on both: 10 of 10 consumed.
+        assert not a.allow("k").allowed
+        assert not b.allow("k").allowed
+        assert group.sync() == 0                 # nothing new
+        a.close()
+        b.close()
+
+    def test_mixed_family_rejected(self):
+        from ratelimiter_tpu.parallel.dcn import DcnMirrorGroup
+
+        (a, _), (w, _) = bucket_pod(), pod()
+        with pytest.raises(InvalidConfigError):
+            DcnMirrorGroup([a, w])
+        a.close()
+        w.close()
+
 
 class TestMirrorGroup:
     def test_cross_pod_convergence_and_envelope(self):

@@ -1,0 +1,184 @@
+"""Heavy-hitter exact side table (SketchParams.hh_slots): promotion,
+additive estimates, eviction, reset, and mesh parity. The design notes
+live in ops/sketch_kernels._sketch_step; measured accuracy impact is
+documented in ROADMAP.md (neutral under conservative update, aimed at
+the vanilla-update regimes such as the mesh delta merge)."""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+
+T0 = 1_700_000_000.0
+
+
+def make(limit=10, window=6.0, hh_slots=16, frac=0.5, cu=True, **kw):
+    cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=limit, window=window,
+                 max_batch_admission_iters=4,
+                 sketch=SketchParams(depth=2, width=64, sub_windows=6,
+                                     hh_slots=hh_slots,
+                                     hh_promote_fraction=frac,
+                                     conservative_update=cu), **kw)
+    clock = ManualClock(T0)
+    return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+
+class TestHHSemantics:
+    def test_exactness_across_promotion(self):
+        """A hot key admits exactly `limit`, with promotion happening
+        mid-stream (no quota reset, no double count)."""
+        lim, _ = make(limit=10)
+        assert sum(lim.allow("hot").allowed for _ in range(25)) == 10
+        owners = np.asarray(lim._state["hh_owner"])
+        assert np.count_nonzero(owners) == 1     # promoted
+        lim.close()
+
+    def test_window_slide_recovers_quota(self):
+        lim, clock = make(limit=10)
+        for _ in range(15):
+            lim.allow("hot")
+        clock.advance(7.0)                        # full window elapsed
+        assert sum(lim.allow("hot").allowed for _ in range(15)) == 10
+        lim.close()
+
+    def test_boundary_weighting_survives_promotion(self):
+        """Sub-window-resolution sliding semantics hold through the side
+        table: mass consumed at t=0 stays full-weight until its
+        sub-window becomes the boundary (one full window later), then
+        fades by the overlap fraction."""
+        lim, clock = make(limit=10, window=6.0)   # 6 x 1 s sub-windows
+        assert lim.allow_n("hot", 10).allowed
+        assert not lim.allow("hot").allowed
+        clock.advance(3.5)                        # still fully in window
+        assert not lim.allow("hot").allowed
+        clock.advance(3.0)                        # t=6.5: boundary frac 0.5
+        got = sum(lim.allow("hot").allowed for _ in range(10))
+        assert 2 <= got <= 8                      # partial, never full
+        lim.close()
+
+    def test_reset_clears_promoted_key(self):
+        lim, _ = make(limit=10)
+        lim.allow_n("hot", 10)
+        assert not lim.allow("hot").allowed
+        lim.reset("hot")
+        assert lim.allow("hot").allowed
+        lim.close()
+
+    def test_idle_owner_evicted_and_slot_reusable(self):
+        lim, clock = make(limit=10)
+        for _ in range(12):
+            lim.allow("hot")                      # promote "hot"
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 1
+        # Idle a full window + rollovers: slot reclaimed.
+        for step in range(8):
+            clock.advance(1.0)
+            lim.allow(f"tick{step}")              # drives rollovers
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) <= 1
+        # And the evicted key starts fresh (its history expired anyway).
+        assert lim.allow("hot").allowed
+        lim.close()
+
+    def test_batch_duplicates_sequenced_through_hh(self):
+        lim, _ = make(limit=10)
+        for _ in range(3):
+            lim.allow("h")                        # promote with count 3
+        out = lim.allow_batch(["h"] * 12)
+        assert int(np.sum(out.allowed)) == 7      # 10 - 3 already used
+        lim.close()
+
+    def test_unpromoted_keys_unaffected(self):
+        """Cold keys below the threshold run pure sketch semantics."""
+        lim, _ = make(limit=10, frac=1.0)
+        out = lim.allow_batch([f"c{i}" for i in range(30)])
+        assert out.allow_count == 30
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 0
+        lim.close()
+
+    def test_vanilla_update_mode_works(self):
+        lim, _ = make(limit=10, cu=False)
+        assert sum(lim.allow("hot").allowed for _ in range(25)) == 10
+        lim.close()
+
+    def test_checkpoint_roundtrip_with_hh_state(self, tmp_path):
+        lim, clock = make(limit=10)
+        for _ in range(12):
+            lim.allow("hot")
+        path = str(tmp_path / "hh.npz")
+        lim.save(path)
+        lim2, _ = make(limit=10)
+        lim2.restore(path)
+        assert not lim2.allow("hot").allowed      # promoted state survived
+        np.testing.assert_array_equal(
+            np.asarray(lim._state["hh_owner"]),
+            np.asarray(lim2._state["hh_owner"]))
+        lim.close()
+        lim2.close()
+
+    def test_validation(self):
+        with pytest.raises(InvalidConfigError):
+            SketchParams(hh_slots=17).validate()       # not a power of two
+        with pytest.raises(InvalidConfigError):
+            SketchParams(hh_slots=8).validate()        # below minimum
+        with pytest.raises(InvalidConfigError):
+            SketchParams(hh_promote_fraction=0.0).validate()
+
+
+class TestHHMesh:
+    @pytest.fixture()
+    def mesh(self):
+        import jax
+
+        from ratelimiter_tpu.parallel import make_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device (CPU) mesh")
+        return make_mesh()
+
+    def test_mesh_gather_exactness_with_hh(self, mesh):
+        """Gather mode is strictly exact with hh enabled: one hot key,
+        limit L, exactly L admitted; promotion state replicated."""
+        from ratelimiter_tpu.parallel import MeshSketchLimiter
+
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+                     max_batch_admission_iters=4,
+                     sketch=SketchParams(depth=2, width=64, sub_windows=6,
+                                         hh_slots=16,
+                                         hh_promote_fraction=0.5))
+        clock = ManualClock(T0)
+        lim = MeshSketchLimiter(cfg, mesh=mesh, merge="gather", clock=clock)
+        out = lim.allow_batch(["hot"] * 32)
+        assert out.allow_count == 10
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 1
+        assert lim.allow_batch(["hot"] * 8).allow_count == 0
+        lim.close()
+
+    def test_mesh_delta_bounded_staleness_with_hh(self, mesh):
+        """Delta mode keeps its documented envelope with hh enabled:
+        per-step over-admission bounded by n_chips x limit, convergence
+        after the psum; promotion (pmax'd claims) stays replicated."""
+        import jax
+
+        from ratelimiter_tpu.parallel import MeshSketchLimiter
+
+        n_chips = len(jax.devices())
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+                     max_batch_admission_iters=4,
+                     sketch=SketchParams(depth=2, width=64, sub_windows=6,
+                                         hh_slots=16,
+                                         hh_promote_fraction=0.5))
+        clock = ManualClock(T0)
+        lim = MeshSketchLimiter(cfg, mesh=mesh, merge="delta", clock=clock)
+        first = lim.allow_batch(["hot"] * 32).allow_count
+        assert 10 <= first <= min(32, n_chips * 10)
+        # Merged state visible: everyone denies now (and the hot key,
+        # far past the threshold, claims its slot identically everywhere).
+        assert lim.allow_batch(["hot"] * 16).allow_count == 0
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 1
+        lim.close()
