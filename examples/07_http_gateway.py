@@ -1,0 +1,67 @@
+"""HTTP interop: the server binary serving plain HTTP alongside the
+binary protocol — 429 + X-RateLimit-* headers, exactly the reference's
+flagship usage example (its docs/EXAMPLES.md weather API), curl-able."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+port, http_port = free_port(), free_port()
+env = dict(os.environ)
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+env["PYTHONPATH"] = os.pathsep.join(
+    [repo] + env.get("PYTHONPATH", "").split(os.pathsep))
+server = subprocess.Popen(
+    [sys.executable, "-m", "ratelimiter_tpu.serving",
+     "--backend", "exact", "--algorithm", "sliding_window",
+     "--limit", "3", "--window", "60", "--port", str(port),
+     "--http-port", str(http_port)],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+print(server.stdout.readline().strip())
+
+base = f"http://127.0.0.1:{http_port}"
+for i in range(3):
+    with urllib.request.urlopen(f"{base}/v1/allow?key=user:1") as r:
+        body = json.loads(r.read())
+        print(f"request {i}: 200 allowed remaining="
+              f"{r.headers['X-RateLimit-Remaining']}")
+
+try:
+    urllib.request.urlopen(f"{base}/v1/allow?key=user:1")
+except urllib.error.HTTPError as e:
+    assert e.code == 429
+    print(f"request 3: 429 Retry-After={e.headers['Retry-After']}s "
+          f"X-RateLimit-Limit={e.headers['X-RateLimit-Limit']}")
+
+# Key via the X-User-ID header (the reference example's convention).
+req = urllib.request.Request(f"{base}/v1/allow",
+                             headers={"X-User-ID": "user:2"})
+with urllib.request.urlopen(req) as r:
+    print(f"header key: 200 remaining={r.headers['X-RateLimit-Remaining']}")
+
+# Reset over HTTP, then the key admits again.
+urllib.request.urlopen(urllib.request.Request(
+    f"{base}/v1/reset?key=user:1", method="POST"))
+with urllib.request.urlopen(f"{base}/v1/allow?key=user:1") as r:
+    print("after reset: 200")
+
+with urllib.request.urlopen(f"{base}/healthz") as r:
+    print("healthz:", json.loads(r.read()))
+
+server.send_signal(signal.SIGTERM)
+assert server.wait(timeout=15) == 0
+print("OK")

@@ -93,9 +93,7 @@ class RateLimiter(abc.ABC):
         Semantics: takes effect for every subsequent decision; quota
         already consumed stands. For the token bucket the refill rate
         (limit/window) and capacity both change; stored levels clamp to
-        the new capacity lazily on each key's next refill. The window
-        cannot change dynamically (it defines the state's time geometry
-        — build a new limiter for that)."""
+        the new capacity lazily on each key's next refill."""
         from dataclasses import replace
 
         self._check_open()
@@ -103,6 +101,31 @@ class RateLimiter(abc.ABC):
         new_cfg.validate()
         self._apply_config(new_cfg)
         self.config = new_cfg
+
+    def update_window(self, new_window: float) -> None:
+        """Change the window without losing state (the other half of the
+        dynamic-configuration story; the window defines the state's time
+        geometry, so backends that support this migrate state to the new
+        geometry).
+
+        Semantics: takes effect for every subsequent decision. Consumed
+        quota is re-bucketed onto the new geometry conservatively —
+        counts never expire earlier than they would have under either
+        window, so a migration can only err toward denying, never toward
+        over-admission. For the token bucket the refill rate
+        (limit/window) changes; accumulated debt stands."""
+        self._check_open()
+        from dataclasses import replace
+
+        new_cfg = replace(self.config, window=float(new_window))
+        new_cfg.validate()
+        self._apply_window(new_cfg)
+        self.config = new_cfg
+
+    def _apply_window(self, new_cfg: Config) -> None:
+        """Backend hook: migrate state onto the new window geometry."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support dynamic window updates")
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Backend hook: rebuild compiled steps / derived constants /

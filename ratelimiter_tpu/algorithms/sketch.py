@@ -256,9 +256,50 @@ class SketchLimiter(RateLimiter):
             self._step, self._reset_step, self._rollover = steps
             self._mass_budget = new_cfg.sketch.mass_budget(new_cfg.limit)
 
+    def _apply_window(self, new_cfg: Config) -> None:
+        """Dynamic window: migrate the ring onto the new sub-window
+        geometry (ops/sketch_kernels._migrate_window — conservative
+        re-bucketing, never over-admits), swap compiled steps, and
+        re-bucket the mass-watchdog's period ledger by wall time."""
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        migrate = sketch_kernels.build_migrate(self.config, new_cfg)
+        steps = sketch_kernels.build_steps(new_cfg)
+        new_sub = sketch_kernels.sketch_geometry(new_cfg)[1]
+        new_sw = sketch_kernels.sketch_geometry(new_cfg)[2]
+        import jax.numpy as jnp
+
+        now_us = to_micros(self.clock.now())
+        with self._lock:
+            old_sub = self._sub_us
+            self._state = migrate(self._state, jnp.int64(now_us))
+            self._step, self._reset_step, self._rollover = steps
+            self._window_us = to_micros(new_cfg.window)
+            self._sub_us = new_sub
+            self._ring_sw = new_sw
+            self._host_period = now_us // new_sub
+            self._period_mass = self._remap_mass(old_sub, new_sub)
+            self._warned_period = -1
+            # DCN bookkeeping is denominated in old-unit periods: drop it
+            # (foreign subtraction against renumbered periods would be
+            # wrong; the pusher detects the sub_us change and resets its
+            # watermarks — parallel/dcn.py, serving/dcn_peer.py).
+            if hasattr(self, "_dcn_foreign"):
+                self._dcn_foreign = {}
+
+    def _remap_mass(self, old_sub: int, new_sub: int) -> dict:
+        merged: dict = {}
+        for p, mass in self._period_mass.items():
+            q = ((p + 1) * old_sub - 1) // new_sub
+            merged[q] = merged.get(q, 0) + mass
+        return merged
+
     # ------------------------------------------------- checkpoint/restore
 
     _CKPT_KIND = "sketch"
+    #: State arrays that may be absent in older checkpoints and default
+    #: to zeros on restore (see restore()).
+    _CKPT_OPTIONAL: tuple = ()
 
     def save(self, path: str) -> None:
         """Snapshot device state to ``path`` (.npz). See
@@ -285,6 +326,11 @@ class SketchLimiter(RateLimiter):
         self._check_open()
         arrays, meta = load_state(path, self._CKPT_KIND, self.config)
         with self._lock:
+            # Arrays added in later releases may default when absent from
+            # an older checkpoint (each class lists the safe ones).
+            for k in self._CKPT_OPTIONAL:
+                if k not in arrays and k in self._state:
+                    arrays[k] = np.zeros_like(np.asarray(self._state[k]))
             if set(arrays) != set(self._state):
                 from ratelimiter_tpu.core.errors import CheckpointError
 
@@ -328,6 +374,12 @@ class SketchTokenBucketLimiter(SketchLimiter):
     Shares the SketchLimiter shell (hashing, padding, locking, fault
     injection, fail-open) and swaps the kernels: no sub-window ring, no
     rollover dispatches — decay is inside the step itself."""
+
+    #: ``acc`` (the DCN export accumulator) was added after v0.1: older
+    #: checkpoints restore with a zero accumulator (worst case: traffic
+    #: from before the upgrade is never exported — local decisions and
+    #: future exchange are unaffected).
+    _CKPT_OPTIONAL = ("acc",)
 
     def __init__(self, config: Config, clock: Optional[Clock] = None):
         RateLimiter.__init__(self, config, clock)
@@ -380,6 +432,23 @@ class SketchTokenBucketLimiter(SketchLimiter):
             self._state = dict(self._state,
                                debt=jnp.minimum(self._state["debt"], cap),
                                rem=jnp.asarray(0, jnp.int64))
+
+    def _apply_window(self, new_cfg: Config) -> None:
+        """Dynamic window for the debt sketch: the window only sets the
+        refill rate (limit/window), so the kernels swap and accumulated
+        debt stands (it now drains at the new rate — the same semantics
+        as the token-form backends). The decay remainder is denominated
+        in the old rate fraction, so it resets (forfeits < 1 micro-token
+        toward denying)."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        steps = bucket_kernels.build_steps(new_cfg)
+        with self._lock:
+            self._step, self._reset_step = steps
+            self._window_us = to_micros(new_cfg.window)
+            self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
 
     def _finish(self, outs, b: int, now_us: int) -> BatchResult:
         """Token-bucket result assembly: retry-after = deficit / refill rate

@@ -140,6 +140,14 @@ class LimiterDecorator(RateLimiter):
         raise AssertionError("decorator delegates the public surface")
 
 
+def undecorated(limiter: RateLimiter) -> RateLimiter:
+    """Peel the decorator stack down to the backend limiter (the object
+    owning ``_state``/``_lock``, which checkpoint and DCN code needs)."""
+    while isinstance(limiter, LimiterDecorator):
+        limiter = limiter.inner
+    return limiter
+
+
 def _error_kind(exc: Exception) -> str:
     if isinstance(exc, StorageUnavailableError):
         return "storage_unavailable"

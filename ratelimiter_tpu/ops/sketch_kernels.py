@@ -543,6 +543,80 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
     return step, reset, rollover
 
 
+def _migrate_window(state: State, now_us, *, sub_o: int, SWo: int, So: int,
+                    sub_n: int, SWn: int, Sn: int, hh: int):
+    """Re-bucket ring state onto a new sub-window geometry (dynamic
+    window updates). Every old sub-window's mass is attributed to the
+    LAST new period its time span overlaps, so nothing expires earlier
+    than it would have under either window — migration can only err
+    toward denying, never over-admission. Mass mapped past the new
+    window's tail (an old window longer than the new one) drops into the
+    boundary-or-older region and ages out exactly like native history.
+    """
+    p_last = state["last_period"]
+    p_now = now_us // sub_n
+    sp = state["slab_period"]                              # (So,)
+    valid = (sp >= p_last - SWo) & (sp <= p_last - 1)
+    q = ((sp + 1) * sub_o - 1) // sub_n                    # last overlapped
+    to_cur = valid & (q >= p_now)
+    in_ring = valid & (q < p_now) & (q >= p_now - SWn)
+    slot = (q % Sn).astype(jnp.int32)
+
+    def rebucket(slabs, cur):
+        contrib = slabs * in_ring.reshape((-1,) + (1,) * (slabs.ndim - 1))
+        new_slabs = jnp.zeros((Sn,) + slabs.shape[1:],
+                              slabs.dtype).at[slot].add(contrib)
+        # dtype pinned: jnp.sum would promote int32 to the default int,
+        # permanently doubling the hot arrays' width and tripping the
+        # next rollover's int64->int32 scatter.
+        new_cur = cur + jnp.sum(
+            slabs * to_cur.reshape((-1,) + (1,) * (slabs.ndim - 1)),
+            axis=0, dtype=cur.dtype)
+        return new_slabs, new_cur
+
+    new_slabs, new_cur = rebucket(state["slabs"], state["cur"])
+    periods_n = jnp.full((Sn,), _NEVER, jnp.int64).at[slot].max(
+        jnp.where(in_ring, q, _NEVER))
+    in_window = ((periods_n >= p_now - SWn + 1)
+                 & (periods_n <= p_now - 1)).astype(jnp.int32)
+    totals_n = (jnp.tensordot(in_window, new_slabs, axes=1)
+                .astype(new_cur.dtype) + new_cur)
+    out = {"cur": new_cur, "slabs": new_slabs, "totals": totals_n,
+           "slab_period": periods_n,
+           "last_period": jnp.asarray(p_now, jnp.int64)}
+    if hh:
+        hh_slabs, hh_cur = rebucket(state["hh_slabs"], state["hh_cur"])
+        hh_totals = (jnp.tensordot(in_window, hh_slabs, axes=1)
+                     .astype(hh_cur.dtype) + hh_cur)
+        q_hh = ((state["hh_last"] + 1) * sub_o - 1) // sub_n
+        out.update({
+            "hh_owner": state["hh_owner"],
+            "hh_cur": hh_cur,
+            "hh_slabs": hh_slabs,
+            "hh_totals": hh_totals,
+            "hh_last": jnp.where(state["hh_last"] == _NEVER,
+                                 jnp.int64(_NEVER), q_hh),
+        })
+    return out
+
+
+def build_migrate(old_cfg: Config, new_cfg: Config) -> Callable:
+    """Jitted ``migrate(state, now_us) -> state`` moving ring state from
+    old_cfg's window geometry to new_cfg's. Limit/depth/width/hh must
+    match (only the window changes)."""
+    _, sub_o, SWo, So, _ = sketch_geometry(old_cfg)
+    _, sub_n, SWn, Sn, _ = sketch_geometry(new_cfg)
+    if (old_cfg.sketch.depth, old_cfg.sketch.width) != (
+            new_cfg.sketch.depth, new_cfg.sketch.width):
+        raise InvalidConfigError("window migration cannot change geometry")
+    hh, _ = _hh_params(old_cfg)
+    # No donation: the ring shapes change (So != Sn in general), so the
+    # old buffers cannot be reused anyway and donating only warns.
+    return jax.jit(
+        partial(_migrate_window, sub_o=sub_o, SWo=SWo, So=So, sub_n=sub_n,
+                SWn=SWn, Sn=Sn, hh=hh))
+
+
 _SCAN_CACHE: Dict[tuple, Callable] = {}
 
 

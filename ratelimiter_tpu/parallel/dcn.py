@@ -83,6 +83,25 @@ def export_debt(lim: SketchTokenBucketLimiter) -> np.ndarray:
     return acc
 
 
+def restore_debt(lim: SketchTokenBucketLimiter, delta: np.ndarray) -> None:
+    """Return an exported-but-undelivered delta to the accumulator so the
+    next cycle re-ships it (merges add to ``debt`` only, never ``acc``,
+    so re-accumulation cannot double-export). Used by the push transport
+    when EVERY peer push fails — without it, a network partition drops
+    one interval of traffic per cycle, unbounded in total."""
+    if not isinstance(lim, SketchTokenBucketLimiter):
+        raise InvalidConfigError("restore_debt needs a SketchTokenBucketLimiter")
+    import jax.numpy as jnp
+
+    from ratelimiter_tpu.ops.bucket_kernels import _DEBT_CAP
+
+    with lim._lock:
+        lim._state = dict(
+            lim._state,
+            acc=jnp.minimum(lim._state["acc"] + jnp.asarray(delta),
+                            _DEBT_CAP))
+
+
 def merge_debt(lim: SketchTokenBucketLimiter, delta: np.ndarray) -> int:
     """Add a foreign pod's debt delta to the local slab (clamped to the
     overflow cap). The delta missed refill decay in transit — an
@@ -124,6 +143,27 @@ def merge_debt(lim: SketchTokenBucketLimiter, delta: np.ndarray) -> int:
     return nz
 
 
+def _foreign_record(lim: SketchLimiter, last: int, SW: int) -> Dict[int, np.ndarray]:
+    """Per-period record of foreign contributions merged into the local
+    ring (host numpy, lazily attached, pruned to the live window). Must
+    be accessed with ``lim._lock`` held.
+
+    This is what keeps exports LOCAL-ONLY under an asynchronous push
+    transport: a peer's merge lands in a slab BEFORE this pod happens to
+    export that period, so the raw slab is contaminated — re-exporting
+    it would echo the peer's own traffic back (systematic double count,
+    effective limit ~halved under steady exchange). Exports subtract the
+    record, restoring the export-all-then-merge-all guarantee the
+    in-process mirror group gets from strict ordering."""
+    rec = getattr(lim, "_dcn_foreign", None)
+    if rec is None:
+        rec = {}
+        lim._dcn_foreign = rec
+    for q in [q for q in rec if q < last - SW]:
+        del rec[q]
+    return rec
+
+
 def export_completed(lim: SketchLimiter, after_period: int,
                      ) -> Tuple[np.ndarray, np.ndarray, int]:
     """(periods int64[k], slabs int32[k, d, w], last_period): every
@@ -131,13 +171,15 @@ def export_completed(lim: SketchLimiter, after_period: int,
     ring, plus the pod's current period. The caller's next watermark is
     ``last_period - 1`` — NOT the max exported period — so periods that
     complete (or receive foreign merges) after this snapshot still
-    export next cycle. Call before merging foreign data for the cycle
-    (module docstring)."""
+    export next cycle. Exported slabs carry LOCAL traffic only: foreign
+    contributions merged into the ring are subtracted via the per-period
+    record (_foreign_record) before shipping."""
     _check(lim)
     _, _, SW, S, _ = sketch_kernels.sketch_geometry(lim.config)
     with lim._lock:
         sp = np.asarray(lim._state["slab_period"])
         last = int(np.asarray(lim._state["last_period"]))
+        rec = _foreign_record(lim, last, SW)
         # In-window completed periods only: [last-SW, last-1]. This also
         # excludes the _NEVER sentinel slab the first rollover flushes.
         take = [(int(p), slot) for slot, p in enumerate(sp.tolist())
@@ -148,8 +190,14 @@ def export_completed(lim: SketchLimiter, after_period: int,
             return (np.empty(0, np.int64), np.empty((0, d, w), np.int32),
                     last)
         periods = np.array([p for p, _ in take], dtype=np.int64)
-        slabs = np.stack([np.asarray(lim._state["slabs"][slot])
-                          for _, slot in take])
+        out = []
+        for per, slot in take:
+            slab = np.asarray(lim._state["slabs"][slot])
+            f = rec.get(per)
+            if f is not None:
+                slab = np.maximum(slab - f, 0)
+            out.append(slab)
+        slabs = np.stack(out)
     return periods, slabs, last
 
 
@@ -192,6 +240,7 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
     with lim._lock:
         sp = np.array(np.asarray(lim._state["slab_period"]))  # writable copy
         last = int(np.asarray(lim._state["last_period"]))
+        rec = _foreign_record(lim, last, SW)
         new_slabs = lim._state["slabs"]
         new_sp = lim._state["slab_period"]
         for p_np, slab in zip(periods.tolist(), slabs):
@@ -208,10 +257,14 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
             cur_p = int(sp[slot])
             if cur_p == p:
                 new_slabs = new_slabs.at[slot].add(jnp.asarray(slab))
+                prev = rec.get(p)
+                rec[p] = slab.astype(np.int64) if prev is None else prev + slab
             elif cur_p < p:
                 new_slabs = new_slabs.at[slot].set(jnp.asarray(slab))
                 new_sp = new_sp.at[slot].set(p)
                 sp[slot] = p
+                # The whole slot content is foreign now.
+                rec[p] = slab.astype(np.int64).copy()
             else:
                 continue
             applied += 1

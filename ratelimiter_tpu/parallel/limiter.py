@@ -98,6 +98,17 @@ class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
         with self._lock:
             self._step, self._reset_step, self._rollover = steps
 
+    def _apply_window(self, new_cfg):
+        """Dynamic window on a mesh: migrate the (replicated) ring with
+        the plain kernel, then re-install the mesh-compiled steps and
+        re-replicate — the base hook alone would silently swap in
+        single-chip kernels and drop the merge contract."""
+        super()._apply_window(new_cfg)
+        steps = mesh_kernels.build_mesh_steps(new_cfg, self.mesh, self.merge)
+        with self._lock:
+            self._step, self._reset_step, self._rollover = steps
+            self._state = mesh_kernels.replicate_state(self._state, self.mesh)
+
 
 class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
     """Sketched token bucket spanning a mesh: replicated debt slab, batch
@@ -130,4 +141,21 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
             self._state = dict(
                 self._state,
                 debt=jnp.minimum(self._state["debt"], cap),
+                rem=self._place_replicated(jnp.asarray(0, jnp.int64)))
+
+    def _apply_window(self, new_cfg):
+        """Dynamic window on a mesh bucket: the window only sets the
+        refill rate, so rebuild the MESH steps (not the single-chip ones
+        the base hook installs) and reset the remainder replicated."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.core.clock import to_micros as _to_micros
+
+        steps = mesh_kernels.build_mesh_bucket_steps(new_cfg, self.mesh,
+                                                     self.merge)
+        with self._lock:
+            self._step, self._reset_step = steps
+            self._window_us = _to_micros(new_cfg.window)
+            self._state = dict(
+                self._state,
                 rem=self._place_replicated(jnp.asarray(0, jnp.int64)))

@@ -21,6 +21,7 @@ from ratelimiter_tpu.observability import (
     MetricsDecorator,
     TracingDecorator,
 )
+from ratelimiter_tpu.observability import metrics as obs_metrics
 from ratelimiter_tpu.serving.server import RateLimitServer
 
 
@@ -78,6 +79,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "front door")
     ap.add_argument("--dcn-interval", type=float, default=1.0,
                     help="seconds between DCN export+push cycles")
+    ap.add_argument("--dcn-listen", action="store_true",
+                    help="accept T_DCN_PUSH frames from peers (implied by "
+                         "--dcn-peer); off by default so plain deployments "
+                         "keep the 1 MiB per-frame bound")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="also serve the HTTP gateway (429 + X-RateLimit-* "
+                         "headers, /healthz, /metrics) on this port; HTTP "
+                         "decisions share the micro-batcher with binary "
+                         "traffic on the asyncio front door")
     return ap
 
 
@@ -161,10 +171,10 @@ async def amain(args) -> None:
 
         if args.backend != "sketch":
             raise SystemExit("--dcn-peer needs --backend sketch")
-        inner = limiter
-        while hasattr(inner, "inner"):
-            inner = inner.inner
-        pusher = DcnPusher(inner, [parse_peer(s) for s in args.dcn_peer],
+        from ratelimiter_tpu.observability.decorators import undecorated
+
+        pusher = DcnPusher(undecorated(limiter),
+                           [parse_peer(s) for s in args.dcn_peer],
                            interval=args.dcn_interval)
         pusher.start()
     if args.native:
@@ -176,16 +186,31 @@ async def amain(args) -> None:
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                               if args.dispatch_timeout_ms else None))
         server.start()
+        gateway = None
+        if args.http_port is not None:
+            from ratelimiter_tpu.serving.http_gateway import HttpGateway
+
+            gateway = HttpGateway(
+                lambda key, n: limiter.allow_n(key, n), limiter.reset,
+                host=args.host, port=args.http_port,
+                metrics_render=obs_metrics.DEFAULT.render,
+                health=lambda: {"serving": True,
+                                **{k: v for k, v in server.stats().items()
+                                   if k == "decisions_total"}})
+            gateway.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         print(f"serving(native) {args.algorithm}/{args.backend} "
               f"limit={args.limit}/{args.window:g}s on "
-              f"{args.host}:{server.port}", flush=True)
+              f"{args.host}:{server.port}"
+              + (f" http:{gateway.port}" if gateway else ""), flush=True)
         await stop.wait()
         if pusher is not None:
             pusher.stop()
+        if gateway is not None:
+            gateway.shutdown()
         server.shutdown()
         limiter.close()
         return
@@ -194,19 +219,42 @@ async def amain(args) -> None:
         max_batch=args.max_batch,
         max_delay=args.max_delay_us * 1e-6,
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
-                          if args.dispatch_timeout_ms else None))
+                          if args.dispatch_timeout_ms else None),
+        dcn=bool(args.dcn_listen or args.dcn_peer))
     await server.start()
 
-    stop = asyncio.Event()
+    gateway = None
     loop = asyncio.get_running_loop()
+    if args.http_port is not None:
+        from ratelimiter_tpu.serving.http_gateway import HttpGateway
+
+        def http_decide(key: str, n: int):
+            # Gateway threads funnel into the SAME micro-batcher as the
+            # binary protocol: HTTP and binary traffic share device
+            # dispatches.
+            return asyncio.run_coroutine_threadsafe(
+                server.batcher.submit(key, n), loop).result(timeout=30)
+
+        gateway = HttpGateway(
+            http_decide, limiter.reset,
+            host=args.host, port=args.http_port,
+            metrics_render=obs_metrics.DEFAULT.render,
+            health=lambda: {"serving": True,
+                            "decisions_total": server.batcher.decisions_total})
+        gateway.start()
+
+    stop = asyncio.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     print(f"serving {args.algorithm}/{args.backend} "
           f"limit={args.limit}/{args.window:g}s on "
-          f"{args.host}:{server.port}", flush=True)
+          f"{args.host}:{server.port}"
+          + (f" http:{gateway.port}" if gateway else ""), flush=True)
     await stop.wait()
     if pusher is not None:
         pusher.stop()
+    if gateway is not None:
+        gateway.shutdown()
     await server.shutdown()
     limiter.close()
 

@@ -37,6 +37,7 @@ from ratelimiter_tpu.algorithms.sketch import (
     SketchLimiter,
     SketchTokenBucketLimiter,
 )
+from ratelimiter_tpu.ops.sketch_kernels import sketch_geometry
 from ratelimiter_tpu.serving import protocol as p
 
 log = logging.getLogger("ratelimiter_tpu.serving.dcn")
@@ -110,6 +111,20 @@ class DcnPusher:
         # that already merged them is never re-sent (re-merging the same
         # period double-counts by design of the add-merge).
         self._watermarks: List[int] = [-(1 << 62)] * len(self.peers)
+        self._sub_us = (0 if self._bucket
+                        else sketch_geometry(limiter.config)[1])
+        sk = limiter.config.sketch
+        self._slab_bytes = sk.depth * sk.width * 4
+        self._payload_budget = (p.MAX_DCN_FRAME - 4096) // 2
+        if not self._bucket and self._slab_bytes > self._payload_budget:
+            raise ValueError(
+                f"sketch geometry too large for the DCN transport: one "
+                f"slab is {self._slab_bytes >> 20} MiB vs the "
+                f"{self._payload_budget >> 20} MiB frame budget")
+        if self._bucket and sk.depth * sk.width * 8 > p.MAX_DCN_FRAME - 4096:
+            raise ValueError(
+                "sketch geometry too large for the DCN debt transport "
+                f"(delta is {(sk.depth * sk.width * 8) >> 20} MiB)")
         self._ids = itertools.count(1)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -141,22 +156,70 @@ class DcnPusher:
                     self.pushes_failed += 1
                     log.warning("DCN push to %s:%d failed: %s",
                                 peer.host, peer.port, exc)
+            if delivered == 0 and self.peers:
+                # Total failure (partition): put the delta back so the
+                # next cycle re-ships it — loss stays bounded by ONE
+                # interval per partial-failure episode, not per cycle.
+                # (On PARTIAL failure the delta is not returned: the
+                # peers that got it must not get it twice; the failing
+                # peer loses this interval — documented envelope.)
+                dcn.restore_debt(self.limiter, delta)
             return delivered
+        # A window change renumbers periods (new sub_us units): stored
+        # watermarks are meaningless, so reset them to "everything before
+        # now" — skipped history is bounded by one window, the documented
+        # migration loss; peers reject mixed-unit frames via the wire's
+        # sub_us check until they migrate too.
+        epoch_sub = sketch_geometry(self.limiter.config)[1]
+        if epoch_sub != self._sub_us:
+            log.warning("DCN pusher: window changed (sub %dus -> %dus); "
+                        "resetting peer watermarks", self._sub_us, epoch_sub)
+            self._sub_us = epoch_sub
+            with self.limiter._lock:
+                import numpy as _np
+
+                last_now = int(_np.asarray(
+                    self.limiter._state["last_period"]))
+            self._watermarks = [last_now - 1] * len(self.peers)
+        # ONE device->host export per cycle (at the laggiest watermark),
+        # sliced per peer — not one full ring snapshot per peer.
+        periods, slabs, last = dcn.export_completed(
+            self.limiter, min(self._watermarks))
+        if periods.shape[0] == 0:
+            return 0
+        # Chunk so no frame exceeds the protocol's DCN cap (one slab per
+        # frame minimum; geometry too big for even that was rejected at
+        # construction).
+        per_frame = max(1, self._payload_budget // self._slab_bytes)
         for i, peer in enumerate(self.peers):
-            periods, slabs, last = dcn.export_completed(
-                self.limiter, self._watermarks[i])
-            if periods.shape[0] == 0:
+            sel = periods > self._watermarks[i]
+            if not sel.any():
                 continue
-            frame = p.encode_dcn_slabs(req_id, periods, slabs)
-            try:
-                peer.push(frame, req_id)
+            pp, ss = periods[sel], slabs[sel]
+            ok = True
+            sent_up_to = self._watermarks[i]
+            for s0 in range(0, pp.shape[0], per_frame):
+                frame = p.encode_dcn_slabs(
+                    req_id, pp[s0:s0 + per_frame], ss[s0:s0 + per_frame],
+                    self._sub_us)
+                try:
+                    peer.push(frame, req_id)
+                    self.pushes_ok += 1
+                    # Periods are sorted ascending: the watermark tracks
+                    # the last DELIVERED chunk, so a partial failure
+                    # never re-sends (and never re-merges) what already
+                    # landed.
+                    sent_up_to = int(pp[min(s0 + per_frame, len(pp)) - 1])
+                except Exception as exc:
+                    self.pushes_failed += 1
+                    ok = False
+                    log.warning("DCN push to %s:%d failed: %s",
+                                peer.host, peer.port, exc)
+                    break
+            if ok:
                 delivered += 1
-                self.pushes_ok += 1
-                self._watermarks[i] = max(self._watermarks[i], last - 1)
-            except Exception as exc:
-                self.pushes_failed += 1
-                log.warning("DCN push to %s:%d failed: %s",
-                            peer.host, peer.port, exc)
+                sent_up_to = last - 1
+            self._watermarks[i] = max(self._watermarks[i], sent_up_to)
         return delivered
 
     # ---------------------------------------------------------- lifecycle

@@ -1,0 +1,183 @@
+"""HTTP interop gateway: the reference's flagship usage shape as a
+service.
+
+The reference's canonical example is an HTTP handler that consults the
+limiter and answers 429 with ``X-RateLimit-Limit`` / ``-Remaining`` /
+``-Reset`` and ``Retry-After`` headers (``docs/EXAMPLES.md:44-57``), and
+maps backend failure to 503 Service Unavailable. This gateway is that
+example as a standalone surface, so plain HTTP clients (curl, sidecars,
+anything without the binary protocol) get drop-in rate limiting:
+
+    GET/POST /v1/allow?key=K[&n=N]   -> 200 allowed / 429 denied,
+                                        X-RateLimit-* + Retry-After
+    POST     /v1/reset?key=K         -> 200 {"ok": true}
+    GET      /healthz                -> 200 {"serving": true, ...}
+    GET      /metrics                -> Prometheus text
+
+The key may also ride the ``X-User-ID`` header (the reference example's
+convention) when no ``key`` query parameter is given.
+
+Transport-agnostic core: the gateway takes ``decide(key, n) -> Result``
+and ``reset(key)`` callables. The server binary wires them to the SAME
+micro-batcher as the binary protocol (HTTP and binary traffic coalesce
+into shared device dispatches); standalone embedding wires them straight
+to a limiter. The gRPC shape of this same surface is checked in at
+``api/proto/ratelimiter.proto``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ratelimiter_tpu.core.errors import (
+    InvalidKeyError,
+    InvalidNError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import Result
+
+log = logging.getLogger("ratelimiter_tpu.serving.http")
+
+
+class HttpGateway:
+    """Threaded stdlib HTTP front door over decide/reset callables."""
+
+    def __init__(self, decide: Callable[[str, int], Result],
+                 reset: Callable[[str], None], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics_render: Optional[Callable[[], str]] = None,
+                 health: Optional[Callable[[], dict]] = None):
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("http %s", fmt % args)
+
+            def _send(self, status: int, body: dict, headers=()):
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _handle(self):
+                # Drain any request body first: HTTP/1.1 keep-alive means
+                # unread body bytes would be parsed as the next request
+                # line, corrupting the connection.
+                try:
+                    remaining = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    remaining = 0
+                while remaining > 0:
+                    chunk = self.rfile.read(min(remaining, 65536))
+                    if not chunk:
+                        break
+                    remaining -= len(chunk)
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                try:
+                    if url.path == "/v1/allow":
+                        key = q.get("key", [None])[0] \
+                            or self.headers.get("X-User-ID")
+                        n = int(q.get("n", ["1"])[0])
+                        if key is None:
+                            self._send(400, {"error": "missing key (query "
+                                             "param or X-User-ID header)"})
+                            return
+                        res = gateway.decide(key, n)
+                        headers = [
+                            ("X-RateLimit-Limit", str(res.limit)),
+                            ("X-RateLimit-Remaining", str(res.remaining)),
+                            ("X-RateLimit-Reset", str(int(res.reset_at))),
+                        ]
+                        body = {"allowed": bool(res.allowed),
+                                "limit": int(res.limit),
+                                "remaining": int(res.remaining),
+                                "retry_after": float(res.retry_after),
+                                "reset_at": float(res.reset_at),
+                                "fail_open": bool(res.fail_open)}
+                        if res.allowed:
+                            self._send(200, body, headers)
+                        else:
+                            headers.append(
+                                ("Retry-After",
+                                 str(max(1, int(res.retry_after)))))
+                            self._send(429, body, headers)
+                    elif url.path == "/v1/reset" and self.command == "POST":
+                        key = q.get("key", [None])[0]
+                        if key is None:
+                            self._send(400, {"error": "missing key"})
+                            return
+                        gateway.reset(key)
+                        self._send(200, {"ok": True})
+                    elif url.path == "/healthz":
+                        self._send(200, gateway.health())
+                    elif url.path == "/metrics":
+                        text = gateway.metrics_render().encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(text)))
+                        self.end_headers()
+                        self.wfile.write(text)
+                    else:
+                        self._send(404, {"error": f"no route {url.path}"})
+                except (InvalidKeyError, InvalidNError, ValueError) as exc:
+                    self._send(400, {"error": str(exc)})
+                except StorageUnavailableError as exc:
+                    # Reference example: backend down -> 503
+                    # (docs/EXAMPLES.md:38-41).
+                    self._send(503, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — never kill the conn
+                    log.exception("http gateway internal error")
+                    self._send(500, {"error": str(exc)})
+
+            do_GET = _handle
+            do_POST = _handle
+
+        self.decide = decide
+        self.reset = reset
+        self.metrics_render = metrics_render if metrics_render else lambda: ""
+        self.health = health if health else lambda: {"serving": True}
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="rl-http-gateway")
+        self._thread.start()
+        log.info("http gateway listening on %s:%d", self.host, self.port)
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+def gateway_for_limiter(limiter, *, host: str = "127.0.0.1",
+                        port: int = 0) -> HttpGateway:
+    """Standalone embedding: the gateway calls the limiter directly
+    (the limiter's own lock serializes; for coalescing with binary
+    traffic use the server binary's --http-port instead)."""
+    from ratelimiter_tpu.observability import metrics as m
+
+    return HttpGateway(
+        lambda key, n: limiter.allow_n(key, n),
+        limiter.reset,
+        host=host, port=port,
+        metrics_render=m.DEFAULT.render,
+        health=lambda: {"serving": True})

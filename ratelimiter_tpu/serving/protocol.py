@@ -246,10 +246,15 @@ class ProtocolError(RateLimiterError):
     """Malformed frame — the connection is beyond recovery."""
 
 
-def parse_header(buf: bytes) -> Tuple[int, int, int]:
-    """(payload_length, type, req_id) from the 13 header bytes."""
+def parse_header(buf: bytes, *, allow_dcn: bool = False) -> Tuple[int, int, int]:
+    """(payload_length, type, req_id) from the 13 header bytes.
+
+    ``allow_dcn`` raises the size cap for T_DCN_PUSH frames — ONLY a
+    server that actually participates in DCN should pass it, otherwise
+    any client could force MAX_DCN_FRAME-sized buffering per connection
+    just by labeling frames (memory DoS on plain deployments)."""
     length, type_, req_id = _HDR.unpack_from(buf)
-    cap = MAX_DCN_FRAME if type_ == T_DCN_PUSH else MAX_FRAME
+    cap = MAX_DCN_FRAME if (allow_dcn and type_ == T_DCN_PUSH) else MAX_FRAME
     if length < 9 or length > cap:
         raise ProtocolError(f"bad frame length {length}")
     return length, type_, req_id
@@ -298,22 +303,27 @@ def parse_error(body: bytes) -> Tuple[int, str]:
 #
 # T_DCN_PUSH body:
 #   u8 kind
-#   kind=DCN_KIND_SLABS: u32 count | s64 periods[count] |
+#   kind=DCN_KIND_SLABS: s64 sub_us | u32 count | s64 periods[count] |
 #                        count * d*w int32 slabs (C order)
 #   kind=DCN_KIND_DEBT:  d*w int64 delta (C order)
-# The receiver validates payload size against ITS OWN (d, w) geometry —
-# a mismatched peer gets E_INVALID_CONFIG, never a reshaped merge.
+# The receiver validates payload size against ITS OWN (d, w) geometry
+# and, for slabs, the sub-window duration (periods are denominated in
+# sub_us units — a window change renumbers them, so a pod mid-window-
+# migration must not merge old-unit periods). Mismatches answer
+# E_INVALID_CONFIG, never a reshaped/renumbered merge.
 
 _DCN_HEAD = struct.Struct("<B")
 _S64 = struct.Struct("<q")
 
 
-def encode_dcn_slabs(req_id: int, periods, slabs) -> bytes:
-    """periods int64[k], slabs int32[k, d, w] (export_completed output)."""
+def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int) -> bytes:
+    """periods int64[k] in sub_us units, slabs int32[k, d, w]
+    (export_completed output)."""
     import numpy as np
 
     k = int(periods.shape[0])
-    body = (_DCN_HEAD.pack(DCN_KIND_SLABS) + _U32.pack(k)
+    body = (_DCN_HEAD.pack(DCN_KIND_SLABS) + _S64.pack(sub_us)
+            + _U32.pack(k)
             + np.ascontiguousarray(periods, dtype=np.int64).tobytes()
             + np.ascontiguousarray(slabs, dtype=np.int32).tobytes())
     return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
@@ -328,10 +338,10 @@ def encode_dcn_debt(req_id: int, delta) -> bytes:
     return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
 
 
-def parse_dcn(body: bytes, d: int, w: int):
+def parse_dcn(body: bytes, d: int, w: int, sub_us: int):
     """-> (DCN_KIND_SLABS, periods int64[k], slabs int32[k,d,w]) or
     (DCN_KIND_DEBT, delta int64[d,w], None), validated against the
-    receiver's geometry."""
+    receiver's geometry (incl. the sub-window duration for slabs)."""
     import numpy as np
 
     if len(body) < 1:
@@ -339,17 +349,25 @@ def parse_dcn(body: bytes, d: int, w: int):
     (kind,) = _DCN_HEAD.unpack_from(body)
     payload = body[1:]
     if kind == DCN_KIND_SLABS:
-        if len(payload) < 4:
+        if len(payload) < 12:
             raise ProtocolError("short DCN slabs body")
-        (k,) = _U32.unpack_from(payload)
-        want = 4 + k * 8 + k * d * w * 4
+        (peer_sub,) = _S64.unpack_from(payload)
+        if peer_sub != sub_us:
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"DCN peer sub-window {peer_sub}us != local {sub_us}us "
+                "(window mismatch or mid-migration) — periods would "
+                "merge into the wrong sub-windows")
+        (k,) = _U32.unpack_from(payload, 8)
+        want = 12 + k * 8 + k * d * w * 4
         if len(payload) != want:
             raise ProtocolError(
                 f"DCN slabs payload {len(payload)}B != {want}B for "
                 f"k={k} d={d} w={w} (geometry mismatch?)")
-        periods = np.frombuffer(payload, dtype=np.int64, count=k, offset=4)
+        periods = np.frombuffer(payload, dtype=np.int64, count=k, offset=12)
         slabs = np.frombuffer(payload, dtype=np.int32,
-                              offset=4 + k * 8).reshape(k, d, w)
+                              offset=12 + k * 8).reshape(k, d, w)
         return kind, periods, slabs
     if kind == DCN_KIND_DEBT:
         want = d * w * 8

@@ -44,10 +44,15 @@ class RateLimitServer:
                  port: int = 0, *, max_batch: int = 4096,
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
-                 registry: Optional[m.Registry] = None):
+                 registry: Optional[m.Registry] = None,
+                 dcn: bool = False):
         self.limiter = limiter
         self.host = host
         self.port = port
+        #: Accept T_DCN_PUSH frames (and their larger size cap). Off by
+        #: default: a plain deployment must keep the 1 MiB bad-input
+        #: bound on every frame.
+        self.dcn = dcn
         self.registry = registry if registry is not None else m.DEFAULT
         self.batcher = MicroBatcher(
             limiter, max_batch=max_batch, max_delay=max_delay,
@@ -127,7 +132,8 @@ class RateLimitServer:
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     break
                 try:
-                    length, type_, req_id = p.parse_header(hdr)
+                    length, type_, req_id = p.parse_header(
+                        hdr, allow_dcn=self.dcn)
                     body = await reader.readexactly(length - 9)
                 except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
                     log.warning("protocol error, dropping connection: %s", exc)
@@ -182,25 +188,24 @@ class RateLimitServer:
             if task is not None:
                 self._conn_tasks.discard(task)
 
-    def _dcn_target(self):
-        """The undecorated limiter the DCN merge functions operate on."""
-        lim = self.limiter
-        while hasattr(lim, "inner"):
-            lim = lim.inner
-        return lim
-
     async def _handle_dcn(self, req_id: int, body: bytes) -> bytes:
         from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.decorators import undecorated
         from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
 
-        lim = self._dcn_target()
+        lim = undecorated(self.limiter)
         if not isinstance(lim, SketchLimiter):
             from ratelimiter_tpu.core.errors import InvalidConfigError
 
             raise InvalidConfigError(
                 "DCN exchange needs a sketch-family backend")
+        from ratelimiter_tpu.algorithms.sketch import SketchTokenBucketLimiter
+        from ratelimiter_tpu.ops import sketch_kernels
+
         d, w = lim.config.sketch.depth, lim.config.sketch.width
-        kind, a, b = p.parse_dcn(body, d, w)
+        sub_us = (0 if isinstance(lim, SketchTokenBucketLimiter)
+                  else sketch_kernels.sketch_geometry(lim.config)[1])
+        kind, a, b = p.parse_dcn(body, d, w, sub_us)
         loop = asyncio.get_running_loop()
         if kind == p.DCN_KIND_SLABS:
             await loop.run_in_executor(None, merge_completed, lim, a, b)
@@ -228,10 +233,16 @@ class RateLimitServer:
             elif type_ == p.T_METRICS:
                 out = p.encode_metrics(req_id, self.registry.render())
             elif type_ == p.T_DCN_PUSH:
-                try:
-                    out = await self._handle_dcn(req_id, body)
-                except Exception as exc:
-                    out = p.encode_error(req_id, p.code_for(exc), str(exc))
+                if not self.dcn:
+                    out = p.encode_error(
+                        req_id, p.E_INVALID_CONFIG,
+                        "DCN exchange not enabled on this server")
+                else:
+                    try:
+                        out = await self._handle_dcn(req_id, body)
+                    except Exception as exc:
+                        out = p.encode_error(req_id, p.code_for(exc),
+                                             str(exc))
             else:
                 out = p.encode_error(req_id, p.E_INTERNAL,
                                      f"unknown request type {type_}")

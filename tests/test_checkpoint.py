@@ -223,3 +223,29 @@ class TestValidation:
         with pytest.raises(CheckpointError, match="capacity"):
             lim2.restore(path)
         lim2.close()
+
+
+class TestBackCompat:
+    def test_bucket_checkpoint_without_acc_restores(self, tmp_path):
+        """The v0.1 token-bucket snapshot had no `acc` (DCN export
+        accumulator): it must restore with a zero accumulator instead of
+        failing the key-set check (upgrade path)."""
+        mk, lim = pair(Algorithm.TOKEN_BUCKET, "sketch")
+        lim.allow_n("k", 7)
+        path = str(tmp_path / "old.npz")
+        lim.save(path)
+        # Rewrite the snapshot as a pre-`acc` release would have laid
+        # it out (same meta, `acc` array absent).
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files if k != "acc"}
+        np.savez(path, **arrays)
+        lim2 = mk()
+        lim2.restore(path)
+        # The defaulted accumulator exports nothing stale.
+        from ratelimiter_tpu.parallel.dcn import export_debt
+
+        assert export_debt(lim2).sum() == 0
+        assert lim2.allow_n("k", 3).allowed        # 7 + 3 = limit
+        assert not lim2.allow("k").allowed
+        lim.close()
+        lim2.close()

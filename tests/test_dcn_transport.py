@@ -33,10 +33,11 @@ class TestDcnFrames:
     def test_slabs_roundtrip(self):
         periods = np.array([5, 9], dtype=np.int64)
         slabs = np.arange(2 * 3 * 16, dtype=np.int32).reshape(2, 3, 16)
-        frame = p.encode_dcn_slabs(7, periods, slabs)
+        frame = p.encode_dcn_slabs(7, periods, slabs, 1_000_000)
         length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
         assert type_ == p.T_DCN_PUSH and rid == 7
-        kind, got_p, got_s = p.parse_dcn(frame[p.HEADER_SIZE:], 3, 16)
+        kind, got_p, got_s = p.parse_dcn(frame[p.HEADER_SIZE:], 3, 16,
+                                         1_000_000)
         assert kind == p.DCN_KIND_SLABS
         np.testing.assert_array_equal(got_p, periods)
         np.testing.assert_array_equal(got_s, slabs)
@@ -44,7 +45,7 @@ class TestDcnFrames:
     def test_debt_roundtrip(self):
         delta = np.arange(3 * 16, dtype=np.int64).reshape(3, 16)
         frame = p.encode_dcn_debt(9, delta)
-        kind, got, _ = p.parse_dcn(frame[p.HEADER_SIZE:], 3, 16)
+        kind, got, _ = p.parse_dcn(frame[p.HEADER_SIZE:], 3, 16, 0)
         assert kind == p.DCN_KIND_DEBT
         np.testing.assert_array_equal(got, delta)
 
@@ -52,23 +53,37 @@ class TestDcnFrames:
         delta = np.zeros((3, 16), dtype=np.int64)
         frame = p.encode_dcn_debt(1, delta)
         with pytest.raises(p.ProtocolError, match="geometry"):
-            p.parse_dcn(frame[p.HEADER_SIZE:], 4, 16)
+            p.parse_dcn(frame[p.HEADER_SIZE:], 4, 16, 0)
+
+    def test_subwindow_mismatch_rejected(self):
+        """Periods are denominated in sub_us units: a peer mid-window-
+        migration (different sub_us) must be refused, not renumbered."""
+        from ratelimiter_tpu import InvalidConfigError
+
+        periods = np.array([5], dtype=np.int64)
+        slabs = np.zeros((1, 3, 16), dtype=np.int32)
+        frame = p.encode_dcn_slabs(1, periods, slabs, 1_000_000)
+        with pytest.raises(InvalidConfigError, match="sub-window"):
+            p.parse_dcn(frame[p.HEADER_SIZE:], 3, 16, 500_000)
 
     def test_dcn_frames_may_exceed_request_cap(self):
         # A d=4 w=65536 debt delta is 2 MiB > MAX_FRAME; the DCN type has
-        # its own bound.
+        # its own bound — but ONLY for servers that opted into DCN.
         delta = np.zeros((4, 65536), dtype=np.int64)
         frame = p.encode_dcn_debt(1, delta)
-        length, type_, _ = p.parse_header(frame[:p.HEADER_SIZE])
+        length, type_, _ = p.parse_header(frame[:p.HEADER_SIZE],
+                                          allow_dcn=True)
         assert length > p.MAX_FRAME and type_ == p.T_DCN_PUSH
+        with pytest.raises(p.ProtocolError):
+            p.parse_header(frame[:p.HEADER_SIZE])  # plain deployments
 
 
-def _server_on_thread(limiter):
+def _server_on_thread(limiter, dcn=True):
     """A live asyncio server on a background loop; returns (srv, loop)."""
     loop = asyncio.new_event_loop()
     t = threading.Thread(target=loop.run_forever, daemon=True)
     t.start()
-    srv = RateLimitServer(limiter, "127.0.0.1", 0)
+    srv = RateLimitServer(limiter, "127.0.0.1", 0, dcn=dcn)
     asyncio.run_coroutine_threadsafe(srv.start(), loop).result(10)
     return srv, loop, t
 
@@ -130,6 +145,131 @@ class TestPushOverTcp:
         finally:
             _stop(srv, loop, t)
         a.close()
+
+    def test_dcn_frames_rejected_when_not_enabled(self):
+        """A plain server (dcn=False, the default) refuses T_DCN_PUSH:
+        small frames get a typed error, oversized headers drop the
+        connection before buffering (memory-DoS bound)."""
+        import struct
+
+        a, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        b, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        srv, loop, t = _server_on_thread(b, dcn=False)
+        try:
+            a.allow_n("k", 5)
+            from ratelimiter_tpu.parallel.dcn import export_debt
+            from ratelimiter_tpu.serving.dcn_peer import _PeerConn
+
+            delta = export_debt(a)
+            peer = _PeerConn("127.0.0.1", srv.port)
+            with pytest.raises(Exception, match="not enabled"):
+                peer.push(p.encode_dcn_debt(1, delta), 1)
+            peer.close()
+            # Oversized header claiming T_DCN_PUSH: connection dropped,
+            # nothing buffered.
+            with socket.create_connection(("127.0.0.1", srv.port)) as sk:
+                sk.sendall(struct.pack("<IBQ", 48 << 20, p.T_DCN_PUSH, 2))
+                sk.settimeout(5)
+                assert sk.recv(16) == b""          # server closed it
+            # And the key is still fresh on B (nothing merged).
+            assert b.allow("k").allowed
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_no_echo_of_foreign_slabs(self):
+        """Bidirectional pushers must not re-export merged foreign data
+        (the contamination double-count): after A->B then B->A, A's view
+        of the key equals the true global count, not double."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, ca = self._pod(Algorithm.TPU_SKETCH)
+        b, cb = self._pod(Algorithm.TPU_SKETCH)
+        srv_a, loop_a, ta = _server_on_thread(a)
+        srv_b, loop_b, tb = _server_on_thread(b)
+        try:
+            a.allow_n("k", 4)                      # 4 of 10 on A
+            ca.advance(1.0)
+            cb.advance(1.0)
+            a.allow("warm")
+            b.allow("warm")
+            push_a = DcnPusher(a, [("127.0.0.1", srv_b.port)])
+            push_b = DcnPusher(b, [("127.0.0.1", srv_a.port)])
+            assert push_a.sync_once() == 1         # A's slab lands on B
+            assert push_b.sync_once() == 1         # B exports its "warm"
+            # B's export must NOT have echoed A's 4 back: A still sees
+            # exactly 4 consumed, so 6 remain.
+            assert a.allow_n("k", 6).allowed
+            assert not a.allow("k").allowed
+            push_a.stop()
+            push_b.stop()
+        finally:
+            _stop(srv_a, loop_a, ta)
+            _stop(srv_b, loop_b, tb)
+        a.close()
+        b.close()
+
+    def test_debt_delta_restored_on_total_push_failure(self):
+        """A partitioned pusher re-accumulates the delta instead of
+        dropping an interval of traffic per cycle."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        b, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        pusher = DcnPusher(a, [("127.0.0.1", dead_port)])
+        a.allow_n("k", 10)
+        assert pusher.sync_once() == 0             # partition: restored
+        # Point at a live peer: the SAME traffic ships on the next cycle.
+        srv, loop, t = _server_on_thread(b)
+        try:
+            pusher.peers[0].port = srv.port
+            assert pusher.sync_once() == 1
+            assert not b.allow("k").allowed
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_slab_pushes_chunk_under_frame_cap(self):
+        """Many pending periods split across frames (one ring's worth of
+        large slabs would exceed MAX_DCN_FRAME in a single frame)."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, ca = self._pod(Algorithm.TPU_SKETCH)
+        b, cb = self._pod(Algorithm.TPU_SKETCH)
+        srv, loop, t = _server_on_thread(b)
+        try:
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)])
+            pusher._payload_budget = pusher._slab_bytes  # force 1 slab/frame
+            for i in range(4):                     # 4 completed periods
+                a.allow_n(f"k{i}", 10)
+                ca.advance(1.0)
+                cb.advance(1.0)
+            a.allow("warm")
+            b.allow("warm")
+            assert pusher.sync_once() == 1
+            assert pusher.pushes_ok >= 4           # one frame per period
+            for i in range(4):
+                assert not b.allow(f"k{i}").allowed
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_oversized_geometry_rejected_at_construction(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+                     sketch=SketchParams(depth=16, width=1 << 21,
+                                         sub_windows=6))
+        lim = create_limiter(cfg, backend="sketch", clock=ManualClock(T0))
+        with pytest.raises(ValueError, match="too large"):
+            DcnPusher(lim, [("127.0.0.1", 1)])
+        lim.close()
 
     def test_push_failure_counted_not_fatal(self):
         from ratelimiter_tpu.serving.dcn_peer import DcnPusher

@@ -1,0 +1,188 @@
+"""Dynamic window updates (update_window): ring-state migration onto a
+new sub-window geometry (VERDICT r3 item 10 — the other half of the
+dynamic-configuration story; limits shipped in r3)."""
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+
+T0 = 1_700_000_000.0
+
+
+def mk(window=6.0, limit=10, sub_windows=6, backend="sketch",
+       algo=Algorithm.TPU_SKETCH, **kw):
+    cfg = Config(algorithm=algo, limit=limit, window=window,
+                 max_batch_admission_iters=4,
+                 sketch=SketchParams(depth=2, width=128,
+                                     sub_windows=sub_windows, **kw))
+    clock = ManualClock(T0)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+class TestWindowedMigration:
+    def test_consumed_quota_survives_shrink(self):
+        """Shrinking the window keeps consumed quota visible (never a
+        free refill) until it ages out on the new schedule."""
+        lim, clock = mk(window=6.0)
+        assert lim.allow_n("k", 10).allowed
+        lim.update_window(3.0)
+        assert lim.config.window == 3.0
+        assert not lim.allow("k").allowed          # no refill from migration
+        lim.close()
+
+    def test_consumed_quota_survives_grow(self):
+        lim, clock = mk(window=3.0, sub_windows=3)
+        assert lim.allow_n("k", 10).allowed
+        lim.update_window(12.0)
+        assert not lim.allow("k").allowed
+        lim.close()
+
+    def test_expiry_follows_new_window(self):
+        """After migration, history expires on the NEW window schedule."""
+        lim, clock = mk(window=60.0, sub_windows=60)
+        assert lim.allow_n("k", 10).allowed
+        lim.update_window(3.0)
+        clock.advance(4.5)                         # > new window
+        assert lim.allow_n("k", 10).allowed        # fully recovered
+        lim.close()
+
+    def test_grow_keeps_history_longer(self):
+        lim, clock = mk(window=3.0, sub_windows=3)
+        assert lim.allow_n("k", 10).allowed
+        lim.update_window(30.0)
+        clock.advance(5.0)                         # old window would expire
+        assert not lim.allow("k").allowed          # new window still holds it
+        clock.advance(35.0)
+        assert lim.allow("k").allowed
+        lim.close()
+
+    def test_never_over_admits_through_migration(self):
+        """Error direction: across a migration the total admitted for a
+        hot key within any window never exceeds limit (+0 tolerance here
+        because migration maps conservatively)."""
+        lim, clock = mk(window=6.0, limit=10)
+        got = sum(lim.allow("k").allowed for _ in range(8))
+        lim.update_window(4.0)
+        got += sum(lim.allow("k").allowed for _ in range(8))
+        assert got == 10
+        lim.close()
+
+    def test_fresh_keys_unaffected(self):
+        lim, clock = mk()
+        lim.allow_n("a", 10)
+        lim.update_window(3.0)
+        assert lim.allow_batch(["b"] * 10).allow_count == 10
+        lim.close()
+
+    def test_watchdog_ledger_remapped(self):
+        lim, clock = mk()
+        lim.allow_batch([f"k{i}" for i in range(50)])
+        before = lim.in_window_admitted_mass()
+        assert before == 50
+        lim.update_window(12.0)
+        assert lim.in_window_admitted_mass() == 50  # mass carried by time
+        lim.close()
+
+    def test_hh_state_migrates(self):
+        lim, clock = mk(hh_slots=16, hh_promote_fraction=0.5)
+        for _ in range(12):
+            lim.allow("hot")                        # promote + cap at 10
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 1
+        lim.update_window(3.0)
+        assert not lim.allow("hot").allowed         # private count survived
+        assert np.count_nonzero(np.asarray(lim._state["hh_owner"])) == 1
+        clock.advance(4.0)
+        assert lim.allow("hot").allowed             # new-window expiry
+        lim.close()
+
+    def test_retry_and_reset_follow_new_window(self):
+        """Denial hints must be computed from the NEW window (a stale
+        _window_us would tell clients to wait for the old one)."""
+        lim, clock = mk(window=60.0, sub_windows=60)
+        lim.allow_n("k", 10)
+        lim.update_window(5.0)
+        res = lim.allow("k")
+        assert not res.allowed
+        assert 0 < res.retry_after <= 5.0
+        assert res.reset_at <= clock.now() + 5.0
+        lim.close()
+
+    def test_mesh_limiters_keep_mesh_steps(self):
+        """update_window on a mesh limiter must migrate AND re-install
+        the mesh-compiled steps (not silently fall back to single-chip
+        kernels) for both algorithm families."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a multi-device (CPU) mesh")
+        from ratelimiter_tpu.parallel import (
+            MeshSketchLimiter,
+            MeshTokenBucketLimiter,
+            make_mesh,
+        )
+
+        mesh = make_mesh()
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
+                     max_batch_admission_iters=4,
+                     sketch=SketchParams(depth=2, width=128, sub_windows=6))
+        lim = MeshSketchLimiter(cfg, mesh=mesh, clock=ManualClock(T0))
+        assert lim.allow_batch(["k"] * 16).allow_count == 10
+        lim.update_window(3.0)
+        out = lim.allow_batch(["k"] * 16)          # mesh batch still works
+        assert out.allow_count == 0                # no refill from migration
+        lim.close()
+
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=10.0,
+                     sketch=SketchParams(depth=2, width=128))
+        clock = ManualClock(T0)
+        tb = MeshTokenBucketLimiter(cfg, mesh=mesh, clock=clock)
+        assert tb.allow_batch(["k"] * 16).allow_count == 10
+        tb.update_window(5.0)
+        clock.advance(1.05)                        # 2 tokens at the new rate
+        assert tb.allow_batch(["k"] * 4).allow_count == 2
+        tb.close()
+
+    def test_geometry_change_rejected(self):
+        from ratelimiter_tpu import InvalidConfigError
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        lim, _ = mk()
+        cfg2 = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=3.0,
+                      sketch=SketchParams(depth=3, width=128, sub_windows=6))
+        with pytest.raises(InvalidConfigError):
+            sketch_kernels.build_migrate(lim.config, cfg2)
+        lim.close()
+
+    def test_invalid_window_rejected(self):
+        from ratelimiter_tpu import InvalidConfigError
+
+        lim, _ = mk()
+        with pytest.raises(InvalidConfigError):
+            lim.update_window(0.0)
+        lim.close()
+
+
+class TestBucketWindowUpdate:
+    def test_rate_changes_debt_stands(self):
+        """window sets the refill rate; debt carries across the update."""
+        lim, clock = mk(algo=Algorithm.TOKEN_BUCKET, window=10.0, limit=10)
+        assert lim.allow_n("k", 10).allowed         # drained
+        lim.update_window(5.0)                      # refill 2x faster now
+        assert not lim.allow("k").allowed
+        clock.advance(1.1)                          # ~2.2 tokens at new rate
+        assert lim.allow_n("k", 2).allowed
+        assert not lim.allow("k").allowed
+        lim.close()
+
+    def test_unsupported_backends_raise(self):
+        lim, _ = mk(backend="exact", algo=Algorithm.SLIDING_WINDOW)
+        with pytest.raises(NotImplementedError):
+            lim.update_window(3.0)
+        lim.close()

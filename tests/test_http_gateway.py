@@ -1,0 +1,186 @@
+"""HTTP interop gateway (serving/http_gateway.py): the reference's
+flagship example surface — 429 + X-RateLimit-* headers, 503 on backend
+failure, /healthz, /metrics — plus the server-binary integration
+(VERDICT r3 item 6; reference docs/EXAMPLES.md:44-57)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.observability import MetricsDecorator, Registry
+from ratelimiter_tpu.serving.http_gateway import HttpGateway, gateway_for_limiter
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture()
+def gw():
+    clock = ManualClock(T0)
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3, window=60.0,
+                 fail_open=False)
+    lim = create_limiter(cfg, backend="exact", clock=clock)
+    reg = Registry()
+    lim = MetricsDecorator(lim, registry=reg)
+    gateway = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                          metrics_render=reg.render)
+    gateway.start()
+    yield gateway, lim, clock
+    gateway.shutdown()
+    lim.close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+class TestHttpGateway:
+    def test_allow_with_headers_then_429(self, gw):
+        gateway, _, _ = gw
+        base = f"http://127.0.0.1:{gateway.port}"
+        for i in range(3):
+            status, headers, body = _get(f"{base}/v1/allow?key=u1")
+            assert status == 200 and body["allowed"]
+            assert headers["X-RateLimit-Limit"] == "3"
+            assert headers["X-RateLimit-Remaining"] == str(2 - i)
+            assert int(headers["X-RateLimit-Reset"]) >= int(T0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/v1/allow?key=u1")
+        e = ei.value
+        assert e.code == 429
+        assert e.headers["X-RateLimit-Remaining"] == "0"
+        assert int(e.headers["Retry-After"]) >= 1
+        body = json.loads(e.read())
+        assert body["allowed"] is False and body["retry_after"] > 0
+
+    def test_allow_n_and_header_key(self, gw):
+        gateway, _, _ = gw
+        base = f"http://127.0.0.1:{gateway.port}"
+        status, headers, _ = _get(f"{base}/v1/allow?key=u2&n=3")
+        assert status == 200 and headers["X-RateLimit-Remaining"] == "0"
+        req = urllib.request.Request(f"{base}/v1/allow",
+                                     headers={"X-User-ID": "u3"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+
+    def test_reset_roundtrip(self, gw):
+        gateway, _, _ = gw
+        base = f"http://127.0.0.1:{gateway.port}"
+        _get(f"{base}/v1/allow?key=u4&n=3")
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v1/reset?key=u4", method="POST"))
+        status, _, body = _get(f"{base}/v1/allow?key=u4")
+        assert status == 200 and body["allowed"]
+
+    def test_validation_errors_are_400(self, gw):
+        gateway, _, _ = gw
+        base = f"http://127.0.0.1:{gateway.port}"
+        for url in (f"{base}/v1/allow",                # no key anywhere
+                    f"{base}/v1/allow?key=u5&n=0",     # bad n
+                    f"{base}/v1/allow?key=u5&n=abc"):  # unparsable n
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url)
+            assert ei.value.code == 400
+
+    def test_backend_failure_is_503(self, gw):
+        gateway, lim, _ = gw
+        inner = lim.inner
+        inner.inject_failure()
+        base = f"http://127.0.0.1:{gateway.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/v1/allow?key=u6")
+        assert ei.value.code == 503
+        inner.heal()
+        status, _, _ = _get(f"{base}/v1/allow?key=u6")
+        assert status == 200
+
+    def test_healthz_metrics_and_404(self, gw):
+        gateway, _, _ = gw
+        base = f"http://127.0.0.1:{gateway.port}"
+        status, _, body = _get(f"{base}/healthz")
+        assert status == 200 and body["serving"]
+        _get(f"{base}/v1/allow?key=u7")
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            assert "rate_limiter" in r.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/nope")
+        assert ei.value.code == 404
+
+    def test_gateway_for_limiter_convenience(self):
+        cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=2, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        gw = gateway_for_limiter(lim)
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            assert _get(f"{base}/v1/allow?key=k")[0] == 200
+        finally:
+            gw.shutdown()
+            lim.close()
+
+
+class TestServerBinaryHttp:
+    def test_http_alongside_binary_protocol(self):
+        """--http-port on the real binary: both protocols serve the SAME
+        limiter (quota consumed over HTTP is gone over the binary
+        protocol too)."""
+        import os
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+
+        from ratelimiter_tpu.serving import Client
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        port, http_port = free_port(), free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "exact", "--algorithm", "sliding_window",
+             "--limit", "2", "--window", "60", "--port", str(port),
+             "--http-port", str(http_port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            # Skip log lines (e.g. "listening on ...") until the banner.
+            for _ in range(10):
+                line = proc.stdout.readline()
+                if line.startswith("serving"):
+                    break
+            assert "http:" in line, line
+            base = f"http://127.0.0.1:{http_port}"
+            status, _, _ = _get(f"{base}/v1/allow?key=shared")
+            assert status == 200
+            with Client(port=port, timeout=10.0) as c:
+                assert c.allow("shared").allowed     # 2 of 2 used now
+                assert not c.allow("shared").allowed
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/allow?key=shared")
+            assert ei.value.code == 429
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
