@@ -44,7 +44,7 @@ def _free_port() -> int:
 
 def _spawn_server(backend: str, *, platform: Optional[str] = None,
                   max_batch: int = 4096, max_delay_us: float = 500.0,
-                  native: bool = False):
+                  native: bool = False, shards: int = 1):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
@@ -59,7 +59,8 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
          "--max-batch", str(max_batch),
          "--max-delay-us", str(max_delay_us),
          "--port", str(port)]
-        + (["--native"] if native else []),
+        + (["--native"] if native else [])
+        + (["--shards", str(shards)] if shards > 1 else []),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline()  # blocks until "serving ..." banner
     if "serving" not in line:
@@ -177,11 +178,17 @@ def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
              os.path.join(REPO, "clients", "cpp", "loadgen.cpp"),
              "-o", binary, "-pthread"],
             check=True, capture_output=True, timeout=180)
-        proc, port = _spawn_server("sketch", platform="cpu", native=True)
+        # max_batch 16384: the CPU-device decide costs ~1 us/decision
+        # flat, so deeper coalescing amortizes the per-dispatch overhead
+        # (r4: C++-side key prefixing + responder-thread encode overlap
+        # moved the ceiling from ~300K to ~0.8-1M/s on this harness; the
+        # wall is the XLA-CPU step itself, see ADR-003).
+        proc, port = _spawn_server("sketch", platform="cpu", native=True,
+                                   max_batch=16384)
         try:
             out = subprocess.run(
-                [binary, "127.0.0.1", str(port), str(seconds), "4", "8",
-                 "512", "100000"],
+                [binary, "127.0.0.1", str(port), str(seconds), "6", "8",
+                 "1024", "100000"],
                 capture_output=True, text=True, timeout=seconds + 60)
             row = json.loads(out.stdout.strip())
         finally:

@@ -10,17 +10,33 @@
 // serving test suite drive both servers interchangeably.
 //
 // Threading model:
-//   io thread          epoll on listener + conns + eventfd; frame
-//                      assembly; C++-side validation (empty key, n==0,
-//                      oversized frames) answers ERROR inline; ALLOW
-//                      work lands in the pending queue; HEALTH answered
-//                      inline from atomics; writes flushed from
-//                      per-conn output queues.
-//   dispatcher thread  waits up to max_delay_us for work, drains up to
-//                      max_batch keys, builds contiguous (blob, offsets,
-//                      lengths, ns) buffers, calls the Python callback
-//                      under PyGILState_Ensure, encodes RESULT /
-//                      RESULT_BATCH frames, queues them, kicks eventfd.
+//   io thread            epoll on listener + conns + eventfd; frame
+//                        assembly; C++-side validation (empty key, n==0,
+//                        UTF-8, oversized frames) answers ERROR inline;
+//                        ALLOW work is hash-routed to a dispatch shard;
+//                        HEALTH answered inline from atomics; writes
+//                        flushed from per-conn output queues.
+//   dispatcher thread(s) one per shard: waits up to max_delay_us for
+//                        work, drains up to max_batch keys, builds the
+//                        contiguous (blob, offsets, lengths, ns) buffers
+//                        WITH the key prefix prepended (so Python hashes
+//                        ready-made bytes), calls the Python callback
+//                        under PyGILState_Ensure, and hands results to
+//                        the responder.
+//   responder thread     encodes RESULT / RESULT_BATCH frames and queues
+//                        them on connections — batch k's encode+send
+//                        overlaps batch k+1's Python decide. Split
+//                        batches (keys spanning shards) reassemble via
+//                        BatchJoin; the last shard sends the frame.
+//                        (SLO mode keeps the inline single-shard path.)
+//
+// Dispatch shards (num_shards > 1) decide on separate Python-side
+// limiter shards concurrently. NOTE: within ONE Python process the GIL
+// and the XLA-CPU thread pool serialize most of the decide, so shards
+// only pay off when each shard's limiter dispatches to its own device
+// (multi-chip hosts) or the decide path is GIL-free; measured on the
+// CPU harness, shards=1 is fastest. Keys are routed by FNV-1a, so
+// per-key semantics are exact regardless.
 //
 // The Python side (serving/native_server.py) supplies three callbacks:
 //   decide(blob, offsets, lengths, ns) -> (flags, remaining, retry,
@@ -129,13 +145,38 @@ struct Conn {
 
 using ConnPtr = std::shared_ptr<Conn>;
 
-// One queued decision unit: a scalar ALLOW_N or a whole ALLOW_BATCH frame.
+// Reassembly of one ALLOW_BATCH frame split across dispatch shards:
+// each shard writes its results at the original positions; the LAST
+// shard to finish encodes and sends the single response frame.
+struct BatchJoin {
+  std::atomic<uint32_t> remaining;
+  ConnPtr conn;
+  uint64_t req_id;
+  uint32_t count;
+  std::vector<uint8_t> flags;
+  std::vector<int64_t> rem;
+  std::vector<double> retry, reset;
+  std::atomic<int64_t> limit{0};
+  std::atomic<uint16_t> err{0};
+  std::mutex emx;  // guards err_msg only
+  std::string err_msg;
+  BatchJoin(uint32_t nsh, ConnPtr c, uint64_t rid, uint32_t cnt)
+      : remaining(nsh), conn(std::move(c)), req_id(rid), count(cnt),
+        flags(cnt), rem(cnt), retry(cnt), reset(cnt) {}
+};
+using JoinPtr = std::shared_ptr<BatchJoin>;
+
+// One queued decision unit: a scalar ALLOW_N, a whole ALLOW_BATCH frame,
+// or one shard's slice of a split batch (join != null; pos holds each
+// key's index in the original frame).
 struct Pending {
   ConnPtr conn;
   uint64_t req_id;
   bool is_batch;
   std::vector<std::string> keys;
   std::vector<int64_t> ns;
+  JoinPtr join;
+  std::vector<uint32_t> pos;
 };
 
 // The dispatch currently being decided, shared between the dispatcher
@@ -166,22 +207,72 @@ struct Server {
   std::atomic<uint64_t> slo_breaches{0};
   double started_at = 0.0;
 
-  std::thread io_thread, dispatch_thread, slo_thread;
+  std::thread io_thread, slo_thread;
+  std::vector<std::thread> dispatch_threads;
   std::map<int, ConnPtr> conns;  // io thread only
 
-  std::mutex qmx;
-  std::condition_variable qcv;
-  std::deque<Pending> queue;
-  size_t queued_keys = 0;
+  // Dispatch shards (default 1): keys are routed by hash, each shard has
+  // its own queue, dispatcher thread, and (Python-side) limiter shard —
+  // per-key semantics are exact because a key always lands on the same
+  // shard; shards decide concurrently (the in-process analog of the
+  // reference's Redis-Cluster keyspace sharding, and the per-chip layout
+  // on a multi-chip serving deployment).
+  struct ShardQ {
+    std::mutex qmx;
+    std::condition_variable qcv;
+    std::deque<Pending> queue;
+    size_t queued_keys = 0;
+  };
+  uint32_t num_shards = 1;
+  std::vector<std::unique_ptr<ShardQ>> shardqs;
+  //: Dispatchers still alive — the responder must outlive them (a
+  //: dispatcher inside a long Python decide will enqueue its Reply
+  //: AFTER stop is set; exiting on stop+empty alone would drop it).
+  std::atomic<uint32_t> live_dispatchers{0};
 
   std::mutex ifmx;
   std::condition_variable ifcv;
   InFlight inflight;
 
+  //: Key namespace prepended in C++ while building the decide blob, so
+  //: the Python fast path hashes ready-made "prefix:key" bytes instead
+  //: of re-packing the blob per dispatch (measured 7 ms/4096 keys in
+  //: numpy — the single largest serving cost before this).
+  std::string key_prefix;
+
+  // Responder thread (non-SLO path): encoding + send of one batch's
+  // responses overlaps the NEXT batch's Python decide.
+  struct Reply {
+    std::vector<Pending> items;
+    std::vector<uint8_t> flags;
+    std::vector<int64_t> remaining;
+    std::vector<double> retry, reset_at;
+    size_t total = 0;
+    int64_t limit = 0;
+    uint16_t err_code = 0;
+    std::string err_msg;
+  };
+  std::mutex rmx;
+  std::condition_variable rcv;
+  std::deque<Reply> rqueue;
+  std::thread resp_thread;
+
   PyObject* cb_decide = nullptr;
   PyObject* cb_reset = nullptr;
   PyObject* cb_metrics = nullptr;
 };
+
+// FNV-1a over the raw key bytes: deterministic shard routing (need not
+// match the limiter's own key hashing — only stability per key).
+uint32_t key_shard(const Server* s, const std::string& k) {
+  if (s->num_shards == 1) return 0;
+  uint64_t h = 1469598103934665603ull;
+  for (unsigned char ch : k) {
+    h ^= ch;
+    h *= 1099511628211ull;
+  }
+  return (uint32_t)(h % s->num_shards);
+}
 
 double now_s() {
   struct timespec ts;
@@ -265,15 +356,21 @@ void slo_main(Server* s) {
 
 // ---- dispatcher ----------------------------------------------------------
 
-// Calls the Python decide callback for a drained run of Pending items.
-// Returns false if the callback raised (all items get ERROR frames).
-// When `gate` is non-null, responses are sent only if the SLO watcher
-// has not already answered for this batch.
-bool run_decide(Server* s, std::vector<Pending>& items,
-                std::atomic<bool>* gate) {
+// Calls the Python decide callback for a drained run of Pending items,
+// filling `r` with per-request results (or an error). Returns false if
+// the callback raised.
+bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
+                 Server::Reply& r) {
   size_t total = 0;
   for (auto& p : items) total += p.keys.size();
+  if (total == 0) {
+    // Only empty ALLOW_BATCH frames: nothing to decide (and empty
+    // buffers would reach Python as None through Py_BuildValue y#).
+    r.limit = s->limit;
+    return true;
+  }
 
+  const std::string& prefix = s->key_prefix;
   std::string blob;
   std::vector<int64_t> offsets, lengths, ns;
   offsets.reserve(total);
@@ -282,15 +379,21 @@ bool run_decide(Server* s, std::vector<Pending>& items,
   for (auto& p : items) {
     for (size_t i = 0; i < p.keys.size(); ++i) {
       offsets.push_back((int64_t)blob.size());
-      lengths.push_back((int64_t)p.keys[i].size());
+      lengths.push_back((int64_t)(prefix.size() + p.keys[i].size()));
+      blob += prefix;
       blob += p.keys[i];
       ns.push_back(p.ns[i]);
     }
   }
 
-  std::vector<uint8_t> flags(total);
-  std::vector<int64_t> remaining(total);
-  std::vector<double> retry(total), reset_at(total);
+  std::vector<uint8_t>& flags = r.flags;
+  std::vector<int64_t>& remaining = r.remaining;
+  std::vector<double>& retry = r.retry;
+  std::vector<double>& reset_at = r.reset_at;
+  flags.resize(total);
+  remaining.resize(total);
+  retry.resize(total);
+  reset_at.resize(total);
   int64_t limit = 0;
   uint16_t err_code = 0;
   std::string err_msg;
@@ -298,7 +401,8 @@ bool run_decide(Server* s, std::vector<Pending>& items,
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* args = Py_BuildValue(
-        "(y#y#y#y#)", blob.data(), (Py_ssize_t)blob.size(),
+        "(Iy#y#y#y#)", (unsigned int)shard,
+        blob.data(), (Py_ssize_t)blob.size(),
         (const char*)offsets.data(), (Py_ssize_t)(offsets.size() * 8),
         (const char*)lengths.data(), (Py_ssize_t)(lengths.size() * 8),
         (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
@@ -364,54 +468,152 @@ bool run_decide(Server* s, std::vector<Pending>& items,
     PyGILState_Release(g);
   }
 
-  if (gate != nullptr && gate->exchange(true)) {
-    // SLO watcher already answered these waiters; the (late) state
-    // update above still landed in the limiter — drop the responses.
-    return err_code == 0;
-  }
-  if (err_code != 0) {
-    for (auto& p : items)
-      conn_send(s, p.conn, make_error(p.req_id, err_code, err_msg));
-    return false;
-  }
+  r.limit = limit;
+  r.total = total;
+  r.err_code = err_code;
+  r.err_msg = std::move(err_msg);
+  // decisions accounting is the CALLER's job: the SLO path must not
+  // double-count a breached batch the watcher already counted.
+  return err_code == 0;
+}
 
-  s->decisions.fetch_add(total);
+// Finalize one split batch: called by the LAST shard to contribute.
+// Failure semantics across shards are NOT transactional (the same
+// contract as any keyspace-sharded store, e.g. a multi-key op spanning
+// Redis Cluster slots): if one shard's decide fails, the whole frame
+// answers ERROR, but keys on shards that succeeded HAVE consumed quota.
+// The error direction is toward denying on retry, never over-admission.
+void finish_join(Server* s, const JoinPtr& j) {
+  uint16_t err = j->err.load();
+  if (err != 0) {
+    std::string msg;
+    {
+      std::lock_guard<std::mutex> g(j->emx);
+      msg = j->err_msg;
+    }
+    conn_send(s, j->conn, make_error(j->req_id, err, msg));
+    return;
+  }
+  std::string out;
+  frame_header(out, T_RESULT_BATCH, j->req_id, 12 + 25 * j->count);
+  put_i64(out, j->limit.load());
+  put_u32(out, j->count);
+  for (uint32_t i = 0; i < j->count; ++i) {
+    out.push_back((char)j->flags[i]);
+    put_i64(out, j->rem[i]);
+    put_f64(out, j->retry[i]);
+    put_f64(out, j->reset[i]);
+  }
+  conn_send(s, j->conn, std::move(out));
+}
+
+// Encode and queue one batch's responses from filled results.
+void emit_reply(Server* s, std::vector<Pending>& items,
+                const Server::Reply& r) {
   size_t idx = 0;
   for (auto& p : items) {
+    if (p.join) {
+      // One shard's slice of a split batch: deposit results at the
+      // original positions; the last contributor sends the frame.
+      JoinPtr j = p.join;
+      if (r.err_code != 0) {
+        uint16_t zero = 0;
+        if (j->err.compare_exchange_strong(zero, r.err_code)) {
+          std::lock_guard<std::mutex> g(j->emx);
+          j->err_msg = r.err_msg;
+        }
+      } else {
+        for (size_t i = 0; i < p.pos.size(); ++i) {
+          uint32_t at = p.pos[i];
+          j->flags[at] = r.flags[idx];
+          j->rem[at] = r.remaining[idx];
+          j->retry[at] = r.retry[idx];
+          j->reset[at] = r.reset_at[idx];
+          ++idx;
+        }
+        j->limit.store(r.limit);
+      }
+      if (r.err_code != 0) idx += p.keys.size();
+      if (j->remaining.fetch_sub(1) == 1) finish_join(s, j);
+      continue;
+    }
+    if (r.err_code != 0) {
+      conn_send(s, p.conn, make_error(p.req_id, r.err_code, r.err_msg));
+      continue;
+    }
     std::string out;
     if (!p.is_batch) {
       frame_header(out, T_RESULT, p.req_id, 33);
-      out.push_back((char)flags[idx]);
-      put_i64(out, limit);
-      put_i64(out, remaining[idx]);
-      put_f64(out, retry[idx]);
-      put_f64(out, reset_at[idx]);
+      out.push_back((char)r.flags[idx]);
+      put_i64(out, r.limit);
+      put_i64(out, r.remaining[idx]);
+      put_f64(out, r.retry[idx]);
+      put_f64(out, r.reset_at[idx]);
       ++idx;
     } else {
       uint32_t count = (uint32_t)p.keys.size();
       frame_header(out, T_RESULT_BATCH, p.req_id, 12 + 25 * count);
-      put_i64(out, limit);
+      put_i64(out, r.limit);
       put_u32(out, count);
       for (uint32_t i = 0; i < count; ++i) {
-        out.push_back((char)flags[idx]);
-        put_i64(out, remaining[idx]);
-        put_f64(out, retry[idx]);
-        put_f64(out, reset_at[idx]);
+        out.push_back((char)r.flags[idx]);
+        put_i64(out, r.remaining[idx]);
+        put_f64(out, r.retry[idx]);
+        put_f64(out, r.reset_at[idx]);
         ++idx;
       }
     }
     conn_send(s, p.conn, std::move(out));
   }
-  return true;
 }
 
-void handle_reset(Server* s, const Pending& p) {
+// SLO-path wrapper (single-shard only): decide, then answer inline
+// unless the watcher beat us to it.
+bool run_decide(Server* s, std::vector<Pending>& items,
+                std::atomic<bool>* gate) {
+  Server::Reply r;
+  bool ok = decide_core(s, 0, items, r);
+  if (gate != nullptr && gate->exchange(true)) {
+    // SLO watcher already answered (and counted) these waiters; the
+    // (late) state update above still landed in the limiter — drop the
+    // responses.
+    return ok;
+  }
+  if (ok) s->decisions.fetch_add(r.total);
+  emit_reply(s, items, r);
+  return ok;
+}
+
+// Non-SLO responder: encoding + socket handoff for batch k runs here
+// while the dispatcher's batch k+1 is already inside the Python decide.
+// Exits only once every dispatcher has exited AND the queue is drained —
+// a dispatcher still inside a Python decide at stop time will enqueue
+// its Reply afterward, and those waiters must still be answered.
+void responder_main(Server* s) {
+  while (true) {
+    Server::Reply r;
+    {
+      std::unique_lock<std::mutex> lk(s->rmx);
+      s->rcv.wait(lk, [&] {
+        return !s->rqueue.empty() ||
+               (s->stop.load() && s->live_dispatchers.load() == 0);
+      });
+      if (s->rqueue.empty()) return;  // stopped, dispatchers gone, drained
+      r = std::move(s->rqueue.front());
+      s->rqueue.pop_front();
+    }
+    emit_reply(s, r.items, r);
+  }
+}
+
+void handle_reset(Server* s, uint32_t shard, const Pending& p) {
   uint16_t err_code = 0;
   std::string err_msg;
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* res = PyObject_CallFunction(
-        s->cb_reset, "y#", p.keys[0].data(), (Py_ssize_t)p.keys[0].size());
+        s->cb_reset, "Iy#", (unsigned int)shard, p.keys[0].data(),
+        (Py_ssize_t)p.keys[0].size());
     if (res == nullptr) {
       PyObject *t, *v, *tb;
       PyErr_Fetch(&t, &v, &tb);
@@ -474,36 +676,45 @@ void handle_metrics(Server* s, const Pending& p) {
   conn_send(s, p.conn, std::move(out));
 }
 
-void dispatcher_main(Server* s) {
+void dispatcher_main(Server* s, uint32_t shard) {
+  Server::ShardQ& q = *s->shardqs[shard];
+  s->live_dispatchers.fetch_add(1);
+  struct Depart {
+    Server* s;
+    ~Depart() {
+      s->live_dispatchers.fetch_sub(1);
+      s->rcv.notify_all();  // let the responder re-check its exit condition
+    }
+  } depart{s};
   while (true) {
     std::vector<Pending> run;
     size_t run_keys = 0;
     {
-      std::unique_lock<std::mutex> lk(s->qmx);
-      if (s->queue.empty()) {
-        s->qcv.wait(lk, [&] { return s->stop.load() || !s->queue.empty(); });
+      std::unique_lock<std::mutex> lk(q.qmx);
+      if (q.queue.empty()) {
+        q.qcv.wait(lk, [&] { return s->stop.load() || !q.queue.empty(); });
       } else {
         // First item already waiting: coalesce for up to max_delay.
-        s->qcv.wait_for(lk, std::chrono::microseconds(s->max_delay_us),
-                        [&] {
-                          return s->stop.load() ||
-                                 s->queued_keys >= s->max_batch;
-                        });
+        q.qcv.wait_for(lk, std::chrono::microseconds(s->max_delay_us),
+                       [&] {
+                         return s->stop.load() ||
+                                q.queued_keys >= s->max_batch;
+                       });
       }
-      if (s->stop.load() && s->queue.empty()) return;
-      while (!s->queue.empty() && run_keys < s->max_batch) {
+      if (s->stop.load() && q.queue.empty()) return;
+      while (!q.queue.empty() && run_keys < s->max_batch) {
         // RESET/METRICS ride the same queue (keys empty or kind marker).
-        run_keys += s->queue.front().keys.size();
-        run.push_back(std::move(s->queue.front()));
-        s->queue.pop_front();
+        run_keys += q.queue.front().keys.size();
+        run.push_back(std::move(q.queue.front()));
+        q.queue.pop_front();
       }
-      s->queued_keys -= std::min(s->queued_keys, run_keys);
+      q.queued_keys -= std::min(q.queued_keys, run_keys);
     }
     // Split control items (req_id flag via ns sentinel) from decisions.
     std::vector<Pending> decisions;
     for (auto& p : run) {
       if (p.ns.size() == 1 && p.ns[0] == -1) {
-        handle_reset(s, p);
+        handle_reset(s, shard, p);
       } else if (p.ns.size() == 1 && p.ns[0] == -2) {
         handle_metrics(s, p);
       } else {
@@ -512,7 +723,17 @@ void dispatcher_main(Server* s) {
     }
     if (decisions.empty()) continue;
     if (s->slo_us == 0) {
-      run_decide(s, decisions, nullptr);
+      // Throughput path: decide here, hand encode+send to the responder
+      // so the next batch's decide starts immediately.
+      Server::Reply r;
+      if (decide_core(s, shard, decisions, r))
+        s->decisions.fetch_add(r.total);
+      r.items = std::move(decisions);
+      {
+        std::lock_guard<std::mutex> g(s->rmx);
+        s->rqueue.push_back(std::move(r));
+      }
+      s->rcv.notify_one();
       continue;
     }
     {
@@ -585,11 +806,12 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     uint32_t blen = length - 9;
     off += 4 + length;
 
-    auto enqueue = [&](Pending&& p, size_t nkeys) {
-      std::lock_guard<std::mutex> g(s->qmx);
-      s->queue.push_back(std::move(p));
-      s->queued_keys += nkeys;
-      s->qcv.notify_one();
+    auto enqueue = [&](Pending&& p, size_t nkeys, uint32_t shard) {
+      Server::ShardQ& q = *s->shardqs[shard];
+      std::lock_guard<std::mutex> g(q.qmx);
+      q.queue.push_back(std::move(p));
+      q.queued_keys += nkeys;
+      q.qcv.notify_one();
     };
 
     if (type == T_ALLOW_N) {
@@ -612,8 +834,10 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         conn_send(s, c, make_error(req_id, E_INVALID_N,
                                    "n must be a positive integer, got 0"));
       } else {
-        Pending p{c, req_id, false, {std::string(body + 6, klen)}, {(int64_t)n}};
-        enqueue(std::move(p), 1);
+        std::string key(body + 6, klen);
+        uint32_t shard = key_shard(s, key);
+        Pending p{c, req_id, false, {std::move(key)}, {(int64_t)n}};
+        enqueue(std::move(p), 1, shard);
       }
     } else if (type == T_ALLOW_BATCH) {
       if (blen < 4) return false;
@@ -658,9 +882,49 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       } else if (first_err == E_INVALID_N) {
         conn_send(s, c, make_error(req_id, E_INVALID_N,
                                    "n must be a positive integer"));
-      } else {
+      } else if (s->num_shards == 1 || p.keys.empty()) {
+        // count==0 frames are valid (empty RESULT_BATCH): route whole to
+        // shard 0 — the mixed-shard splitter below indexes keys[0].
         size_t nk = p.keys.size();
-        enqueue(std::move(p), nk);
+        enqueue(std::move(p), nk, 0);
+      } else {
+        // Route each key to its shard. Single-shard frames go whole;
+        // mixed frames split into per-shard slices joined for the one
+        // response (BatchJoin).
+        std::vector<uint32_t> shards_of(p.keys.size());
+        uint32_t first_shard = key_shard(s, p.keys[0]);
+        bool mixed = false;
+        shards_of[0] = first_shard;
+        for (size_t i = 1; i < p.keys.size(); ++i) {
+          shards_of[i] = key_shard(s, p.keys[i]);
+          mixed |= shards_of[i] != first_shard;
+        }
+        if (!mixed) {
+          size_t nk = p.keys.size();
+          enqueue(std::move(p), nk, first_shard);
+        } else {
+          std::vector<std::vector<uint32_t>> per(s->num_shards);
+          for (size_t i = 0; i < p.keys.size(); ++i)
+            per[shards_of[i]].push_back((uint32_t)i);
+          uint32_t involved = 0;
+          for (auto& v : per) involved += !v.empty();
+          JoinPtr j = std::make_shared<BatchJoin>(
+              involved, c, req_id, (uint32_t)p.keys.size());
+          for (uint32_t sh = 0; sh < s->num_shards; ++sh) {
+            if (per[sh].empty()) continue;
+            Pending part{c, req_id, true, {}, {}};
+            part.join = j;
+            part.pos = std::move(per[sh]);
+            part.keys.reserve(part.pos.size());
+            part.ns.reserve(part.pos.size());
+            for (uint32_t at : part.pos) {
+              part.keys.push_back(std::move(p.keys[at]));
+              part.ns.push_back(p.ns[at]);
+            }
+            size_t nk = part.keys.size();
+            enqueue(std::move(part), nk, sh);
+          }
+        }
       }
     } else if (type == T_RESET) {
       if (blen < 2) return false;
@@ -671,8 +935,10 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         conn_send(s, c, make_error(req_id, E_INVALID_KEY,
                                    "key must be a non-empty UTF-8 string"));
       } else {
-        Pending p{c, req_id, false, {std::string(body + 2, klen)}, {-1}};
-        enqueue(std::move(p), 0);
+        std::string key(body + 2, klen);
+        uint32_t shard = key_shard(s, key);
+        Pending p{c, req_id, false, {std::move(key)}, {-1}};
+        enqueue(std::move(p), 0, shard);
       }
     } else if (type == T_HEALTH) {
       std::string out;
@@ -684,7 +950,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       conn_send(s, c, std::move(out));
     } else if (type == T_METRICS) {
       Pending p{c, req_id, false, {std::string()}, {-2}};
-      enqueue(std::move(p), 0);
+      enqueue(std::move(p), 0, 0);
     } else {
       conn_send(s, c, make_error(req_id, E_INTERNAL, "unknown request type"));
     }
@@ -802,9 +1068,14 @@ PyObject* server_start(PyObject* self, PyObject* args) {
   epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->event_fd, &ev);
 
   s->started_at = now_s();
+  s->shardqs.clear();
+  for (uint32_t i = 0; i < s->num_shards; ++i)
+    s->shardqs.push_back(std::make_unique<Server::ShardQ>());
   s->io_thread = std::thread(io_main, s);
-  s->dispatch_thread = std::thread(dispatcher_main, s);
+  for (uint32_t i = 0; i < s->num_shards; ++i)
+    s->dispatch_threads.emplace_back(dispatcher_main, s, i);
   if (s->slo_us > 0) s->slo_thread = std::thread(slo_main, s);
+  else s->resp_thread = std::thread(responder_main, s);
   return PyLong_FromLong(s->port);
 }
 
@@ -812,26 +1083,40 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
   PyServer* ps = (PyServer*)self;
   Server* s = ps->s;
   if (s->listen_fd >= 0) {
-    // Graceful: stop new work, let the dispatcher drain the queue.
+    // Graceful: stop new work, let the dispatchers drain their queues.
     s->draining.store(true);
     Py_BEGIN_ALLOW_THREADS;
     for (int i = 0; i < 200; ++i) {  // up to ~2 s of drain
+      bool empty = true;
+      for (auto& q : s->shardqs) {
+        std::lock_guard<std::mutex> g(q->qmx);
+        empty = empty && q->queue.empty();
+      }
+      if (empty) break;
+      usleep(10000);
+    }
+    // Let the responder drain queued replies before stopping.
+    for (int i = 0; i < 200; ++i) {
       {
-        std::lock_guard<std::mutex> g(s->qmx);
-        if (s->queue.empty()) break;
+        std::lock_guard<std::mutex> g(s->rmx);
+        if (s->rqueue.empty()) break;
       }
       usleep(10000);
     }
     usleep(20000);  // let final responses flush
     s->stop.store(true);
-    s->qcv.notify_all();
+    for (auto& q : s->shardqs) q->qcv.notify_all();
     s->ifcv.notify_all();
+    s->rcv.notify_all();
     uint64_t one_ = 1;
     ssize_t r = write(s->event_fd, &one_, 8);
     (void)r;
     if (s->io_thread.joinable()) s->io_thread.join();
-    if (s->dispatch_thread.joinable()) s->dispatch_thread.join();
+    for (auto& t : s->dispatch_threads)
+      if (t.joinable()) t.join();
+    s->dispatch_threads.clear();
     if (s->slo_thread.joinable()) s->slo_thread.join();
+    if (s->resp_thread.joinable()) s->resp_thread.join();
     Py_END_ALLOW_THREADS;
     close(s->listen_fd);
     close(s->epoll_fd);
@@ -855,8 +1140,9 @@ void server_dealloc(PyObject* self) {
   if (ps->s != nullptr) {
     if (ps->s->listen_fd >= 0) {
       ps->s->stop.store(true);
-      ps->s->qcv.notify_all();
+      for (auto& q : ps->s->shardqs) q->qcv.notify_all();
       ps->s->ifcv.notify_all();
+      ps->s->rcv.notify_all();
       uint64_t one = 1;
       ssize_t r = write(ps->s->event_fd, &one, 8);
       (void)r;
@@ -864,8 +1150,11 @@ void server_dealloc(PyObject* self) {
       // joining while holding the GIL would deadlock.
       Py_BEGIN_ALLOW_THREADS;
       if (ps->s->io_thread.joinable()) ps->s->io_thread.join();
-      if (ps->s->dispatch_thread.joinable()) ps->s->dispatch_thread.join();
+      for (auto& t : ps->s->dispatch_threads)
+        if (t.joinable()) t.join();
+      ps->s->dispatch_threads.clear();
       if (ps->s->slo_thread.joinable()) ps->s->slo_thread.join();
+      if (ps->s->resp_thread.joinable()) ps->s->resp_thread.join();
       Py_END_ALLOW_THREADS;
       close(ps->s->listen_fd);
       close(ps->s->epoll_fd);
@@ -895,17 +1184,31 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   static const char* kwlist[] = {"decide",    "reset",        "metrics",
                                  "max_batch", "max_delay_us", "slo_us",
                                  "fail_open", "limit",        "window_s",
-                                 nullptr};
+                                 "key_prefix", "num_shards", nullptr};
   PyObject *decide, *reset, *metrics = Py_None;
   unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
   int fail_open = 0;
   long long limit = 0;
   double window_s = 60.0;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLd", (char**)kwlist,
+  const char* key_prefix = nullptr;
+  Py_ssize_t key_prefix_len = 0;
+  unsigned int num_shards = 1;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#I",
+                                   (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
-                                   &window_s))
+                                   &window_s, &key_prefix, &key_prefix_len,
+                                   &num_shards))
     return nullptr;
+  if (num_shards < 1 || num_shards > 64) {
+    PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
+    return nullptr;
+  }
+  if (num_shards > 1 && slo_us > 0) {
+    PyErr_SetString(PyExc_ValueError,
+                    "dispatch_timeout (SLO) requires num_shards == 1");
+    return nullptr;
+  }
   PyServer* ps = PyObject_New(PyServer, &PyServerType);
   if (ps == nullptr) return nullptr;
   ps->s = new Server();
@@ -915,6 +1218,9 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   ps->s->fail_open = fail_open != 0;
   ps->s->limit = (int64_t)limit;
   ps->s->window_s = window_s;
+  ps->s->num_shards = num_shards;
+  if (key_prefix != nullptr && key_prefix_len > 0)
+    ps->s->key_prefix.assign(key_prefix, (size_t)key_prefix_len);
   Py_INCREF(decide);
   Py_INCREF(reset);
   Py_INCREF(metrics);
@@ -942,7 +1248,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 2; }
+int64_t rl_server_abi_version() { return 3; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--native", action="store_true",
                     help="use the C++ epoll front door (native/server.cpp) "
                          "instead of the asyncio server")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="native front door dispatch shards: keys are "
+                         "hash-routed, each shard decides on its own "
+                         "limiter concurrently (per-key semantics exact)")
     ap.add_argument("--no-prewarm", action="store_true",
                     help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
@@ -184,7 +188,8 @@ async def amain(args) -> None:
             limiter, args.host, args.port,
             max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6,
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
-                              if args.dispatch_timeout_ms else None))
+                              if args.dispatch_timeout_ms else None),
+            shards=args.shards)
         server.start()
         gateway = None
         if args.http_port is not None:

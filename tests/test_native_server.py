@@ -350,6 +350,114 @@ class TestNativeServer:
         lim.close()
 
 
+class TestShardedServer:
+    """Dispatch shards: hash-routed keys, concurrent per-shard limiters,
+    split-batch reassembly (the in-process Redis-Cluster analog)."""
+
+    def test_per_key_exactness_across_shards(self):
+        lim, _ = _mk_limiter(limit=10, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=4)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                for i in range(8):                 # keys spread over shards
+                    assert c.allow_n(f"k{i}", 10).allowed
+                    assert not c.allow(f"k{i}").allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_split_batch_reassembles_in_order(self):
+        lim, _ = _mk_limiter(limit=3, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=4)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                keys = [f"u{i}" for i in range(40)] + ["u0"] * 4
+                res = c.allow_batch(keys, [1] * 44)
+                assert [r.allowed for r in res[:40]] == [True] * 40
+                # The 4 trailing duplicates of u0 share its shard and its
+                # in-batch sequencing: 2 more admits, then denial.
+                assert [r.allowed for r in res[40:]] == [True, True, False,
+                                                         False]
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_reset_routed_to_owning_shard(self):
+        lim, _ = _mk_limiter(limit=2, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=4)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                for i in range(6):
+                    key = f"r{i}"
+                    assert c.allow_n(key, 2).allowed
+                    assert not c.allow(key).allowed
+                    c.reset(key)
+                    assert c.allow(key).allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_concurrent_clients_sharded_exactness(self):
+        lim, _ = _mk_limiter(limit=100, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=2,
+                                    max_batch=512, max_delay=2e-3)
+        srv.start()
+        try:
+            allowed = []
+            lock = threading.Lock()
+
+            def worker(count):
+                with Client(port=srv.port) as c:
+                    mine = [c.allow("hot").allowed for _ in range(count)]
+                with lock:
+                    allowed.extend(mine)
+
+            threads = [threading.Thread(target=worker, args=(15,))
+                       for _ in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert sum(allowed) == 100             # one shard owns "hot"
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_empty_batch_on_sharded_server(self):
+        """count==0 ALLOW_BATCH is valid and must not crash the shard
+        router (it indexes keys[0] on the split path)."""
+        lim, _ = _mk_limiter(algo=Algorithm.TPU_SKETCH, backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=4)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                assert list(c.allow_batch([], [])) == []
+                assert c.allow("still-up").allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_non_sketch_backend_rejected_for_shards(self):
+        lim, _ = _mk_limiter(backend="exact")
+        with pytest.raises(ValueError, match="sketch-family"):
+            NativeRateLimitServer(lim, "127.0.0.1", 0, shards=2)
+        lim.close()
+
+    def test_slo_conflicts_with_shards(self):
+        lim, _ = _mk_limiter()
+        with pytest.raises(ValueError, match="shards"):
+            NativeRateLimitServer(lim, "127.0.0.1", 0, shards=2,
+                                  dispatch_timeout=0.05)
+        lim.close()
+
+
 class _SlowOnce:
     """Delays only the FIRST allow_batch (the SLO-breach fixture; later
     dispatches run fast so the server's recovery is observable)."""
@@ -373,16 +481,3 @@ class _SlowOnce:
     # hasattr() capability sniffing truthful for the wrapped backend.
 
 
-class TestPrefixPack:
-    def test_prefix_pack_matches_python(self):
-        from ratelimiter_tpu.serving.native_server import _prefix_pack
-
-        keys = ["a", "user:42", "", "xyz"]
-        blob = "".join(keys).encode()
-        lengths = np.array([len(k) for k in keys], dtype=np.int64)
-        offsets = np.concatenate(([0], np.cumsum(lengths)[:-1]))
-        buf = np.frombuffer(blob, dtype=np.uint8)
-        nb, no, nl = _prefix_pack(buf, offsets, lengths, b"pre:")
-        out = [bytes(nb[o:o + l]).decode() for o, l in zip(no.tolist(),
-                                                           nl.tolist())]
-        assert out == [f"pre:{k}" for k in keys]
