@@ -16,15 +16,22 @@ B. Accuracy at the benched operating point: the SAME trace stream is decided
    steady-state error).
 C. Serving shape: ingest batches of 4096 (BASELINE config 3) coalesced
    64-at-a-time into one device dispatch via the lax.scan runner
-   (ops/sketch_kernels.build_scan). Reports on-chip per-ingest-batch step
-   latency and serving-shape throughput. (Through the dev tunnel, e2e
-   dispatch latency is dominated by ~100 ms tunnel RTT — that is an
-   environment property; dispatch_rtt_ms reports it for completeness.)
+   (ops/sketch_kernels.build_scan), 32 dispatches pipelined per sync.
+   Measured at BOTH sizing doctrines and labeled as such in the JSON:
+   the LITERAL config-3 geometry (d=4 w=65536 — the spec'd shape) is
+   the headline ``serving_decisions_per_sec``; the wide accuracy-
+   headline geometry (d=3 w=2^20, the one phases A/B run) is reported
+   alongside. (Through the dev tunnel, e2e dispatch latency is
+   dominated by ~100 ms tunnel RTT — an environment property;
+   dispatch_rtt_ms reports it for completeness.)
 D. End-to-end serving: a real ``python -m ratelimiter_tpu.serving``
    subprocess (sketch backend on the CPU device — the host/RPC path
-   without the tunnel artifact) driven by pipelined clients with STRING
-   keys, so the number includes ingest, hashing, batching, and fan-out
-   (benchmarks/e2e.py). Skipped gracefully if the subprocess fails.
+   without the tunnel artifact) driven by the NATIVE C++ closed-loop
+   loadgen (clients/cpp/loadgen.cpp) when a compiler is present — the
+   Python asyncio driver saturates its own event loop long before the
+   server, so it measured the CLIENT, not the server (r3/r4 regression
+   root cause). Falls back to the Python driver without g++; the
+   ``e2e_harness`` field says which one produced the number.
 
 Baseline: the reference's own single-instance sliding-window estimate,
 ~30,000 req/s (``docs/ARCHITECTURE.md:439``, SURVEY.md §6); north star:
@@ -177,67 +184,104 @@ def main() -> None:
     del states, acc
 
     # ---------------------------------------------- phase C: serving shape
-    scan = sketch_kernels.build_scan(cfg)
-    state = sk_roll(sketch_kernels.init_state(cfg), jnp.int64(T0_US // sub_us))
-    rng = np.random.default_rng(0)
-    ids = rng.zipf(ZIPF_A, size=(SCAN_STEPS, INGEST_BATCH)).astype(np.uint64)
+    # K pipelined dispatches per sync: r4 used K=8 and the sync overhead
+    # alone kept the captured number at 7.7M/s (469 us/step vs 333 at
+    # K=32 on the same kernels) — the ceiling was always there, the
+    # harness just didn't amortize the tunnel sync.
+    K = 32
     from ratelimiter_tpu.ops.hashing import split_hash, splitmix64
 
-    h1, h2 = split_hash(splitmix64(ids.reshape(-1)), cfg.sketch.seed)
-    h1s = jnp.asarray(h1.reshape(SCAN_STEPS, INGEST_BATCH))
-    h2s = jnp.asarray(h2.reshape(SCAN_STEPS, INGEST_BATCH))
-    ns = jnp.ones((SCAN_STEPS, INGEST_BATCH), jnp.int32)
-    dt_us = 400  # 2.5K ingest batches/s cadence; 64 steps stay in one sub-window
-    t0 = time.perf_counter()
-    state, masks, _ = scan(state, h1s, h2s, ns, jnp.int64(T0_US), jnp.int64(dt_us))
-    _sync(masks)
-    compile_c = time.perf_counter() - t0
-    # e2e round-trip of one dispatch (incl. readback; tunnel-dominated here).
-    t0 = time.perf_counter()
-    state, masks, _ = scan(state, h1s, h2s, ns,
-                           jnp.int64(T0_US + SCAN_STEPS * dt_us), jnp.int64(dt_us))
-    _sync(masks)
-    rtt_s = time.perf_counter() - t0
-    # pipelined on-chip rate: K dispatches, one sync.
-    K = 8
-    t0 = time.perf_counter()
-    for i in range(K):
-        now0 = T0_US + (2 + i) * SCAN_STEPS * dt_us
-        state, masks, _ = scan(state, h1s, h2s, ns, jnp.int64(now0), jnp.int64(dt_us))
-    _sync(masks)
-    scan_s = (time.perf_counter() - t0) / K
-    serving_rps = SCAN_STEPS * INGEST_BATCH / scan_s
-    step_latency_ms = scan_s / SCAN_STEPS * 1e3
+    def serve_shape(scfg, warm_state_roll):
+        scan = sketch_kernels.build_scan(scfg)
+        _, s_sub, _, _, _ = sketch_kernels.sketch_geometry(scfg)
+        st = warm_state_roll(sketch_kernels.init_state(scfg),
+                             jnp.int64(T0_US // s_sub))
+        rng = np.random.default_rng(0)
+        ids = rng.zipf(ZIPF_A, size=(SCAN_STEPS, INGEST_BATCH)
+                       ).astype(np.uint64)
+        h1, h2 = split_hash(splitmix64(ids.reshape(-1)), scfg.sketch.seed)
+        h1s = jnp.asarray(h1.reshape(SCAN_STEPS, INGEST_BATCH))
+        h2s = jnp.asarray(h2.reshape(SCAN_STEPS, INGEST_BATCH))
+        ns_t = jnp.ones((SCAN_STEPS, INGEST_BATCH), jnp.int32)
+        dt_us = 400  # 2.5K ingest batches/s; 64 steps stay in one sub-window
+        t0 = time.perf_counter()
+        st, masks, _ = scan(st, h1s, h2s, ns_t, jnp.int64(T0_US),
+                            jnp.int64(dt_us))
+        _sync(masks)
+        comp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        st, masks, _ = scan(st, h1s, h2s, ns_t,
+                            jnp.int64(T0_US + SCAN_STEPS * dt_us),
+                            jnp.int64(dt_us))
+        _sync(masks)
+        rtt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(K):
+            now0 = T0_US + (2 + i) * SCAN_STEPS * dt_us
+            st, masks, _ = scan(st, h1s, h2s, ns_t, jnp.int64(now0),
+                                jnp.int64(dt_us))
+        _sync(masks)
+        per_scan = (time.perf_counter() - t0) / K
+        return (SCAN_STEPS * INGEST_BATCH / per_scan,
+                per_scan / SCAN_STEPS * 1e3, rtt, comp)
+
+    # Headline: the LITERAL BASELINE config-3 geometry (the spec'd
+    # serving shape). Secondary: the wide geometry phases A/B measure
+    # accuracy at, so both doctrines are captured in one artifact.
+    lit_cfg = Config(
+        algorithm=Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+        max_batch_admission_iters=1,
+        sketch=SketchParams(depth=4, width=1 << 16, sub_windows=60,
+                            conservative_update=True))
+    _, _, lit_roll = sketch_kernels.build_steps(lit_cfg)
+    serving_rps, step_latency_ms, rtt_s, compile_c = serve_shape(
+        lit_cfg, lit_roll)
+    wide_rps, wide_step_ms, _, compile_c2 = serve_shape(cfg, sk_roll)
+    compile_c += compile_c2
 
     # ---------------------------------------------- phase D: e2e serving
+    # The native C++ loadgen measures the SERVER (the Python asyncio
+    # driver bottlenecks on its own event loop at ~150-180K/s — that is
+    # what BENCH_r03/r04 recorded); fall back to it only without g++.
     e2e: dict = {}
     try:
-        from benchmarks.e2e import _drive, _spawn_server
-        import asyncio
+        import shutil
 
-        try:  # native C++ front door first; asyncio as fallback
-            proc, port = _spawn_server("sketch", platform="cpu",
-                                       max_batch=4096, max_delay_us=500.0,
-                                       native=True)
-            front_door = "native"
-        except Exception:
+        if shutil.which("g++"):
+            from benchmarks.e2e import _run_native_loadgen
+
+            row = _run_native_loadgen(seconds=4.0, log=lambda *a: None)
+            if "error" in row:
+                raise RuntimeError(row["error"])
+            e2e = {
+                "e2e_server_decisions_per_sec": row["decisions_per_sec"],
+                "e2e_frame_p50_ms": row["frame_p50_ms"],
+                "e2e_frame_p99_ms": row["frame_p99_ms"],
+                "e2e_server_front_door": "native",
+                "e2e_harness": "cpp_loadgen (6 conns x 8 pipelined "
+                               "1024-key frames; latency is per frame)",
+            }
+        else:
+            from benchmarks.e2e import _drive, _spawn_server
+            import asyncio
+
             proc, port = _spawn_server("sketch", platform="cpu",
                                        max_batch=4096, max_delay_us=500.0)
-            front_door = "asyncio"
-        try:
-            e2e_out = asyncio.run(_drive(port, seconds=4.0, conns=4,
-                                         window=2048, n_keys=100_000))
-            e2e = {
-                "e2e_server_decisions_per_sec": e2e_out["decisions_per_sec"],
-                "e2e_server_scalar_p50_ms": e2e_out["scalar_p50_ms"],
-                "e2e_server_scalar_p99_ms": e2e_out["scalar_p99_ms"],
-                # Which front door actually served (numbers are not
-                # comparable across the two implementations).
-                "e2e_server_front_door": front_door,
-            }
-        finally:
-            proc.terminate()
-            proc.wait(timeout=15)
+            try:
+                e2e_out = asyncio.run(_drive(port, seconds=4.0, conns=4,
+                                             window=2048, n_keys=100_000))
+                e2e = {
+                    "e2e_server_decisions_per_sec":
+                        e2e_out["decisions_per_sec"],
+                    "e2e_server_scalar_p50_ms": e2e_out["scalar_p50_ms"],
+                    "e2e_server_scalar_p99_ms": e2e_out["scalar_p99_ms"],
+                    "e2e_server_front_door": "asyncio",
+                    "e2e_harness": "python_asyncio_clients (client-bound; "
+                                   "no g++ for the real harness)",
+                }
+            finally:
+                proc.terminate()
+                proc.wait(timeout=15)
     except Exception as exc:  # report the omission, never fail the bench
         e2e = {"e2e_server_error": str(exc)[:200]}
 
@@ -254,10 +298,35 @@ def main() -> None:
         "false_allow_rate_vs_oracle": round(fa / max(or_deny, 1), 9),
         "accuracy_decisions": acc_decisions,
         "accuracy_window_coverage": round(coverage, 3),
+        # Why coverage matters (r3 measured 0.043% at 0.25 coverage, r4
+        # 0.83% at 1.25): error GROWS as the window fills with admitted
+        # mass, so only >= 1.0-window coverage is steady state — the two
+        # numbers measure different operating points, not a regression.
+        "accuracy_note": "steady-state (>=1x window filled); partial "
+                         "coverage understates false-deny",
+        # The accuracy geometry's sizing doctrine, CHECKED in-run: the
+        # measured admitted in-window mass vs SketchParams.mass_budget
+        # (the for_load sizing anchor).
+        "accuracy_geometry_doctrine": (
+            "for_load-consistent: admitted in-window mass within the "
+            "geometry's calibrated budget"
+            if (acc_decisions - sk_deny) / max(coverage, 1e-9)
+            <= cfg.sketch.mass_budget(cfg.limit)
+            else "OVER mass budget: geometry undersized for this load"),
+        "accuracy_admitted_mass_per_window": int(
+            (acc_decisions - sk_deny) / max(coverage, 1e-9)),
+        "accuracy_mass_budget": cfg.sketch.mass_budget(cfg.limit),
         "serving_ingest_batch": INGEST_BATCH,
         "serving_scan_steps": SCAN_STEPS,
+        "serving_pipelined_dispatches": K,
         "serving_decisions_per_sec": round(serving_rps, 1),
         "serving_step_latency_ms": round(step_latency_ms, 3),
+        "serving_geometry": {"depth": 4, "width": 1 << 16,
+                             "sub_windows": 60, "conservative_update": True},
+        "serving_sizing_doctrine": "literal BASELINE config 3 "
+                                   "(d=4 w=65536, the spec'd shape)",
+        "serving_decisions_per_sec_wide_geometry": round(wide_rps, 1),
+        "serving_step_latency_ms_wide_geometry": round(wide_step_ms, 3),
         "dispatch_rtt_ms": round(rtt_s * 1e3, 1),
         "compile_s": round(compile_a + compile_b + compile_c, 1),
         "platform": platform,

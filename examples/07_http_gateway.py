@@ -29,7 +29,9 @@ server = subprocess.Popen(
     [sys.executable, "-m", "ratelimiter_tpu.serving",
      "--backend", "exact", "--algorithm", "sliding_window",
      "--limit", "3", "--window", "60", "--port", str(port),
-     "--http-port", str(http_port)],
+     # Reset over HTTP is OFF by default (quota-erase lever on a
+     # curl-able surface); this demo token-gates it.
+     "--http-port", str(http_port), "--http-reset-token", "demo-token"],
     env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
 print(server.stdout.readline().strip())
 
@@ -53,9 +55,18 @@ req = urllib.request.Request(f"{base}/v1/allow",
 with urllib.request.urlopen(req) as r:
     print(f"header key: 200 remaining={r.headers['X-RateLimit-Remaining']}")
 
-# Reset over HTTP, then the key admits again.
+# Reset over HTTP, then the key admits again. Without the bearer token
+# the gateway answers 403 (reset is a guarded surface).
+try:
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/v1/reset?key=user:1", method="POST"))
+    raise AssertionError("unauthenticated reset must 403")
+except urllib.error.HTTPError as e:
+    assert e.code == 403
+    print("reset without token: 403")
 urllib.request.urlopen(urllib.request.Request(
-    f"{base}/v1/reset?key=user:1", method="POST"))
+    f"{base}/v1/reset?key=user:1", method="POST",
+    headers={"Authorization": "Bearer demo-token"}))
 with urllib.request.urlopen(f"{base}/v1/allow?key=user:1") as r:
     print("after reset: 200")
 

@@ -93,6 +93,66 @@ class DenseLimiter(RateLimiter):
                                        tokens=np.asarray(cap, dtype=np.int64),
                                        rem=np.asarray(0, dtype=np.int64))
 
+    def _apply_window(self, new_cfg: Config) -> None:
+        """Dynamic window: slot-state re-bucketing, same contract as the
+        exact backend's host migration (exact.ExactLimiter._apply_window
+        — consumption stands, re-expiry on the NEW schedule, errs toward
+        denying) as ONE fused device update; the new-window step comes
+        from the kernel cache (window is part of its key).
+
+        All grid quantities are host scalars, so the migration lowers to
+        a handful of elementwise selects over the slot arrays."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import dense_kernels
+
+        W_old = self._window_us
+        W_new = to_micros(new_cfg.window)
+        now_us = to_micros(self.clock.now())
+        cur_old = (now_us // W_old) * W_old
+        p_now = now_us // W_new
+        new_start = p_now * W_new
+        new_step = dense_kernels.build_step(new_cfg)
+        with self._lock:
+            self._step = new_step
+            algo = self.config.algorithm
+            if algo is Algorithm.FIXED_WINDOW:
+                # The live old window's span always reaches into the
+                # current new-grid window (now < cur_old + W_old), so a
+                # live count is always carried; stale slots zero.
+                live = self._state["win_start"] == cur_old
+                self._state = dict(
+                    self._state,
+                    count=jnp.where(live, self._state["count"], 0),
+                    win_start=jnp.where(live, jnp.int64(new_start), 0))
+            elif algo in (Algorithm.SLIDING_WINDOW, Algorithm.TPU_SKETCH):
+                ws = self._state["win_start"]
+                on_cur = ws == cur_old
+                on_prev = ws == cur_old - W_old
+                curr = jnp.where(on_cur, self._state["curr"], 0)
+                prev = jnp.where(on_cur, self._state["prev"],
+                                 jnp.where(on_prev, self._state["curr"], 0))
+                # The old curr bucket's span always overlaps the current
+                # new window (same argument as FW above) -> new curr.
+                # Old prev lands by its span end: current window, the
+                # one before (weighted boundary), or aged out.
+                q_prev = (cur_old - 1) // W_new
+                new_curr = curr + (prev if q_prev >= p_now else 0)
+                new_prev = prev if q_prev == p_now - 1 else jnp.zeros_like(prev)
+                keep = (new_curr > 0) | (new_prev > 0)
+                self._state = dict(
+                    self._state,
+                    curr=jnp.where(keep, new_curr, 0),
+                    prev=jnp.where(keep, new_prev, 0),
+                    win_start=jnp.where(keep, jnp.int64(new_start), 0))
+            else:  # token bucket: rate changes (baked into the new step),
+                self._window_us = W_new  # levels/last stand, remainder
+                self._state = dict(      # resets (< 1 micro-token, toward
+                    self._state,         # denying).
+                    rem=jnp.zeros_like(self._state["rem"]))
+                return
+            self._window_us = W_new
+
     # ------------------------------------------------------------ slot admin
 
     def _assign_slots(self, keys: List[str], now_us: int) -> np.ndarray:

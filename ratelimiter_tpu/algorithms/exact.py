@@ -92,6 +92,60 @@ class ExactLimiter(RateLimiter):
             self._rate_num = new_cfg.limit * MICROS // g
             self._rate_den = self._window_us // g
 
+    def _apply_window(self, new_cfg: Config) -> None:
+        """Dynamic window: host-side re-bucketing under the SAME contract
+        the sketch migration pins (tests/test_dynamic_window.py):
+        consumption stands, history re-expires on the NEW window's
+        schedule, and migration can only err toward denying — each live
+        old bucket's mass is attributed to the last new-grid window its
+        time span overlaps (the window-granularity mirror of
+        ops/sketch_kernels._migrate_window's sub-window rule), so
+        nothing gets an early free refill.
+
+        Token bucket: the window only sets the refill rate; debt/levels
+        stand and the sub-micro-token remainder (denominated in the old
+        rate fraction) resets — forfeits < 1 micro-token, toward
+        denying."""
+        W_old = self._window_us
+        W_new = to_micros(new_cfg.window)
+        now_us = to_micros(self.clock.now())
+        cur_old = (now_us // W_old) * W_old
+        p_now = now_us // W_new
+        new_start = p_now * W_new
+        with self._lock:
+            # Fixed window: the live old window's span always reaches
+            # into the current new-grid window (now < cur_old + W_old),
+            # so live counts carry; stale entries drop.
+            self._fw = {fkey: (new_start, count)
+                        for fkey, (start, count) in self._fw.items()
+                        if start == cur_old}
+            # Sliding window: normalize (lazy-roll) under the old grid,
+            # then attribute each bucket by its span's last new period.
+            # The old curr bucket always overlaps the current new window
+            # (same argument as FW) -> new curr; old prev lands in the
+            # current window, the boundary one, or ages out.
+            q_prev = (cur_old - 1) // W_new
+            sw = {}
+            for fkey, (start, curr, prev) in self._sw.items():
+                if start == cur_old:
+                    pass                      # both buckets live
+                elif start == cur_old - W_old:
+                    prev, curr = curr, 0      # rolled exactly one window
+                else:
+                    continue                  # idle > one window: dead
+                new_curr = curr + (prev if q_prev >= p_now else 0)
+                new_prev = prev if q_prev == p_now - 1 else 0
+                if new_curr or new_prev:
+                    sw[fkey] = (new_start, new_curr, new_prev)
+            self._sw = sw
+            # Token bucket: new rate fraction; levels and last stand.
+            self._window_us = W_new
+            g = math.gcd(new_cfg.limit * MICROS, W_new)
+            self._rate_num = new_cfg.limit * MICROS // g
+            self._rate_den = W_new // g
+            self._tb = {k: (t, 0, last)
+                        for k, (t, _rem, last) in self._tb.items()}
+
     # ---------------------------------------------------- fault injection
 
     def inject_failure(self, exc: Optional[Exception] = None) -> None:

@@ -70,6 +70,7 @@ class SketchLimiter(RateLimiter):
         # integers only — no device cost.
         self._ring_sw = sketch_kernels.sketch_geometry(self.config)[2]
         self._mass_budget = self.config.sketch.mass_budget(self.config.limit)
+        self._strict = self.config.sketch.overload_policy == "strict"
         self._period_mass: dict = {}
         self._warned_period = -1
         self.overload_periods = 0
@@ -123,12 +124,59 @@ class SketchLimiter(RateLimiter):
             if self._injected_failure is not None:
                 raise self._injected_failure
             self._sync_period(now_us)
+            if self._strict and self._over_budget_locked(now_us):
+                # Strict overload policy: REJECT new admissions (no
+                # state write, no dispatch) while admitted in-window
+                # mass exceeds the geometry's accuracy budget — loud
+                # bounded denials instead of silent unbounded
+                # misaccounting. Clears as history ages out of the ring.
+                return self._deny_all(b, now_us)
             self._state, outs = self._step(
                 self._state, self._place(h1p), self._place(h2p),
                 self._place(np_ns), jnp.int64(now_us))
         res = self._finish(outs, b, now_us)
         self._note_mass(int(np_ns[:b][res.allowed].sum()), now_us)
         return res
+
+    def _over_budget_locked(self, now_us: int) -> bool:
+        """Prune + check the admitted-mass ledger; counts/warns once per
+        offending sub-window. Lock must be held."""
+        p = now_us // self._sub_us
+        if self._period_mass:
+            p = max(p, max(self._period_mass))
+        low = p - self._ring_sw
+        for q in [q for q in self._period_mass if q <= low]:
+            del self._period_mass[q]
+        mass = sum(self._period_mass.values())
+        if mass <= self._mass_budget:
+            return False
+        if p > self._warned_period:
+            self._warned_period = p
+            self.overload_periods += 1
+            log.warning(
+                "sketch overload (strict): admitted in-window mass %d "
+                "exceeds the d=%d w=%d budget of %d — rejecting new "
+                "admissions until history expires; size the geometry "
+                "with SketchParams.for_load", mass,
+                self.config.sketch.depth, self.config.sketch.width,
+                self._mass_budget)
+        return True
+
+    def _deny_all(self, b: int, now_us: int) -> BatchResult:
+        """Uniform denial batch for the strict overload path. Retry
+        points at the next sub-window boundary: mass drains one
+        sub-window at a time, so that is when admission could resume."""
+        retry = ((now_us // self._sub_us + 1) * self._sub_us
+                 - now_us) / MICROS
+        cur_ws = (now_us // self._window_us) * self._window_us
+        reset_at = (cur_ws + self._window_us) / MICROS
+        return BatchResult(
+            allowed=np.zeros(b, dtype=bool),
+            limit=self.config.limit,
+            remaining=np.zeros(b, dtype=np.int64),
+            retry_after=np.full(b, retry, dtype=np.float64),
+            reset_at=np.full(b, reset_at, dtype=np.float64),
+        )
 
     # ------------------------------------------------- accuracy envelope
 
@@ -140,6 +188,12 @@ class SketchLimiter(RateLimiter):
         Warns loudly once per sub-window while overloaded."""
         p = now_us // self._sub_us
         with self._lock:
+            # Clamp forward like the kernels clamp now_us: after a backward
+            # clock step the ledger would otherwise keep "future" periods
+            # alive past pruning, inflating the in-window mass and firing
+            # spurious undersized-geometry warnings.
+            if self._period_mass:
+                p = max(p, max(self._period_mass))
             self._period_mass[p] = self._period_mass.get(p, 0) + admitted
             low = p - self._ring_sw
             for q in [q for q in self._period_mass if q <= low]:
@@ -298,8 +352,11 @@ class SketchLimiter(RateLimiter):
 
     _CKPT_KIND = "sketch"
     #: State arrays that may be absent in older checkpoints and default
-    #: to zeros on restore (see restore()).
-    _CKPT_OPTIONAL: tuple = ()
+    #: to zeros on restore (see restore()). ``hh_owner2`` (added r5 for
+    #: DCN export of promoted keys) restoring as zeros only means those
+    #: owners' traffic stays local-only until re-promotion — decisions
+    #: are unaffected (export_completed skips owner2==0 slots).
+    _CKPT_OPTIONAL: tuple = ("hh_owner2",)
 
     def save(self, path: str) -> None:
         """Snapshot device state to ``path`` (.npz). See
@@ -390,6 +447,9 @@ class SketchTokenBucketLimiter(SketchLimiter):
         self._window_us = to_micros(self.config.window)
         self._seed = self.config.sketch.seed
         self._lock = threading.Lock()
+        # The mass watchdog (and with it overload_policy="strict") is a
+        # windowed-sketch concept; debt decays continuously (_note_mass).
+        self._strict = False
         self._injected_failure: Optional[Exception] = None
 
     def _sync_period(self, now_us: int) -> None:

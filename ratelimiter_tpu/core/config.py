@@ -55,6 +55,18 @@ class SketchParams:
     #: admitted mass (ROADMAP v0.2; ops/sketch_kernels.py docstring).
     hh_slots: int = 0
     hh_promote_fraction: float = 0.5
+    #: What to do when the admitted in-window mass exceeds this geometry's
+    #: calibrated budget (``mass_budget`` — the point where collision
+    #: error passes ~1% false denies):
+    #:   "warn"   (default) log loudly once per sub-window and keep
+    #:            serving (accuracy silently degrades with load);
+    #:   "strict" additionally REJECT new admissions while over budget —
+    #:            prefer loud, bounded unavailability (extra denies, the
+    #:            limiter's safe direction) over unbounded silent
+    #:            misaccounting. The overload clears as history expires.
+    #: Either way ``overload_periods`` counts offending sub-windows and is
+    #: exported via /metrics and healthz (docs/OPERATIONS.md §3).
+    overload_policy: str = "warn"
 
     def validate(self) -> None:
         if self.depth < 1 or self.depth > 16:
@@ -75,6 +87,10 @@ class SketchParams:
             raise InvalidConfigError(
                 f"hh_promote_fraction must be in (0, 1], "
                 f"got {self.hh_promote_fraction}")
+        if self.overload_policy not in ("warn", "strict"):
+            raise InvalidConfigError(
+                f"overload_policy must be 'warn' or 'strict', "
+                f"got {self.overload_policy!r}")
 
     # ------------------------------------------------- load-aware sizing
     #

@@ -74,12 +74,18 @@ namespace {
 
 // ---- protocol constants (serving/protocol.py) ----
 constexpr uint8_t T_ALLOW_N = 1, T_RESET = 2, T_HEALTH = 3, T_METRICS = 4,
-                  T_ALLOW_BATCH = 5;
+                  T_ALLOW_BATCH = 5, T_DCN_PUSH = 6;
 constexpr uint8_t T_RESULT = 129, T_OK = 130, T_HEALTH_R = 131,
                   T_METRICS_R = 132, T_RESULT_BATCH = 133, T_ERROR = 255;
 constexpr uint16_t E_INVALID_N = 1, E_INVALID_KEY = 2,
-                   E_STORAGE_UNAVAILABLE = 3, E_INTERNAL = 7;
+                   E_STORAGE_UNAVAILABLE = 3, E_INVALID_CONFIG = 5,
+                   E_INTERNAL = 7;
 constexpr uint32_t MAX_FRAME = 1u << 20;
+// T_DCN_PUSH frames carry whole slabs / debt deltas; the larger cap is
+// honored ONLY when the server was created with a dcn callback, so plain
+// deployments keep the 1 MiB bad-input bound per frame
+// (serving/protocol.py MAX_DCN_FRAME).
+constexpr uint32_t MAX_DCN_FRAME = 96u << 20;
 constexpr uint32_t MAX_KEY_LEN = 4096;
 
 // Keys are UTF-8 strings at the protocol level (the asyncio server
@@ -260,6 +266,11 @@ struct Server {
   PyObject* cb_decide = nullptr;
   PyObject* cb_reset = nullptr;
   PyObject* cb_metrics = nullptr;
+  // DCN merge callback (None = T_DCN_PUSH rejected and the frame cap
+  // stays at MAX_FRAME). Called with the raw push payload; the Python
+  // side owns auth verification and the merge into every shard limiter.
+  PyObject* cb_dcn = nullptr;
+  bool dcn_enabled = false;
 };
 
 // FNV-1a over the raw key bytes: deterministic shard routing (need not
@@ -272,6 +283,32 @@ uint32_t key_shard(const Server* s, const std::string& k) {
     h *= 1099511628211ull;
   }
   return (uint32_t)(h % s->num_shards);
+}
+
+// Extract (code, message) from the pending Python exception: message =
+// str(exc), code = exc.rl_code when present (the bridge's typed wire
+// code), else `fallback_code`. Clears the error. GIL must be held.
+uint16_t fetch_py_error(std::string& msg, const char* fallback_msg,
+                        uint16_t fallback_code) {
+  uint16_t code = fallback_code;
+  PyObject *t, *v, *tb;
+  PyErr_Fetch(&t, &v, &tb);
+  PyObject* str = v ? PyObject_Str(v) : nullptr;
+  const char* u =
+      (str && PyUnicode_Check(str)) ? PyUnicode_AsUTF8(str) : nullptr;
+  msg = u ? u : fallback_msg;
+  if (v != nullptr) {
+    PyObject* codeattr = PyObject_GetAttrString(v, "rl_code");
+    if (codeattr && PyLong_Check(codeattr))
+      code = (uint16_t)PyLong_AsLong(codeattr);
+    Py_XDECREF(codeattr);
+    if (PyErr_Occurred()) PyErr_Clear();
+  }
+  Py_XDECREF(str);
+  Py_XDECREF(t);
+  Py_XDECREF(v);
+  Py_XDECREF(tb);
+  return code;
 }
 
 double now_s() {
@@ -409,26 +446,10 @@ bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
     PyObject* res = args ? PyObject_CallObject(s->cb_decide, args) : nullptr;
     Py_XDECREF(args);
     if (res == nullptr) {
-      PyObject *t, *v, *tb;
-      PyErr_Fetch(&t, &v, &tb);
-      PyObject* str = v ? PyObject_Str(v) : nullptr;
-      const char* u =
-          (str && PyUnicode_Check(str)) ? PyUnicode_AsUTF8(str) : nullptr;
-      err_msg = u ? u : "decide callback failed";
       // Python-side mapping: the bridge returns a typed code via the
       // exception's .rl_code when it can; default storage_unavailable.
-      err_code = E_STORAGE_UNAVAILABLE;
-      if (v != nullptr) {
-        PyObject* codeattr = PyObject_GetAttrString(v, "rl_code");
-        if (codeattr && PyLong_Check(codeattr))
-          err_code = (uint16_t)PyLong_AsLong(codeattr);
-        Py_XDECREF(codeattr);
-        if (PyErr_Occurred()) PyErr_Clear();
-      }
-      Py_XDECREF(str);
-      Py_XDECREF(t);
-      Py_XDECREF(v);
-      Py_XDECREF(tb);
+      err_code = fetch_py_error(err_msg, "decide callback failed",
+                                E_STORAGE_UNAVAILABLE);
     } else {
       // (flags, remaining, retry, reset_at, limit) — buffer protocol.
       PyObject *o_fl, *o_rem, *o_ret, *o_rst;
@@ -615,24 +636,34 @@ void handle_reset(Server* s, uint32_t shard, const Pending& p) {
         s->cb_reset, "Iy#", (unsigned int)shard, p.keys[0].data(),
         (Py_ssize_t)p.keys[0].size());
     if (res == nullptr) {
-      PyObject *t, *v, *tb;
-      PyErr_Fetch(&t, &v, &tb);
-      PyObject* str = v ? PyObject_Str(v) : nullptr;
-      const char* u =
-          (str && PyUnicode_Check(str)) ? PyUnicode_AsUTF8(str) : nullptr;
-      err_msg = u ? u : "reset failed";
-      err_code = E_STORAGE_UNAVAILABLE;
-      if (v != nullptr) {
-        PyObject* codeattr = PyObject_GetAttrString(v, "rl_code");
-        if (codeattr && PyLong_Check(codeattr))
-          err_code = (uint16_t)PyLong_AsLong(codeattr);
-        Py_XDECREF(codeattr);
-        if (PyErr_Occurred()) PyErr_Clear();
-      }
-      Py_XDECREF(str);
-      Py_XDECREF(t);
-      Py_XDECREF(v);
-      Py_XDECREF(tb);
+      err_code = fetch_py_error(err_msg, "reset failed",
+                                E_STORAGE_UNAVAILABLE);
+    } else {
+      Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+  }
+  std::string out;
+  if (err_code) {
+    out = make_error(p.req_id, err_code, err_msg);
+  } else {
+    frame_header(out, T_OK, p.req_id, 0);
+  }
+  conn_send(s, p.conn, std::move(out));
+}
+
+void handle_dcn(Server* s, const Pending& p) {
+  // One T_DCN_PUSH payload (keys[0] holds the raw body). Rides shard 0's
+  // queue so merges serialize with that dispatcher; the Python callback
+  // fans the merge out to every shard limiter itself.
+  uint16_t err_code = 0;
+  std::string err_msg;
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* res = PyObject_CallFunction(
+        s->cb_dcn, "y#", p.keys[0].data(), (Py_ssize_t)p.keys[0].size());
+    if (res == nullptr) {
+      err_code = fetch_py_error(err_msg, "DCN merge failed", E_INTERNAL);
     } else {
       Py_DECREF(res);
     }
@@ -717,6 +748,8 @@ void dispatcher_main(Server* s, uint32_t shard) {
         handle_reset(s, shard, p);
       } else if (p.ns.size() == 1 && p.ns[0] == -2) {
         handle_metrics(s, p);
+      } else if (p.ns.size() == 1 && p.ns[0] == -3) {
+        handle_dcn(s, p);
       } else {
         decisions.push_back(std::move(p));
       }
@@ -797,9 +830,16 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
   while (c->rbuf.size() - off >= 13) {
     uint32_t length;
     memcpy(&length, c->rbuf.data() + off, 4);
-    if (length < 9 || length > MAX_FRAME) return false;  // protocol error
-    if (c->rbuf.size() - off < 4 + length) break;
+    if (length < 9) return false;  // protocol error
+    // The type byte is already in hand (>= 13 bytes buffered), so the
+    // per-frame cap can be type-aware: DCN pushes get the slab-sized cap
+    // ONLY on a DCN-enabled server (mirrors protocol.parse_header's
+    // allow_dcn).
     uint8_t type = (uint8_t)c->rbuf[off + 4];
+    uint32_t cap =
+        (s->dcn_enabled && type == T_DCN_PUSH) ? MAX_DCN_FRAME : MAX_FRAME;
+    if (length > cap) return false;  // protocol error
+    if (c->rbuf.size() - off < 4 + length) break;
     uint64_t req_id;
     memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     const char* body = c->rbuf.data() + off + 13;
@@ -951,6 +991,17 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     } else if (type == T_METRICS) {
       Pending p{c, req_id, false, {std::string()}, {-2}};
       enqueue(std::move(p), 0, 0);
+    } else if (type == T_DCN_PUSH) {
+      if (!s->dcn_enabled) {
+        conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
+                                   "DCN exchange not enabled on this server"));
+      } else if (s->draining.load()) {
+        conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                                   "server is shutting down"));
+      } else {
+        Pending p{c, req_id, false, {std::string(body, blen)}, {-3}};
+        enqueue(std::move(p), 0, 0);
+      }
     } else {
       conn_send(s, c, make_error(req_id, E_INTERNAL, "unknown request type"));
     }
@@ -999,12 +1050,21 @@ void io_main(Server* s) {
           continue;
         }
         if (events[i].events & EPOLLIN) {
+          // Backpressure bound on unparsed bytes. A DCN-enabled server
+          // must hold one whole in-flight push (up to MAX_DCN_FRAME, the
+          // same buffering the asyncio door accepts via readexactly) —
+          // with the 4 MiB bound a production-geometry slab frame would
+          // kill the connection mid-frame, before process_rbuf's
+          // type-aware cap ever saw the type byte.
+          const size_t rbuf_cap =
+              s->dcn_enabled ? 4ul + MAX_DCN_FRAME + 4ul * MAX_FRAME
+                             : 4ul * MAX_FRAME;
           bool dead = false;
           while (true) {
             ssize_t r = recv(fd, buf, sizeof(buf), 0);
             if (r > 0) {
               c->rbuf.append(buf, (size_t)r);
-              if (c->rbuf.size() > 4 * MAX_FRAME) { dead = true; break; }
+              if (c->rbuf.size() > rbuf_cap) { dead = true; break; }
             } else if (r == 0) {
               dead = true;
               break;
@@ -1163,6 +1223,7 @@ void server_dealloc(PyObject* self) {
     Py_XDECREF(ps->s->cb_decide);
     Py_XDECREF(ps->s->cb_reset);
     Py_XDECREF(ps->s->cb_metrics);
+    Py_XDECREF(ps->s->cb_dcn);
     delete ps->s;
   }
   Py_TYPE(self)->tp_free(self);
@@ -1184,8 +1245,8 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   static const char* kwlist[] = {"decide",    "reset",        "metrics",
                                  "max_batch", "max_delay_us", "slo_us",
                                  "fail_open", "limit",        "window_s",
-                                 "key_prefix", "num_shards", nullptr};
-  PyObject *decide, *reset, *metrics = Py_None;
+                                 "key_prefix", "num_shards",  "dcn", nullptr};
+  PyObject *decide, *reset, *metrics = Py_None, *dcn = Py_None;
   unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
   int fail_open = 0;
   long long limit = 0;
@@ -1193,12 +1254,12 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   const char* key_prefix = nullptr;
   Py_ssize_t key_prefix_len = 0;
   unsigned int num_shards = 1;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#I",
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IO",
                                    (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
                                    &window_s, &key_prefix, &key_prefix_len,
-                                   &num_shards))
+                                   &num_shards, &dcn))
     return nullptr;
   if (num_shards < 1 || num_shards > 64) {
     PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
@@ -1224,9 +1285,12 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_INCREF(decide);
   Py_INCREF(reset);
   Py_INCREF(metrics);
+  Py_INCREF(dcn);
   ps->s->cb_decide = decide;
   ps->s->cb_reset = reset;
   ps->s->cb_metrics = metrics;
+  ps->s->cb_dcn = dcn;
+  ps->s->dcn_enabled = dcn != Py_None;
   return (PyObject*)ps;
 }
 
@@ -1248,7 +1312,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 3; }
+int64_t rl_server_abi_version() { return 4; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -164,10 +164,16 @@ class MetricsDecorator(LimiterDecorator):
     """Records the reference-specced metric families into a Registry
     (``docs/ADR/003:44-66``; names ``docs/ARCHITECTURE.md:550-566``)."""
 
-    def __init__(self, inner: RateLimiter, registry: Optional[m.Registry] = None):
+    def __init__(self, inner: RateLimiter, registry: Optional[m.Registry] = None,
+                 shard: str = "0"):
         super().__init__(inner)
         reg = registry if registry is not None else m.DEFAULT
         self.registry = reg
+        #: Envelope-gauge label: with dispatch shards each shard's
+        #: decorator must write its OWN series — a shared unlabeled gauge
+        #: would be overwritten by whichever shard observed last, masking
+        #: an overloaded shard behind a healthy one.
+        self._shard = str(shard)
         self._algo = str(inner.config.algorithm)
         self._requests = reg.counter(
             "rate_limiter_requests_total",
@@ -187,6 +193,33 @@ class MetricsDecorator(LimiterDecorator):
         self._errors = reg.counter(
             "rate_limiter_storage_errors_total",
             "Backend failures (fail-open allowances included)")
+        # Accuracy-envelope surface (windowed sketch only): exported so a
+        # mis-sized geometry shows up on /metrics, not just in a log line
+        # (SURVEY.md §7.4 hard part 3; docs/OPERATIONS.md §3).
+        base = undecorated(inner)
+        self._sketch = base if hasattr(base, "_period_mass") else None
+        if self._sketch is not None:
+            self._overload_g = reg.gauge(
+                "rate_limiter_sketch_overload_periods",
+                "Sub-windows whose admitted mass exceeded the geometry's "
+                "accuracy budget (growing value = undersized sketch)")
+            self._mass_g = reg.gauge(
+                "rate_limiter_sketch_in_window_admitted_mass",
+                "Admitted requests currently inside the sliding window")
+            self._budget_g = reg.gauge(
+                "rate_limiter_sketch_mass_budget",
+                "Admitted-mass level where collision error reaches ~1% "
+                "false denies for this geometry")
+            self._budget_g.set(float(base.mass_budget), shard=self._shard)
+
+    def _observe_envelope(self) -> None:
+        if self._sketch is not None:
+            self._overload_g.set(float(self._sketch.overload_periods),
+                                 shard=self._shard)
+            self._mass_g.set(float(self._sketch.in_window_admitted_mass()),
+                             shard=self._shard)
+            self._budget_g.set(float(self._sketch.mass_budget),
+                               shard=self._shard)
 
     def _result_label(self, res: Result) -> str:
         if res.fail_open:
@@ -203,6 +236,7 @@ class MetricsDecorator(LimiterDecorator):
             self._denied.inc(algorithm=self._algo)
         self._latency.observe(dt, algorithm=self._algo, op=op)
         self._batch.observe(1.0)
+        self._observe_envelope()
 
     def _observe_batch(self, op: str, out: BatchResult, ns, dt: float) -> None:
         b = len(out)
@@ -215,6 +249,7 @@ class MetricsDecorator(LimiterDecorator):
         self._denied.inc(b - n_allowed, algorithm=self._algo)
         self._latency.observe(dt, algorithm=self._algo, op=op)
         self._batch.observe(float(b))
+        self._observe_envelope()
 
     def _observe_op(self, op: str, dt: float) -> None:
         self._latency.observe(dt, algorithm=self._algo, op=op)

@@ -114,6 +114,11 @@ def init_state(cfg: Config) -> State:
         # 2^-32 event whose only effect is staying on the sketch path.
         state.update({
             "hh_owner": jnp.zeros((K,), jnp.uint32),
+            # The owner's SECOND hash half, captured at claim time: the
+            # DCN exporter needs the full (h1, h2) pair to fold a
+            # promoted key's private counts back into CMS-column form on
+            # the wire (parallel/dcn.export_completed).
+            "hh_owner2": jnp.zeros((K,), jnp.uint32),
             "hh_cur": jnp.zeros((K,), jnp.int32),
             "hh_slabs": jnp.zeros((S, K), jnp.int32),
             "hh_totals": jnp.zeros((K,), jnp.int32),
@@ -160,6 +165,8 @@ def _rollover(state: State, p, *, SW: int, S: int) -> State:
         idle = state["hh_last"] <= p - SW
         out.update({
             "hh_owner": jnp.where(idle, jnp.uint32(0), state["hh_owner"]),
+            "hh_owner2": jnp.where(idle, jnp.uint32(0),
+                                   state["hh_owner2"]),
             "hh_cur": jnp.zeros_like(state["hh_cur"]),
             "hh_slabs": hh_slabs,
             "hh_totals": hh_totals,
@@ -368,11 +375,21 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
             # target (ties broken by h1) wins everywhere.
             claims = jax.lax.pmax(claims, axis_name)
             touched = jax.lax.pmax(touched, axis_name)
+        # Winner's h2, recovered by a second scatter keyed on the winning
+        # packed value (equal packed => equal h1 => same key => same h2,
+        # so ties cannot mix pairs). Needed so DCN export can rebuild the
+        # owner's CMS columns (export_completed).
+        winner = cand & (packed == claims[sid_hh])
+        h2w = jnp.zeros((hh,), jnp.uint32).at[sid_hh].max(
+            jnp.where(winner, h2, jnp.uint32(0)))
+        if axis_name is not None:
+            h2w = jax.lax.pmax(h2w, axis_name)
         claim_owner = (claims & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
         newly = (state["hh_owner"] == jnp.uint32(0)) & (
             claim_owner != jnp.uint32(0))
         new_state.update({
             "hh_owner": jnp.where(newly, claim_owner, state["hh_owner"]),
+            "hh_owner2": jnp.where(newly, h2w, state["hh_owner2"]),
             "hh_cur": state["hh_cur"] + hh_hist,
             "hh_slabs": state["hh_slabs"],
             "hh_totals": state["hh_totals"] + hh_hist,
@@ -423,6 +440,7 @@ def _sketch_reset(state: State, h1, h2, now_us, *,
     if hh:
         out.update({
             "hh_owner": state["hh_owner"],
+            "hh_owner2": state["hh_owner2"],
             "hh_cur": state["hh_cur"] - hh_hist,
             "hh_slabs": state["hh_slabs"],
             "hh_totals": state["hh_totals"] - hh_hist,
@@ -591,6 +609,7 @@ def _migrate_window(state: State, now_us, *, sub_o: int, SWo: int, So: int,
         q_hh = ((state["hh_last"] + 1) * sub_o - 1) // sub_n
         out.update({
             "hh_owner": state["hh_owner"],
+            "hh_owner2": state["hh_owner2"],
             "hh_cur": hh_cur,
             "hh_slabs": hh_slabs,
             "hh_totals": hh_totals,

@@ -55,15 +55,6 @@ def _check(lim: SketchLimiter) -> None:
         raise InvalidConfigError(
             "slab exchange applies to windowed sketch limiters; token "
             "buckets exchange debt deltas (export_debt/merge_debt)")
-    if lim.config.sketch.hh_slots:
-        # Promoted keys' traffic lives in the side table, not the slabs;
-        # exporting slabs alone would make exactly the heavy hitters
-        # invisible cross-pod (unbounded over-admission for the hottest
-        # keys). Side-table exchange is future work — refuse loudly.
-        raise InvalidConfigError(
-            "DCN slab exchange does not cover the heavy-hitter side "
-            "table (hh_slots > 0): promoted keys' counts would be "
-            "invisible to peers; disable hh_slots on DCN pods")
 
 
 def export_debt(lim: SketchTokenBucketLimiter) -> np.ndarray:
@@ -173,9 +164,23 @@ def export_completed(lim: SketchLimiter, after_period: int,
     complete (or receive foreign merges) after this snapshot still
     export next cycle. Exported slabs carry LOCAL traffic only: foreign
     contributions merged into the ring are subtracted via the per-period
-    record (_foreign_record) before shipping."""
+    record (_foreign_record) before shipping.
+
+    Heavy-hitter side table (hh_slots > 0): a promoted key's traffic
+    lives in its private per-period cell, not the CMS — so each exported
+    slab FOLDS the side table's row for that period back into CMS form,
+    scatter-adding each owner's count at its Kirsch-Mitzenmacher columns
+    (the owner's (h1, h2) pair is captured at claim time, ``hh_owner2``).
+    The wire format stays pure (d, w) slabs: receivers need no hh
+    awareness, merges never touch a receiver's own side table, and the
+    error direction is unchanged (foreign hh mass can only collide into
+    over-estimates, i.e. extra denies). Slots whose owner pre-dates the
+    ``hh_owner2`` state array (older checkpoints restore it as zeros)
+    are skipped — their traffic stays local-only, the pre-r5 envelope.
+    """
     _check(lim)
     _, _, SW, S, _ = sketch_kernels.sketch_geometry(lim.config)
+    d, w = lim.config.sketch.depth, lim.config.sketch.width
     with lim._lock:
         sp = np.asarray(lim._state["slab_period"])
         last = int(np.asarray(lim._state["last_period"]))
@@ -186,9 +191,14 @@ def export_completed(lim: SketchLimiter, after_period: int,
                 if after_period < p < last and p >= last - SW]
         take.sort()
         if not take:
-            d, w = lim.config.sketch.depth, lim.config.sketch.width
             return (np.empty(0, np.int64), np.empty((0, d, w), np.int32),
                     last)
+        hh = "hh_owner" in lim._state
+        if hh:
+            owner = np.asarray(lim._state["hh_owner"])
+            owner2 = np.asarray(lim._state["hh_owner2"])
+            hh_slabs = np.asarray(lim._state["hh_slabs"])     # (S, K)
+            exportable = (owner != 0) & (owner2 != 0)
         periods = np.array([p for p, _ in take], dtype=np.int64)
         out = []
         for per, slot in take:
@@ -196,6 +206,17 @@ def export_completed(lim: SketchLimiter, after_period: int,
             f = rec.get(per)
             if f is not None:
                 slab = np.maximum(slab - f, 0)
+            if hh:
+                row = hh_slabs[slot]
+                m = exportable & (row > 0)
+                if m.any():
+                    slab = np.array(slab, dtype=np.int32)     # writable copy
+                    o1 = owner[m].astype(np.uint64)
+                    o2 = owner2[m].astype(np.uint64)
+                    cnt = row[m].astype(np.int32)
+                    for r in range(d):
+                        cols = ((o1 + r * o2) & (w - 1)).astype(np.int64)
+                        np.add.at(slab[r], cols, cnt)
             out.append(slab)
         slabs = np.stack(out)
     return periods, slabs, last
@@ -231,6 +252,8 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
     """
     import jax.numpy as jnp
 
+    from ratelimiter_tpu.core.clock import to_micros
+
     _check(lim)
     if periods.shape[0] == 0:
         return 0, -(1 << 62)
@@ -238,6 +261,15 @@ def merge_completed(lim: SketchLimiter, periods: np.ndarray,
     applied = 0
     max_applied = -(1 << 62)
     with lim._lock:
+        # Self-roll to the local clock FIRST: the exporter only ships
+        # periods ITS clock has completed, and its watermark advances on
+        # delivery — if this pod's ring lagged (quiet pod, merge racing
+        # the rollover), the p >= last drop below would discard the
+        # period FOREVER, not "until the next cycle". With synced clocks
+        # this removes the race entirely; residual loss needs cross-pod
+        # clock skew > sub_us (the reference's own NTP caveat,
+        # ``docs/ALGORITHMS.md:162``).
+        lim._sync_period(to_micros(lim.clock.now()))
         sp = np.array(np.asarray(lim._state["slab_period"]))  # writable copy
         last = int(np.asarray(lim._state["last_period"]))
         rec = _foreign_record(lim, last, SW)

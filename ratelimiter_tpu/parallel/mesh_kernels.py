@@ -101,8 +101,8 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
         # Side-table state is replicated like the sketch: gather mode
         # updates it with a replicated computation; delta mode psums the
         # write histogram and pmaxes the promotion claims (_sketch_step).
-        state_keys += ["hh_owner", "hh_cur", "hh_slabs", "hh_totals",
-                       "hh_last"]
+        state_keys += ["hh_owner", "hh_owner2", "hh_cur", "hh_slabs",
+                       "hh_totals", "hh_last"]
     state_spec = {k: P() for k in state_keys}
     # check_vma=False: the state outputs ARE replicated — they are a
     # deterministic function of replicated state and all_gathered/psum'd

@@ -79,29 +79,51 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--dcn-peer", action="append", default=[],
                     metavar="HOST:PORT",
                     help="push completed slabs / debt deltas to this peer "
-                         "server (repeatable); receiving needs the asyncio "
-                         "front door")
+                         "server (repeatable); both front doors can "
+                         "receive (asyncio and --native)")
     ap.add_argument("--dcn-interval", type=float, default=1.0,
                     help="seconds between DCN export+push cycles")
     ap.add_argument("--dcn-listen", action="store_true",
                     help="accept T_DCN_PUSH frames from peers (implied by "
                          "--dcn-peer); off by default so plain deployments "
                          "keep the 1 MiB per-frame bound")
+    ap.add_argument("--dcn-secret", default=None,
+                    help="shared secret HMAC-gating T_DCN_PUSH frames "
+                         "(both sides must set it; prefer the "
+                         "RATELIMITER_TPU_DCN_SECRET env var to keep it "
+                         "off argv). Without it, anyone with reach to the "
+                         "serving port can inject counter mass — firewall "
+                         "the port or set a secret (docs/OPERATIONS.md)")
     ap.add_argument("--http-port", type=int, default=None,
                     help="also serve the HTTP gateway (429 + X-RateLimit-* "
                          "headers, /healthz, /metrics) on this port; HTTP "
                          "decisions share the micro-batcher with binary "
-                         "traffic on the asyncio front door")
+                         "traffic on the asyncio front door, and are "
+                         "shard-routed on the native one")
+    ap.add_argument("--http-reset", action="store_true",
+                    help="expose POST /v1/reset on the HTTP gateway "
+                         "(OFF by default: reset is a quota-erase lever "
+                         "on a curl-able surface)")
+    ap.add_argument("--http-reset-token", default=None,
+                    help="bearer token required by /v1/reset (implies "
+                         "--http-reset)")
+    ap.add_argument("--grpc-port", type=int, default=None,
+                    help="also serve the gRPC contract "
+                         "(api/proto/ratelimiter.proto) on this port; "
+                         "needs the optional grpcio runtime + protoc. "
+                         "Decisions share the limiter (and shard router "
+                         "under --native) with all other surfaces")
     return ap
 
 
-def build_limiter_stack(limiter, args):
+def build_limiter_stack(limiter, args, shard: int = 0):
     """Apply the configured decorator stack, innermost first.
 
     Order (inner -> outer): Tracing (annotates the real device dispatch),
     CircuitBreaker (judges backend health from real calls), Metrics
     (observes everything, including breaker short-circuits), Logging
-    (outermost, sees final outcomes)."""
+    (outermost, sees final outcomes). ``shard`` labels the accuracy-
+    envelope gauges so dispatch shards report distinct series."""
     if args.trace:
         limiter = TracingDecorator(limiter)
     if args.circuit_breaker:
@@ -109,10 +131,34 @@ def build_limiter_stack(limiter, args):
             limiter, failure_threshold=args.breaker_threshold,
             cooldown=args.breaker_cooldown)
     if not args.no_metrics:
-        limiter = MetricsDecorator(limiter)
+        limiter = MetricsDecorator(limiter, shard=str(shard))
     if args.log_decisions:
         limiter = LoggingDecorator(limiter)
     return limiter
+
+
+def _envelope_health(limiters) -> dict:
+    """Accuracy-envelope fields for /healthz (windowed sketch only): a
+    growing overload_periods flags an undersized geometry at the
+    operational surface, not just in logs (VERDICT r4 weak 6). With
+    dispatch shards, pass EVERY shard limiter: counters/mass sum across
+    shards (each shard has its own budget, so the aggregate budget is
+    per-shard x N) and ``shards_overloaded`` says how many are currently
+    past their own budget."""
+    from ratelimiter_tpu.observability.decorators import undecorated
+
+    lims = [undecorated(lim) for lim in limiters]
+    lims = [lim for lim in lims if hasattr(lim, "_period_mass")]
+    if not lims:
+        return {}
+    masses = [lim.in_window_admitted_mass() for lim in lims]
+    return {"overload_periods": sum(lim.overload_periods for lim in lims),
+            "in_window_admitted_mass": sum(masses),
+            "mass_budget": sum(lim.mass_budget for lim in lims),
+            "shards_overloaded": sum(
+                mass > lim.mass_budget
+                for lim, mass in zip(lims, masses)),
+            "overload_policy": lims[0].config.sketch.overload_policy}
 
 
 def _prewarm(limiter, max_batch: int) -> None:
@@ -169,18 +215,17 @@ async def amain(args) -> None:
                                   args)
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
-    pusher = None
+    dcn_secret = (args.dcn_secret
+                  or os.environ.get("RATELIMITER_TPU_DCN_SECRET") or None)
+    http_reset = bool(args.http_reset or args.http_reset_token)
+    dcn_peers = []
     if args.dcn_peer:
-        from ratelimiter_tpu.serving.dcn_peer import DcnPusher, parse_peer
+        from ratelimiter_tpu.serving.dcn_peer import parse_peer
 
         if args.backend != "sketch":
             raise SystemExit("--dcn-peer needs --backend sketch")
-        from ratelimiter_tpu.observability.decorators import undecorated
-
-        pusher = DcnPusher(undecorated(limiter),
-                           [parse_peer(s) for s in args.dcn_peer],
-                           interval=args.dcn_interval)
-        pusher.start()
+        dcn_peers = [parse_peer(s) for s in args.dcn_peer]
+    pushers = []
     if args.native:
         from ratelimiter_tpu.serving.native_server import NativeRateLimitServer
 
@@ -189,20 +234,56 @@ async def amain(args) -> None:
             max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6,
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                               if args.dispatch_timeout_ms else None),
-            shards=args.shards)
+            shards=args.shards,
+            dcn=bool(args.dcn_listen or args.dcn_peer),
+            dcn_secret=dcn_secret,
+            # Clone shards get the same decorator stack as shard 0, so
+            # /metrics and the breaker see all N shards' traffic (each
+            # under its own shard label).
+            shard_decorate=(lambda lim, i: build_limiter_stack(
+                lim, args, shard=i)))
         server.start()
+        if dcn_peers:
+            # One pusher PER SHARD limiter: keys are hash-routed across
+            # shards, so exporting shard 0 alone would hide (N-1)/N of
+            # local traffic from every peer.
+            from ratelimiter_tpu.observability.decorators import undecorated
+            from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+            for shard_lim in server.shard_limiters:
+                pushers.append(DcnPusher(
+                    undecorated(shard_lim), dcn_peers,
+                    interval=args.dcn_interval, secret=dcn_secret))
+            for pu in pushers:
+                pu.start()
         gateway = None
         if args.http_port is not None:
             from ratelimiter_tpu.serving.http_gateway import HttpGateway
 
+            # decide/reset route through the server's shard router, so a
+            # key's quota lives on ONE shard no matter which surface
+            # (binary or HTTP) served it.
             gateway = HttpGateway(
-                lambda key, n: limiter.allow_n(key, n), limiter.reset,
+                server.decide_one, server.reset_one,
                 host=args.host, port=args.http_port,
                 metrics_render=obs_metrics.DEFAULT.render,
                 health=lambda: {"serving": True,
                                 **{k: v for k, v in server.stats().items()
-                                   if k == "decisions_total"}})
+                                   if k == "decisions_total"},
+                                **_envelope_health(server.shard_limiters)},
+                enable_reset=http_reset,
+                reset_token=args.http_reset_token)
             gateway.start()
+        grpc_srv = None
+        if args.grpc_port is not None:
+            from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
+
+            grpc_srv = GrpcRateLimitServer(
+                server.decide_one, server.reset_one,
+                host=args.host, port=args.grpc_port,
+                decisions_total=lambda: server.stats().get(
+                    "decisions_total", 0))
+            grpc_srv.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -210,43 +291,71 @@ async def amain(args) -> None:
         print(f"serving(native) {args.algorithm}/{args.backend} "
               f"limit={args.limit}/{args.window:g}s on "
               f"{args.host}:{server.port}"
-              + (f" http:{gateway.port}" if gateway else ""), flush=True)
+              + (f" http:{gateway.port}" if gateway else "")
+              + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
         await stop.wait()
-        if pusher is not None:
-            pusher.stop()
+        for pu in pushers:
+            pu.stop()
         if gateway is not None:
             gateway.shutdown()
+        if grpc_srv is not None:
+            grpc_srv.shutdown()
         server.shutdown()
         limiter.close()
         return
+    if args.shards > 1:
+        raise SystemExit("--shards needs --native (the asyncio front door "
+                         "has one dispatcher)")
+    if dcn_peers:
+        from ratelimiter_tpu.observability.decorators import undecorated
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        pushers.append(DcnPusher(undecorated(limiter), dcn_peers,
+                                 interval=args.dcn_interval,
+                                 secret=dcn_secret))
+        for pu in pushers:
+            pu.start()
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,
         max_delay=args.max_delay_us * 1e-6,
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                           if args.dispatch_timeout_ms else None),
-        dcn=bool(args.dcn_listen or args.dcn_peer))
+        dcn=bool(args.dcn_listen or args.dcn_peer),
+        dcn_secret=dcn_secret)
     await server.start()
 
     gateway = None
+    grpc_srv = None
     loop = asyncio.get_running_loop()
+
+    def threadsafe_decide(key: str, n: int):
+        # Gateway/gRPC worker threads funnel into the SAME micro-batcher
+        # as the binary protocol: all surfaces share device dispatches.
+        return asyncio.run_coroutine_threadsafe(
+            server.batcher.submit(key, n), loop).result(timeout=30)
+
     if args.http_port is not None:
         from ratelimiter_tpu.serving.http_gateway import HttpGateway
 
-        def http_decide(key: str, n: int):
-            # Gateway threads funnel into the SAME micro-batcher as the
-            # binary protocol: HTTP and binary traffic share device
-            # dispatches.
-            return asyncio.run_coroutine_threadsafe(
-                server.batcher.submit(key, n), loop).result(timeout=30)
-
         gateway = HttpGateway(
-            http_decide, limiter.reset,
+            threadsafe_decide, limiter.reset,
             host=args.host, port=args.http_port,
             metrics_render=obs_metrics.DEFAULT.render,
             health=lambda: {"serving": True,
-                            "decisions_total": server.batcher.decisions_total})
+                            "decisions_total": server.batcher.decisions_total,
+                            **_envelope_health([limiter])},
+            enable_reset=http_reset,
+            reset_token=args.http_reset_token)
         gateway.start()
+    if args.grpc_port is not None:
+        from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
+
+        grpc_srv = GrpcRateLimitServer(
+            threadsafe_decide, limiter.reset,
+            host=args.host, port=args.grpc_port,
+            decisions_total=lambda: server.batcher.decisions_total)
+        grpc_srv.start()
 
     stop = asyncio.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
@@ -254,12 +363,15 @@ async def amain(args) -> None:
     print(f"serving {args.algorithm}/{args.backend} "
           f"limit={args.limit}/{args.window:g}s on "
           f"{args.host}:{server.port}"
-          + (f" http:{gateway.port}" if gateway else ""), flush=True)
+          + (f" http:{gateway.port}" if gateway else "")
+          + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
     await stop.wait()
-    if pusher is not None:
-        pusher.stop()
+    for pu in pushers:
+        pu.stop()
     if gateway is not None:
         gateway.shutdown()
+    if grpc_srv is not None:
+        grpc_srv.shutdown()
     await server.shutdown()
     limiter.close()
 

@@ -19,10 +19,12 @@ availability-over-global-accuracy tradeoff the reference accepts for
 cross-region Redis (``docs/ALGORITHMS.md:162`` NTP-skew bound), erring
 toward over-admission, bounded by one export interval of traffic.
 
-Wire shape: serving/protocol.py T_DCN_PUSH (kind + payload); responses
-are T_OK / T_ERROR. The asyncio front door handles these frames; the
-native (C++) front door does not — run the asyncio server (optionally
-behind the native one on a different port) for cross-pod deployments.
+Wire shape: serving/protocol.py T_DCN_PUSH (kind + payload, optionally
+HMAC-tagged — protocol.wrap_dcn_auth); responses are T_OK / T_ERROR.
+Both front doors handle these frames: the asyncio server in
+serving/server.py and the native (C++) door via its ``dcn`` callback
+(both funnel into ``merge_push_payload`` below), so a multi-pod
+deployment can run ``--native`` servers end to end.
 """
 
 from __future__ import annotations
@@ -41,6 +43,40 @@ from ratelimiter_tpu.ops.sketch_kernels import sketch_geometry
 from ratelimiter_tpu.serving import protocol as p
 
 log = logging.getLogger("ratelimiter_tpu.serving.dcn")
+
+
+def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
+                       secret: Optional[str] = None) -> None:
+    """Parse one T_DCN_PUSH body and merge it into every given limiter —
+    the single receive path shared by the asyncio server (its one
+    limiter) and the native front door (every shard limiter).
+
+    With dispatch shards, the full foreign payload merges into EVERY
+    shard: a key is only ever read on its owner shard, where the foreign
+    mass is then present exactly once — no double count. The copies in
+    other shards are unread for that key and only add CMS collision
+    noise there (over-estimate, i.e. toward denying — the safe
+    direction)."""
+    from ratelimiter_tpu.observability.decorators import undecorated
+    from ratelimiter_tpu.ops import sketch_kernels
+    from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
+
+    body = p.unwrap_dcn_auth(body, secret)
+    lims = [undecorated(lim) for lim in limiters]
+    lim0 = lims[0]
+    if not isinstance(lim0, SketchLimiter):
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+
+        raise InvalidConfigError("DCN exchange needs a sketch-family backend")
+    d, w = lim0.config.sketch.depth, lim0.config.sketch.width
+    sub_us = (0 if isinstance(lim0, SketchTokenBucketLimiter)
+              else sketch_kernels.sketch_geometry(lim0.config)[1])
+    kind, a, b = p.parse_dcn(body, d, w, sub_us)
+    for lim in lims:
+        if kind == p.DCN_KIND_SLABS:
+            merge_completed(lim, a, b)
+        else:
+            merge_debt(lim, a)
 
 
 class _PeerConn:
@@ -100,8 +136,10 @@ class DcnPusher:
 
     def __init__(self, limiter: SketchLimiter,
                  peers: Sequence[Tuple[str, int]], *,
-                 interval: float = 1.0):
+                 interval: float = 1.0,
+                 secret: Optional[str] = None):
         self.limiter = limiter
+        self.secret = secret
         self.peers: List[_PeerConn] = [_PeerConn(h, pt) for h, pt in peers]
         self.interval = float(interval)
         self._bucket = isinstance(limiter, SketchTokenBucketLimiter)
@@ -146,7 +184,7 @@ class DcnPusher:
             delta = dcn.export_debt(self.limiter)
             if not delta.any():
                 return 0
-            frame = p.encode_dcn_debt(req_id, delta)
+            frame = p.encode_dcn_debt(req_id, delta, secret=self.secret)
             for peer in self.peers:
                 try:
                     peer.push(frame, req_id)
@@ -165,6 +203,15 @@ class DcnPusher:
                 # peer loses this interval — documented envelope.)
                 dcn.restore_debt(self.limiter, delta)
             return delivered
+        # Drive the rollover from the export cadence, not just traffic:
+        # a quiet limiter (or a quiet dispatch shard) would otherwise
+        # never complete its current sub-window, so a burst followed by
+        # silence would never ship. Same host-decides-the-period contract
+        # as any dispatch (_sync_period requires the lock).
+        from ratelimiter_tpu.core.clock import to_micros
+
+        with self.limiter._lock:
+            self.limiter._sync_period(to_micros(self.limiter.clock.now()))
         # A window change renumbers periods (new sub_us units): stored
         # watermarks are meaningless, so reset them to "everything before
         # now" — skipped history is bounded by one window, the documented
@@ -201,7 +248,7 @@ class DcnPusher:
             for s0 in range(0, pp.shape[0], per_frame):
                 frame = p.encode_dcn_slabs(
                     req_id, pp[s0:s0 + per_frame], ss[s0:s0 + per_frame],
-                    self._sub_us)
+                    self._sub_us, secret=self.secret)
                 try:
                     peer.push(frame, req_id)
                     self.pushes_ok += 1

@@ -1,0 +1,198 @@
+"""gRPC adapter for the checked-in contract (api/proto/ratelimiter.proto).
+
+The reference's planned L5 surface is explicitly a gRPC service
+(reference ``docs/ARCHITECTURE.md:297-304``; empty ``api/proto/``
+placeholder). The proto here is the contract; this module is the
+"~100-line adapter" its header promises: each RPC maps onto the same
+decide/reset callables the HTTP gateway uses, so the server binary can
+front all three surfaces (binary protocol, HTTP, gRPC) with one
+limiter/micro-batcher.
+
+Import-guarded: ``grpcio`` is an optional runtime (the binary protocol
+is the native wire format). ``grpc_available()`` says whether this
+environment can serve gRPC; tests ``importorskip`` on it. Message
+classes are generated on demand with ``protoc --python_out`` (no
+grpc_tools dependency — service wiring below is hand-rolled via
+``grpc.method_handlers_generic_handler``, which is the documented
+grpcio API for exactly this situation).
+
+Error mapping (proto comment, bottom):
+  INVALID_ARGUMENT    <- InvalidKeyError, InvalidNError
+  UNAVAILABLE         <- StorageUnavailableError (fail-closed path)
+  FAILED_PRECONDITION <- ClosedError
+  INTERNAL            <- anything else
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, Optional
+
+from ratelimiter_tpu.core.errors import (
+    ClosedError,
+    InvalidKeyError,
+    InvalidNError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import Result
+
+log = logging.getLogger("ratelimiter_tpu.serving.grpc")
+
+_PROTO = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "api", "proto", "ratelimiter.proto")
+
+_pb2 = None
+_pb2_lock = threading.Lock()
+
+
+def _load_pb2():
+    """Generate + import ratelimiter_pb2 (cached per process). Generated
+    code lands in a per-user cache dir so the repo never contains
+    machine-generated files."""
+    global _pb2
+    with _pb2_lock:
+        if _pb2 is not None:
+            return _pb2
+        import importlib.util
+
+        cache = os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "ratelimiter_tpu_grpc")
+        os.makedirs(cache, exist_ok=True)
+        out = os.path.join(cache, "ratelimiter_pb2.py")
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(_PROTO)):
+            subprocess.run(
+                ["protoc", f"--proto_path={os.path.dirname(_PROTO)}",
+                 f"--python_out={cache}", os.path.basename(_PROTO)],
+                check=True, capture_output=True, timeout=60)
+        spec = importlib.util.spec_from_file_location("ratelimiter_pb2", out)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _pb2 = mod
+        return mod
+
+
+def grpc_available() -> bool:
+    """True when both the grpcio runtime and protoc are usable here."""
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        return False
+    try:
+        _load_pb2()
+    except Exception:
+        return False
+    return True
+
+
+def _to_pb(pb2, res: Result):
+    return pb2.AllowResponse(
+        allowed=bool(res.allowed), limit=int(res.limit),
+        remaining=int(res.remaining), retry_after=float(res.retry_after),
+        reset_at=float(res.reset_at), fail_open=bool(res.fail_open))
+
+
+class GrpcRateLimitServer:
+    """grpcio server over decide/reset callables (the same transport-
+    agnostic shape as HttpGateway, so it wires to a raw limiter, the
+    micro-batcher, or the native door's shard router unchanged)."""
+
+    def __init__(self, decide: Callable[[str, int], Result],
+                 reset: Callable[[str], None], *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 decisions_total: Optional[Callable[[], int]] = None,
+                 max_workers: int = 8):
+        import grpc
+        from concurrent import futures
+
+        pb2 = _load_pb2()
+        self.decide = decide
+        self.reset = reset
+        self._decisions_total = decisions_total or (lambda: 0)
+        self._started_at = time.time()
+        grpc_mod = grpc
+
+        def guard(fn):
+            """Run one RPC body, mapping core errors to gRPC status."""
+            def wrapped(request, context):
+                try:
+                    return fn(request)
+                except (InvalidKeyError, InvalidNError) as exc:
+                    context.abort(grpc_mod.StatusCode.INVALID_ARGUMENT,
+                                  str(exc))
+                except StorageUnavailableError as exc:
+                    context.abort(grpc_mod.StatusCode.UNAVAILABLE, str(exc))
+                except ClosedError as exc:
+                    context.abort(grpc_mod.StatusCode.FAILED_PRECONDITION,
+                                  str(exc))
+                except Exception as exc:  # noqa: BLE001 — typed INTERNAL
+                    log.exception("grpc internal error")
+                    context.abort(grpc_mod.StatusCode.INTERNAL, str(exc))
+            return wrapped
+
+        def allow(req):
+            return _to_pb(pb2, self.decide(req.key, 1))
+
+        def allow_n(req):
+            return _to_pb(pb2, self.decide(req.key, int(req.n)))
+
+        def allow_batch(req):
+            # Sequential submission preserves request order; in-batch
+            # same-key sequencing is the decide callable's contract
+            # (the micro-batcher coalesces these into shared dispatches).
+            # n=0 (incl. proto3-unset) maps to InvalidN exactly like the
+            # binary protocol's ALLOW_BATCH items.
+            return pb2.AllowBatchResponse(results=[
+                _to_pb(pb2, self.decide(it.key, int(it.n)))
+                for it in req.items])
+
+        def do_reset(req):
+            self.reset(req.key)
+            return pb2.ResetResponse()
+
+        def health(_req):
+            return pb2.HealthResponse(
+                serving=True, uptime_seconds=time.time() - self._started_at,
+                decisions_total=int(self._decisions_total()))
+
+        rpcs = {
+            "Allow": (allow, pb2.AllowRequest),
+            "AllowN": (allow_n, pb2.AllowNRequest),
+            "AllowBatch": (allow_batch, pb2.AllowBatchRequest),
+            "Reset": (do_reset, pb2.ResetRequest),
+            "Health": (health, pb2.HealthRequest),
+        }
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                guard(fn), request_deserializer=req_cls.FromString,
+                response_serializer=lambda resp: resp.SerializeToString())
+            for name, (fn, req_cls) in rpcs.items()
+        }
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "ratelimiter.v1.RateLimiter", handlers),))
+        self.host = host
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+        log.info("grpc server listening on %s:%d", self.host, self.port)
+
+    def shutdown(self, grace: float = 5.0) -> None:
+        self._server.stop(grace).wait()
+
+
+def grpc_server_for_limiter(limiter, *, host: str = "127.0.0.1",
+                            port: int = 0) -> GrpcRateLimitServer:
+    """Standalone embedding (mirror of gateway_for_limiter)."""
+    return GrpcRateLimitServer(
+        lambda key, n: limiter.allow_n(key, n), limiter.reset,
+        host=host, port=port)

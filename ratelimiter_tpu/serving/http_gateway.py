@@ -14,6 +14,13 @@ anything without the binary protocol) get drop-in rate limiting:
     GET      /healthz                -> 200 {"serving": true, ...}
     GET      /metrics                -> Prometheus text
 
+Reset is a quota-erase lever, so on a broad plain-HTTP surface it is a
+bypass risk: the server binary ships it DISABLED (enable with
+``--http-reset``, optionally token-gated with ``--http-reset-token`` —
+the token rides ``Authorization: Bearer <t>`` or ``?token=``). Embedded
+gateways choose their own exposure via ``enable_reset``/``reset_token``
+(see docs/OPERATIONS.md "Trust boundaries").
+
 The key may also ride the ``X-User-ID`` header (the reference example's
 convention) when no ``key`` query parameter is given.
 
@@ -51,7 +58,9 @@ class HttpGateway:
                  reset: Callable[[str], None], *,
                  host: str = "127.0.0.1", port: int = 0,
                  metrics_render: Optional[Callable[[], str]] = None,
-                 health: Optional[Callable[[], dict]] = None):
+                 health: Optional[Callable[[], dict]] = None,
+                 enable_reset: bool = True,
+                 reset_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -114,6 +123,20 @@ class HttpGateway:
                                  str(max(1, int(res.retry_after)))))
                             self._send(429, body, headers)
                     elif url.path == "/v1/reset" and self.command == "POST":
+                        if not gateway.enable_reset:
+                            self._send(403, {"error": "reset is disabled on "
+                                             "this gateway"})
+                            return
+                        if gateway.reset_token is not None:
+                            auth = self.headers.get("Authorization", "")
+                            supplied = (auth[7:] if auth.startswith("Bearer ")
+                                        else q.get("token", [""])[0])
+                            import hmac
+
+                            if not hmac.compare_digest(supplied,
+                                                       gateway.reset_token):
+                                self._send(403, {"error": "bad reset token"})
+                                return
                         key = q.get("key", [None])[0]
                         if key is None:
                             self._send(400, {"error": "missing key"})
@@ -147,6 +170,8 @@ class HttpGateway:
 
         self.decide = decide
         self.reset = reset
+        self.enable_reset = enable_reset
+        self.reset_token = reset_token
         self.metrics_render = metrics_render if metrics_render else lambda: ""
         self.health = health if health else lambda: {"serving": True}
         self._httpd = ThreadingHTTPServer((host, port), Handler)

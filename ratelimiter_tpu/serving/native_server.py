@@ -31,7 +31,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 3
+_ABI = 4
 
 
 def _load_extension():
@@ -122,7 +122,9 @@ class NativeRateLimitServer:
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
                  registry: Optional[m.Registry] = None,
-                 shards: int = 1):
+                 shards: int = 1, dcn: bool = False,
+                 dcn_secret: Optional[str] = None,
+                 shard_decorate=None):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -163,12 +165,16 @@ class NativeRateLimitServer:
                 "shards > 1 requires a sketch-family limiter (its state "
                 "is fully determined by the config)")
         self._shard_limiters = [limiter]
-        for _ in range(shards - 1):
-            # Clones of the UNDECORATED backend class: decorators observe
-            # shard 0 (the caller's limiter); the clones are pure state
-            # shards owned by this server.
+        for i in range(1, shards):
+            # Clones rebuilt from (config, clock); ``shard_decorate(lim,
+            # shard_index)`` (e.g. the server binary's decorator stack)
+            # wraps each one so observability sees EVERY shard's traffic
+            # — per-shard labeled, not just the 1/N of keys that land on
+            # the caller's limiter. Without it the clones are raw state
+            # shards (the pre-r5 behavior).
+            clone = type(base)(base.config, clock=base.clock)
             self._shard_limiters.append(
-                type(base)(base.config, clock=base.clock))
+                shard_decorate(clone, i) if shard_decorate else clone)
         self._locks = [threading.Lock() for _ in range(shards)]
 
         # Fast path: C++ prepends the prefix while building the blob, so
@@ -176,6 +182,8 @@ class NativeRateLimitServer:
         # this replaces measured 7 ms per 4096 keys — the single largest
         # serving cost). Slow path: keys are decoded to strings and
         # allow_batch applies the prefix itself, so C++ must not.
+        self.dcn = bool(dcn)
+        self.dcn_secret = dcn_secret
         self._server = ext.create_server(
             decide=self._decide, reset=self._reset, metrics=self._metrics,
             max_batch=max_batch, max_delay_us=int(max_delay * 1e6),
@@ -184,7 +192,8 @@ class NativeRateLimitServer:
             limit=int(limiter.config.limit),
             window_s=float(limiter.config.window),
             key_prefix=self._prefix_bytes if self._fast else b"",
-            num_shards=shards)
+            num_shards=shards,
+            dcn=self._dcn if dcn else None)
 
     # ------------------------------------------------------------ callbacks
 
@@ -231,6 +240,66 @@ class NativeRateLimitServer:
 
     def _metrics(self) -> bytes:
         return self.registry.render().encode()
+
+    def _dcn(self, payload: bytes) -> None:
+        """T_DCN_PUSH receive path: merge the foreign payload into EVERY
+        shard limiter (see dcn_peer.merge_push_payload for why that is
+        double-count-free)."""
+        from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
+
+        try:
+            merge_push_payload(self._shard_limiters, payload,
+                               self.dcn_secret)
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+
+    # ----------------------------------------------- key-routed side doors
+
+    def shard_of(self, key: str) -> int:
+        """Python mirror of the C++ FNV-1a shard router (server.cpp
+        key_shard) — side doors (HTTP gateway, embedding) MUST route
+        through this so a key's quota lives on one shard regardless of
+        which surface served it."""
+        n_shards = len(self._shard_limiters)
+        if n_shards == 1:
+            return 0
+        # Constants copied bit-for-bit from server.cpp key_shard — the
+        # basis there is nonstandard, and only C++<->Python AGREEMENT
+        # matters (a mismatch silently gives one key two quotas).
+        h = 1469598103934665603
+        for b in key.encode("utf-8"):
+            h ^= b
+            h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+        return h % n_shards
+
+    def decide_one(self, key: str, n: int = 1):
+        """Single-key decision routed to the key's dispatch shard — the
+        HTTP/gRPC gateways' decide callable when this server fronts
+        traffic. Observability covers every shard when the server was
+        built with ``shard_decorate`` (the server binary does this).
+
+        Each call is one synchronous batch-of-1 dispatch serialized with
+        the shard's wire batches — fine for the interop surfaces these
+        gateways exist for (curl, sidecars, admin); bulk traffic belongs
+        on the binary protocol, whose micro-batching this path cannot
+        join (the C++ batcher owns the coalescing window)."""
+        shard = self.shard_of(key)
+        with self._locks[shard]:
+            return self._shard_limiters[shard].allow_n(key, n)
+
+    def reset_one(self, key: str) -> None:
+        """Reset routed to the key's dispatch shard (resetting shard 0's
+        limiter for a key owned by shard 2 would be a silent no-op)."""
+        shard = self.shard_of(key)
+        with self._locks[shard]:
+            self._shard_limiters[shard].reset(key)
+
+    @property
+    def shard_limiters(self):
+        """All shard limiters (index 0 = the caller's). A DCN exporter
+        must push from EVERY one of these — shard 0 alone misses
+        (N-1)/N of local traffic."""
+        return list(self._shard_limiters)
 
     # ------------------------------------------------------------ lifecycle
 

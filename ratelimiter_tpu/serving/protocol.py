@@ -316,7 +316,57 @@ _DCN_HEAD = struct.Struct("<B")
 _S64 = struct.Struct("<q")
 
 
-def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int) -> bytes:
+#: Auth envelope for T_DCN_PUSH bodies. A push injects counter mass into
+#: the receiver's limiter, so an open serving port accepting pushes is a
+#: targeted false-deny lever for anyone with network reach; deployments
+#: that cannot firewall the port share a secret instead. The envelope is
+#: MAGIC + HMAC-SHA256(secret, body) + body; a kind byte is 1 or 2, so
+#: the magic ('R') is unambiguous. A server WITHOUT a secret accepts both
+#: forms (open by configuration); a server WITH one rejects untagged or
+#: mistagged pushes. See docs/OPERATIONS.md "Trust boundaries".
+DCN_AUTH_MAGIC = b"RLA1"
+_DCN_TAG_LEN = 32
+
+
+def wrap_dcn_auth(frame: bytes, secret: str) -> bytes:
+    """Re-frame a T_DCN_PUSH frame with the HMAC envelope on its body."""
+    import hashlib
+    import hmac as _hmac
+
+    length, type_, req_id = _HDR.unpack_from(frame)
+    body = frame[HEADER_SIZE:]
+    tag = _hmac.new(secret.encode(), body, hashlib.sha256).digest()
+    body = DCN_AUTH_MAGIC + tag + body
+    return _HDR.pack(1 + 8 + len(body), type_, req_id) + body
+
+
+def unwrap_dcn_auth(body: bytes, secret) -> bytes:
+    """Verify/strip the auth envelope per the receiver's configuration.
+    Raises InvalidConfigError (a typed wire error) on missing or bad
+    tags when a secret is required."""
+    from ratelimiter_tpu.core.errors import InvalidConfigError
+
+    if body[:4] == DCN_AUTH_MAGIC:
+        if len(body) < 4 + _DCN_TAG_LEN:
+            raise ProtocolError("truncated DCN auth envelope")
+        tag, rest = body[4:4 + _DCN_TAG_LEN], body[4 + _DCN_TAG_LEN:]
+        if secret is not None:
+            import hashlib
+            import hmac as _hmac
+
+            want = _hmac.new(secret.encode(), rest, hashlib.sha256).digest()
+            if not _hmac.compare_digest(tag, want):
+                raise InvalidConfigError("DCN push auth tag mismatch")
+        return rest
+    if secret is not None:
+        raise InvalidConfigError(
+            "unauthenticated DCN push rejected (this server requires "
+            "--dcn-secret)")
+    return body
+
+
+def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int,
+                     secret=None) -> bytes:
     """periods int64[k] in sub_us units, slabs int32[k, d, w]
     (export_completed output)."""
     import numpy as np
@@ -326,16 +376,18 @@ def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int) -> bytes:
             + _U32.pack(k)
             + np.ascontiguousarray(periods, dtype=np.int64).tobytes()
             + np.ascontiguousarray(slabs, dtype=np.int32).tobytes())
-    return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    return wrap_dcn_auth(frame, secret) if secret is not None else frame
 
 
-def encode_dcn_debt(req_id: int, delta) -> bytes:
+def encode_dcn_debt(req_id: int, delta, secret=None) -> bytes:
     """delta int64[d, w] (export_debt output)."""
     import numpy as np
 
     body = (_DCN_HEAD.pack(DCN_KIND_DEBT)
             + np.ascontiguousarray(delta, dtype=np.int64).tobytes())
-    return _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    return wrap_dcn_auth(frame, secret) if secret is not None else frame
 
 
 def parse_dcn(body: bytes, d: int, w: int, sub_us: int):

@@ -45,14 +45,18 @@ class RateLimitServer:
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
                  registry: Optional[m.Registry] = None,
-                 dcn: bool = False):
+                 dcn: bool = False, dcn_secret: Optional[str] = None):
         self.limiter = limiter
         self.host = host
         self.port = port
         #: Accept T_DCN_PUSH frames (and their larger size cap). Off by
         #: default: a plain deployment must keep the 1 MiB bad-input
-        #: bound on every frame.
+        #: bound on every frame. When ``dcn_secret`` is set, pushes must
+        #: carry a valid HMAC envelope (protocol.wrap_dcn_auth) — without
+        #: it, anyone with network reach can inject counter mass
+        #: (targeted false denies); see docs/OPERATIONS.md.
         self.dcn = dcn
+        self.dcn_secret = dcn_secret
         self.registry = registry if registry is not None else m.DEFAULT
         self.batcher = MicroBatcher(
             limiter, max_batch=max_batch, max_delay=max_delay,
@@ -189,28 +193,10 @@ class RateLimitServer:
                 self._conn_tasks.discard(task)
 
     async def _handle_dcn(self, req_id: int, body: bytes) -> bytes:
-        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
-        from ratelimiter_tpu.observability.decorators import undecorated
-        from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
+        from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
 
-        lim = undecorated(self.limiter)
-        if not isinstance(lim, SketchLimiter):
-            from ratelimiter_tpu.core.errors import InvalidConfigError
-
-            raise InvalidConfigError(
-                "DCN exchange needs a sketch-family backend")
-        from ratelimiter_tpu.algorithms.sketch import SketchTokenBucketLimiter
-        from ratelimiter_tpu.ops import sketch_kernels
-
-        d, w = lim.config.sketch.depth, lim.config.sketch.width
-        sub_us = (0 if isinstance(lim, SketchTokenBucketLimiter)
-                  else sketch_kernels.sketch_geometry(lim.config)[1])
-        kind, a, b = p.parse_dcn(body, d, w, sub_us)
-        loop = asyncio.get_running_loop()
-        if kind == p.DCN_KIND_SLABS:
-            await loop.run_in_executor(None, merge_completed, lim, a, b)
-        else:
-            await loop.run_in_executor(None, merge_debt, lim, a)
+        await asyncio.get_running_loop().run_in_executor(
+            None, merge_push_payload, [self.limiter], body, self.dcn_secret)
         return p.encode_ok(req_id)
 
     async def _handle_frame(self, type_: int, req_id: int, body: bytes,

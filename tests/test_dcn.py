@@ -75,17 +75,74 @@ class TestExportMerge:
             export_completed(tb, 0)
         tb.close()
 
-    def test_hh_pods_rejected(self):
-        """Promoted keys' counts live outside the slabs; shipping slabs
-        alone would hide exactly the heavy hitters from peers."""
-        clock = ManualClock(T0)
-        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10, window=6.0,
-                     sketch=SketchParams(depth=3, width=256, sub_windows=6,
-                                         hh_slots=16))
-        lim = create_limiter(cfg, backend="sketch", clock=clock)
-        with pytest.raises(InvalidConfigError, match="hh_slots"):
-            export_completed(lim, 0)
-        lim.close()
+    def test_hh_traffic_exported_as_cms_mass(self):
+        """Promoted keys' private counts are folded back into CMS form
+        at export (via the owner's captured (h1, h2) pair), so heavy
+        hitters — precisely the keys whose traffic matters cross-pod —
+        are visible to peers (VERDICT r4 item 4; r3 refused hh+DCN)."""
+
+        def hh_pod():
+            clock = ManualClock(T0)
+            cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10,
+                         window=6.0,
+                         sketch=SketchParams(depth=3, width=256,
+                                             sub_windows=6, hh_slots=16,
+                                             hh_promote_fraction=0.2))
+            return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+        a, ca = hh_pod()
+        b, cb = hh_pod()
+        # Promote "hot" on A (crosses 0.2*10=2 estimate), then consume
+        # most of its quota IN the side table.
+        for _ in range(3):
+            assert a.allow("hot").allowed
+        import numpy as np
+
+        assert int(np.asarray(a._state["hh_owner"]).astype(bool).sum()) >= 1
+        for _ in range(6):
+            a.allow("hot")                       # 9/10 consumed on A
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")
+        b.allow("warm")
+        periods, slabs, _last = export_completed(a, -(1 << 62))
+        assert merge_completed(b, periods, slabs)[0] >= 1
+        # B sees A's 9 (side-table counts included): 2 more at most.
+        assert b.allow("hot").allowed
+        assert not b.allow_n("hot", 2).allowed
+        a.close()
+        b.close()
+
+    def test_hh_export_does_not_double_count(self):
+        """Round-tripping pods with hh enabled must not echo or double:
+        after A->B and B->A, A's view of its own key equals true global
+        consumption."""
+
+        def hh_pod():
+            clock = ManualClock(T0)
+            cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=10,
+                         window=6.0,
+                         sketch=SketchParams(depth=3, width=256,
+                                             sub_windows=6, hh_slots=16,
+                                             hh_promote_fraction=0.2))
+            return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+        a, ca = hh_pod()
+        b, cb = hh_pod()
+        for _ in range(4):
+            assert a.allow("hot").allowed        # promoted + 4 consumed
+        ca.advance(1.0)
+        cb.advance(1.0)
+        a.allow("warm")
+        b.allow("warm")
+        group = DcnMirrorGroup([a, b])
+        group.sync()
+        group.sync()                             # second sync: nothing new
+        # Global consumption of "hot" is 4: A may take exactly 6 more.
+        assert a.allow_n("hot", 6).allowed
+        assert not a.allow("hot").allowed
+        a.close()
+        b.close()
 
     def test_negative_foreign_cells_clamped(self):
         """A corrupt/malicious payload with negative cells must not erase

@@ -288,6 +288,244 @@ class TestPushOverTcp:
         a.close()
 
 
+class TestDcnAuth:
+    """T_DCN_PUSH HMAC envelope (ADVICE r4: an open serving port accepting
+    pushes is a targeted false-deny lever; the secret closes it)."""
+
+    def _pod(self, **kw):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=6.0,
+                     sketch=SketchParams(depth=3, width=256, sub_windows=6))
+        return create_limiter(cfg, backend="sketch", clock=clock)
+
+    def test_matching_secret_accepted(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)], secret="s3cret")
+            assert pusher.sync_once() == 1
+            assert not b.allow("k").allowed
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_unauthenticated_push_rejected(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)])  # no secret
+            assert pusher.sync_once() == 0
+            assert pusher.pushes_failed == 1
+            assert b.allow("k").allowed            # nothing merged
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_wrong_secret_rejected(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)], secret="wrong")
+            assert pusher.sync_once() == 0
+            assert b.allow("k").allowed
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_tagged_push_to_open_server_accepted(self):
+        """An open (no-secret) receiver strips and ignores the tag, so a
+        fleet can roll the secret out one pod at a time."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)      # no secret on receiver
+        try:
+            a.allow_n("k", 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)], secret="s3cret")
+            assert pusher.sync_once() == 1
+            assert not b.allow("k").allowed
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+
+class TestNativeDcn:
+    """The native (C++) front door receives T_DCN_PUSH via its dcn
+    callback — a multi-pod deployment needs only --native servers
+    (VERDICT r4 item 5)."""
+
+    def _pod(self, algo=Algorithm.TPU_SKETCH):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=algo, limit=10, window=6.0,
+                     sketch=SketchParams(depth=3, width=256, sub_windows=6))
+        return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from ratelimiter_tpu.serving.native_server import (
+            native_server_available,
+        )
+
+        if not native_server_available():
+            pytest.skip("needs g++ for the native server")
+
+    def test_windowed_slabs_push_to_native_door(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+
+        a, ca = self._pod()
+        b, cb = self._pod()
+        srv = NativeRateLimitServer(b, "127.0.0.1", 0, dcn=True)
+        srv.start()
+        try:
+            assert a.allow_n("k", 10).allowed
+            ca.advance(1.0)
+            cb.advance(1.0)
+            a.allow("warm")
+            b.allow("warm")
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)])
+            assert pusher.sync_once() == 1
+            assert not b.allow("k").allowed
+            pusher.stop()
+        finally:
+            srv.shutdown()
+        a.close()
+        b.close()
+
+    def test_debt_push_to_native_door_with_secret(self):
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+
+        a, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        b, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        srv = NativeRateLimitServer(b, "127.0.0.1", 0, dcn=True,
+                                    dcn_secret="s3cret")
+        srv.start()
+        try:
+            a.allow_n("k", 10)
+            bad = DcnPusher(a, [("127.0.0.1", srv.port)])  # untagged
+            assert bad.sync_once() == 0
+            bad.stop()
+            # Delta was restored on total failure; the tagged pusher
+            # ships the SAME traffic.
+            good = DcnPusher(a, [("127.0.0.1", srv.port)], secret="s3cret")
+            assert good.sync_once() == 1
+            assert not b.allow("k").allowed
+            good.stop()
+        finally:
+            srv.shutdown()
+        a.close()
+        b.close()
+
+    def test_native_door_without_dcn_refuses_pushes(self):
+        from ratelimiter_tpu.serving.dcn_peer import _PeerConn
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+        from ratelimiter_tpu.parallel.dcn import export_debt
+
+        a, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        b, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        srv = NativeRateLimitServer(b, "127.0.0.1", 0)   # dcn off
+        srv.start()
+        try:
+            a.allow_n("k", 5)
+            delta = export_debt(a)
+            peer = _PeerConn("127.0.0.1", srv.port)
+            with pytest.raises(Exception, match="not enabled"):
+                peer.push(p.encode_dcn_debt(1, delta), 1)
+            peer.close()
+            assert b.allow("k").allowed
+        finally:
+            srv.shutdown()
+        a.close()
+        b.close()
+
+    def test_large_frame_exceeding_request_cap_accepted(self):
+        """A production-geometry push (> the 4 MiB plain read-buffer
+        bound, here an 8 MiB debt delta) must survive the native door's
+        IO loop — the backpressure cap is type-aware only on DCN-enabled
+        servers (code-review r5 finding: the old flat 4*MAX_FRAME guard
+        killed the connection mid-frame)."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+
+        def big_pod():
+            clock = ManualClock(T0)
+            cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10,
+                         window=6.0,
+                         sketch=SketchParams(depth=4, width=1 << 18,
+                                             sub_windows=6))
+            return create_limiter(cfg, backend="sketch", clock=clock)
+
+        a, b = big_pod(), big_pod()
+        srv = NativeRateLimitServer(b, "127.0.0.1", 0, dcn=True)
+        srv.start()
+        try:
+            a.allow_n("k", 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)])
+            assert pusher.sync_once() == 1
+            assert not b.allow("k").allowed
+            pusher.stop()
+        finally:
+            srv.shutdown()
+        a.close()
+        b.close()
+
+    def test_push_merges_into_every_shard(self):
+        """Foreign mass must be visible no matter which shard owns the
+        key (ADVICE r4 medium: shard-0-only export/merge loses
+        (N-1)/N of traffic)."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+
+        a, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        b, _ = self._pod(Algorithm.TOKEN_BUCKET)
+        srv = NativeRateLimitServer(b, "127.0.0.1", 0, shards=4, dcn=True)
+        srv.start()
+        try:
+            keys = [f"user:{i}" for i in range(8)]
+            shards_hit = {srv.shard_of(k) for k in keys}
+            assert len(shards_hit) > 1             # keys span shards
+            for k in keys:
+                a.allow_n(k, 10)
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)])
+            assert pusher.sync_once() == 1
+            with Client(port=srv.port) as c:
+                for k in keys:                     # every shard denies
+                    assert not c.allow(k).allowed
+                assert c.allow("fresh").allowed
+            pusher.stop()
+        finally:
+            srv.shutdown()
+        a.close()
+        b.close()
+
+
 @pytest.mark.slow
 class TestTwoProcesses:
     def test_cross_process_bucket_convergence(self):
@@ -330,16 +568,101 @@ class TestTwoProcesses:
             assert "serving" in pb.stdout.readline()
             with Client(port=port_a, timeout=60.0) as ca:
                 assert ca.allow_n("k", 10).allowed   # drain on A
-            # >= 15 push intervals: ample for A's delta to land on B even
-            # with first-dispatch jit compile noise in either process.
-            time.sleep(3.0)
+            # Poll with a bounded probe budget instead of one fixed
+            # sleep (jit-compile noise under machine load made a 3 s
+            # sleep flaky): <= 8 B-local probes can never exhaust the
+            # limit of 10 by themselves, so a denial PROVES A's debt
+            # landed.
             with Client(port=port_b, timeout=60.0) as cb:
-                # B served no traffic for this key: a denial here can only
-                # come from A's pushed debt (the documented convergence).
-                res = cb.allow("k")
-                assert not res.allowed and res.retry_after > 0
+                res = None
+                for _ in range(8):
+                    time.sleep(1.0)
+                    res = cb.allow("k")
+                    if not res.allowed:
+                        break
+                assert res is not None and not res.allowed
+                assert res.retry_after > 0
                 # Fresh keys still fine on B.
                 assert cb.allow("other").allowed
+            for proc in (pa, pb):
+                proc.send_signal(signal.SIGTERM)
+                assert proc.wait(timeout=20) == 0
+        finally:
+            for proc in (pa, pb):
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+    def test_cross_process_windowed_slab_convergence_native(self):
+        """The windowed slab path (watermarks, foreign-record
+        subtraction, chunking) between two real server binaries — both
+        running the NATIVE front door, pod A with 2 dispatch shards, so
+        the whole multi-pod surface (per-shard pushers, C++ T_DCN_PUSH
+        receive, HMAC auth) is exercised end to end (VERDICT r4 items
+        5+6)."""
+        from ratelimiter_tpu.serving.native_server import (
+            native_server_available,
+        )
+
+        if not native_server_available():
+            pytest.skip("needs g++ for the native server")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        env["JAX_PLATFORMS"] = "cpu"
+        env["RATELIMITER_TPU_DCN_SECRET"] = "two-proc-secret"
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        port_a, port_b = free_port(), free_port()
+        common = [sys.executable, "-m", "ratelimiter_tpu.serving",
+                  "--backend", "sketch", "--algorithm", "sliding_window",
+                  "--limit", "10", "--window", "30",
+                  "--sub-windows", "30",
+                  "--sketch-depth", "3", "--sketch-width", "256",
+                  "--no-prewarm", "--native", "--dcn-interval", "0.2"]
+        pa = subprocess.Popen(
+            common + ["--port", str(port_a), "--shards", "2",
+                      "--dcn-peer", f"127.0.0.1:{port_b}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        pb = subprocess.Popen(
+            common + ["--port", str(port_b),
+                      "--dcn-peer", f"127.0.0.1:{port_a}"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            assert "serving" in pa.stdout.readline()
+            assert "serving" in pb.stdout.readline()
+            keys = [f"user:{i}" for i in range(4)]
+            with Client(port=port_a, timeout=60.0) as ca:
+                for k in keys:
+                    assert ca.allow_n(k, 10).allowed   # drain on A
+            # Slabs only ship once their sub-window (1 s) completes, and
+            # completion is driven by later dispatches: keep warm traffic
+            # flowing on both pods while the exchange happens. Probe each
+            # key at most 8 times: 8 B-local admissions < limit 10, so a
+            # denial on B PROVES A's 10/10 drain landed (B alone could
+            # never deny within the probe budget).
+            converged = False
+            with Client(port=port_a, timeout=60.0) as ca, \
+                    Client(port=port_b, timeout=60.0) as cb:
+                for _ in range(8):
+                    ca.allow("warm-a")
+                    cb.allow("warm-b")
+                    time.sleep(1.0)
+                    if all(not cb.allow(k).allowed for k in keys):
+                        converged = True
+                        break
+                assert converged, "A's slabs never became visible on B"
+                # Fresh keys unaffected.
+                assert cb.allow("fresh").allowed
             for proc in (pa, pb):
                 proc.send_signal(signal.SIGTERM)
                 assert proc.wait(timeout=20) == 0

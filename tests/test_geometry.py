@@ -153,3 +153,117 @@ class TestMassWatchdog:
         lim.update_limit(50)
         assert lim.mass_budget == 2 * 50 * 64
         lim.close()
+
+
+class TestStrictOverloadPolicy:
+    """overload_policy="strict": a mis-sized geometry surfaces in
+    DECISIONS (bounded extra denies), not just logs (VERDICT r4 weak 6 /
+    next-round item 8)."""
+
+    def _lim(self, policy="strict", width=16, limit=5):
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=limit,
+                     window=6.0, max_batch_admission_iters=1,
+                     sketch=SketchParams(depth=3, width=width, sub_windows=6,
+                                         overload_policy=policy))
+        return create_limiter(cfg, backend="sketch",
+                              clock=ManualClock(1_700_000_000.0))
+
+    def test_over_budget_rejects_new_admissions(self):
+        lim = self._lim()
+        budget = lim.mass_budget                       # 160
+        out = lim.allow_batch([f"k{i}" for i in range(200)])
+        assert int(out.allowed.sum()) == 200           # filled the budget
+        out = lim.allow_batch([f"m{i}" for i in range(10)])
+        assert int(out.allowed.sum()) == 0             # strict: reject all
+        assert (out.retry_after > 0).all()
+        assert lim.overload_periods >= 1
+        # Mass did NOT grow past the overload point.
+        assert lim.in_window_admitted_mass() == 200 > budget
+        lim.close()
+
+    def test_recovers_as_history_expires(self):
+        lim = self._lim()
+        lim.allow_batch([f"k{i}" for i in range(200)])
+        assert int(lim.allow("x").allowed) == 0
+        lim.clock.advance(7.0)                         # full window passes
+        assert lim.allow("x").allowed                  # budget clear again
+        lim.close()
+
+    def test_warn_policy_keeps_admitting(self):
+        lim = self._lim(policy="warn")
+        lim.allow_batch([f"k{i}" for i in range(200)])
+        out = lim.allow_batch([f"m{i}" for i in range(10)])
+        assert int(out.allowed.sum()) == 10            # degraded, serving
+        lim.close()
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(InvalidConfigError, match="overload_policy"):
+            SketchParams(overload_policy="explode").validate()
+
+    def test_metrics_gauges_exported(self):
+        from ratelimiter_tpu.observability import MetricsDecorator, Registry
+
+        reg = Registry()
+        lim = MetricsDecorator(self._lim(policy="warn"), registry=reg)
+        lim.allow_batch([f"k{i}" for i in range(200)])
+        text = reg.render()
+        assert 'rate_limiter_sketch_overload_periods{shard="0"} 1' in text
+        assert ('rate_limiter_sketch_in_window_admitted_mass{shard="0"} 200'
+                in text)
+        assert 'rate_limiter_sketch_mass_budget{shard="0"} 160' in text
+        lim.close()
+
+    def test_healthz_surfaces_overload(self):
+        """The server binary's /healthz carries the envelope fields."""
+        import json
+        import os
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+        import urllib.request
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        env["JAX_PLATFORMS"] = "cpu"
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        port, http_port = free_port(), free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "sketch", "--algorithm", "sliding_window",
+             "--limit", "5", "--window", "60",
+             "--sketch-depth", "3", "--sketch-width", "16",
+             "--no-prewarm", "--port", str(port),
+             "--http-port", str(http_port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            for _ in range(10):
+                line = proc.stdout.readline()
+                if line.startswith("serving"):
+                    break
+            from ratelimiter_tpu.serving import Client
+
+            with Client(port=port, timeout=30.0) as c:
+                c.allow_batch([f"k{i}" for i in range(200)], [1] * 200)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthz") as r:
+                health = json.loads(r.read())
+            assert health["overload_periods"] >= 1
+            assert health["in_window_admitted_mass"] > health["mass_budget"]
+            assert health["overload_policy"] == "warn"
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()

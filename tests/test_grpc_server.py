@@ -1,0 +1,229 @@
+"""gRPC adapter (serving/grpc_server.py) for the checked-in proto
+contract (api/proto/ratelimiter.proto) — the reference's planned L5
+surface (its ``docs/ARCHITECTURE.md`` gRPC service). Skips when the
+optional grpcio runtime (or protoc) is absent."""
+
+from __future__ import annotations
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from ratelimiter_tpu import (  # noqa: E402
+    Algorithm,
+    Config,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.serving.grpc_server import (  # noqa: E402
+    GrpcRateLimitServer,
+    _load_pb2,
+    grpc_available,
+    grpc_server_for_limiter,
+)
+
+if not grpc_available():  # pragma: no cover - env without protoc
+    pytest.skip("protoc or grpcio unusable here", allow_module_level=True)
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture()
+def pb2():
+    return _load_pb2()
+
+
+@pytest.fixture()
+def served():
+    clock = ManualClock(T0)
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3, window=60.0)
+    lim = create_limiter(cfg, backend="exact", clock=clock)
+    srv = grpc_server_for_limiter(lim)
+    srv.start()
+    channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield channel, lim, clock
+    channel.close()
+    srv.shutdown()
+    lim.close()
+
+
+def _stub(channel, pb2):
+    """Hand-rolled method callables (no grpc_tools-generated stub)."""
+    base = "/ratelimiter.v1.RateLimiter/"
+
+    def method(name, req_cls, resp_cls):
+        return channel.unary_unary(
+            base + name, request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString)
+
+    class Stub:
+        Allow = method("Allow", pb2.AllowRequest, pb2.AllowResponse)
+        AllowN = method("AllowN", pb2.AllowNRequest, pb2.AllowResponse)
+        AllowBatch = method("AllowBatch", pb2.AllowBatchRequest,
+                            pb2.AllowBatchResponse)
+        Reset = method("Reset", pb2.ResetRequest, pb2.ResetResponse)
+        Health = method("Health", pb2.HealthRequest, pb2.HealthResponse)
+
+    return Stub
+
+
+class TestGrpcServer:
+    def test_allow_deny_reset_roundtrip(self, served, pb2):
+        channel, _, _ = served
+        stub = _stub(channel, pb2)
+        for i in range(3):
+            resp = stub.Allow(pb2.AllowRequest(key="u1"))
+            assert resp.allowed and resp.remaining == 2 - i
+            assert resp.limit == 3
+        resp = stub.Allow(pb2.AllowRequest(key="u1"))
+        assert not resp.allowed and resp.retry_after > 0
+        assert resp.reset_at > T0
+        stub.Reset(pb2.ResetRequest(key="u1"))
+        assert stub.Allow(pb2.AllowRequest(key="u1")).allowed
+
+    def test_allow_n_all_or_nothing(self, served, pb2):
+        channel, _, _ = served
+        stub = _stub(channel, pb2)
+        assert stub.AllowN(pb2.AllowNRequest(key="u2", n=3)).allowed
+        resp = stub.AllowN(pb2.AllowNRequest(key="u2", n=2))
+        assert not resp.allowed and resp.remaining == 0  # denial consumed 0
+
+    def test_allow_batch_in_order_with_sequencing(self, served, pb2):
+        channel, _, _ = served
+        stub = _stub(channel, pb2)
+        req = pb2.AllowBatchRequest(items=[
+            pb2.AllowBatchRequest.Item(key="b1", n=2),
+            pb2.AllowBatchRequest.Item(key="b2", n=1),
+            pb2.AllowBatchRequest.Item(key="b1", n=1),
+            pb2.AllowBatchRequest.Item(key="b1", n=1),   # 4th unit: denied
+        ])
+        out = stub.AllowBatch(req)
+        assert [r.allowed for r in out.results] == [True, True, True, False]
+
+    def test_health(self, served, pb2):
+        channel, _, _ = served
+        stub = _stub(channel, pb2)
+        stub.Allow(pb2.AllowRequest(key="h"))
+        h = stub.Health(pb2.HealthRequest())
+        assert h.serving and h.uptime_seconds >= 0
+
+    def test_error_mapping_invalid_argument(self, served, pb2):
+        channel, _, _ = served
+        stub = _stub(channel, pb2)
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.Allow(pb2.AllowRequest(key=""))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.AllowN(pb2.AllowNRequest(key="k", n=0))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_error_mapping_unavailable_and_fail_open(self, pb2):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3,
+                     window=60.0, fail_open=False)
+        lim = create_limiter(cfg, backend="exact", clock=clock)
+        srv = grpc_server_for_limiter(lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        try:
+            lim.inject_failure()
+            with pytest.raises(grpc.RpcError) as ei:
+                stub.Allow(pb2.AllowRequest(key="k"))
+            assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+            lim.heal()
+            assert stub.Allow(pb2.AllowRequest(key="k")).allowed
+        finally:
+            channel.close()
+            srv.shutdown()
+            lim.close()
+
+    def test_fail_open_flag_carried(self, pb2):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3,
+                     window=60.0, fail_open=True)
+        lim = create_limiter(cfg, backend="exact", clock=clock)
+        srv = grpc_server_for_limiter(lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        try:
+            lim.inject_failure()
+            resp = stub.Allow(pb2.AllowRequest(key="k"))
+            assert resp.allowed and resp.fail_open
+        finally:
+            channel.close()
+            srv.shutdown()
+            lim.close()
+
+    def test_closed_limiter_failed_precondition(self, pb2):
+        cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=3, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        srv = grpc_server_for_limiter(lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        try:
+            lim.close()
+            with pytest.raises(grpc.RpcError) as ei:
+                stub.Allow(pb2.AllowRequest(key="k"))
+            assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        finally:
+            channel.close()
+            srv.shutdown()
+
+
+class TestGrpcOnServerBinary:
+    def test_grpc_alongside_binary_protocol(self):
+        """--grpc-port on the real binary: gRPC and binary-protocol
+        traffic share ONE limiter (quota consumed over gRPC is gone over
+        the binary protocol too)."""
+        import os
+        import signal as sig
+        import socket
+        import subprocess
+        import sys
+
+        from ratelimiter_tpu.serving import Client
+
+        pb2 = _load_pb2()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        port, grpc_port = free_port(), free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "exact", "--algorithm", "sliding_window",
+             "--limit", "2", "--window", "60", "--port", str(port),
+             "--grpc-port", str(grpc_port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            for _ in range(10):
+                line = proc.stdout.readline()
+                if line.startswith("serving"):
+                    break
+            assert "grpc:" in line, line
+            channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+            stub = _stub(channel, pb2)
+            assert stub.Allow(pb2.AllowRequest(key="shared")).allowed
+            with Client(port=port, timeout=10.0) as c:
+                assert c.allow("shared").allowed       # 2 of 2 used
+                assert not c.allow("shared").allowed
+            assert not stub.Allow(pb2.AllowRequest(key="shared")).allowed
+            channel.close()
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()

@@ -116,6 +116,56 @@ class TestHttpGateway:
             _get(f"{base}/nope")
         assert ei.value.code == 404
 
+    def test_reset_disabled_is_403(self):
+        """ADVICE r4: /v1/reset is a quota-erase lever on a curl-able
+        surface; a gateway built with enable_reset=False refuses it."""
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=2, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                         enable_reset=False)
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            _get(f"{base}/v1/allow?key=g&n=2")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/reset?key=g", method="POST"))
+            assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/allow?key=g")   # quota NOT erased
+            assert ei.value.code == 429
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_reset_token_gating(self):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=2, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                         reset_token="tok123")
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            _get(f"{base}/v1/allow?key=g&n=2")
+            # No token / wrong token -> 403, quota intact.
+            for hdrs in ({}, {"Authorization": "Bearer nope"}):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"{base}/v1/reset?key=g", method="POST",
+                        headers=hdrs))
+                assert ei.value.code == 403
+            # Bearer header works; so does ?token=.
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/reset?key=g", method="POST",
+                headers={"Authorization": "Bearer tok123"}))
+            _get(f"{base}/v1/allow?key=g&n=2")
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/reset?key=g&token=tok123", method="POST"))
+            assert _get(f"{base}/v1/allow?key=g")[0] == 200
+        finally:
+            gw.shutdown()
+            lim.close()
+
     def test_gateway_for_limiter_convenience(self):
         cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=2, window=60.0)
         lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))

@@ -403,6 +403,33 @@ class TestShardedServer:
             srv.shutdown()
         lim.close()
 
+    def test_side_door_routes_to_owning_shard(self):
+        """decide_one/reset_one (the HTTP gateway's callables) must land
+        on the same shard limiter as binary traffic for the same key —
+        otherwise one key gets two quotas (ADVICE r4 medium)."""
+        lim, _ = _mk_limiter(limit=10, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, shards=4)
+        srv.start()
+        try:
+            keys = [f"mix{i}" for i in range(8)]
+            assert len({srv.shard_of(k) for k in keys}) > 1
+            with Client(port=srv.port) as c:
+                for k in keys:
+                    # Half the quota over the wire, half via the side
+                    # door; the 11th request must be denied on BOTH
+                    # surfaces (single shared quota).
+                    assert c.allow_n(k, 5).allowed
+                    assert srv.decide_one(k, 5).allowed
+                    assert not c.allow(k).allowed
+                    assert not srv.decide_one(k).allowed
+                    # Reset via the side door frees the wire path too.
+                    srv.reset_one(k)
+                    assert c.allow(k).allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
     def test_concurrent_clients_sharded_exactness(self):
         lim, _ = _mk_limiter(limit=100, algo=Algorithm.TPU_SKETCH,
                              backend="sketch")
