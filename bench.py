@@ -321,8 +321,10 @@ def main() -> None:
         "serving_pipelined_dispatches": K,
         "serving_decisions_per_sec": round(serving_rps, 1),
         "serving_step_latency_ms": round(step_latency_ms, 3),
-        "serving_geometry": {"depth": 4, "width": 1 << 16,
-                             "sub_windows": 60, "conservative_update": True},
+        "serving_geometry": {
+            "depth": lit_cfg.sketch.depth, "width": lit_cfg.sketch.width,
+            "sub_windows": lit_cfg.sketch.sub_windows,
+            "conservative_update": lit_cfg.sketch.conservative_update},
         "serving_sizing_doctrine": "literal BASELINE config 3 "
                                    "(d=4 w=65536, the spec'd shape)",
         "serving_decisions_per_sec_wide_geometry": round(wide_rps, 1),

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Runnable two-pod deployment: two NATIVE rate-limit servers exchanging
+# cross-pod history over HMAC-tagged DCN pushes, each fronting binary +
+# HTTP (add --grpc-port to COMMON for the gRPC surface). This is the
+# process-level shape the docker-compose.yml / systemd units in this
+# directory describe declaratively — same flags, same topology — and it
+# is smoke-tested in CI (tests/test_deployments.py).
+#
+# Usage: deployments/two_pod_local.sh [seconds_to_stay_up]
+# Env:   RATELIMITER_TPU_DCN_SECRET   shared push secret (default demo)
+#        PORT_A/PORT_B/HTTP_A/HTTP_B  fixed ports (default: ephemeral)
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export RATELIMITER_TPU_DCN_SECRET="${RATELIMITER_TPU_DCN_SECRET:-demo-secret}"
+STAY_UP="${1:-15}"
+
+pick_port() {
+  python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0)); print(s.getsockname()[1]); s.close()
+EOF
+}
+PORT_A="${PORT_A:-$(pick_port)}"
+PORT_B="${PORT_B:-$(pick_port)}"
+HTTP_A="${HTTP_A:-$(pick_port)}"
+HTTP_B="${HTTP_B:-$(pick_port)}"
+
+COMMON=(python -m ratelimiter_tpu.serving
+        --backend sketch --algorithm sliding_window
+        --limit 100 --window 60
+        --sketch-depth 4 --sketch-width 65536
+        --native --shards 2 --dcn-interval 1.0
+        --http-reset-token "${HTTP_RESET_TOKEN:-admin-token}")
+# PREWARM=0: skip jit pre-warming (smoke tests / cold caches); production
+# keeps it so no client request ever pays a compile.
+if [ "${PREWARM:-1}" = "0" ]; then COMMON+=(--no-prewarm); fi
+
+"${COMMON[@]}" --port "$PORT_A" --http-port "$HTTP_A" \
+    --dcn-peer "127.0.0.1:$PORT_B" &
+PID_A=$!
+"${COMMON[@]}" --port "$PORT_B" --http-port "$HTTP_B" \
+    --dcn-peer "127.0.0.1:$PORT_A" &
+PID_B=$!
+trap 'kill -TERM $PID_A $PID_B 2>/dev/null; wait $PID_A $PID_B 2>/dev/null' EXIT
+trap 'exit 0' TERM INT   # graceful stop (the EXIT trap drains the pods)
+
+# Wait for both HTTP gateways to answer.
+for port in "$HTTP_A" "$HTTP_B"; do
+  for _ in $(seq 1 120); do
+    if curl -fsS "http://127.0.0.1:$port/healthz" >/dev/null 2>&1; then
+      break
+    fi
+    sleep 0.5
+  done
+done
+
+echo "pod A: binary 127.0.0.1:$PORT_A  http 127.0.0.1:$HTTP_A"
+echo "pod B: binary 127.0.0.1:$PORT_B  http 127.0.0.1:$HTTP_B"
+echo "try:   curl 'http://127.0.0.1:$HTTP_A/v1/allow?key=user:42'"
+echo "       curl 'http://127.0.0.1:$HTTP_B/v1/allow?key=user:42'  # shared quota within ~2 DCN cycles"
+echo "       curl 'http://127.0.0.1:$HTTP_A/healthz'"
+echo "up for ${STAY_UP}s (SIGTERM both pods on exit)"
+# Background sleep + wait: bash only runs signal traps once the current
+# foreground command finishes, so a plain sleep would stall SIGTERM for
+# the whole STAY_UP.
+sleep "$STAY_UP" &
+wait $! || true

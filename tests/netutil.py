@@ -1,0 +1,17 @@
+"""Tiny shared helpers for network-using tests (kept out of conftest so
+subprocess-spawning tests can import them by module name too)."""
+
+from __future__ import annotations
+
+import socket
+
+
+def free_port() -> int:
+    """An ephemeral port that was free at probe time (the standard
+    bind/close/reuse pattern; any future hardening — SO_REUSEADDR,
+    retry-on-race — belongs HERE, not in per-file copies)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -16,6 +16,8 @@ import time
 import numpy as np
 import pytest
 
+from netutil import free_port
+
 from ratelimiter_tpu import (
     Algorithm,
     Config,
@@ -540,12 +542,6 @@ class TestTwoProcesses:
         # processes and is beside the point here.
         env["JAX_PLATFORMS"] = "cpu"
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
 
         port_a, port_b = free_port(), free_port()
         common = [sys.executable, "-m", "ratelimiter_tpu.serving",
@@ -613,12 +609,6 @@ class TestTwoProcesses:
         env["JAX_PLATFORMS"] = "cpu"
         env["RATELIMITER_TPU_DCN_SECRET"] = "two-proc-secret"
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
 
         port_a, port_b = free_port(), free_port()
         common = [sys.executable, "-m", "ratelimiter_tpu.serving",

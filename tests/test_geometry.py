@@ -7,6 +7,8 @@ import logging
 
 import pytest
 
+from netutil import free_port
+
 from ratelimiter_tpu import (
     Algorithm,
     Config,
@@ -229,12 +231,6 @@ class TestStrictOverloadPolicy:
             + env.get("PYTHONPATH", "").split(os.pathsep))
         env["JAX_PLATFORMS"] = "cpu"
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
 
         port, http_port = free_port(), free_port()
         proc = subprocess.Popen(

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import pytest
 
+from netutil import free_port
+
 grpc = pytest.importorskip("grpc")
 
 from ratelimiter_tpu import (  # noqa: E402
@@ -192,12 +194,6 @@ class TestGrpcOnServerBinary:
             [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
             + env.get("PYTHONPATH", "").split(os.pathsep))
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
 
         port, grpc_port = free_port(), free_port()
         proc = subprocess.Popen(

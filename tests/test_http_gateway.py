@@ -11,6 +11,8 @@ import urllib.request
 
 import pytest
 
+from netutil import free_port
+
 from ratelimiter_tpu import (
     Algorithm,
     Config,
@@ -197,12 +199,6 @@ class TestServerBinaryHttp:
             [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
             + env.get("PYTHONPATH", "").split(os.pathsep))
 
-        def free_port():
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-            s.close()
-            return port
 
         port, http_port = free_port(), free_port()
         proc = subprocess.Popen(
