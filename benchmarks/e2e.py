@@ -199,7 +199,7 @@ def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
                 proc.kill()
     row["variant"] = ("NATIVE server + NATIVE loadgen, sketch on cpu "
                       "(no Python in the client loop; latency is per "
-                      "512-key frame, not per scalar request)")
+                      "1024-key frame, not per scalar request)")
     row["connections"] = row.pop("threads")
     row["inflight_per_conn"] = (row.pop("inflight_frames")
                                 * row["keys_per_frame"])

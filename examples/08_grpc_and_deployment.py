@@ -1,0 +1,87 @@
+"""gRPC surface + multi-pod shape: the server binary fronting the
+checked-in proto contract (api/proto/ratelimiter.proto) via the grpcio
+adapter, sharing one limiter with the binary protocol — and where to go
+for the full two-pod deployment (deployments/).
+
+Skips cleanly when the optional grpcio runtime or protoc is absent."""
+
+import os
+import signal
+import subprocess
+import sys
+
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, repo)
+
+try:
+    from ratelimiter_tpu.serving.grpc_server import _load_pb2, grpc_available
+except ImportError:
+    grpc_available = lambda: False  # noqa: E731
+if not grpc_available():
+    print("grpcio/protoc unavailable — skipping (the binary protocol and "
+          "HTTP gateway serve the same contract)")
+    sys.exit(0)
+
+import grpc  # noqa: E402
+
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+port, grpc_port = free_port(), free_port()
+env = dict(os.environ)
+env["PYTHONPATH"] = os.pathsep.join([repo] +
+                                    env.get("PYTHONPATH", "").split(os.pathsep))
+server = subprocess.Popen(
+    [sys.executable, "-m", "ratelimiter_tpu.serving",
+     "--backend", "exact", "--algorithm", "token_bucket",
+     "--limit", "5", "--window", "60", "--port", str(port),
+     "--grpc-port", str(grpc_port)],
+    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+for _ in range(10):
+    line = server.stdout.readline().strip()
+    if line.startswith("serving"):
+        print(line)
+        break
+
+pb2 = _load_pb2()
+channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+call = lambda name, req_cls, resp_cls: channel.unary_unary(  # noqa: E731
+    f"/ratelimiter.v1.RateLimiter/{name}",
+    request_serializer=req_cls.SerializeToString,
+    response_deserializer=resp_cls.FromString)
+Allow = call("Allow", pb2.AllowRequest, pb2.AllowResponse)
+AllowN = call("AllowN", pb2.AllowNRequest, pb2.AllowResponse)
+Health = call("Health", pb2.HealthRequest, pb2.HealthResponse)
+
+resp = AllowN(pb2.AllowNRequest(key="user:1", n=4))
+print(f"AllowN(4): allowed={resp.allowed} remaining={resp.remaining}")
+resp = Allow(pb2.AllowRequest(key="user:1"))
+print(f"Allow:     allowed={resp.allowed} remaining={resp.remaining}")
+resp = AllowN(pb2.AllowNRequest(key="user:1", n=2))
+print(f"AllowN(2): allowed={resp.allowed} retry_after={resp.retry_after:.1f}s")
+assert not resp.allowed
+
+# Typed status mapping (proto footer): n=0 -> INVALID_ARGUMENT.
+try:
+    AllowN(pb2.AllowNRequest(key="user:1", n=0))
+    raise AssertionError("n=0 must be INVALID_ARGUMENT")
+except grpc.RpcError as e:
+    print(f"n=0 -> {e.code().name}")
+    assert e.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+h = Health(pb2.HealthRequest())
+print(f"Health: serving={h.serving}")
+
+channel.close()
+server.send_signal(signal.SIGTERM)
+assert server.wait(timeout=15) == 0
+print("OK — for the two-pod (DCN + HTTP + shards) topology, run "
+      "deployments/two_pod_local.sh")

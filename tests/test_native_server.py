@@ -7,7 +7,6 @@ from __future__ import annotations
 import threading
 from contextlib import contextmanager
 
-import numpy as np
 import pytest
 
 from ratelimiter_tpu import (
