@@ -16,7 +16,7 @@ B. Accuracy at the benched operating point: the SAME trace stream is decided
    steady-state error).
 C. Serving shape: ingest batches of 4096 (BASELINE config 3) coalesced
    64-at-a-time into one device dispatch via the lax.scan runner
-   (ops/sketch_kernels.build_scan), 32 dispatches pipelined per sync.
+   (ops/sketch_kernels.build_scan), 128 dispatches pipelined per sync.
    Measured at BOTH sizing doctrines and labeled as such in the JSON:
    the LITERAL config-3 geometry (d=4 w=65536 — the spec'd shape) is
    the headline ``serving_decisions_per_sec``; the wide accuracy-
@@ -185,10 +185,14 @@ def main() -> None:
 
     # ---------------------------------------------- phase C: serving shape
     # K pipelined dispatches per sync: r4 used K=8 and the sync overhead
-    # alone kept the captured number at 7.7M/s (469 us/step vs 333 at
-    # K=32 on the same kernels) — the ceiling was always there, the
-    # harness just didn't amortize the tunnel sync.
-    K = 32
+    # alone kept the captured number at 7.7M/s (469 us/step) on the same
+    # kernels — the ceiling was always there, the harness just didn't
+    # amortize the tunnel sync. Measured (d=4 w=65536, 3 reps): K=32
+    # ~330-390 us/step, K=128 ~281-290 us/step (~14.3M/s, converging on
+    # the 273 us steady-state the config-3 harness measures). CPU smoke
+    # keeps a small K (its ~7 ms/step would make 128 dispatches take
+    # a minute).
+    K = 128 if on_accel else 4
     from ratelimiter_tpu.ops.hashing import split_hash, splitmix64
 
     def serve_shape(scfg, warm_state_roll):
