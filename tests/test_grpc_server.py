@@ -223,3 +223,64 @@ class TestGrpcOnServerBinary:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+    def test_grpc_on_native_sharded_door(self):
+        """--native --shards 2 --grpc-port: gRPC decisions route through
+        the same FNV shard router as binary traffic, so one key has ONE
+        quota across both surfaces (the ADVICE r4 composition fix,
+        exercised end to end on the real binary)."""
+        import os
+        import signal as sig
+        import subprocess
+        import sys
+
+        from ratelimiter_tpu.serving import Client
+        from ratelimiter_tpu.serving.native_server import (
+            native_server_available,
+        )
+
+        if not native_server_available():
+            pytest.skip("needs g++ for the native server")
+        pb2 = _load_pb2()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        env["JAX_PLATFORMS"] = "cpu"
+
+        port, grpc_port = free_port(), free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "sketch", "--algorithm", "sliding_window",
+             "--limit", "4", "--window", "60",
+             "--sketch-depth", "3", "--sketch-width", "256",
+             "--no-prewarm", "--native", "--shards", "2",
+             "--port", str(port), "--grpc-port", str(grpc_port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            for _ in range(20):
+                line = proc.stdout.readline()
+                if line.startswith("serving"):
+                    break
+            assert "grpc:" in line, line
+            channel = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+            stub = _stub(channel, pb2)
+            with Client(port=port, timeout=30.0) as c:
+                # Keys spanning both shards; half the quota per surface.
+                for k in ("mix0", "mix1", "mix2", "mix3"):
+                    assert c.allow_n(k, 2).allowed
+                    assert stub.AllowN(
+                        pb2.AllowNRequest(key=k, n=2)).allowed
+                    assert not c.allow(k).allowed          # binary sees 4/4
+                    assert not stub.Allow(
+                        pb2.AllowRequest(key=k)).allowed   # so does gRPC
+                    stub.Reset(pb2.ResetRequest(key=k))    # routed reset
+                    assert c.allow(k).allowed
+            channel.close()
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
