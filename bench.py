@@ -56,6 +56,7 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_enable_x64", True)
 # Persistent compile cache (shared with benchmarks/ and the serving tier):
 # first run pays each compile once; re-runs start hot.
 _cache = os.environ.get("RATELIMITER_TPU_COMPILE_CACHE",

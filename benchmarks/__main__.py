@@ -21,6 +21,7 @@ import jax
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+jax.config.update("jax_enable_x64", True)
 # Persistent compile cache: the matrix touches many (shape, algo, backend)
 # cells; caching makes re-runs cheap (first run pays each compile once).
 _cache = os.environ.get("RATELIMITER_TPU_COMPILE_CACHE",

@@ -394,6 +394,7 @@ import json, os, time
 import numpy as np
 import jax
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 from ratelimiter_tpu import Algorithm, Config, ManualClock, SketchParams
 from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
 

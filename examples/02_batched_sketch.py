@@ -4,6 +4,10 @@ Constant memory in key cardinality; one device dispatch per batch.
 (Runs on whatever JAX backend is available — CPU works.)
 """
 
+import jax
+
+jax.config.update("jax_enable_x64", True)  # device backends need int64 state math
+
 import numpy as np
 
 from ratelimiter_tpu import Algorithm, Config, SketchParams, create_limiter

@@ -1,5 +1,9 @@
 """Decorators + checkpoint/restore in one flow."""
 
+import jax
+
+jax.config.update("jax_enable_x64", True)  # device backends need int64 state math
+
 import tempfile
 
 from ratelimiter_tpu import Algorithm, Config, ManualClock, create_limiter

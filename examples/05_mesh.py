@@ -13,6 +13,8 @@ if os.environ.get("JAX_PLATFORMS"):
 
 import jax
 
+jax.config.update("jax_enable_x64", True)
+
 if len(jax.devices()) < 2:
     print("SKIP: need >= 2 devices (see module docstring)")
     raise SystemExit(0)

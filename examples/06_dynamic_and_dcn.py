@@ -1,5 +1,9 @@
 """Dynamic limit updates + cross-region (DCN) slab exchange."""
 
+import jax
+
+jax.config.update("jax_enable_x64", True)  # device backends need int64 state math
+
 from ratelimiter_tpu import Algorithm, Config, ManualClock, SketchParams, create_limiter
 from ratelimiter_tpu.parallel import DcnMirrorGroup
 

@@ -33,7 +33,13 @@ server = subprocess.Popen(
      # curl-able surface); this demo token-gates it.
      "--http-port", str(http_port), "--http-reset-token", "demo-token"],
     env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-print(server.stdout.readline().strip())
+# Log lines (stderr) interleave before the ready banner — wait for the
+# banner itself, or the first request races the gateway's bind.
+for _ in range(50):
+    line = server.stdout.readline().strip()
+    print(line)
+    if line.startswith("serving"):
+        break
 
 base = f"http://127.0.0.1:{http_port}"
 for i in range(3):

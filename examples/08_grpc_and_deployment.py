@@ -18,7 +18,7 @@ try:
 except ImportError:
     grpc_available = lambda: False  # noqa: E731
 if not grpc_available():
-    print("grpcio/protoc unavailable — skipping (the binary protocol and "
+    print("SKIP: grpcio/protoc unavailable (the binary protocol and "
           "HTTP gateway serve the same contract)")
     sys.exit(0)
 

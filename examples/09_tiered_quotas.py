@@ -1,0 +1,54 @@
+"""Tiered quotas with the policy engine: free/pro/enterprise keys with
+different limits, decided together in single fused batches.
+
+The reference documents this pattern as "run one limiter per tier and
+route keys yourself" (its docs/EXAMPLES.md tiered-quota section). Here
+tiers are per-key overrides in a device-resident policy table, resolved
+by a vectorized binary search INSIDE the decision step — one limiter,
+one dispatch per batch, any mix of tiers.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # device backends need int64 state math
+
+import numpy as np
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, create_limiter
+
+clock = ManualClock(1_700_000_000.0)
+cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=5, window=60.0)  # free tier
+lim = create_limiter(cfg, backend="sketch", clock=clock)
+
+# -- tier table: overrides pin ABSOLUTE limits per key ----------------
+lim.set_override("pro:alice", 20)
+lim.set_override("ent:acme", 100)
+print(f"overrides live: {lim.override_count()} "
+      f"({[(k, ov.limit) for k, ov in lim.list_overrides()]})")
+
+# -- one mixed batch, every key decided against its OWN limit ---------
+batch = (["free:bob"] * 8      # free tier: 5 admitted
+         + ["pro:alice"] * 25  # pro tier: 20 admitted
+         + ["ent:acme"] * 40)  # enterprise: all 40 admitted (of 100)
+out = lim.allow_batch(batch)
+free = int(np.sum(out.allowed[:8]))
+pro = int(np.sum(out.allowed[8:33]))
+ent = int(np.sum(out.allowed[33:]))
+print(f"free:bob {free}/8  pro:alice {pro}/25  ent:acme {ent}/40")
+assert (free, pro, ent) == (5, 20, 40)
+
+# Results carry the key's effective limit (X-RateLimit-Limit material).
+assert out.results()[10].limit == 20
+
+# -- downgrades apply immediately; deletes return to the default ------
+lim.set_override("pro:alice", 10)   # already consumed 20 -> denied now
+assert not lim.allow("pro:alice").allowed
+lim.delete_override("ent:acme")
+assert lim.get_override("ent:acme") is None
+
+# Over a running server the same management surface is:
+#   POST/GET/DELETE /v1/policy?key=K&limit=N  (HTTP, bearer-gated)
+#   SetOverride / GetOverride / DeleteOverride (gRPC)
+#   set_override / get_override / delete_override (binary protocol client)
+lim.close()
+print("OK")

@@ -93,14 +93,20 @@ class RateLimiter(abc.ABC):
         Semantics: takes effect for every subsequent decision; quota
         already consumed stands. For the token bucket the refill rate
         (limit/window) and capacity both change; stored levels clamp to
-        the new capacity lazily on each key's next refill."""
+        the new capacity lazily on each key's next refill. Policy
+        overrides pin ABSOLUTE limits, so only non-overridden keys move."""
         from dataclasses import replace
 
         self._check_open()
         new_cfg = replace(self.config, limit=new_limit)
         new_cfg.validate()
+        table = getattr(self, "_policy_table", None)
+        if table is not None:
+            table.validate_rebase(new_cfg.limit, new_cfg.window)
         self._apply_config(new_cfg)
         self.config = new_cfg
+        if table is not None:
+            table.rebase(new_cfg.limit, new_cfg.window)
 
     def update_window(self, new_window: float) -> None:
         """Change the window without losing state (the other half of the
@@ -117,10 +123,24 @@ class RateLimiter(abc.ABC):
         self._check_open()
         from dataclasses import replace
 
+        table = getattr(self, "_policy_table", None)
+        if table is not None and table.has_window_scaled:
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                "update_window with window-scaled overrides present is not "
+                "supported (per-key grids cannot be re-bucketed uniformly); "
+                "delete the scaled overrides first")
         new_cfg = replace(self.config, window=float(new_window))
         new_cfg.validate()
+        if table is not None:
+            # BEFORE migrating state: an entry the backend cannot decide
+            # exactly under the new window is refused up front.
+            table.validate_rebase(new_cfg.limit, new_cfg.window)
         self._apply_window(new_cfg)
         self.config = new_cfg
+        if table is not None:
+            table.rebase(new_cfg.limit, new_cfg.window)
 
     def _apply_window(self, new_cfg: Config) -> None:
         """Backend hook: migrate state onto the new window geometry."""
@@ -167,6 +187,93 @@ class RateLimiter(abc.ABC):
         t = self.clock.now() if now is None else float(now)
         return self._allow_batch(list(keys), ns_arr, t)
 
+    # -- policy engine (tiered per-key overrides) --------------------------
+    #
+    # Backends that support overrides own a ``_policy_table``
+    # (ratelimiter_tpu/policy/table.py) consulted INSIDE their decision
+    # step; these methods are the uniform management surface every serving
+    # front door (binary protocol, HTTP /v1/policy, gRPC) routes through.
+    # Decorators inherit them and reach the backend's table via attribute
+    # delegation.
+
+    def _policy(self):
+        table = getattr(self, "_policy_table", None)
+        if table is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support per-key overrides")
+        return table
+
+    def _policy_gauge(self, table) -> None:
+        from ratelimiter_tpu.observability import metrics as m
+
+        m.DEFAULT.gauge(
+            "rate_limiter_policy_overrides",
+            "Live per-key overrides in the policy table (occupancy; "
+            "capacity is PolicySpec.capacity)").set(float(len(table)))
+
+    def set_override(self, key: str, limit: Optional[int] = None, *,
+                     window_scale: float = 1.0):
+        """Give ``key`` its own limit (and, on backends with per-key
+        windows, a window multiplier). Takes effect for every subsequent
+        decision, including ones in the same batch as default keys —
+        resolution happens inside the fused device step. Consumed quota
+        stands; a raised limit frees headroom immediately, a lowered one
+        denies until usage drains. Returns the stored Override."""
+        self._check_open()
+        check_key(key)
+        table = self._policy()
+
+        def _mutate():
+            ov = table.set(key, limit, window_scale)
+            hook = getattr(self, "_policy_changed", None)
+            if hook is not None:
+                hook(key)
+            return ov
+
+        ov = self._policy_mutate(_mutate)
+        self._policy_gauge(table)
+        return ov
+
+    def get_override(self, key: str):
+        """The Override stored for key, or None (default tier)."""
+        self._check_open()
+        check_key(key)
+        return self._policy().get(key)
+
+    def delete_override(self, key: str) -> bool:
+        """Return key to the default tier. True iff an override existed."""
+        self._check_open()
+        check_key(key)
+        table = self._policy()
+
+        def _mutate():
+            existed = table.delete(key)
+            hook = getattr(self, "_policy_changed", None)
+            if existed and hook is not None:
+                hook(key)
+            return existed
+
+        existed = self._policy_mutate(_mutate)
+        self._policy_gauge(table)
+        return existed
+
+    def list_overrides(self):
+        """All (key, Override) pairs, sorted by key."""
+        self._check_open()
+        return self._policy().items()
+
+    def override_count(self) -> int:
+        return len(self._policy())
+
+    def _policy_mutate(self, fn):
+        """Run a table mutation under the backend's lock when it has one
+        (mutations race with dispatch snapshots otherwise)."""
+        lock = getattr(self, "_lock", None)
+        if lock is None:
+            return fn()
+        with lock:
+            return fn()
+
     # -- implementation hooks ---------------------------------------------
 
     @abc.abstractmethod
@@ -182,12 +289,15 @@ class RateLimiter(abc.ABC):
         """Default: sequential scalar calls (exact). Device backends override
         with a single fused dispatch."""
         results = [self._allow_n(k, int(n), now) for k, n in zip(keys, ns)]
+        limits = np.array([r.limit for r in results], dtype=np.int64)
         return BatchResult(
             allowed=np.array([r.allowed for r in results], dtype=bool),
             limit=self.config.limit,
             remaining=np.array([r.remaining for r in results], dtype=np.int64),
             retry_after=np.array([r.retry_after for r in results], dtype=np.float64),
             reset_at=np.array([r.reset_at for r in results], dtype=np.float64),
+            limits=(limits if bool(np.any(limits != self.config.limit))
+                    else None),
         )
 
     def _check_open(self) -> None:

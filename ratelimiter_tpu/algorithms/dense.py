@@ -66,6 +66,47 @@ class DenseLimiter(RateLimiter):
         self._last_used = np.zeros(self._capacity, dtype=np.int64)  # us
         self._lock = threading.Lock()
         self._injected_failure: Optional[Exception] = None
+        # Policy engine: overrides resolved in-kernel (binary search over
+        # the device-resident table, ops/policy_kernels.py). Entries are
+        # re-gated through the same overflow checks as the base config.
+        from ratelimiter_tpu.ops.dense_kernels import check_gate_values
+        from ratelimiter_tpu.policy import PolicyTable
+
+        self._policy_table = PolicyTable(
+            self.config, key_fn=self._policy_key,
+            validator=lambda lim, w_us: check_gate_values(lim, w_us),
+            window_scaling=True)
+        self._policy_dev = None
+        self._policy_dev_version = -1
+
+    def _policy_key(self, key: str) -> int:
+        from ratelimiter_tpu.ops.hashing import hash_strings_u64
+
+        h = hash_strings_u64([self.config.format_key(key)])
+        return int(h.view(np.int64)[0])
+
+    def _policy_device(self):
+        """Device copy of the override table, rebuilt when the host table's
+        version moved. Lock must be held."""
+        import jax.numpy as jnp
+
+        t = self._policy_table
+        if self._policy_dev is None or self._policy_dev_version != t.version:
+            self._policy_dev = {k: jnp.asarray(v)
+                                for k, v in t.host_arrays().items()}
+            self._policy_dev_version = t.version
+        return self._policy_dev
+
+    def _policy_changed(self, key: str) -> None:
+        """Reset the key's refill remainder: it is denominated in the key's
+        (old) rate fraction. Forfeits < 1 micro-token, toward denying.
+        Lock held by the caller."""
+        if self.config.algorithm is not Algorithm.TOKEN_BUCKET:
+            return
+        slot = self._slots.get(self.config.format_key(key))
+        if slot is not None and "rem" in self._state:
+            self._state = dict(
+                self._state, rem=self._state["rem"].at[slot].set(0))
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit: swap in the step compiled for the new limit
@@ -106,14 +147,18 @@ class DenseLimiter(RateLimiter):
 
         from ratelimiter_tpu.ops import dense_kernels
 
-        W_old = self._window_us
         W_new = to_micros(new_cfg.window)
-        now_us = to_micros(self.clock.now())
-        cur_old = (now_us // W_old) * W_old
-        p_now = now_us // W_new
-        new_start = p_now * W_new
         new_step = dense_kernels.build_step(new_cfg)
         with self._lock:
+            # Grid anchors INSIDE the lock: sampling the clock before
+            # acquiring it races a concurrent dispatch's window roll, and
+            # the migration would then re-bucket against a stale "current
+            # window" (over-admission; advisor round-5 finding).
+            W_old = self._window_us
+            now_us = to_micros(self.clock.now())
+            cur_old = (now_us // W_old) * W_old
+            p_now = now_us // W_new
+            new_start = p_now * W_new
             self._step = new_step
             algo = self.config.algorithm
             if algo is Algorithm.FIXED_WINDOW:
@@ -217,6 +262,8 @@ class DenseLimiter(RateLimiter):
     def _dispatch(self, keys: List[str], ns: np.ndarray, now: float) -> BatchResult:
         import jax.numpy as jnp
 
+        from ratelimiter_tpu.ops.hashing import hash_strings_u64
+
         now_us = to_micros(now)
         with self._lock:
             if self._injected_failure is not None:
@@ -228,27 +275,29 @@ class DenseLimiter(RateLimiter):
             n_arr = np.zeros(padded, dtype=np.int64)
             sid_arr[:b] = sids
             n_arr[:b] = ns
-            self._state, (allowed, remaining, retry_us) = self._step(
+            # Policy search keys: only worth hashing when overrides exist
+            # (an all-zero query vector misses the padded table anyway).
+            keyq = np.zeros(padded, dtype=np.int64)
+            limits_arr = None
+            if len(self._policy_table):
+                h64 = hash_strings_u64(
+                    [self.config.format_key(k) for k in keys])
+                keyq[:b] = h64.view(np.int64)
+                limits_arr = self._policy_table.limits_for(keyq[:b])
+            self._state, (allowed, remaining, retry_us, reset_us) = self._step(
                 self._state, jnp.asarray(sid_arr), jnp.asarray(n_arr),
-                jnp.int64(now_us))
+                jnp.int64(now_us), self._policy_device(), jnp.asarray(keyq))
         allowed = np.asarray(allowed)[:b]
         remaining = np.asarray(remaining)[:b]
         retry_us = np.asarray(retry_us)[:b]
-
-        if self.config.algorithm is Algorithm.TOKEN_BUCKET:
-            # reset_at = now + window (full-fill approximation, §2.4.6).
-            reset_at = (now_us + self._window_us) / MICROS
-            retry = retry_us / MICROS
-        else:
-            cur_ws = (now_us // self._window_us) * self._window_us
-            reset_at = (cur_ws + self._window_us) / MICROS
-            retry = np.where(allowed, 0.0, (cur_ws + self._window_us - now_us) / MICROS)
+        reset_us = np.asarray(reset_us)[:b]
         return BatchResult(
             allowed=allowed,
             limit=self.config.limit,
             remaining=np.maximum(remaining, 0),
-            retry_after=np.asarray(retry, dtype=np.float64),
-            reset_at=np.full(b, reset_at, dtype=np.float64),
+            retry_after=(retry_us / MICROS).astype(np.float64),
+            reset_at=(reset_us / MICROS).astype(np.float64),
+            limits=limits_arr,
         )
 
     def _allow_batch(self, keys: list, ns: np.ndarray, now: float) -> BatchResult:
@@ -300,6 +349,7 @@ class DenseLimiter(RateLimiter):
             arrays["slot_ids"] = np.array(list(self._slots.values()),
                                           dtype=np.int32)
             arrays["last_used"] = self._last_used.copy()
+            arrays.update(self._policy_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now(), "capacity": self._capacity}
         save_state(path, "dense", self.config, arrays, extra)
 
@@ -319,6 +369,8 @@ class DenseLimiter(RateLimiter):
             raise CheckpointError(
                 f"{path}: snapshot capacity {meta.get('capacity')} != "
                 f"limiter capacity {self._capacity}")
+        with self._lock:
+            self._policy_table.restore_arrays(arrays)  # pops policy_* columns
         state_keys = {f"state_{k}" for k in self._state}
         expected = state_keys | {"slot_keys", "slot_ids", "last_used"}
         if set(arrays) != expected:

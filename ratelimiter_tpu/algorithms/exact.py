@@ -74,6 +74,41 @@ class ExactLimiter(RateLimiter):
         self._sw: Dict[str, Tuple[int, int, int]] = {}
         # token bucket: formatted key -> (tokens_micro, refill_remainder, last_us)
         self._tb: Dict[str, Tuple[int, int, int]] = {}
+        # Policy engine: host-side consult (this backend IS the oracle the
+        # device backends' in-kernel lookup is measured against). The key
+        # domain matches the dense backend's so cross-backend tests cover
+        # the same hash path.
+        from ratelimiter_tpu.policy import PolicyTable
+
+        self._policy_table = PolicyTable(
+            self.config, key_fn=self._policy_key, window_scaling=True)
+
+    def _policy_key(self, key: str) -> int:
+        import numpy as np
+
+        from ratelimiter_tpu.ops.hashing import hash_strings_u64
+
+        h = hash_strings_u64([self.config.format_key(key)])
+        return int(h.view(np.int64)[0])
+
+    def _policy_changed(self, key: str) -> None:
+        """An override mutation re-denominates the key's token-bucket
+        refill remainder (it carries sub-micro-token credit in units of
+        the key's rate fraction): reset it — forfeits < 1 micro-token,
+        toward denying. Lock held by the caller."""
+        fkey = self.config.format_key(key)
+        if fkey in self._tb:
+            tokens, _rem, last = self._tb[fkey]
+            self._tb[fkey] = (tokens, 0, last)
+
+    def _eff(self, key: str) -> Tuple[int, int, int, int]:
+        """(limit, window_us, rate_num, rate_den) for key — the override
+        entry when present, the config defaults otherwise."""
+        eff = self._policy_table.effective(key)
+        if eff is None:
+            return (self.config.limit, self._window_us,
+                    self._rate_num, self._rate_den)
+        return eff
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit. The cross-backend contract (pinned in
@@ -106,13 +141,18 @@ class ExactLimiter(RateLimiter):
         stand and the sub-micro-token remainder (denominated in the old
         rate fraction) resets — forfeits < 1 micro-token, toward
         denying."""
-        W_old = self._window_us
         W_new = to_micros(new_cfg.window)
-        now_us = to_micros(self.clock.now())
-        cur_old = (now_us // W_old) * W_old
-        p_now = now_us // W_new
-        new_start = p_now * W_new
         with self._lock:
+            # The grid anchors (now / cur_old / p_now) are computed INSIDE
+            # the lock: sampling them outside raced concurrent decisions —
+            # a decision could roll a key's window against the live clock
+            # after we snapshotted an older "current window", making the
+            # migration misclassify that key's buckets (over-admission).
+            W_old = self._window_us
+            now_us = to_micros(self.clock.now())
+            cur_old = (now_us // W_old) * W_old
+            p_now = now_us // W_new
+            new_start = p_now * W_new
             # Fixed window: the live old window's span always reaches
             # into the current new-grid window (now < cur_old + W_old),
             # so live counts carry; stale entries drop.
@@ -184,22 +224,24 @@ class ExactLimiter(RateLimiter):
     def _fixed_window(self, key: str, n: int, now_us: int) -> Result:
         """Reference ``fixedwindow.go:65-115``: counter per (key, window
         start); windows wall-clock aligned via truncation (§2.4.14); allow iff
-        count + n <= limit (conditional consume, see module docstring)."""
+        count + n <= limit (conditional consume, see module docstring).
+        Limit and window come from the policy table when key carries an
+        override — a window-scaled key lives on its OWN wall-clock grid."""
         cfg = self.config
-        W = self._window_us
+        limit, W, _, _ = self._eff(key)
         window_start = (now_us // W) * W
         fkey = cfg.format_key(key)
         start, count = self._fw.get(fkey, (window_start, 0))
         if start != window_start:
             count = 0  # lazy window roll — the analog of the FW key TTL
         reset_at = (window_start + W) / MICROS
-        if count + n <= cfg.limit:
+        if count + n <= limit:
             count += n
             self._fw[fkey] = (window_start, count)
-            return allowed_result(cfg.limit, cfg.limit - count, reset_at)
+            return allowed_result(limit, limit - count, reset_at)
         self._fw[fkey] = (window_start, count)
         retry = (window_start + W - now_us) / MICROS
-        return denied_result(cfg.limit, cfg.limit - count, retry, reset_at)
+        return denied_result(limit, limit - count, retry, reset_at)
 
     def _sliding_window(self, key: str, n: int, now_us: int) -> Result:
         """Reference ``slidingwindow.go:68-122``: weighted two-window count
@@ -209,7 +251,7 @@ class ExactLimiter(RateLimiter):
         the consume here are one atomic step. All math is window_us-scaled
         integers (module docstring)."""
         cfg = self.config
-        W = self._window_us
+        limit, W, _, _ = self._eff(key)
         curr_start = (now_us // W) * W
         fkey = cfg.format_key(key)
         start, curr, prev = self._sw.get(fkey, (curr_start, 0, 0))
@@ -220,15 +262,15 @@ class ExactLimiter(RateLimiter):
                 prev, curr = 0, 0        # idle > one window: both expired
         elapsed = now_us - curr_start
         # weighted * W == prev*(W-elapsed) + curr*W ; free * W as below.
-        free_scaled = cfg.limit * W - prev * (W - elapsed) - curr * W
+        free_scaled = limit * W - prev * (W - elapsed) - curr * W
         reset_at = (curr_start + W) / MICROS
         if n * W <= free_scaled:
             curr += n
             self._sw[fkey] = (curr_start, curr, prev)
-            return allowed_result(cfg.limit, (free_scaled - n * W) // W, reset_at)
+            return allowed_result(limit, (free_scaled - n * W) // W, reset_at)
         self._sw[fkey] = (curr_start, curr, prev)
         retry = (curr_start + W - now_us) / MICROS
-        return denied_result(cfg.limit, free_scaled // W, retry, reset_at)
+        return denied_result(limit, free_scaled // W, retry, reset_at)
 
     def _token_bucket(self, key: str, n: int, now_us: int) -> Result:
         """Reference Lua ``tokenbucket.go:23-52``: lazy continuous refill
@@ -241,12 +283,12 @@ class ExactLimiter(RateLimiter):
         ``elapsed*num + rem`` micro-token-numerator units accrue, with the
         remainder carried per key (zero drift, module docstring)."""
         cfg = self.config
-        cap = cfg.limit * MICROS
-        num, den = self._rate_num, self._rate_den
+        limit, W, num, den = self._eff(key)
+        cap = limit * MICROS
         fkey = cfg.format_key(key)
         tokens, rem, last = self._tb.get(fkey, (cap, 0, now_us))
         elapsed = max(0, now_us - last)
-        if elapsed >= self._window_us:
+        if elapsed >= W:
             tokens, rem = cap, 0
         else:
             acc = elapsed * num + rem
@@ -257,17 +299,17 @@ class ExactLimiter(RateLimiter):
         # Reference reset_at approximation: now + time to fill the whole
         # bucket from empty, regardless of level (``tokenbucket.go:161-165``)
         # == now + window.
-        reset_at = (now_us + self._window_us) / MICROS
+        reset_at = (now_us + W) / MICROS
         need = n * MICROS
         if tokens >= need:
             tokens -= need
             self._tb[fkey] = (tokens, rem, now_us)
-            return allowed_result(cfg.limit, tokens // MICROS, reset_at)
+            return allowed_result(limit, tokens // MICROS, reset_at)
         self._tb[fkey] = (tokens, rem, now_us)
         # Reference ``tokenbucket.go:122-130``: time for the deficit to refill
         # (ceil so that retrying exactly then succeeds).
         retry_us = -((need - tokens) * den // -num)  # ceil division
-        return denied_result(cfg.limit, tokens // MICROS, retry_us / MICROS, reset_at)
+        return denied_result(limit, tokens // MICROS, retry_us / MICROS, reset_at)
 
     # ------------------------------------------------------------------ reset
 
@@ -324,6 +366,7 @@ class ExactLimiter(RateLimiter):
                 arrays[f"{name}_keys"] = np.array(list(d.keys()), dtype=str)
                 arrays[f"{name}_vals"] = (
                     np.array(list(d.values()), dtype=np.int64).reshape(-1, width))
+            arrays.update(self._policy_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now()}
         save_state(path, "exact", self.config, arrays, extra)
 
@@ -335,6 +378,7 @@ class ExactLimiter(RateLimiter):
         self._check_open()
         arrays, _meta = load_state(path, "exact", self.config)
         with self._lock:
+            self._policy_table.restore_arrays(arrays)
             self._fw = {str(k): tuple(int(x) for x in v)
                         for k, v in zip(arrays["fw_keys"], arrays["fw_vals"])}
             self._sw = {str(k): tuple(int(x) for x in v)

@@ -74,6 +74,59 @@ class SketchLimiter(RateLimiter):
         self._period_mass: dict = {}
         self._warned_period = -1
         self.overload_periods = 0
+        self._init_policy()
+
+    # ------------------------------------------------------------- policy
+
+    def _init_policy(self) -> None:
+        """Per-key limit overrides, resolved in-kernel. The search key is
+        the (h1, h2) packing the CMS columns ride on; window scaling is
+        impossible on a shared ring geometry, so only limits override."""
+        from ratelimiter_tpu.policy import PolicyTable
+
+        self._policy_table = PolicyTable(
+            self.config, key_fn=self._policy_key,
+            validator=self._policy_validate, window_scaling=False)
+        self._policy_dev = None
+        self._policy_dev_version = -1
+
+    def _policy_validate(self, limit: int, _window_us: int) -> None:
+        if limit >= (1 << 24):
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"sketch backends require override limits < 2**24 "
+                f"(f32-exact admission), got {limit}")
+
+    def _policy_key(self, key: str) -> int:
+        from ratelimiter_tpu.ops.policy_kernels import pack_halves_host
+
+        h64 = self._hash([key])
+        h1, h2 = split_hash(h64, self._seed)
+        return int(pack_halves_host(h1, h2)[0])
+
+    def _policy_device(self):
+        """Replicated device copy of the override table (key + limit
+        columns). Lock must be held; rebuilt when the table version moved."""
+        t = self._policy_table
+        if self._policy_dev is None or self._policy_dev_version != t.version:
+            host = t.host_arrays()
+            self._policy_dev = {
+                "key": self._place_replicated(host["key"]),
+                "limit": self._place_replicated(host["limit"]),
+            }
+            self._policy_dev_version = t.version
+        return self._policy_dev
+
+    def _policy_limits(self, h64: np.ndarray):
+        """Host-side per-request effective limits for result assembly
+        (None when no override matches)."""
+        if not len(self._policy_table):
+            return None
+        from ratelimiter_tpu.ops.policy_kernels import pack_halves_host
+
+        h1, h2 = split_hash(np.asarray(h64, np.uint64), self._seed)
+        return self._policy_table.limits_for(pack_halves_host(h1, h2))
 
     def _sync_period(self, now_us: int) -> None:
         """Dispatch the rollover kernel if now_us entered a new sub-window.
@@ -133,8 +186,12 @@ class SketchLimiter(RateLimiter):
                 return self._deny_all(b, now_us)
             self._state, outs = self._step(
                 self._state, self._place(h1p), self._place(h2p),
-                self._place(np_ns), jnp.int64(now_us))
-        res = self._finish(outs, b, now_us)
+                self._place(np_ns), jnp.int64(now_us),
+                self._policy_device())
+            # Inside the lock: a concurrent set/delete_override rebuilds
+            # the table's sorted views, and a torn read would mis-index.
+            limits = self._policy_limits(h64)
+        res = self._finish(outs, b, now_us, limits=limits)
         self._note_mass(int(np_ns[:b][res.allowed].sum()), now_us)
         return res
 
@@ -223,7 +280,7 @@ class SketchLimiter(RateLimiter):
     def mass_budget(self) -> int:
         return self._mass_budget
 
-    def _finish(self, outs, b: int, now_us: int) -> BatchResult:
+    def _finish(self, outs, b: int, now_us: int, limits=None) -> BatchResult:
         """Window-algorithm result assembly: retry-after is time to window
         reset (``fixedwindow.go:107-112``). The token-bucket subclass
         overrides with device-computed deficit/rate retry."""
@@ -240,6 +297,7 @@ class SketchLimiter(RateLimiter):
             remaining=remaining.astype(np.int64),
             retry_after=retry.astype(np.float64),
             reset_at=np.full(b, reset_at, dtype=np.float64),
+            limits=limits,
         )
 
     def allow_hashed(self, h64: np.ndarray, ns: Optional[np.ndarray] = None,
@@ -366,6 +424,7 @@ class SketchLimiter(RateLimiter):
         self._check_open()
         with self._lock:
             arrays = {k: np.asarray(v) for k, v in self._state.items()}
+            arrays.update(self._policy_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now()}
             hp = getattr(self, "_host_period", None)
             if hp is not None:
@@ -383,6 +442,10 @@ class SketchLimiter(RateLimiter):
         self._check_open()
         arrays, meta = load_state(path, self._CKPT_KIND, self.config)
         with self._lock:
+            # Overrides ride the snapshot (policy_* columns; absent in
+            # older checkpoints -> empty table).
+            self._policy_table.restore_arrays(arrays)
+            self._policy_dev = None
             # Arrays added in later releases may default when absent from
             # an older checkpoint (each class lists the safe ones).
             for k in self._CKPT_OPTIONAL:
@@ -451,6 +514,17 @@ class SketchTokenBucketLimiter(SketchLimiter):
         # windowed-sketch concept; debt decays continuously (_note_mass).
         self._strict = False
         self._injected_failure: Optional[Exception] = None
+        self._init_policy()
+
+    def _policy_validate(self, limit: int, _window_us: int) -> None:
+        # Batch admission does exact int64 micro-token cumsums; the same
+        # gate as the dense backend's micro-unit accounting.
+        if limit * MICROS >= 2**42:
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"override limit {limit} too large for micro-unit batch "
+                "accounting (>= 2^42/1e6)")
 
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
@@ -510,7 +584,7 @@ class SketchTokenBucketLimiter(SketchLimiter):
             self._window_us = to_micros(new_cfg.window)
             self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
 
-    def _finish(self, outs, b: int, now_us: int) -> BatchResult:
+    def _finish(self, outs, b: int, now_us: int, limits=None) -> BatchResult:
         """Token-bucket result assembly: retry-after = deficit / refill rate
         computed exactly on device (``tokenbucket.go:122-130``); reset_at is
         the reference's approximation now + window (time to refill the whole
@@ -526,6 +600,7 @@ class SketchTokenBucketLimiter(SketchLimiter):
             retry_after=(retry_us / MICROS).astype(np.float64),
             reset_at=np.full(b, (now_us + self._window_us) / MICROS,
                              dtype=np.float64),
+            limits=limits,
         )
 
     # _reset is inherited: the base implementation's _sync_period call is a

@@ -189,6 +189,32 @@ class SketchParams:
 
 
 @dataclass(frozen=True)
+class PolicySpec:
+    """Geometry of the per-key override table (the policy engine,
+    ratelimiter_tpu/policy/).
+
+    ``capacity`` bounds how many keys may carry a tiered override at once.
+    It is a *compiled-shape* parameter: the device-resident override table
+    is a fixed-size sorted array consulted by a vectorized binary search
+    inside every decision step, so capacity participates in the config
+    fingerprint (checkpoints refuse to restore under a different policy
+    geometry). Powers of two keep the branchless binary search exact in
+    ``log2(capacity)`` steps.
+    """
+
+    #: Max simultaneous per-key overrides; power of two. 1024 entries cost
+    #: ~40 KB of device memory — negligible next to any state backend.
+    capacity: int = 1024
+
+    def validate(self) -> None:
+        if (self.capacity < 8 or self.capacity > (1 << 20)
+                or (self.capacity & (self.capacity - 1)) != 0):
+            raise InvalidConfigError(
+                f"policy capacity must be a power of two in [8, 2^20], "
+                f"got {self.capacity}")
+
+
+@dataclass(frozen=True)
 class DenseParams:
     """Geometry of the dense (exact, slot-addressed) device backend."""
 
@@ -219,6 +245,8 @@ class Config:
             ops/segment.py).
         sketch: CMS geometry (TPU_SKETCH / sketch backend only).
         dense: dense-store geometry (dense backend only).
+        policy: per-key override table geometry (the policy engine;
+            every backend consults it inside its decision step).
     """
 
     algorithm: Algorithm
@@ -229,6 +257,7 @@ class Config:
     max_batch_admission_iters: int = 4
     sketch: SketchParams = field(default_factory=SketchParams)
     dense: DenseParams = field(default_factory=DenseParams)
+    policy: PolicySpec = field(default_factory=PolicySpec)
 
     def validate(self) -> None:
         """Reference ``Config.Validate`` (``config.go:16-50``), same bounds."""
@@ -249,6 +278,7 @@ class Config:
                 f"got {self.max_batch_admission_iters}")
         self.sketch.validate()
         self.dense.validate()
+        self.policy.validate()
 
     def with_defaults(self) -> "Config":
         """Non-mutating defaulting (reference ``config.go:54-67``): returns a

@@ -94,6 +94,9 @@ class BatchResult:
     retry_after: np.ndarray  # float64[B] seconds, 0 where allowed
     reset_at: np.ndarray     # float64[B] unix seconds
     fail_open: bool = False
+    #: Per-request effective limits when policy overrides touched this
+    #: batch (int64[B]); None means every request saw the uniform `limit`.
+    limits: "np.ndarray | None" = None
 
     def __len__(self) -> int:
         return int(self.allowed.shape[0])
@@ -101,7 +104,8 @@ class BatchResult:
     def result(self, i: int) -> Result:
         return Result(
             allowed=bool(self.allowed[i]),
-            limit=self.limit,
+            limit=(int(self.limits[i]) if self.limits is not None
+                   else self.limit),
             remaining=int(self.remaining[i]),
             retry_after=float(self.retry_after[i]),
             reset_at=float(self.reset_at[i]),

@@ -7,3 +7,26 @@ static shapes, no data-dependent Python control flow, int64 micro-units for
 drift-free token accounting, and sort+segment-scan sequencing so one batch
 behaves like the same requests serialized through Redis.
 """
+
+from __future__ import annotations
+
+
+def ensure_x64() -> None:
+    """The device kernels do exact integer state math in int64 microseconds
+    and micro-tokens; without jax_enable_x64 those arrays silently truncate
+    to int32 and every timestamp/level computation is wrong.
+
+    Importing this library does NOT flip the flag for the whole process
+    (that global would change the dtype semantics of unrelated user JAX
+    code); instead every kernel factory calls this and fails loudly so the
+    embedding process opts in explicitly.
+    """
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "ratelimiter_tpu device backends require 64-bit JAX types: call "
+            "jax.config.update('jax_enable_x64', True) (or set the "
+            "JAX_ENABLE_X64=1 env var) before creating a dense/sketch "
+            "limiter. The exact (host) backend works without it.")
+

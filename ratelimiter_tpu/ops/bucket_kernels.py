@@ -47,13 +47,11 @@ from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 
 from ratelimiter_tpu.core.clock import MICROS
 from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.ops import ensure_x64, policy_kernels
 from ratelimiter_tpu.ops.dense_kernels import _check_gates
 from ratelimiter_tpu.ops.segment import admit
 from ratelimiter_tpu.ops.sketch_kernels import _columns, _pack_bits
@@ -101,13 +99,21 @@ def _decay(state: State, now_us, *, rate_num: int, rate_den: int):
     return decay, acc % rate_den
 
 
-def _bucket_step(state: State, h1, h2, n, now_us, *,
+def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
                  limit: int, rate_num: int, rate_den: int,
                  d: int, w: int, iters: int,
                  axis_name: str | None = None):
     """One batched decision step. Returns (state, (allowed, remaining,
-    retry_us)) — dense_kernels._token_bucket_step's output shape, so the
-    limiter-side retry/reset plumbing is shared."""
+    retry_us)) — the limiter-side retry/reset plumbing is shared with the
+    other sketch paths.
+
+    Policy overrides here change a key's burst CAPACITY (cap = limit_k
+    micro-tokens); the decay rate stays the global limit/window — debt
+    cells are shared by colliding keys, so a per-key decay rate does not
+    exist in this representation. Documented divergence from the
+    token-form backends (whose overrides scale the refill rate too):
+    overridden keys burst to their own limit immediately and refill at
+    the default rate. Errors stay toward denying."""
     decay, rem = _decay(state, now_us, rate_num=rate_num, rate_den=rate_den)
     debt = jnp.maximum(jnp.int64(0), state["debt"] - decay)
 
@@ -117,7 +123,13 @@ def _bucket_step(state: State, h1, h2, n, now_us, *,
         (e_r,) = row_gather((debt[r],), cols[:, r])
         est = e_r if est is None else jnp.minimum(est, e_r)
 
-    cap = limit * MICROS
+    if policy is not None:
+        q = policy_kernels.pack_halves(h1, h2)
+        pidx, pfound = policy_kernels.lookup_i64(policy["key"], q)
+        cap = jnp.where(pfound, policy["limit"][pidx],
+                        jnp.int64(limit)) * MICROS
+    else:
+        cap = limit * MICROS
     avail = jnp.maximum(jnp.int64(0), cap - est)        # micro-tokens
     n_units = n.astype(jnp.int64) * MICROS
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
@@ -195,7 +207,9 @@ def _params(cfg: Config) -> tuple:
 
 
 def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
-    """Returns (step, reset) jitted callables, memoized per static config."""
+    """Returns (step, reset) jitted callables, memoized per static config.
+    ``step`` accepts an optional trailing ``policy`` operand."""
+    ensure_x64()
     limit, num, den, d, w, iters = key = _params(cfg)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
@@ -213,6 +227,7 @@ def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
 
 def build_scan(cfg: Config) -> Callable:
     """Jitted multi-step runner, one dispatch for T batches (bench shape)."""
+    ensure_x64()
     limit, num, den, d, w, iters = key = _params(cfg)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:

@@ -23,9 +23,21 @@ the host):
 * token bucket:  tokens:int64[C+1] (micro-tokens), rem:int64[C+1]
                  (refill remainder), last:int64[C+1] (us)
 
-Each step returns (new_state, outputs) where outputs are per-request
-(allowed, remaining, retry_us); retry_us is 0 for the window algorithms
-(their retry-after is the scalar time-to-window-reset, computed on the host).
+Per-key policy overrides (ratelimiter_tpu/policy/): each step optionally
+takes ``(policy, keyq)`` — the device-resident sorted override table and
+the batch's int64 search keys. A vectorized binary search
+(ops/policy_kernels.lookup_i64) resolves each request's effective
+(limit, window, refill rate) INSIDE the fused step, so mixed
+default/override batches still cost one dispatch. With ``policy=None``
+the compiled graph is identical to the pre-policy kernels. Because
+windows become per-request, retry/reset leave the host: each step
+returns (new_state, (allowed, remaining, retry_us, reset_us)) with
+reset_us the absolute reset/refill timestamp.
+
+Exact integer state math needs real int64 (microsecond timestamps and
+micro-token levels exceed int32): every factory calls ops.ensure_x64()
+and refuses to build without jax_enable_x64 — the flag is the embedding
+process's to set, never flipped at import time (a test pins that).
 """
 
 from __future__ import annotations
@@ -35,45 +47,67 @@ from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax
-
-# Exact integer state math needs real int64 (microsecond timestamps and
-# micro-token levels exceed int32). Enabled once, at first import of a device
-# backend; hot-path sketch kernels pick explicit narrow dtypes so they do not
-# pay for this default.
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 
 from ratelimiter_tpu.core.clock import MICROS, to_micros
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import InvalidConfigError
 from ratelimiter_tpu.core.types import Algorithm
+from ratelimiter_tpu.ops import ensure_x64, policy_kernels
 from ratelimiter_tpu.ops.segment import admit
 
 State = Dict[str, jnp.ndarray]
-Outputs = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # allowed, remaining, retry_us
+#: allowed, remaining, retry_us, reset_us (per request)
+Outputs = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
 
-def _check_gates(cfg: Config) -> tuple[int, int, int]:
-    """Overflow gates for the exact-integer paths. Returns
-    (window_us, rate_num, rate_den)."""
-    W = to_micros(cfg.window)
-    g = math.gcd(cfg.limit * MICROS, W)
-    num, den = cfg.limit * MICROS // g, W // g
+def _resolve(policy, keyq, names, defaults):
+    """Per-request effective parameters: ``defaults`` (python ints, baked
+    static) when no policy table rides the dispatch, else the binary-search
+    lookup over the device-resident table for each of ``names``."""
+    if policy is None:
+        return defaults
+    idx, found = policy_kernels.lookup_i64(policy["key"], keyq)
+    return tuple(
+        jnp.where(found, policy[name][idx], jnp.int64(default))
+        for name, default in zip(names, defaults))
+
+
+def _bcast(x, like):
+    """Broadcast a (possibly scalar) time quantity to per-request shape."""
+    return jnp.broadcast_to(jnp.asarray(x, jnp.int64), like.shape)
+
+
+def check_gate_values(limit: int, window_us: int) -> tuple[int, int]:
+    """Overflow gates for the exact-integer paths, for one (limit,
+    window_us) operating point — the base config AND every policy-table
+    override entry must pass (policy/table.py re-runs this per entry, so
+    an override a kernel cannot decide exactly is refused at set time).
+    Returns the reduced refill fraction (rate_num, rate_den)."""
+    W = window_us
+    g = math.gcd(limit * MICROS, W)
+    num, den = limit * MICROS // g, W // g
     # token bucket: elapsed*num + rem with elapsed < W, rem < den
     if W * num >= 2**62:
         raise InvalidConfigError(
             "limit*window too large for exact integer token math "
             f"(window_us*rate_num = {W * num} >= 2^62)")
     # sliding window: counts*(W) terms and the micro-rescale (x % W) * MICROS
-    if cfg.limit * W >= 2**61 or W * MICROS >= 2**63:
+    if limit * W >= 2**61 or W * MICROS >= 2**63:
         raise InvalidConfigError(
             "limit*window too large for exact integer sliding-window math "
-            f"(limit*window_us = {cfg.limit * W} >= 2^61)")
+            f"(limit*window_us = {limit * W} >= 2^61)")
     # admission cumsum: batch_total <= B * limit * MICROS; B <= 2^20 assumed
-    if cfg.limit * MICROS >= 2**42:
+    if limit * MICROS >= 2**42:
         raise InvalidConfigError(
-            f"limit {cfg.limit} too large for micro-unit batch accounting (>= 2^42/1e6)")
+            f"limit {limit} too large for micro-unit batch accounting (>= 2^42/1e6)")
+    return num, den
+
+
+def _check_gates(cfg: Config) -> tuple[int, int, int]:
+    """Config-level gate wrapper. Returns (window_us, rate_num, rate_den)."""
+    W = to_micros(cfg.window)
+    num, den = check_gate_values(cfg.limit, W)
     return W, num, den
 
 
@@ -87,14 +121,17 @@ def _scale_to_micro(x_winscale: jnp.ndarray, window_us: int) -> jnp.ndarray:
 
 # --------------------------------------------------------------- fixed window
 
-def _fixed_window_step(state: State, sid, n, now_us, *, limit, window_us, iters):
-    cur_ws = (now_us // window_us) * window_us
+def _fixed_window_step(state: State, sid, n, now_us, policy=None, keyq=None,
+                       *, limit, window_us, iters):
+    lim, W = _resolve(policy, keyq, ("limit", "window_us"),
+                      (limit, window_us))
+    cur_ws = (now_us // W) * W  # per-request grid when windows are per-key
     count = state["count"][sid]
     stale = state["win_start"][sid] != cur_ws
     count_eff = jnp.where(stale, 0, count)
 
     n_units = n * MICROS
-    avail_units = (limit - count_eff) * MICROS
+    avail_units = (lim - count_eff) * MICROS
     allowed, seen, consumed = admit(sid, n_units, avail_units, iters)
 
     ncap = state["count"].shape[0]
@@ -102,17 +139,21 @@ def _fixed_window_step(state: State, sid, n, now_us, *, limit, window_us, iters)
     delta = jnp.zeros((ncap,), jnp.int64).at[sid].add(consumed)
     new_state = {
         "count": base + delta // MICROS,
-        "win_start": state["win_start"].at[sid].set(cur_ws),
+        "win_start": state["win_start"].at[sid].set(
+            jnp.broadcast_to(cur_ws, count.shape)),
     }
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
-    retry_us = jnp.zeros_like(remaining)
-    return new_state, (allowed, remaining, retry_us)
+    reset_us = _bcast(cur_ws + W, remaining)
+    retry_us = jnp.where(allowed, 0, reset_us - now_us)
+    return new_state, (allowed, remaining, retry_us, reset_us)
 
 
 # ------------------------------------------------------------- sliding window
 
-def _sliding_window_step(state: State, sid, n, now_us, *, limit, window_us, iters):
-    W = window_us
+def _sliding_window_step(state: State, sid, n, now_us, policy=None, keyq=None,
+                         *, limit, window_us, iters):
+    lim, W = _resolve(policy, keyq, ("limit", "window_us"),
+                      (limit, window_us))
     cur_ws = (now_us // W) * W
     ws = state["win_start"][sid]
     curr = state["curr"][sid]
@@ -123,7 +164,7 @@ def _sliding_window_step(state: State, sid, n, now_us, *, limit, window_us, iter
     prev_eff = jnp.where(current, prev, jnp.where(rolled_one, curr, 0))
 
     elapsed = now_us - cur_ws
-    free_scaled = limit * W - prev_eff * (W - elapsed) - curr_eff * W
+    free_scaled = lim * W - prev_eff * (W - elapsed) - curr_eff * W
     avail_units = _scale_to_micro(free_scaled, W)
     n_units = n * MICROS
     allowed, seen, consumed = admit(sid, n_units, avail_units, iters)
@@ -134,27 +175,32 @@ def _sliding_window_step(state: State, sid, n, now_us, *, limit, window_us, iter
     new_state = {
         "curr": curr_base + delta // MICROS,
         "prev": state["prev"].at[sid].set(prev_eff),
-        "win_start": state["win_start"].at[sid].set(cur_ws),
+        "win_start": state["win_start"].at[sid].set(
+            jnp.broadcast_to(cur_ws, curr.shape)),
     }
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
-    retry_us = jnp.zeros_like(remaining)
-    return new_state, (allowed, remaining, retry_us)
+    reset_us = _bcast(cur_ws + W, remaining)
+    retry_us = jnp.where(allowed, 0, reset_us - now_us)
+    return new_state, (allowed, remaining, retry_us, reset_us)
 
 
 # --------------------------------------------------------------- token bucket
 
-def _token_bucket_step(state: State, sid, n, now_us, *, limit, window_us,
-                       rate_num, rate_den, iters):
-    cap = limit * MICROS
+def _token_bucket_step(state: State, sid, n, now_us, policy=None, keyq=None,
+                       *, limit, window_us, rate_num, rate_den, iters):
+    lim, W, num, den = _resolve(
+        policy, keyq, ("limit", "window_us", "rate_num", "rate_den"),
+        (limit, window_us, rate_num, rate_den))
+    cap = lim * MICROS
     tokens = state["tokens"][sid]
     rem = state["rem"][sid]
     last = state["last"][sid]
 
     elapsed = jnp.maximum(0, now_us - last)
-    full = elapsed >= window_us  # time-to-full from any level <= window
-    acc = jnp.where(full, 0, elapsed) * rate_num + rem
-    tokens_r = tokens + acc // rate_den
-    rem_r = acc % rate_den
+    full = elapsed >= W  # time-to-full from any level <= window
+    acc = jnp.where(full, 0, elapsed) * num + rem
+    tokens_r = tokens + acc // den
+    rem_r = acc % den
     capped = full | (tokens_r >= cap)
     tokens_eff = jnp.where(capped, cap, tokens_r)
     rem_eff = jnp.where(capped, 0, rem_r)
@@ -173,8 +219,11 @@ def _token_bucket_step(state: State, sid, n, now_us, *, limit, window_us,
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
     # Reference ``tokenbucket.go:122-130``: deficit/rate, ceil'd (exact.py).
     deficit = jnp.maximum(0, n_units - seen)
-    retry_us = jnp.where(allowed, 0, -((-deficit * rate_den) // rate_num))
-    return new_state, (allowed, remaining, retry_us)
+    retry_us = jnp.where(allowed, 0, -((-deficit * den) // num))
+    # Reference reset_at approximation: now + time to fill the whole bucket
+    # from empty (``tokenbucket.go:161-165``) == now + window.
+    reset_us = _bcast(now_us + W, remaining)
+    return new_state, (allowed, remaining, retry_us, reset_us)
 
 
 # ------------------------------------------------------------------- factory
@@ -183,7 +232,11 @@ def init_state(algorithm: Algorithm, capacity: int, limit: int) -> State:
     """Fresh state with capacity+1 rows (last = padding slot). Token buckets
     start full with last=0: the first touch sees elapsed >= window and
     saturates at capacity, which is exactly the reference's or-capacity
-    default for absent keys (``tokenbucket.go:31-33``)."""
+    default for absent keys (``tokenbucket.go:31-33``) — and with a policy
+    override, the step's per-request cap clamp makes the first touch
+    saturate at the KEY'S capacity, so fresh overridden keys burst to
+    their own limit."""
+    ensure_x64()
     n = capacity + 1
     z = lambda: jnp.zeros((n,), jnp.int64)
     if algorithm is Algorithm.FIXED_WINDOW:
@@ -215,7 +268,11 @@ def _step_fn(cfg: Config) -> Callable:
 def build_step(cfg: Config) -> Callable[[State, jnp.ndarray, jnp.ndarray, jnp.ndarray],
                                         Tuple[State, Outputs]]:
     """Returns the jitted batched step for cfg's algorithm. State buffers are
-    donated: the caller must treat the passed-in state as consumed."""
+    donated: the caller must treat the passed-in state as consumed. Call as
+    ``step(state, sid, n, now_us[, policy, keyq])`` — the optional trailing
+    operands carry the device-resident override table and the batch's int64
+    search keys (ops/policy_kernels.py)."""
+    ensure_x64()
     W, _, _ = _check_gates(cfg)
     cache_key = (cfg.algorithm, cfg.limit, W, cfg.max_batch_admission_iters)
     cached = _STEP_CACHE.get(cache_key)
@@ -236,7 +293,7 @@ def _dense_scan(state: State, sids, ns, now0_us, dt_us, *, fn):
 
     def body(st, xs):
         sid, n, i = xs
-        st, (allowed, _rem, _retry) = fn(st, sid, n, now0_us + i * dt_us)
+        st, (allowed, *_rest) = fn(st, sid, n, now0_us + i * dt_us)
         return st, (_pack_bits(allowed), jnp.sum(~allowed).astype(jnp.int32))
 
     T = sids.shape[0]
@@ -252,7 +309,9 @@ def build_scan(cfg: Config) -> Callable:
     """Jitted multi-step runner: ``scan(state, sids, ns, now0_us, dt_us)
     -> (state, packed_masks, deny_counts)``. One device dispatch for T
     batches — the amortized shape benchmarks use to see device time
-    instead of per-dispatch host round-trips."""
+    instead of per-dispatch host round-trips. Default policy only (the
+    bench path; policy-bearing traffic goes through build_step)."""
+    ensure_x64()
     W, _, _ = _check_gates(cfg)
     key = (cfg.algorithm, cfg.limit, W, cfg.max_batch_admission_iters)
     cached = _SCAN_CACHE.get(key)

@@ -47,14 +47,12 @@ from functools import partial
 from typing import Callable, Dict
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 
 from ratelimiter_tpu.core.clock import to_micros
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import InvalidConfigError
+from ratelimiter_tpu.ops import ensure_x64, policy_kernels
 from ratelimiter_tpu.ops.segment import admit
 from ratelimiter_tpu.ops.sortmerge import row_gather, row_histogram, row_histogram_max
 
@@ -97,6 +95,7 @@ def sketch_geometry(cfg: Config) -> tuple[int, int, int, int, int]:
 
 
 def init_state(cfg: Config) -> State:
+    ensure_x64()
     _, _, _, S, _ = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width
     state = {
@@ -261,7 +260,7 @@ def _hh_boundary_slab(state: State, p, *, SW: int, S: int):
                                         keepdims=False)
 
 
-def _sketch_step(state: State, h1, h2, n, now_us, *,
+def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
                  iters: int, weighted: bool, conservative: bool,
                  hh: int = 0, hh_thresh: float = 0.0,
@@ -302,7 +301,19 @@ def _sketch_step(state: State, h1, h2, n, now_us, *,
     else:
         mine = None
 
-    avail = jnp.maximum(jnp.float32(limit) - est, 0.0)
+    if policy is not None:
+        # Per-key limit overrides (policy engine): the search key is the
+        # device-side packing of the (h1, h2) halves the columns already
+        # ride on, so the lookup costs log2(capacity) tiny gathers and no
+        # extra operand. Limits are validated < 2^24 at override-set time
+        # (the same f32-exactness gate as the base limit).
+        q = policy_kernels.pack_halves(h1, h2)
+        pidx, pfound = policy_kernels.lookup_i64(policy["key"], q)
+        lim_f = jnp.where(pfound, policy["limit"][pidx],
+                          jnp.int64(limit)).astype(jnp.float32)
+    else:
+        lim_f = jnp.float32(limit)
+    avail = jnp.maximum(lim_f - est, 0.0)
     n_f = n.astype(jnp.float32)
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, _ = admit(sid, n_f, avail, iters)
@@ -533,8 +544,11 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
     """Returns (step, reset, rollover) jitted callables; memoized per static
     config. The host calls ``rollover(state, p)`` whenever the sub-window
     period of the dispatch timestamp differs from the state's period (see
-    _rollover for why this is host-driven)."""
+    _rollover for why this is host-driven). ``step`` accepts an optional
+    trailing ``policy`` operand (the device-resident override table)."""
     from ratelimiter_tpu.core.types import Algorithm
+
+    ensure_x64()
 
     W, sub_us, SW, S, limit = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width
@@ -623,6 +637,7 @@ def build_migrate(old_cfg: Config, new_cfg: Config) -> Callable:
     """Jitted ``migrate(state, now_us) -> state`` moving ring state from
     old_cfg's window geometry to new_cfg's. Limit/depth/width/hh must
     match (only the window changes)."""
+    ensure_x64()
     _, sub_o, SWo, So, _ = sketch_geometry(old_cfg)
     _, sub_n, SWn, Sn, _ = sketch_geometry(new_cfg)
     if (old_cfg.sketch.depth, old_cfg.sketch.width) != (
@@ -644,6 +659,8 @@ def build_scan(cfg: Config) -> Callable:
     -> (state, packed_masks, deny_counts)`` where the leading axis of
     h1s/h2s/ns is time. One device dispatch for T batches."""
     from ratelimiter_tpu.core.types import Algorithm
+
+    ensure_x64()
 
     W, sub_us, SW, S, limit = sketch_geometry(cfg)
     d, w = cfg.sketch.depth, cfg.sketch.width

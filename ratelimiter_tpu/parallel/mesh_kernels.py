@@ -32,31 +32,41 @@ from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.ops import sketch_kernels
 from ratelimiter_tpu.parallel.mesh import AXIS
 
-# jax >= 0.8 (top-level shard_map with check_vma); older jax is unsupported —
-# the experimental shim's check_rep kwarg is incompatible with this module.
-shard_map = jax.shard_map
+# jax >= 0.8 exposes top-level shard_map with the check_vma kwarg; older
+# releases ship it under jax.experimental with the same semantics behind a
+# check_rep kwarg. The thin adapter below maps one onto the other so the
+# mesh tier (and its CI runs) work on both.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma)
 
 MERGE_MODES = ("gather", "delta")
 
 
-def _gather_step(state, h1, h2, n, now_us, *, step_kw):
+def _gather_step(state, h1, h2, n, now_us, policy, *, step_kw):
     """Gather-mode per-chip body: all_gather shards, decide globally,
-    slice local verdicts."""
+    slice local verdicts. The policy table is replicated like the state."""
     Bl = h1.shape[0]
     h1g = jax.lax.all_gather(h1, AXIS).reshape(-1)
     h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
     ng = jax.lax.all_gather(n, AXIS).reshape(-1)
     state, (allowed, remaining, est) = sketch_kernels._sketch_step(
-        state, h1g, h2g, ng, now_us, **step_kw)
+        state, h1g, h2g, ng, now_us, policy, **step_kw)
     i = jax.lax.axis_index(AXIS)
     sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
     return state, (sl(allowed), sl(remaining), sl(est))
 
 
-def _delta_step(state, h1, h2, n, now_us, *, step_kw):
+def _delta_step(state, h1, h2, n, now_us, policy, *, step_kw):
     """Delta-mode per-chip body: local decide, collective-merged write."""
     return sketch_kernels._sketch_step(
-        state, h1, h2, n, now_us, axis_name=AXIS, **step_kw)
+        state, h1, h2, n, now_us, policy, axis_name=AXIS, **step_kw)
 
 
 _MESH_CACHE: Dict[tuple, Tuple[Callable, Callable, Callable]] = {}
@@ -66,11 +76,12 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
                      ) -> Tuple[Callable, Callable, Callable]:
     """Returns (step, reset, rollover) for the mesh.
 
-    ``step(state, h1, h2, n, now_us)`` expects h1/h2/n sharded over AXIS
-    (length divisible by mesh size) and replicated state; returns sharded
-    verdicts and replicated state. ``reset`` / ``rollover`` are the plain
-    replicated kernels from sketch_kernels.build_steps (they run unsharded
-    on the replicated state arrays).
+    ``step(state, h1, h2, n, now_us, policy)`` expects h1/h2/n sharded
+    over AXIS (length divisible by mesh size), state AND the policy
+    override table replicated; returns sharded verdicts and replicated
+    state. ``reset`` / ``rollover`` are the plain replicated kernels from
+    sketch_kernels.build_steps (they run unsharded on the replicated
+    state arrays).
     """
     if merge not in MERGE_MODES:
         raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
@@ -104,6 +115,7 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
         state_keys += ["hh_owner", "hh_owner2", "hh_cur", "hh_slabs",
                        "hh_totals", "hh_last"]
     state_spec = {k: P() for k in state_keys}
+    policy_spec = {"key": P(), "limit": P()}  # replicated override table
     # check_vma=False: the state outputs ARE replicated — they are a
     # deterministic function of replicated state and all_gathered/psum'd
     # batch data — but the static checker cannot prove that through
@@ -112,7 +124,7 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     mapped = shard_map(
         partial(body, step_kw=step_kw),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P()),
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )
@@ -124,7 +136,7 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
 
 # ------------------------------------------------------------ token bucket
 
-def _bucket_gather_step(state, h1, h2, n, now_us, *, step_kw):
+def _bucket_gather_step(state, h1, h2, n, now_us, policy, *, step_kw):
     """Gather-mode bucket body: all_gather shards, decide globally on the
     replicated debt slab, slice local verdicts (same shape as _gather_step;
     the decided tuple is (allowed, remaining, retry_us))."""
@@ -135,20 +147,20 @@ def _bucket_gather_step(state, h1, h2, n, now_us, *, step_kw):
     h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
     ng = jax.lax.all_gather(n, AXIS).reshape(-1)
     state, (allowed, remaining, retry_us) = bucket_kernels._bucket_step(
-        state, h1g, h2g, ng, now_us, **step_kw)
+        state, h1g, h2g, ng, now_us, policy, **step_kw)
     i = jax.lax.axis_index(AXIS)
     sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
     return state, (sl(allowed), sl(remaining), sl(retry_us))
 
 
-def _bucket_delta_step(state, h1, h2, n, now_us, *, step_kw):
+def _bucket_delta_step(state, h1, h2, n, now_us, policy, *, step_kw):
     """Delta-mode bucket body: local admission, psum'd debt increments.
     The scalar decay is a deterministic function of replicated (rem, last),
     so replication is preserved without a collective for it."""
     from ratelimiter_tpu.ops import bucket_kernels
 
     return bucket_kernels._bucket_step(
-        state, h1, h2, n, now_us, axis_name=AXIS, **step_kw)
+        state, h1, h2, n, now_us, policy, axis_name=AXIS, **step_kw)
 
 
 _MESH_BUCKET_CACHE: Dict[tuple, Tuple[Callable, Callable]] = {}
@@ -173,10 +185,11 @@ def build_mesh_bucket_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
                    iters=iters)
     body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
     state_spec = {k: P() for k in ("debt", "acc", "rem", "last")}
+    policy_spec = {"key": P(), "limit": P()}
     mapped = shard_map(
         partial(body, step_kw=step_kw),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P()),
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )

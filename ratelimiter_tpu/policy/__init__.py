@@ -1,0 +1,30 @@
+"""Policy engine: tiered per-key limit/window overrides.
+
+The reference documents tiered quotas (free/pro/enterprise keys with
+different limits) as a first-class usage pattern (its
+``docs/EXAMPLES.md`` tiered-quota section) but implements them as "run
+one limiter per tier and route keys yourself". Here tiers are a
+first-class *policy table*: a bounded set of per-key overrides resolved
+INSIDE the same jitted device step as the admission decision
+(ops/policy_kernels.py), so a batch mixing default and overridden keys
+still costs exactly one dispatch.
+
+Pieces:
+
+* PolicyTable (policy/table.py) — host-authoritative entry store +
+  padded sorted host arrays the backends ship to the device;
+* ops/policy_kernels.py — the vectorized binary search the decision
+  kernels run per batch;
+* RateLimiter.set_override / get_override / delete_override /
+  list_overrides (algorithms/base.py) — the management surface, exposed
+  over every serving front door (binary protocol, HTTP ``/v1/policy``,
+  gRPC Set/Get/DeleteOverride).
+
+Overrides ride checkpoints (each backend snapshots its table and the
+config fingerprint covers the table *geometry*), and occupancy is
+exported as the ``rate_limiter_policy_overrides`` gauge.
+"""
+
+from ratelimiter_tpu.policy.table import Override, PolicyTable
+
+__all__ = ["Override", "PolicyTable"]

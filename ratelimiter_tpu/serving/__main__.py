@@ -106,7 +106,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "on a curl-able surface)")
     ap.add_argument("--http-reset-token", default=None,
                     help="bearer token required by /v1/reset (implies "
-                         "--http-reset)")
+                         "--http-reset); Authorization header only — "
+                         "query-string tokens are never accepted")
+    ap.add_argument("--http-policy", action="store_true",
+                    help="expose the tiered-override endpoint "
+                         "(GET/POST/PUT/DELETE /v1/policy) on the HTTP "
+                         "gateway (OFF by default: overrides are a "
+                         "quota-GRANT lever on a curl-able surface)")
+    ap.add_argument("--http-policy-token", default=None,
+                    help="bearer token required by /v1/policy (implies "
+                         "--http-policy); Authorization header only")
     ap.add_argument("--grpc-port", type=int, default=None,
                     help="also serve the gRPC contract "
                          "(api/proto/ratelimiter.proto) on this port; "
@@ -161,6 +170,34 @@ def _envelope_health(limiters) -> dict:
             "overload_policy": lims[0].config.sketch.overload_policy}
 
 
+def make_threadsafe_decide(batcher, loop):
+    """Single-decision bridge from gateway/gRPC worker threads into the
+    event loop's micro-batcher: every surface shares device dispatches."""
+    def decide(key: str, n: int):
+        return asyncio.run_coroutine_threadsafe(
+            batcher.submit(key, n), loop).result(timeout=30)
+
+    return decide
+
+
+def make_threadsafe_decide_many(batcher, loop):
+    """Bulk bridge for gRPC AllowBatch: the WHOLE frame is submitted to
+    the micro-batcher before any result is awaited, so N items coalesce
+    into O(1) batched dispatches (they typically land in ONE, together
+    with concurrent binary-protocol traffic) instead of N sequential
+    submit-wait round-trips. Results return in request order
+    (submit_many_nowait preserves it; gather keeps positions)."""
+    def decide_many(pairs):
+        async def _run():
+            futs = batcher.submit_many_nowait(pairs)
+            return await asyncio.gather(*futs)
+
+        return asyncio.run_coroutine_threadsafe(
+            _run(), loop).result(timeout=30)
+
+    return decide_many
+
+
 def _prewarm(limiter, max_batch: int) -> None:
     """Compile every batch pad shape the micro-batcher can produce (powers
     of two up to max_batch) BEFORE accepting traffic, so no client request
@@ -192,6 +229,9 @@ def _configure_jax(args) -> None:
 
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # Device backends do exact int64 state math; the library never flips
+    # this global at import time (ops.ensure_x64), so the binary opts in.
+    jax.config.update("jax_enable_x64", True)
     cache = os.environ.get(
         "RATELIMITER_TPU_COMPILE_CACHE",
         os.path.expanduser("~/.cache/ratelimiter_tpu_jax"))
@@ -218,6 +258,7 @@ async def amain(args) -> None:
     dcn_secret = (args.dcn_secret
                   or os.environ.get("RATELIMITER_TPU_DCN_SECRET") or None)
     http_reset = bool(args.http_reset or args.http_reset_token)
+    http_policy = bool(args.http_policy or args.http_policy_token)
     dcn_peers = []
     if args.dcn_peer:
         from ratelimiter_tpu.serving.dcn_peer import parse_peer
@@ -270,9 +311,17 @@ async def amain(args) -> None:
                 health=lambda: {"serving": True,
                                 **{k: v for k, v in server.stats().items()
                                    if k == "decisions_total"},
+                                "policy_overrides":
+                                    server.shard_limiters[0].override_count(),
                                 **_envelope_health(server.shard_limiters)},
                 enable_reset=http_reset,
-                reset_token=args.http_reset_token)
+                reset_token=args.http_reset_token,
+                # Overrides apply on every shard (keys hash-route).
+                policy_set=server.set_override_all,
+                policy_get=server.get_override_one,
+                policy_delete=server.delete_override_all,
+                enable_policy=http_policy,
+                policy_token=args.http_policy_token)
             gateway.start()
         grpc_srv = None
         if args.grpc_port is not None:
@@ -282,7 +331,11 @@ async def amain(args) -> None:
                 server.decide_one, server.reset_one,
                 host=args.host, port=args.grpc_port,
                 decisions_total=lambda: server.stats().get(
-                    "decisions_total", 0))
+                    "decisions_total", 0),
+                decide_many=server.decide_many,
+                policy=(server.set_override_all, server.get_override_one,
+                        server.delete_override_all),
+                default_limit=lambda: limiter.config.limit)
             grpc_srv.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -329,11 +382,9 @@ async def amain(args) -> None:
     grpc_srv = None
     loop = asyncio.get_running_loop()
 
-    def threadsafe_decide(key: str, n: int):
-        # Gateway/gRPC worker threads funnel into the SAME micro-batcher
-        # as the binary protocol: all surfaces share device dispatches.
-        return asyncio.run_coroutine_threadsafe(
-            server.batcher.submit(key, n), loop).result(timeout=30)
+    # Gateway/gRPC worker threads funnel into the SAME micro-batcher as
+    # the binary protocol: all surfaces share device dispatches.
+    threadsafe_decide = make_threadsafe_decide(server.batcher, loop)
 
     if args.http_port is not None:
         from ratelimiter_tpu.serving.http_gateway import HttpGateway
@@ -344,9 +395,15 @@ async def amain(args) -> None:
             metrics_render=obs_metrics.DEFAULT.render,
             health=lambda: {"serving": True,
                             "decisions_total": server.batcher.decisions_total,
+                            "policy_overrides": limiter.override_count(),
                             **_envelope_health([limiter])},
             enable_reset=http_reset,
-            reset_token=args.http_reset_token)
+            reset_token=args.http_reset_token,
+            policy_set=limiter.set_override,
+            policy_get=limiter.get_override,
+            policy_delete=limiter.delete_override,
+            enable_policy=http_policy,
+            policy_token=args.http_policy_token)
         gateway.start()
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
@@ -354,7 +411,11 @@ async def amain(args) -> None:
         grpc_srv = GrpcRateLimitServer(
             threadsafe_decide, limiter.reset,
             host=args.host, port=args.grpc_port,
-            decisions_total=lambda: server.batcher.decisions_total)
+            decisions_total=lambda: server.batcher.decisions_total,
+            decide_many=make_threadsafe_decide_many(server.batcher, loop),
+            policy=(limiter.set_override, limiter.get_override,
+                    limiter.delete_override),
+            default_limit=lambda: limiter.config.limit)
         grpc_srv.start()
 
     stop = asyncio.Event()

@@ -107,6 +107,37 @@ class Client:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)
 
+    # ------------------------------------------- policy overrides (tiers)
+
+    def _policy_roundtrip(self, frame: bytes, req_id: int):
+        type_, body = self._roundtrip(frame, req_id)
+        if type_ != p.T_POLICY_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_policy_r(body)
+
+    def set_override(self, key: str, limit=None,
+                     window_scale: float = 1.0) -> tuple[int, float]:
+        """Store a tiered override for key; returns the stored
+        (limit, window_scale)."""
+        req_id = next(self._ids)
+        _, limit, scale = self._policy_roundtrip(
+            p.encode_policy_set(req_id, key, limit, window_scale), req_id)
+        return limit, scale
+
+    def get_override(self, key: str):
+        """(limit, window_scale) of key's override, or None (default tier)."""
+        req_id = next(self._ids)
+        found, limit, scale = self._policy_roundtrip(
+            p.encode_policy_key(p.T_POLICY_GET, req_id, key), req_id)
+        return (limit, scale) if found else None
+
+    def delete_override(self, key: str) -> bool:
+        """Return key to the default tier; True iff an override existed."""
+        req_id = next(self._ids)
+        found, _, _ = self._policy_roundtrip(
+            p.encode_policy_key(p.T_POLICY_DEL, req_id, key), req_id)
+        return found
+
     def close(self) -> None:
         try:
             self._sock.close()
@@ -220,6 +251,33 @@ class AsyncClient:
         if type_ != p.T_METRICS_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)
+
+    # ------------------------------------------- policy overrides (tiers)
+
+    async def _policy_request(self, frame: bytes, req_id: int):
+        type_, body = await self._request(frame, req_id)
+        if type_ != p.T_POLICY_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_policy_r(body)
+
+    async def set_override(self, key: str, limit=None,
+                           window_scale: float = 1.0) -> tuple[int, float]:
+        req_id = next(self._ids)
+        _, limit, scale = await self._policy_request(
+            p.encode_policy_set(req_id, key, limit, window_scale), req_id)
+        return limit, scale
+
+    async def get_override(self, key: str):
+        req_id = next(self._ids)
+        found, limit, scale = await self._policy_request(
+            p.encode_policy_key(p.T_POLICY_GET, req_id, key), req_id)
+        return (limit, scale) if found else None
+
+    async def delete_override(self, key: str) -> bool:
+        req_id = next(self._ids)
+        found, _, _ = await self._policy_request(
+            p.encode_policy_key(p.T_POLICY_DEL, req_id, key), req_id)
+        return found
 
     async def close(self) -> None:
         if self._reader_task is not None:

@@ -34,6 +34,7 @@ from typing import Callable, Optional
 
 from ratelimiter_tpu.core.errors import (
     ClosedError,
+    InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
     StorageUnavailableError,
@@ -67,10 +68,21 @@ def _load_pb2():
         out = os.path.join(cache, "ratelimiter_pb2.py")
         if (not os.path.exists(out)
                 or os.path.getmtime(out) < os.path.getmtime(_PROTO)):
-            subprocess.run(
-                ["protoc", f"--proto_path={os.path.dirname(_PROTO)}",
-                 f"--python_out={cache}", os.path.basename(_PROTO)],
-                check=True, capture_output=True, timeout=60)
+            args = [f"--proto_path={os.path.dirname(_PROTO)}",
+                    f"--python_out={cache}", os.path.basename(_PROTO)]
+            try:
+                subprocess.run(["protoc", *args], check=True,
+                               capture_output=True, timeout=60)
+            except FileNotFoundError:
+                # No protoc binary: grpcio-tools bundles the same
+                # compiler (python -m grpc_tools.protoc) — use it so
+                # pip-only environments (CI images, venvs) still serve
+                # gRPC without a system package.
+                import sys
+
+                subprocess.run(
+                    [sys.executable, "-m", "grpc_tools.protoc", *args],
+                    check=True, capture_output=True, timeout=60)
         spec = importlib.util.spec_from_file_location("ratelimiter_pb2", out)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
@@ -107,13 +119,26 @@ class GrpcRateLimitServer:
                  reset: Callable[[str], None], *,
                  host: str = "127.0.0.1", port: int = 0,
                  decisions_total: Optional[Callable[[], int]] = None,
-                 max_workers: int = 8):
+                 max_workers: int = 8,
+                 decide_many: Optional[Callable] = None,
+                 policy: Optional[tuple] = None,
+                 default_limit: Optional[Callable[[], int]] = None):
+        """``decide_many``: optional bulk callable ``[(key, n), ...] ->
+        [Result, ...]`` (request order). When wired, AllowBatch submits
+        the WHOLE frame to the micro-batcher before waiting, so an
+        N-item RPC costs O(1) coalesced dispatches instead of N
+        sequential submit-wait round-trips. ``policy``: optional
+        ``(set_override, get_override, delete_override)`` triple
+        enabling the override RPCs; ``default_limit`` supplies the
+        default-tier limit GetOverride reports on a miss."""
         import grpc
         from concurrent import futures
 
         pb2 = _load_pb2()
         self.decide = decide
+        self.decide_many = decide_many
         self.reset = reset
+        self._default_limit = default_limit or (lambda: 0)
         self._decisions_total = decisions_total or (lambda: 0)
         self._started_at = time.time()
         grpc_mod = grpc
@@ -123,7 +148,8 @@ class GrpcRateLimitServer:
             def wrapped(request, context):
                 try:
                     return fn(request)
-                except (InvalidKeyError, InvalidNError) as exc:
+                except (InvalidKeyError, InvalidNError,
+                        InvalidConfigError) as exc:
                     context.abort(grpc_mod.StatusCode.INVALID_ARGUMENT,
                                   str(exc))
                 except StorageUnavailableError as exc:
@@ -131,6 +157,8 @@ class GrpcRateLimitServer:
                 except ClosedError as exc:
                     context.abort(grpc_mod.StatusCode.FAILED_PRECONDITION,
                                   str(exc))
+                except NotImplementedError as exc:
+                    context.abort(grpc_mod.StatusCode.UNIMPLEMENTED, str(exc))
                 except Exception as exc:  # noqa: BLE001 — typed INTERNAL
                     log.exception("grpc internal error")
                     context.abort(grpc_mod.StatusCode.INTERNAL, str(exc))
@@ -143,14 +171,19 @@ class GrpcRateLimitServer:
             return _to_pb(pb2, self.decide(req.key, int(req.n)))
 
         def allow_batch(req):
-            # Sequential submission preserves request order; in-batch
-            # same-key sequencing is the decide callable's contract
-            # (the micro-batcher coalesces these into shared dispatches).
-            # n=0 (incl. proto3-unset) maps to InvalidN exactly like the
-            # binary protocol's ALLOW_BATCH items.
-            return pb2.AllowBatchResponse(results=[
-                _to_pb(pb2, self.decide(it.key, int(it.n)))
-                for it in req.items])
+            # Request order is preserved either way; in-batch same-key
+            # sequencing is the decide callable's contract. n=0 (incl.
+            # proto3-unset) maps to InvalidN exactly like the binary
+            # protocol's ALLOW_BATCH items.
+            pairs = [(it.key, int(it.n)) for it in req.items]
+            if self.decide_many is not None:
+                # One bulk submission: all items coalesce into shared
+                # device dispatches instead of N sequential round-trips.
+                results = self.decide_many(pairs)
+            else:
+                results = [self.decide(k, n) for k, n in pairs]
+            return pb2.AllowBatchResponse(
+                results=[_to_pb(pb2, r) for r in results])
 
         def do_reset(req):
             self.reset(req.key)
@@ -168,6 +201,41 @@ class GrpcRateLimitServer:
             "Reset": (do_reset, pb2.ResetRequest),
             "Health": (health, pb2.HealthRequest),
         }
+
+        if policy is not None:
+            p_set, p_get, p_del = policy
+
+            def set_override(req):
+                ov = p_set(req.key,
+                           int(req.limit) if req.limit else None,
+                           window_scale=(req.window_scale
+                                         if req.window_scale else 1.0))
+                return pb2.OverrideResponse(
+                    found=True, key=req.key, limit=int(ov.limit),
+                    window_scale=float(ov.window_scale))
+
+            def get_override(req):
+                ov = p_get(req.key)
+                if ov is None:
+                    # Proto contract (and binary-protocol parity): a miss
+                    # carries the DEFAULT tier values, not proto3 zeros.
+                    return pb2.OverrideResponse(
+                        found=False, key=req.key,
+                        limit=int(self._default_limit()), window_scale=1.0)
+                return pb2.OverrideResponse(
+                    found=True, key=req.key, limit=int(ov.limit),
+                    window_scale=float(ov.window_scale))
+
+            def delete_override(req):
+                return pb2.DeleteOverrideResponse(
+                    deleted=bool(p_del(req.key)))
+
+            rpcs.update({
+                "SetOverride": (set_override, pb2.SetOverrideRequest),
+                "GetOverride": (get_override, pb2.GetOverrideRequest),
+                "DeleteOverride": (delete_override,
+                                   pb2.DeleteOverrideRequest),
+            })
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
                 guard(fn), request_deserializer=req_cls.FromString,
@@ -193,6 +261,14 @@ class GrpcRateLimitServer:
 def grpc_server_for_limiter(limiter, *, host: str = "127.0.0.1",
                             port: int = 0) -> GrpcRateLimitServer:
     """Standalone embedding (mirror of gateway_for_limiter)."""
+    def decide_many(pairs):
+        out = limiter.allow_batch([k for k, _ in pairs],
+                                  [n for _, n in pairs])
+        return out.results()
+
     return GrpcRateLimitServer(
         lambda key, n: limiter.allow_n(key, n), limiter.reset,
-        host=host, port=port)
+        host=host, port=port, decide_many=decide_many,
+        policy=(limiter.set_override, limiter.get_override,
+                limiter.delete_override),
+        default_limit=lambda: limiter.config.limit)

@@ -11,15 +11,23 @@ anything without the binary protocol) get drop-in rate limiting:
     GET/POST /v1/allow?key=K[&n=N]   -> 200 allowed / 429 denied,
                                         X-RateLimit-* + Retry-After
     POST     /v1/reset?key=K         -> 200 {"ok": true}
+    GET      /v1/policy?key=K        -> 200 override | 404 default tier
+    POST/PUT /v1/policy?key=K&limit=N[&window_scale=S]
+                                     -> 200 stored override
+    DELETE   /v1/policy?key=K        -> 200 {"ok": true, "deleted": ...}
     GET      /healthz                -> 200 {"serving": true, ...}
     GET      /metrics                -> Prometheus text
 
-Reset is a quota-erase lever, so on a broad plain-HTTP surface it is a
-bypass risk: the server binary ships it DISABLED (enable with
-``--http-reset``, optionally token-gated with ``--http-reset-token`` —
-the token rides ``Authorization: Bearer <t>`` or ``?token=``). Embedded
-gateways choose their own exposure via ``enable_reset``/``reset_token``
-(see docs/OPERATIONS.md "Trust boundaries").
+Reset is a quota-erase lever and the policy endpoint is a quota-GRANT
+lever, so on a broad plain-HTTP surface both are bypass risks: the
+server binary ships them DISABLED (enable with ``--http-reset`` /
+``--http-policy``, optionally token-gated with ``--http-reset-token`` /
+``--http-policy-token``). Tokens ride ``Authorization: Bearer <t>``
+ONLY — never the query string, where they would leak into access logs,
+proxies, and browser history. Embedded gateways choose their own
+exposure via ``enable_reset``/``reset_token`` and
+``enable_policy``/``policy_token`` (docs/OPERATIONS.md "Trust
+boundaries").
 
 The key may also ride the ``X-User-ID`` header (the reference example's
 convention) when no ``key`` query parameter is given.
@@ -42,6 +50,7 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ratelimiter_tpu.core.errors import (
+    InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
     StorageUnavailableError,
@@ -49,6 +58,10 @@ from ratelimiter_tpu.core.errors import (
 from ratelimiter_tpu.core.types import Result
 
 log = logging.getLogger("ratelimiter_tpu.serving.http")
+
+
+def _policy_unsupported(*_a, **_kw):
+    raise InvalidConfigError("no policy callables wired to this gateway")
 
 
 class HttpGateway:
@@ -60,7 +73,12 @@ class HttpGateway:
                  metrics_render: Optional[Callable[[], str]] = None,
                  health: Optional[Callable[[], dict]] = None,
                  enable_reset: bool = True,
-                 reset_token: Optional[str] = None):
+                 reset_token: Optional[str] = None,
+                 policy_set: Optional[Callable] = None,
+                 policy_get: Optional[Callable] = None,
+                 policy_delete: Optional[Callable] = None,
+                 enable_policy: bool = False,
+                 policy_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -78,6 +96,58 @@ class HttpGateway:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _bearer_ok(self, token: Optional[str]) -> bool:
+                """Constant-time bearer check. HEADER ONLY: a token in the
+                query string would persist in access logs, proxy caches,
+                and Referer headers — the regression the old ``?token=``
+                fallback invited (tests pin its removal)."""
+                if token is None:
+                    return True
+                import hmac
+
+                auth = self.headers.get("Authorization", "")
+                supplied = auth[7:] if auth.startswith("Bearer ") else ""
+                return hmac.compare_digest(supplied, token)
+
+            def _handle_policy(self, q) -> None:
+                """Tiered per-key overrides (policy engine). A quota-GRANT
+                lever, so gated exactly like reset: disabled unless the
+                embedding opted in, bearer-token in the header only."""
+                if not gateway.enable_policy:
+                    self._send(403, {"error": "policy endpoint is disabled "
+                                     "on this gateway"})
+                    return
+                if not self._bearer_ok(gateway.policy_token):
+                    self._send(403, {"error": "bad policy token"})
+                    return
+                key = q.get("key", [None])[0]
+                if key is None:
+                    self._send(400, {"error": "missing key"})
+                    return
+                if self.command == "GET":
+                    ov = gateway.policy_get(key)
+                    if ov is None:
+                        self._send(404, {"error": f"no override for {key!r}",
+                                         "key": key})
+                        return
+                    self._send(200, {"key": key, "limit": int(ov.limit),
+                                     "window_scale": float(ov.window_scale)})
+                elif self.command in ("POST", "PUT"):
+                    raw = q.get("limit", [None])[0]
+                    limit = int(raw) if raw is not None else None
+                    scale = float(q.get("window_scale", ["1.0"])[0])
+                    ov = gateway.policy_set(key, limit, window_scale=scale)
+                    self._send(200, {"ok": True, "key": key,
+                                     "limit": int(ov.limit),
+                                     "window_scale": float(ov.window_scale)})
+                elif self.command == "DELETE":
+                    deleted = bool(gateway.policy_delete(key))
+                    self._send(200, {"ok": True, "key": key,
+                                     "deleted": deleted})
+                else:
+                    self._send(405, {"error": f"method {self.command} not "
+                                     "allowed on /v1/policy"})
 
             def _handle(self):
                 # Drain any request body first: HTTP/1.1 keep-alive means
@@ -127,22 +197,17 @@ class HttpGateway:
                             self._send(403, {"error": "reset is disabled on "
                                              "this gateway"})
                             return
-                        if gateway.reset_token is not None:
-                            auth = self.headers.get("Authorization", "")
-                            supplied = (auth[7:] if auth.startswith("Bearer ")
-                                        else q.get("token", [""])[0])
-                            import hmac
-
-                            if not hmac.compare_digest(supplied,
-                                                       gateway.reset_token):
-                                self._send(403, {"error": "bad reset token"})
-                                return
+                        if not self._bearer_ok(gateway.reset_token):
+                            self._send(403, {"error": "bad reset token"})
+                            return
                         key = q.get("key", [None])[0]
                         if key is None:
                             self._send(400, {"error": "missing key"})
                             return
                         gateway.reset(key)
                         self._send(200, {"ok": True})
+                    elif url.path == "/v1/policy":
+                        self._handle_policy(q)
                     elif url.path == "/healthz":
                         self._send(200, gateway.health())
                     elif url.path == "/metrics":
@@ -155,7 +220,8 @@ class HttpGateway:
                         self.wfile.write(text)
                     else:
                         self._send(404, {"error": f"no route {url.path}"})
-                except (InvalidKeyError, InvalidNError, ValueError) as exc:
+                except (InvalidKeyError, InvalidNError, InvalidConfigError,
+                        ValueError) as exc:
                     self._send(400, {"error": str(exc)})
                 except StorageUnavailableError as exc:
                     # Reference example: backend down -> 503
@@ -167,11 +233,19 @@ class HttpGateway:
 
             do_GET = _handle
             do_POST = _handle
+            do_PUT = _handle
+            do_DELETE = _handle
 
         self.decide = decide
         self.reset = reset
         self.enable_reset = enable_reset
         self.reset_token = reset_token
+        self.policy_set = policy_set or _policy_unsupported
+        self.policy_get = policy_get or _policy_unsupported
+        self.policy_delete = policy_delete or _policy_unsupported
+        # Policy needs both an explicit opt-in AND wired callables.
+        self.enable_policy = bool(enable_policy and policy_set is not None)
+        self.policy_token = policy_token
         self.metrics_render = metrics_render if metrics_render else lambda: ""
         self.health = health if health else lambda: {"serving": True}
         self._httpd = ThreadingHTTPServer((host, port), Handler)
@@ -194,7 +268,8 @@ class HttpGateway:
 
 
 def gateway_for_limiter(limiter, *, host: str = "127.0.0.1",
-                        port: int = 0) -> HttpGateway:
+                        port: int = 0, enable_policy: bool = False,
+                        policy_token: Optional[str] = None) -> HttpGateway:
     """Standalone embedding: the gateway calls the limiter directly
     (the limiter's own lock serializes; for coalescing with binary
     traffic use the server binary's --http-port instead)."""
@@ -205,4 +280,9 @@ def gateway_for_limiter(limiter, *, host: str = "127.0.0.1",
         limiter.reset,
         host=host, port=port,
         metrics_render=m.DEFAULT.render,
-        health=lambda: {"serving": True})
+        health=lambda: {"serving": True},
+        policy_set=limiter.set_override,
+        policy_get=limiter.get_override,
+        policy_delete=limiter.delete_override,
+        enable_policy=enable_policy,
+        policy_token=policy_token)

@@ -294,6 +294,49 @@ class NativeRateLimitServer:
         with self._locks[shard]:
             self._shard_limiters[shard].reset(key)
 
+    def decide_many(self, pairs):
+        """Bulk decide for the gRPC AllowBatch surface: group by owning
+        shard, ONE allow_batch per touched shard (in-batch same-key
+        sequencing preserved — a key's requests all land on its shard in
+        frame order), results reassembled in request order."""
+        by_shard: dict = {}
+        for i, (key, n) in enumerate(pairs):
+            by_shard.setdefault(self.shard_of(key), []).append((i, key, n))
+        results = [None] * len(pairs)
+        for shard, items in by_shard.items():
+            with self._locks[shard]:
+                out = self._shard_limiters[shard].allow_batch(
+                    [k for _, k, _ in items], [n for _, _, n in items])
+            for (i, _, _), res in zip(items, out.results()):
+                results[i] = res
+        return results
+
+    # ------------------------------------------------- policy management
+
+    def set_override_all(self, key: str, limit=None, *,
+                         window_scale: float = 1.0):
+        """Apply an override on EVERY shard limiter: keys hash-route, so
+        the owning shard must have it — and setting it everywhere is
+        idempotent for the others (their copy is simply never queried for
+        this key)."""
+        ov = None
+        for shard, lim in enumerate(self._shard_limiters):
+            with self._locks[shard]:
+                ov = lim.set_override(key, limit, window_scale=window_scale)
+        return ov
+
+    def get_override_one(self, key: str):
+        shard = self.shard_of(key)
+        with self._locks[shard]:
+            return self._shard_limiters[shard].get_override(key)
+
+    def delete_override_all(self, key: str) -> bool:
+        existed = False
+        for shard, lim in enumerate(self._shard_limiters):
+            with self._locks[shard]:
+                existed = lim.delete_override(key) or existed
+        return existed
+
     @property
     def shard_limiters(self):
         """All shard limiters (index 0 = the caller's). A DCN exporter

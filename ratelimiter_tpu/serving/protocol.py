@@ -24,6 +24,11 @@ Requests:
                      one frame, many decisions (the client-side batching
                      analog of Redis pipelining; decisions still coalesce
                      with every other connection in the micro-batcher)
+    POLICY_SET  (7): u8 flags (bit0 has_limit), i64 limit,
+                     f64 window_scale, u16 key_len, key utf-8 —
+                     tiered per-key override (policy engine)
+    POLICY_GET  (8): u16 key_len, key utf-8
+    POLICY_DEL  (9): u16 key_len, key utf-8
 
 Responses:
     RESULT   (129): u8 flags (bit0 allowed, bit1 fail_open), i64 limit,
@@ -33,7 +38,15 @@ Responses:
                     u64 decisions_total
     METRICS  (132): u32 text_len, prometheus text utf-8
     RESULT_BATCH (133): i64 limit, u32 count, then count x {u8 flags,
-                    i64 remaining, f64 retry_after, f64 reset_at}
+                    i64 remaining, f64 retry_after, f64 reset_at}.
+                    NOTE: the header ``limit`` is the DEFAULT limit;
+                    overridden keys' true limits ride the scalar RESULT
+                    path and every HTTP/gRPC surface (wire-format
+                    stability with the native front door).
+    POLICY   (134): u8 found, i64 limit, f64 window_scale — answer to
+                    POLICY_SET (the stored entry) and POLICY_GET
+                    (found=0 means default tier); POLICY_DEL answers it
+                    too (found=1 iff an override existed)
     ERROR    (255): u16 code, u16 msg_len, msg utf-8; for ALLOW_BATCH an
                     error response covers the whole frame
 
@@ -70,6 +83,9 @@ T_HEALTH = 3
 T_METRICS = 4
 T_ALLOW_BATCH = 5
 T_DCN_PUSH = 6
+T_POLICY_SET = 7
+T_POLICY_GET = 8
+T_POLICY_DEL = 9
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
@@ -80,6 +96,7 @@ T_OK = 130
 T_HEALTH_R = 131
 T_METRICS_R = 132
 T_RESULT_BATCH = 133
+T_POLICY_R = 134
 T_ERROR = 255
 
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
@@ -175,6 +192,49 @@ def encode_error(req_id: int, code: int, msg: str) -> bytes:
     mb = msg.encode("utf-8")[:65535]
     body = _ERROR_HEAD.pack(code, len(mb)) + mb
     return _HDR.pack(1 + 8 + len(body), T_ERROR, req_id) + body
+
+
+# ----------------------------------------------------- policy overrides
+
+_POLICY_SET_HEAD = struct.Struct("<BqdH")  # flags, limit, window_scale, key_len
+_POLICY_R_BODY = struct.Struct("<Bqd")     # found, limit, window_scale
+
+
+def encode_policy_set(req_id: int, key: str, limit=None,
+                      window_scale: float = 1.0) -> bytes:
+    kb = key.encode("utf-8")
+    flags = 1 if limit is not None else 0
+    body = _POLICY_SET_HEAD.pack(flags, limit if limit is not None else 0,
+                                 float(window_scale), len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_POLICY_SET, req_id) + body
+
+
+def parse_policy_set(body: bytes):
+    """-> (key, limit | None, window_scale)."""
+    flags, limit, scale, key_len = _POLICY_SET_HEAD.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _POLICY_SET_HEAD.size + key_len:
+        raise ProtocolError("bad POLICY_SET body")
+    key = body[_POLICY_SET_HEAD.size:].decode("utf-8")
+    return key, (limit if flags & 1 else None), scale
+
+
+def encode_policy_key(type_: int, req_id: int, key: str) -> bytes:
+    """POLICY_GET / POLICY_DEL share the RESET body shape."""
+    kb = key.encode("utf-8")
+    body = _KEYLEN.pack(len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), type_, req_id) + body
+
+
+def encode_policy_r(req_id: int, found: bool, limit: int,
+                    window_scale: float) -> bytes:
+    body = _POLICY_R_BODY.pack(1 if found else 0, limit, float(window_scale))
+    return _HDR.pack(1 + 8 + len(body), T_POLICY_R, req_id) + body
+
+
+def parse_policy_r(body: bytes):
+    """-> (found, limit, window_scale)."""
+    found, limit, scale = _POLICY_R_BODY.unpack(body)
+    return bool(found), limit, scale
 
 
 _BATCH_ITEM = struct.Struct("<IH")       # n, key_len (per request)

@@ -199,6 +199,37 @@ class RateLimitServer:
             None, merge_push_payload, [self.limiter], body, self.dcn_secret)
         return p.encode_ok(req_id)
 
+    async def _handle_policy(self, type_: int, req_id: int,
+                             body: bytes) -> bytes:
+        """Tiered-override management (policy engine): SET stores an
+        override, GET reads it, DEL returns the key to the default tier.
+        All answer T_POLICY_R. Rare control-plane frames — off the event
+        loop like reset (the mutation takes the limiter lock)."""
+        loop = asyncio.get_running_loop()
+        try:
+            if type_ == p.T_POLICY_SET:
+                key, limit, scale = p.parse_policy_set(body)
+                ov = await loop.run_in_executor(
+                    None, lambda: self.limiter.set_override(
+                        key, limit, window_scale=scale))
+                return p.encode_policy_r(req_id, True, ov.limit,
+                                         ov.window_scale)
+            if type_ == p.T_POLICY_GET:
+                key = p.parse_reset(body)
+                ov = self.limiter.get_override(key)
+                if ov is None:
+                    return p.encode_policy_r(
+                        req_id, False, self.limiter.config.limit, 1.0)
+                return p.encode_policy_r(req_id, True, ov.limit,
+                                         ov.window_scale)
+            key = p.parse_reset(body)
+            existed = await loop.run_in_executor(
+                None, self.limiter.delete_override, key)
+            return p.encode_policy_r(req_id, bool(existed),
+                                     self.limiter.config.limit, 1.0)
+        except Exception as exc:
+            return p.encode_error(req_id, p.code_for(exc), str(exc))
+
     async def _handle_frame(self, type_: int, req_id: int, body: bytes,
                             writer: asyncio.StreamWriter,
                             write_lock: asyncio.Lock) -> None:
@@ -212,6 +243,8 @@ class RateLimitServer:
                     out = p.encode_ok(req_id)
                 except Exception as exc:
                     out = p.encode_error(req_id, p.code_for(exc), str(exc))
+            elif type_ in (p.T_POLICY_SET, p.T_POLICY_GET, p.T_POLICY_DEL):
+                out = await self._handle_policy(type_, req_id, body)
             elif type_ == p.T_HEALTH:
                 out = p.encode_health(
                     req_id, self._serving, time.time() - self._started_at,

@@ -20,3 +20,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Device backends require x64 (int64 timestamps / micro-tokens) and no
+# longer flip the global at import time (ops.ensure_x64 gates instead) —
+# the test env opts in here, once, before any backend initializes.
+jax.config.update("jax_enable_x64", True)

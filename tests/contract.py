@@ -40,6 +40,12 @@ class ContractTests:
     #: exact backends admit exactly `limit`; approximate (sketch) backends may
     #: under-admit, never over-admit — they set exact_admission = False.
     exact_admission = True
+    #: per-key window_scale overrides need per-key window grids; sketch
+    #: backends share one ring geometry and set this False.
+    supports_window_scale = True
+    #: relaxed-consistency backends (mesh delta) cannot pin exact in-batch
+    #: allow/deny positions and set this False.
+    strict_batch_order = True
 
     def make_limiter(self, config: Config, clock) -> object:
         return create_limiter(config, backend=self.backend, clock=clock)
@@ -234,6 +240,115 @@ class ContractTests:
         out = lim.allow_batch(["x", "y", "x", "y", "x"])
         if self.exact_admission:
             assert list(out.allowed) == [True, True, True, True, False]
+        lim.close()
+
+    # --------------------------------------------- policy overrides (tiers)
+
+    def _assert_admitted(self, count: int, limit: int, sent: int) -> None:
+        """Admission-count envelope for one fresh key decided in one batch.
+        Exact backends: exactly min(limit, sent); approximate backends:
+        never more; relaxed-consistency backends (mesh delta) override."""
+        if self.exact_admission:
+            assert count == min(limit, sent)
+        else:
+            assert count <= min(limit, sent)
+
+    def test_override_mixed_batch_single_dispatch(self, algo):
+        """The policy-engine acceptance shape: ONE batch mixing default and
+        overridden keys, every key decided against ITS OWN limit (the
+        override resolves inside the same fused step — no per-key host
+        dispatch on device backends)."""
+        lim, _ = self.make(algo, limit=4)
+        lim.set_override("vip", 10)
+        out = lim.allow_batch(["vip"] * 12 + ["std"] * 6)
+        self._assert_admitted(int(np.sum(out.allowed[:12])), 10, 12)
+        self._assert_admitted(int(np.sum(out.allowed[12:])), 4, 6)
+        lim.close()
+
+    def test_override_interleaved_order(self, algo):
+        """Interleaving default/override keys in one frame keeps per-key
+        in-batch sequencing: each key's first `its-limit` requests win."""
+        lim, _ = self.make(algo, limit=2)
+        lim.set_override("v", 3)
+        out = lim.allow_batch(["v", "d", "v", "d", "v", "d", "v", "d"])
+        if self.exact_admission and self.strict_batch_order:
+            assert list(out.allowed) == [True, True, True, True,
+                                         True, False, False, False]
+        lim.close()
+
+    def test_override_lowers_limit(self, algo):
+        lim, _ = self.make(algo, limit=10)
+        lim.set_override("cheap", 2)
+        out = lim.allow_batch(["cheap"] * 5)
+        self._assert_admitted(out.allow_count, 2, 5)
+        self._assert_admitted(lim.allow_batch(["normal"] * 10).allow_count,
+                              10, 10)
+        lim.close()
+
+    def test_override_result_reports_key_limit(self, algo):
+        """Result.limit (and with it X-RateLimit-Limit) is the KEY's
+        effective limit, not the config default."""
+        lim, _ = self.make(algo, limit=4)
+        lim.set_override("vip", 9)
+        assert lim.allow("vip").limit == 9
+        assert lim.allow("std").limit == 4
+        assert lim.allow_batch(["vip", "std"]).results()[0].limit == 9
+        lim.close()
+
+    def test_override_get_delete_roundtrip(self, algo):
+        lim, _ = self.make(algo, limit=4)
+        assert lim.get_override("vip") is None
+        ov = lim.set_override("vip", 8)
+        assert ov.limit == 8 and lim.get_override("vip").limit == 8
+        assert lim.override_count() == 1
+        assert dict(lim.list_overrides())["vip"].limit == 8
+        assert lim.delete_override("vip") is True
+        assert lim.delete_override("vip") is False
+        assert lim.get_override("vip") is None
+        # Back on the default tier.
+        self._assert_admitted(lim.allow_batch(["vip"] * 6).allow_count, 4, 6)
+        lim.close()
+
+    def test_override_window_scale(self, algo):
+        """Window-scaled keys expire on their OWN grid: a 1/4-window key
+        regains quota while default keys are still inside their window.
+        (Token bucket: the scale shortens time-to-full the same way.)"""
+        if not self.supports_window_scale:
+            from ratelimiter_tpu import InvalidConfigError
+
+            lim, _ = self.make(algo, limit=4)
+            with pytest.raises(InvalidConfigError):
+                lim.set_override("fast", window_scale=0.25)
+            lim.close()
+            return
+        lim, clock = self.make(algo, limit=4, window=40.0)
+        lim.set_override("fast", window_scale=0.25)     # 10s window
+        assert lim.allow_batch(["fast"] * 4).allow_count == 4
+        assert lim.allow_batch(["slow"] * 4).allow_count == 4
+        clock.advance(21.0)   # > 2 fast windows, < 1 slow window
+        assert lim.allow_batch(["fast"] * 4).allow_count == 4
+        slow = lim.allow_batch(["slow"] * 4).allow_count
+        if algo is Algorithm.TOKEN_BUCKET:
+            assert slow == 2  # continuous refill: 21s * 4/40s = 2.1
+        else:
+            assert slow == 0
+        lim.close()
+
+    def test_override_invalid_rejected(self, algo):
+        from ratelimiter_tpu import InvalidConfigError
+
+        lim, _ = self.make(algo)
+        with pytest.raises(InvalidConfigError):
+            lim.set_override("k", 0)
+        with pytest.raises(InvalidConfigError):
+            lim.set_override("k", -5)
+        with pytest.raises(InvalidConfigError):
+            lim.set_override("k", window_scale=0.0)
+        from ratelimiter_tpu import InvalidKeyError
+
+        with pytest.raises(InvalidKeyError):
+            lim.set_override("", 5)
+        assert lim.override_count() == 0
         lim.close()
 
     # ----------------------------------------------------------- failure

@@ -50,6 +50,7 @@ def _make_mesh_limiter(config: Config, clock, merge: str):
 
 class TestMeshContract(ContractTests):
     backend = "mesh-sketch-gather"
+    supports_window_scale = False
     supports_failure_injection = True
 
     def make_limiter(self, config: Config, clock):
@@ -65,6 +66,8 @@ class TestMeshDeltaContract(ContractTests):
     plus convergence instead of strict in-batch exactness."""
 
     backend = "mesh-sketch-delta"
+    supports_window_scale = False
+    strict_batch_order = False
     supports_failure_injection = True
     n_chips = 8
 
@@ -81,6 +84,12 @@ class TestMeshDeltaContract(ContractTests):
         assert limit <= out.allow_count <= min(b, self.n_chips * limit)
         after = lim.allow_batch(["hot"] * b)
         assert after.allow_count == 0, "delta merge must converge in one step"
+
+    def _assert_admitted(self, count: int, limit: int, sent: int) -> None:
+        # Same staleness envelope for the policy-override batches: a key
+        # decided on several chips in ONE step can over-admit up to the
+        # per-chip sum; converged state denies from the next step on.
+        assert count <= min(sent, self.n_chips * limit)
 
 
 class TestMeshDeltaStalenessEnvelope:

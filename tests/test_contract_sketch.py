@@ -22,6 +22,7 @@ from ratelimiter_tpu import (
 class TestSketchContract(ContractTests):
     backend = "sketch"
     supports_failure_injection = True
+    supports_window_scale = False  # one shared ring geometry
 
     def inject_failure(self, lim) -> None:
         lim.inject_failure()

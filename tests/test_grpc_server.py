@@ -17,6 +17,7 @@ from ratelimiter_tpu import (  # noqa: E402
     ManualClock,
     create_limiter,
 )
+from ratelimiter_tpu.core.types import Result  # noqa: E402
 from ratelimiter_tpu.serving.grpc_server import (  # noqa: E402
     GrpcRateLimitServer,
     _load_pb2,
@@ -65,6 +66,12 @@ def _stub(channel, pb2):
                             pb2.AllowBatchResponse)
         Reset = method("Reset", pb2.ResetRequest, pb2.ResetResponse)
         Health = method("Health", pb2.HealthRequest, pb2.HealthResponse)
+        SetOverride = method("SetOverride", pb2.SetOverrideRequest,
+                             pb2.OverrideResponse)
+        GetOverride = method("GetOverride", pb2.GetOverrideRequest,
+                             pb2.OverrideResponse)
+        DeleteOverride = method("DeleteOverride", pb2.DeleteOverrideRequest,
+                                pb2.DeleteOverrideResponse)
 
     return Stub
 
@@ -153,6 +160,76 @@ class TestGrpcServer:
             lim.inject_failure()
             resp = stub.Allow(pb2.AllowRequest(key="k"))
             assert resp.allowed and resp.fail_open
+        finally:
+            channel.close()
+            srv.shutdown()
+            lim.close()
+
+    def test_allow_batch_single_bulk_submission(self, pb2):
+        """Satellite pin: an N-item AllowBatch reaches the decide layer as
+        ONE bulk submission (O(1) dispatches, not N sequential
+        submit-wait round-trips), and results come back in request
+        order."""
+        calls = {"many": 0, "one": 0}
+
+        def decide_many(pairs):
+            calls["many"] += 1
+            # Distinguishable per-item results to pin ordering.
+            return [Result(allowed=(i % 2 == 0), limit=100, remaining=i,
+                           retry_after=0.0, reset_at=T0)
+                    for i, _ in enumerate(pairs)]
+
+        def decide(key, n):
+            calls["one"] += 1
+            raise AssertionError("scalar path must not serve AllowBatch")
+
+        srv = GrpcRateLimitServer(decide, lambda k: None,
+                                  decide_many=decide_many)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        try:
+            n_items = 64
+            req = pb2.AllowBatchRequest(items=[
+                pb2.AllowBatchRequest.Item(key=f"k{i}", n=1)
+                for i in range(n_items)])
+            out = stub.AllowBatch(req)
+            assert calls == {"many": 1, "one": 0}
+            assert [r.remaining for r in out.results] == list(range(n_items))
+            assert [r.allowed for r in out.results] == [
+                i % 2 == 0 for i in range(n_items)]
+        finally:
+            channel.close()
+            srv.shutdown()
+
+    def test_override_rpcs(self, pb2):
+        """Set/Get/DeleteOverride change live decisions over gRPC."""
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=clock)
+        srv = grpc_server_for_limiter(lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        try:
+            resp = stub.GetOverride(pb2.GetOverrideRequest(key="vip"))
+            assert not resp.found
+            resp = stub.SetOverride(pb2.SetOverrideRequest(key="vip",
+                                                           limit=7))
+            assert resp.found and resp.limit == 7
+            allowed = sum(stub.Allow(pb2.AllowRequest(key="vip")).allowed
+                          for _ in range(9))
+            assert allowed == 7
+            assert stub.Allow(pb2.AllowRequest(key="std")).limit == 3
+            resp = stub.GetOverride(pb2.GetOverrideRequest(key="vip"))
+            assert resp.found and resp.limit == 7
+            assert stub.DeleteOverride(
+                pb2.DeleteOverrideRequest(key="vip")).deleted
+            assert not stub.DeleteOverride(
+                pb2.DeleteOverrideRequest(key="vip")).deleted
+            with pytest.raises(grpc.RpcError) as ei:
+                stub.SetOverride(pb2.SetOverrideRequest(key="v", limit=-4))
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
         finally:
             channel.close()
             srv.shutdown()

@@ -156,14 +156,77 @@ class TestHttpGateway:
                         f"{base}/v1/reset?key=g", method="POST",
                         headers=hdrs))
                 assert ei.value.code == 403
-            # Bearer header works; so does ?token=.
+            # Bearer header works.
             urllib.request.urlopen(urllib.request.Request(
                 f"{base}/v1/reset?key=g", method="POST",
                 headers={"Authorization": "Bearer tok123"}))
             _get(f"{base}/v1/allow?key=g&n=2")
-            urllib.request.urlopen(urllib.request.Request(
-                f"{base}/v1/reset?key=g&token=tok123", method="POST"))
-            assert _get(f"{base}/v1/allow?key=g")[0] == 200
+            # Regression: a ?token= query parameter must NOT authorize —
+            # query strings land in access logs, proxies, and Referer
+            # headers (tokens are header-only now).
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/reset?key=g&token=tok123", method="POST"))
+            assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/allow?key=g")   # quota intact
+            assert ei.value.code == 429
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_policy_endpoint_disabled_by_default(self):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=2, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        gw = gateway_for_limiter(lim)   # no enable_policy
+        gw.start()
+        try:
+            base = f"http://127.0.0.1:{gw.port}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/policy?key=k&limit=9", method="POST"))
+            assert ei.value.code == 403
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_policy_endpoint_crud_and_token_gating(self):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=2, window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+        gw = gateway_for_limiter(lim, enable_policy=True, policy_token="pt")
+        gw.start()
+
+        def req(method, path, token=None):
+            return urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}{path}", method=method,
+                headers=({"Authorization": f"Bearer {token}"}
+                         if token else {})))
+
+        try:
+            # No token / query token -> 403 (header-only, like reset).
+            for path in ("/v1/policy?key=v&limit=9",
+                         "/v1/policy?key=v&limit=9&token=pt"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    req("POST", path)
+                assert ei.value.code == 403
+            with req("POST", "/v1/policy?key=v&limit=9", token="pt") as r:
+                body = json.loads(r.read())
+                assert body["limit"] == 9 and body["window_scale"] == 1.0
+            with req("GET", "/v1/policy?key=v", token="pt") as r:
+                assert json.loads(r.read())["limit"] == 9
+            # The override changes live decisions + headers.
+            status, headers, body = _get(
+                f"http://127.0.0.1:{gw.port}/v1/allow?key=v")
+            assert status == 200 and headers["X-RateLimit-Limit"] == "9"
+            # Invalid override -> 400, not 500.
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                req("POST", "/v1/policy?key=v&limit=-3", token="pt")
+            assert ei.value.code == 400
+            with req("DELETE", "/v1/policy?key=v", token="pt") as r:
+                assert json.loads(r.read())["deleted"] is True
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                req("GET", "/v1/policy?key=v", token="pt")
+            assert ei.value.code == 404
         finally:
             gw.shutdown()
             lim.close()
@@ -224,6 +287,64 @@ class TestServerBinaryHttp:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get(f"{base}/v1/allow?key=shared")
             assert ei.value.code == 429
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    def test_policy_override_against_running_binary(self):
+        """The tentpole acceptance shape end to end: a per-key override
+        set over HTTP against the real binary (sketch backend) changes
+        THAT key's admission decisions while other keys stay on the
+        default limit; occupancy shows up on /healthz and /metrics."""
+        import os
+        import signal as sig
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        port, http_port = free_port(), free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ratelimiter_tpu.serving",
+             "--backend", "sketch", "--algorithm", "tpu_sketch",
+             "--limit", "3", "--window", "60", "--port", str(port),
+             "--http-port", str(http_port), "--max-batch", "64",
+             "--http-policy-token", "pt", "--no-prewarm"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            for _ in range(10):
+                line = proc.stdout.readline()
+                if line.startswith("serving"):
+                    break
+            assert "http:" in line, line
+            base = f"http://127.0.0.1:{http_port}"
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/policy?key=vip&limit=7", method="POST",
+                headers={"Authorization": "Bearer pt"}))
+            vip = [_get(f"{base}/v1/allow?key=vip") for _ in range(7)]
+            assert all(s == 200 for s, _, _ in vip)
+            assert vip[0][1]["X-RateLimit-Limit"] == "7"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/allow?key=vip")       # 8th denied
+            assert ei.value.code == 429
+            # Default keys stay at limit 3.
+            std = [_get(f"{base}/v1/allow?key=std") for _ in range(3)]
+            assert all(s == 200 for s, _, _ in std)
+            assert std[0][1]["X-RateLimit-Limit"] == "3"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/allow?key=std")
+            assert ei.value.code == 429
+            # Observability: occupancy on /healthz and /metrics.
+            status, _, health = _get(f"{base}/healthz")
+            assert status == 200 and health["policy_overrides"] == 1
+            with urllib.request.urlopen(f"{base}/metrics") as r:
+                assert "rate_limiter_policy_overrides 1" in r.read().decode()
             proc.send_signal(sig.SIGTERM)
             assert proc.wait(timeout=15) == 0
         finally:

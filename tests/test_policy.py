@@ -1,0 +1,407 @@
+"""Policy engine (ratelimiter_tpu/policy/): the device-resident override
+table, its ops-level binary search, checkpoint/restore survival, the
+config-fingerprint gate, the occupancy gauge, and the serving wire frames.
+
+Backend-contract behavior (mixed batches, per-key limits/windows) lives in
+tests/contract.py and runs per backend; this file covers the subsystem's
+own pieces."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    CheckpointError,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.core.config import PolicySpec
+from ratelimiter_tpu.ops import policy_kernels as pk
+from ratelimiter_tpu.policy import PolicyTable
+
+T0 = 1_700_000_000.0
+BACKENDS = ("exact", "dense", "sketch")
+
+
+def make(backend, algo=Algorithm.SLIDING_WINDOW, limit=4, window=60.0, **kw):
+    clock = ManualClock(T0)
+    cfg = Config(algorithm=algo, limit=limit, window=window, **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+# ---------------------------------------------------------------- ops level
+
+class TestLookupKernel:
+    def _table(self, n, capacity, rng):
+        keys = np.sort(rng.choice(2**62, size=n, replace=False)
+                       .astype(np.int64))
+        padded = np.full(capacity, pk.PAD_KEY, dtype=np.int64)
+        padded[:n] = keys
+        return keys, padded
+
+    @pytest.mark.parametrize("capacity", [8, 64, 1024])
+    def test_device_matches_host(self, capacity):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        n = capacity // 2
+        keys, padded = self._table(n, capacity, rng)
+        hits = rng.choice(keys, size=50)
+        misses = rng.choice(2**62, size=50).astype(np.int64)
+        queries = np.concatenate([hits, misses])
+        d_idx, d_found = pk.lookup_i64(jnp.asarray(padded),
+                                       jnp.asarray(queries))
+        h_idx, h_found = pk.lookup_host(padded, queries)
+        np.testing.assert_array_equal(np.asarray(d_found), h_found)
+        # Where found, both must point at the matching row.
+        np.testing.assert_array_equal(
+            padded[np.asarray(d_idx)][np.asarray(d_found)],
+            queries[np.asarray(d_found)])
+        np.testing.assert_array_equal(padded[h_idx][h_found],
+                                      queries[h_found])
+        # All planted keys are found; random non-members are not (they
+        # were drawn from a disjoint range with prob ~1).
+        assert bool(np.all(np.asarray(d_found)[:50]))
+
+    @pytest.mark.parametrize("capacity", [8, 64])
+    def test_full_table_every_row_reachable(self, capacity):
+        """Regression: the offset descent must reach index capacity-1 —
+        a FULL table's max-key override was silently invisible to the
+        kernels before the bounds-masked step-P probe."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        keys = np.sort(rng.choice(2**62, size=capacity, replace=False)
+                       .astype(np.int64))
+        idx, found = pk.lookup_i64(jnp.asarray(keys), jnp.asarray(keys))
+        assert bool(np.all(np.asarray(found)))
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      np.arange(capacity, dtype=np.int32))
+
+    def test_full_limiter_table_max_key_decides(self):
+        """End-to-end form of the same regression: fill the table to
+        capacity and check the entry with the LARGEST search key still
+        changes decisions."""
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=2,
+                     window=60.0, policy=PolicySpec(capacity=8))
+        lim = create_limiter(cfg, backend="dense", clock=clock)
+        for i in range(8):
+            lim.set_override(f"k{i}", 5)
+        arrs = lim._policy_table.host_arrays()
+        max_key = [k for k, _ in lim._policy_table.items()
+                   if lim._policy_key(k) == int(arrs["key"][7])][0]
+        out = lim.allow_batch([max_key] * 7)
+        assert out.allow_count == 5, max_key
+        lim.close()
+
+    def test_empty_table_misses_everything(self):
+        import jax.numpy as jnp
+
+        empty = pk.empty_arrays(16, {"limit": 5})
+        _, found = pk.lookup_i64(jnp.asarray(empty["key"]),
+                                 jnp.asarray(np.arange(100, dtype=np.int64)))
+        assert not bool(np.any(np.asarray(found)))
+
+    def test_pack_halves_device_matches_host(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        h1 = rng.integers(0, 2**32, size=64, dtype=np.uint32)
+        h2 = rng.integers(0, 2**32, size=64, dtype=np.uint32)
+        dev = np.asarray(pk.pack_halves(jnp.asarray(h1), jnp.asarray(h2)))
+        np.testing.assert_array_equal(dev, pk.pack_halves_host(h1, h2))
+
+
+# ------------------------------------------------------------- table level
+
+class TestPolicyTable:
+    def _table(self, capacity=8, limit=4, window=60.0, **kw):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=limit,
+                     window=window, policy=PolicySpec(capacity=capacity))
+        return PolicyTable(cfg, key_fn=lambda k: hash(k) & (2**62 - 1), **kw)
+
+    def test_capacity_enforced(self):
+        t = self._table(capacity=8)
+        for i in range(8):
+            t.set(f"k{i}", 10)
+        with pytest.raises(InvalidConfigError, match="full"):
+            t.set("overflow", 10)
+        # Updating an existing entry is not a new slot.
+        t.set("k0", 11)
+        assert t.get("k0").limit == 11
+
+    def test_spec_validation(self):
+        with pytest.raises(InvalidConfigError):
+            PolicySpec(capacity=12).validate()
+        with pytest.raises(InvalidConfigError):
+            PolicySpec(capacity=4).validate()
+        PolicySpec(capacity=512).validate()
+
+    def test_window_scaling_gate(self):
+        t = self._table(window_scaling=False)
+        with pytest.raises(InvalidConfigError, match="window"):
+            t.set("k", 5, window_scale=0.5)
+        t.set("k", 5)  # scale 1 is fine
+
+    def test_effective_window_bounds(self):
+        t = self._table(window=60.0)
+        with pytest.raises(InvalidConfigError, match="window"):
+            t.set("k", 5, window_scale=1e-9)
+
+    def test_host_arrays_sorted_and_padded(self):
+        t = self._table(capacity=8, limit=4)
+        t.set("a", 7)
+        t.set("b", 9)
+        arrs = t.host_arrays()
+        assert arrs["key"].shape == (8,)
+        assert list(arrs["key"]) == sorted(arrs["key"])
+        assert np.sum(arrs["key"] != pk.PAD_KEY) == 2
+        # Padding rows carry defaults.
+        assert arrs["limit"][-1] == 4
+
+    def test_rebase_moves_defaults_only(self):
+        t = self._table(limit=4)
+        t.set("vip", 10)
+        t.rebase(6, 60.0)
+        arrs = t.host_arrays()
+        assert arrs["limit"][-1] == 6            # default column moved
+        assert t.get("vip").limit == 10          # entry pinned
+
+
+# ----------------------------------------------------- limiter integration
+
+class TestCheckpointRoundTrip:
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_overrides_survive_restore(self, backend, tmp_path):
+        lim, clock = make(backend)
+        lim.set_override("vip", 9)
+        lim.set_override("cheap", 2)
+        lim.allow_batch(["vip"] * 5)
+        path = str(tmp_path / "snap.npz")
+        lim.save(path)
+        lim2, _ = make(backend)
+        lim2.restore(path)
+        assert lim2.get_override("vip").limit == 9
+        assert lim2.get_override("cheap").limit == 2
+        assert lim2.override_count() == 2
+        # Both the override AND the consumed quota restored: 4 of 9 left.
+        assert lim2.allow_batch(["vip"] * 9).allow_count == 4
+        lim.close()
+        lim2.close()
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_policy_spec_mismatch_rejected(self, backend, tmp_path):
+        """PolicySpec is part of the config fingerprint: a snapshot taken
+        under a different override-table geometry must refuse to load."""
+        lim, _ = make(backend)
+        path = str(tmp_path / "snap.npz")
+        lim.save(path)
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=4,
+                     window=60.0, policy=PolicySpec(capacity=64))
+        lim2 = create_limiter(cfg, backend=backend, clock=clock)
+        with pytest.raises(CheckpointError, match="fingerprint"):
+            lim2.restore(path)
+        lim.close()
+        lim2.close()
+
+    def test_pre_policy_checkpoint_restores_empty_table(self, tmp_path):
+        """Snapshots written before any override existed restore with an
+        empty table (the policy_* columns are present but zero-length)."""
+        lim, _ = make("exact")
+        path = str(tmp_path / "snap.npz")
+        lim.save(path)
+        lim2, _ = make("exact")
+        lim2.set_override("vip", 9)
+        lim2.restore(path)
+        assert lim2.override_count() == 0
+        lim.close()
+        lim2.close()
+
+
+class TestOccupancyGauge:
+    def test_gauge_tracks_mutations(self):
+        from ratelimiter_tpu.observability import metrics as m
+
+        lim, _ = make("exact")
+        lim.set_override("a", 5)
+        lim.set_override("b", 6)
+        g = m.DEFAULT.get("rate_limiter_policy_overrides")
+        assert g is not None and g.value() == 2.0
+        lim.delete_override("a")
+        assert g.value() == 1.0
+        lim.close()
+
+    def test_occupancy_in_metrics_text(self):
+        from ratelimiter_tpu.observability import metrics as m
+
+        lim, _ = make("exact")
+        lim.set_override("a", 5)
+        assert "rate_limiter_policy_overrides" in m.DEFAULT.render()
+        lim.close()
+
+
+class TestUpdateInteractions:
+    def test_update_limit_moves_default_tier_only(self):
+        lim, _ = make("exact", limit=4)
+        lim.set_override("vip", 10)
+        lim.update_limit(6)
+        assert lim.allow_batch(["std"] * 8).allow_count == 6
+        assert lim.allow_batch(["vip"] * 12).allow_count == 10
+        lim.close()
+
+    def test_update_window_blocked_with_scaled_overrides(self):
+        lim, _ = make("exact", window=60.0)
+        lim.set_override("fast", window_scale=0.5)
+        with pytest.raises(InvalidConfigError, match="window-scaled"):
+            lim.update_window(30.0)
+        lim.delete_override("fast")
+        lim.update_window(30.0)  # fine once the scaled entry is gone
+        lim.close()
+
+    def test_update_window_revalidates_overrides(self):
+        """A window change that would push an existing override past the
+        exact-integer overflow gates is refused BEFORE any state moves."""
+        lim, _ = make("dense", algo=Algorithm.TOKEN_BUCKET, limit=10,
+                      window=60.0)
+        lim.set_override("vip", 4_000_000)  # fine at 60s
+        with pytest.raises(InvalidConfigError, match="vip"):
+            lim.update_window(3.15e7)       # ~1 year: W*num overflows
+        assert lim.config.window == 60.0    # nothing migrated
+        lim.close()
+
+    def test_dense_override_validated_against_gates(self):
+        lim, _ = make("dense", limit=4, window=60.0)
+        with pytest.raises(InvalidConfigError):
+            lim.set_override("huge", 1 << 50)
+        lim.close()
+
+    def test_sketch_override_f32_gate(self):
+        lim, _ = make("sketch", algo=Algorithm.TPU_SKETCH)
+        with pytest.raises(InvalidConfigError, match="2\\*\\*24"):
+            lim.set_override("huge", 1 << 24)
+        lim.close()
+
+
+# ------------------------------------------------------------- wire frames
+
+class TestWireProtocol:
+    def test_policy_frames_roundtrip_encode_parse(self):
+        from ratelimiter_tpu.serving import protocol as p
+
+        frame = p.encode_policy_set(7, "vip", 9, 0.5)
+        length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert type_ == p.T_POLICY_SET and rid == 7
+        key, limit, scale = p.parse_policy_set(frame[p.HEADER_SIZE:])
+        assert (key, limit, scale) == ("vip", 9, 0.5)
+        # limit=None -> "keep default" flag
+        frame = p.encode_policy_set(8, "w", None, 2.0)
+        _, limit, scale = p.parse_policy_set(frame[p.HEADER_SIZE:])
+        assert limit is None and scale == 2.0
+        body = p.encode_policy_r(9, True, 9, 0.5)[p.HEADER_SIZE:]
+        assert p.parse_policy_r(body) == (True, 9, 0.5)
+
+    def test_server_policy_rpcs(self):
+        """SET/GET/DEL over the asyncio server change live decisions."""
+        from ratelimiter_tpu.serving import Client
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        async def run():
+            lim, _ = make("exact", limit=3)
+            srv = RateLimitServer(lim, port=0)
+            await srv.start()
+
+            def client_ops():
+                c = Client(port=srv.port)
+                assert c.set_override("vip", 7) == (7, 1.0)
+                assert c.get_override("vip") == (7, 1.0)
+                assert c.get_override("other") is None
+                allowed = sum(c.allow("vip").allowed for _ in range(9))
+                assert allowed == 7
+                assert c.allow("std").limit == 3
+                assert c.delete_override("vip") is True
+                assert c.delete_override("vip") is False
+                with pytest.raises(InvalidConfigError):
+                    c.set_override("bad", -1)
+                c.close()
+
+            await asyncio.get_running_loop().run_in_executor(None, client_ops)
+            await srv.shutdown()
+            lim.close()
+
+        asyncio.run(run())
+
+
+# --------------------------------------------------------------- x64 hygiene
+
+class TestX64Hygiene:
+    def test_import_leaves_x64_untouched(self):
+        """Satellite: importing the library (and its kernel modules) must
+        not flip the process-global jax_enable_x64 — that global changes
+        dtype semantics for unrelated user JAX code."""
+        code = (
+            "import jax\n"
+            "before = bool(jax.config.jax_enable_x64)\n"
+            "import ratelimiter_tpu\n"
+            "import ratelimiter_tpu.ops.dense_kernels\n"
+            "import ratelimiter_tpu.ops.sketch_kernels\n"
+            "import ratelimiter_tpu.ops.bucket_kernels\n"
+            "import ratelimiter_tpu.ops.policy_kernels\n"
+            "after = bool(jax.config.jax_enable_x64)\n"
+            "assert before == after == False, (before, after)\n"
+            "print('untouched')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "untouched" in out.stdout
+
+    def test_device_limiter_requires_x64(self):
+        """Construction (not some deep dispatch) fails loudly without the
+        flag, naming the fix."""
+        code = (
+            "import jax\n"
+            "from ratelimiter_tpu import Algorithm, Config, create_limiter\n"
+            "cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=5,"
+            " window=60.0)\n"
+            "try:\n"
+            "    create_limiter(cfg, backend='sketch')\n"
+            "except RuntimeError as e:\n"
+            "    assert 'jax_enable_x64' in str(e), e\n"
+            "    print('raised')\n"
+            "else:\n"
+            "    raise SystemExit('no error raised')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "raised" in out.stdout
+
+    def test_exact_backend_works_without_x64(self):
+        code = (
+            "from ratelimiter_tpu import Algorithm, Config, create_limiter\n"
+            "cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=5,"
+            " window=60.0)\n"
+            "lim = create_limiter(cfg, backend='exact')\n"
+            "assert lim.allow('k').allowed\n"
+            "print('exact ok')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr

@@ -112,9 +112,9 @@ def test_dense_scan_equals_sequential_steps():
         st2 = dense_kernels.init_state(algo, cap, cfg.limit)
         want = []
         for t in range(T):
-            st2, (allowed, _, _) = step(st2, jnp.asarray(sids[t]),
-                                        jnp.asarray(ns[t]),
-                                        jnp.int64(T0 + t * dt))
+            st2, (allowed, *_rest) = step(st2, jnp.asarray(sids[t]),
+                                          jnp.asarray(ns[t]),
+                                          jnp.int64(T0 + t * dt))
             want.append(np.asarray(allowed))
         np.testing.assert_array_equal(got, np.stack(want), err_msg=str(algo))
         np.testing.assert_array_equal(np.asarray(denies),

@@ -124,6 +124,48 @@ class TestMicroBatcher:
         batcher.close()
         lim.close()
 
+    def test_threadsafe_decide_many_single_dispatch_in_order(self):
+        """Satellite pin (gRPC AllowBatch path, transport-free): the bulk
+        bridge submits the WHOLE frame before waiting, so N items cost
+        O(1) coalesced dispatches — and results come back in request
+        order even with duplicate keys."""
+        import threading
+
+        from ratelimiter_tpu.serving.__main__ import (
+            make_threadsafe_decide_many,
+        )
+
+        lim, _ = _mk_limiter(limit=2)
+        dispatches = []
+        inner_allow_batch = lim.allow_batch
+
+        def counting_allow_batch(keys, ns=None, **kw):
+            dispatches.append(len(keys))
+            return inner_allow_batch(keys, ns, **kw)
+
+        lim.allow_batch = counting_allow_batch
+        reg = Registry()
+        batcher = MicroBatcher(lim, max_batch=4096, max_delay=2e-3,
+                               registry=reg)
+
+        async def go():
+            loop = asyncio.get_running_loop()
+            decide_many = make_threadsafe_decide_many(batcher, loop)
+            pairs = [("a", 1), ("b", 1), ("a", 1), ("b", 1), ("a", 1)]
+            # decide_many blocks, so it runs on a worker thread exactly
+            # like a gRPC handler does.
+            results = await loop.run_in_executor(None, decide_many, pairs)
+            await batcher.drain()
+            return results
+
+        results = asyncio.run(go())
+        # One dispatch for the whole 5-item frame.
+        assert dispatches == [5]
+        # Order preserved: per-key greedy in frame order at limit=2.
+        assert [r.allowed for r in results] == [True, True, True, True, False]
+        batcher.close()
+        lim.close()
+
     def test_validation_rejected_before_batching(self):
         lim, _ = _mk_limiter()
         batcher = MicroBatcher(lim, max_batch=8, max_delay=1e-3)
