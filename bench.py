@@ -37,10 +37,20 @@ Baseline: the reference's own single-instance sliding-window estimate,
 ~30,000 req/s (``docs/ARCHITECTURE.md:439``, SURVEY.md §6); north star:
 10M decisions/s (BASELINE.json).
 
+E. (opt-in, ``--snapshot-interval S``) Durability overhead: the SAME
+   allow_hashed dispatch loop measured twice — bare, then with the
+   persistence subsystem's background snapshotter running at interval S —
+   and the p50/p99 per-dispatch latencies of both. Guards the off-lock
+   serialization claim (persistence/snapshotter.py): only the device→host
+   capture holds the limiter lock, so background snapshots must not blow
+   up tail latency (tests/test_snapshot_overhead.py asserts the budget).
+
 Run: python bench.py                 (real chip; CPU fallback uses tiny shapes)
      BENCH_ACC_WINDOWS=0.25 python bench.py    (quicker, partial coverage)
+     python bench.py --snapshot-interval 1.0   (adds phase E to the JSON)
 """
 
+import argparse
 import json
 import os
 import sys
@@ -87,9 +97,98 @@ def _sync(x) -> None:
     np.asarray(x.ravel()[:1] if hasattr(x, "ravel") else x)
 
 
+def measure_snapshot_overhead(snapshot_interval: float, *,
+                              snapshot_dir: str,
+                              seconds: float = 2.0,
+                              batch: int = INGEST_BATCH,
+                              depth: int = 3, width: int = 1 << 15,
+                              sub_windows: int = 60) -> dict:
+    """Phase E: p50/p99 per-dispatch allow latency with and without the
+    background snapshotter, same limiter shape, same trace. Importable —
+    tests/test_snapshot_overhead.py runs it small and asserts the p99
+    budget (the off-lock serialization guard)."""
+    import tempfile
+
+    from ratelimiter_tpu import (
+        Algorithm,
+        Config,
+        ManualClock,
+        PersistenceSpec,
+        create_limiter,
+    )
+    from ratelimiter_tpu.ops.hashing import splitmix64
+
+    def run(with_snapshots: bool) -> dict:
+        d = tempfile.mkdtemp(dir=snapshot_dir)
+        cfg = Config(
+            algorithm=Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+            max_batch_admission_iters=1,
+            sketch=SketchParams(depth=depth, width=width,
+                                sub_windows=sub_windows),
+            persistence=PersistenceSpec(dir=d,
+                                        snapshot_interval=snapshot_interval))
+        lim = create_limiter(cfg, backend="sketch",
+                             clock=ManualClock(T0_US / 1e6))
+        rng = np.random.default_rng(0)
+        h = splitmix64(rng.integers(1, 1 << 40, size=batch,
+                                    dtype=np.uint64))
+        lim.allow_hashed(h, now=T0_US / 1e6)          # compile
+        mgr = None
+        if with_snapshots:
+            from ratelimiter_tpu.observability.metrics import Registry
+            from ratelimiter_tpu.persistence import PersistenceManager
+
+            # Private registry: the DEFAULT families are process-global
+            # and cumulative, so reading them here would over-report
+            # snapshots_taken on any second run in the same process.
+            mgr = PersistenceManager(cfg.persistence, registry=Registry())
+            lim_top = mgr.wrap(lim)
+            mgr.attach([lim_top])
+            mgr.start()
+        lats = []
+        t_end = time.perf_counter() + seconds
+        step = 0
+        while time.perf_counter() < t_end:
+            now = (T0_US + step * 1000) / 1e6          # 1 ms virtual steps
+            t0 = time.perf_counter()
+            lim.allow_hashed(h, now=now)
+            lats.append(time.perf_counter() - t0)
+            step += 1
+        snaps = 0
+        if mgr is not None:
+            snaps = int(mgr.snapshotter._snap_total.value())
+            mgr.stop(final_snapshot=False)
+        lim.close()
+        lats = np.asarray(lats)
+        return {"dispatches": int(lats.size),
+                "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+                "snapshots_taken": snaps}
+
+    base = run(False)
+    with_snap = run(True)
+    return {
+        "snapshot_interval_s": snapshot_interval,
+        "geometry": {"depth": depth, "width": width,
+                     "sub_windows": sub_windows},
+        "baseline": base,
+        "with_snapshots": with_snap,
+        "p99_overhead_ms": round(
+            with_snap["p99_ms"] - base["p99_ms"], 3),
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--snapshot-interval", type=float, default=None,
+                    metavar="S",
+                    help="also measure durability overhead (phase E): "
+                         "p50/p99 allow latency with a background "
+                         "snapshotter at this interval vs bare")
+    args = ap.parse_args()
 
     platform = jax.devices()[0].platform
     on_accel = platform != "cpu"
@@ -294,6 +393,17 @@ def main() -> None:
     except Exception as exc:  # report the omission, never fail the bench
         e2e = {"e2e_server_error": str(exc)[:200]}
 
+    # ------------------------------------------ phase E: durability cost
+    snap_overhead: dict = {}
+    if args.snapshot_interval is not None:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            snap_overhead = {"snapshot_overhead": measure_snapshot_overhead(
+                args.snapshot_interval, snapshot_dir=d,
+                seconds=2.0 if on_accel else 1.0,
+                width=(1 << 18) if on_accel else (1 << 14))}
+
     print(json.dumps({
         "metric": "sketch_allow_decisions_per_sec",
         "value": round(rps, 1),
@@ -344,6 +454,7 @@ def main() -> None:
         "sketch_geometry": {"depth": cfg.sketch.depth, "width": cfg.sketch.width,
                             "sub_windows": 60, "conservative_update": True},
         **e2e,
+        **snap_overhead,
     }))
 
 

@@ -30,7 +30,13 @@ Public API (capability parity with reference ``internal/ratelimiter/interface.go
 """
 
 from ratelimiter_tpu.core.types import Algorithm, Result, BatchResult
-from ratelimiter_tpu.core.config import Config, SketchParams, DenseParams, DEFAULT_PREFIX
+from ratelimiter_tpu.core.config import (
+    Config,
+    SketchParams,
+    DenseParams,
+    PersistenceSpec,
+    DEFAULT_PREFIX,
+)
 from ratelimiter_tpu.core.errors import (
     RateLimiterError,
     InvalidConfigError,
@@ -53,6 +59,7 @@ __all__ = [
     "Config",
     "SketchParams",
     "DenseParams",
+    "PersistenceSpec",
     "DEFAULT_PREFIX",
     "RateLimiterError",
     "InvalidConfigError",

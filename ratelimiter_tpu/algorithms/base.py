@@ -274,6 +274,28 @@ class RateLimiter(abc.ABC):
         with lock:
             return fn()
 
+    # -- durability (checkpoint / async snapshot seam) ---------------------
+
+    def capture_state(self):
+        """Lock-held, cheap device→host capture of full limiter state:
+        returns ``(kind, arrays, extra)`` ready for
+        ``checkpoint.save_state``. The contract that makes async
+        snapshotting (persistence/snapshotter.py) safe: everything
+        needing the limiter's lock happens INSIDE this call; the caller
+        serializes and writes off-lock. ``save()`` is capture + write in
+        one blocking call (the manual checkpoint surface)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support state capture")
+
+    def save(self, path: str) -> None:
+        """Blocking snapshot to ``path`` (.npz): capture under the lock,
+        then a crash-atomic write (checkpoint.save_state). Format and
+        staleness contract: ratelimiter_tpu/checkpoint.py."""
+        from ratelimiter_tpu.checkpoint import save_state
+
+        kind, arrays, extra = self.capture_state()
+        save_state(path, kind, self.config, arrays, extra)
+
     # -- implementation hooks ---------------------------------------------
 
     @abc.abstractmethod

@@ -336,11 +336,10 @@ class DenseLimiter(RateLimiter):
 
     # ------------------------------------------------- checkpoint/restore
 
-    def save(self, path: str) -> None:
-        """Snapshot device state + the host slot map to ``path`` (.npz).
+    def capture_state(self):
+        """Lock-held device→host transfer of state buffers + the host
+        slot map; serialization/writing happen in the caller, off-lock.
         Format/staleness contract: ratelimiter_tpu/checkpoint.py."""
-        from ratelimiter_tpu.checkpoint import save_state
-
         self._check_open()
         with self._lock:
             arrays = {f"state_{k}": np.asarray(v)
@@ -351,7 +350,7 @@ class DenseLimiter(RateLimiter):
             arrays["last_used"] = self._last_used.copy()
             arrays.update(self._policy_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now(), "capacity": self._capacity}
-        save_state(path, "dense", self.config, arrays, extra)
+        return "dense", arrays, extra
 
     def restore(self, path: str) -> None:
         """Replace device state and slot map with the snapshot. Elapsed-time

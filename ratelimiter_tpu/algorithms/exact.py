@@ -350,13 +350,12 @@ class ExactLimiter(RateLimiter):
 
     # ------------------------------------------------- checkpoint/restore
 
-    def save(self, path: str) -> None:
-        """Snapshot the host dicts to ``path`` (.npz) — same format family
+    def capture_state(self):
+        """Lock-held copy of the host dicts as arrays — same format family
         as the device backends (ratelimiter_tpu/checkpoint.py), so the
-        oracle can be checkpointed alongside the backend it validates."""
+        oracle can be checkpointed alongside the backend it validates.
+        Serialization/writing happen in the caller, off-lock."""
         import numpy as np
-
-        from ratelimiter_tpu.checkpoint import save_state
 
         self._check_open()
         with self._lock:
@@ -368,7 +367,7 @@ class ExactLimiter(RateLimiter):
                     np.array(list(d.values()), dtype=np.int64).reshape(-1, width))
             arrays.update(self._policy_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now()}
-        save_state(path, "exact", self.config, arrays, extra)
+        return "exact", arrays, extra
 
     def restore(self, path: str) -> None:
         import numpy as np  # noqa: F401  (symmetry with save)

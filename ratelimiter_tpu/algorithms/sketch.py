@@ -416,11 +416,13 @@ class SketchLimiter(RateLimiter):
     #: are unaffected (export_completed skips owner2==0 slots).
     _CKPT_OPTIONAL: tuple = ("hh_owner2",)
 
-    def save(self, path: str) -> None:
-        """Snapshot device state to ``path`` (.npz). See
-        ratelimiter_tpu/checkpoint.py for format and staleness contract."""
-        from ratelimiter_tpu.checkpoint import save_state
-
+    def capture_state(self):
+        """Lock-held device→host transfer of the full ring + policy
+        columns (the np.asarray calls). This is the only part of a
+        snapshot that blocks decisions — serialization and the fsynced
+        write happen in the caller, off-lock
+        (persistence/snapshotter.py). Format and staleness contract:
+        ratelimiter_tpu/checkpoint.py."""
         self._check_open()
         with self._lock:
             arrays = {k: np.asarray(v) for k, v in self._state.items()}
@@ -429,7 +431,7 @@ class SketchLimiter(RateLimiter):
             hp = getattr(self, "_host_period", None)
             if hp is not None:
                 extra["host_period"] = int(hp)
-        save_state(path, self._CKPT_KIND, self.config, arrays, extra)
+        return self._CKPT_KIND, arrays, extra
 
     def restore(self, path: str) -> None:
         """Replace device state with the snapshot at ``path``. Catch-up for

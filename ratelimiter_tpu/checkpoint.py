@@ -49,17 +49,67 @@ _tmp_counter = itertools.count()
 
 def config_fingerprint(config: Config) -> str:
     """Stable hash over every semantic config field (dataclass fields are
-    all plain values, so the sorted-JSON of asdict is canonical)."""
+    all plain values, so the sorted-JSON of asdict is canonical).
+
+    The ``persistence`` spec is excluded: snapshot cadence / fsync policy
+    are operational knobs, and a snapshot taken at one cadence must
+    restore under another. Every OTHER field participates — changing this
+    function's output strands every existing snapshot, which is why
+    tests/test_checkpoint.py pins a golden value.
+    """
+    fields = asdict(config)
+    fields.pop("persistence", None)
     payload = json.dumps(
-        {**asdict(config), "algorithm": str(config.algorithm)},
+        {**fields, "algorithm": str(config.algorithm)},
         sort_keys=True, default=str)
     return hashlib.sha256(payload.encode()).hexdigest()[:32]
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss
+    (the rename itself lives in the directory's metadata). Best-effort:
+    some filesystems/platforms refuse O_RDONLY fsync on directories."""
+    try:
+        fd = os.open(path if path else ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_atomic(path: str, data: bytes) -> None:
+    """Crash-atomic file write: tmp + fsync(file) + os.replace + fsync(dir).
+    A crash at ANY point leaves either the old file or the new one, never
+    a torn mix; after return the bytes are on stable storage."""
+    # Unique per call, not just per process: concurrent writers to the
+    # same path would otherwise share one tmp name and steal each
+    # other's file out from under os.replace (last replace wins either
+    # way; both must survive).
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
 def save_state(path: str, kind: str, config: Config,
                arrays: Dict[str, np.ndarray], extra: Dict[str, Any]) -> None:
-    """Atomic write (tmp + rename): a crash mid-save never corrupts the
-    previous snapshot."""
+    """Crash-atomic snapshot write (see write_atomic): a crash mid-save
+    never corrupts the previous snapshot, and a completed save survives
+    power loss (file and directory entry both fsynced)."""
     meta = {
         "format_version": FORMAT_VERSION,
         "kind": kind,
@@ -72,14 +122,7 @@ def save_state(path: str, kind: str, config: Config,
     np.savez(buf, **arrays,
              **{_META_KEY: np.frombuffer(
                  json.dumps(meta).encode(), dtype=np.uint8)})
-    # Unique per call, not just per process: concurrent save() calls to
-    # the same path would otherwise share one tmp name and steal each
-    # other's file out from under os.replace (last replace wins either
-    # way; both must survive).
-    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_counter)}"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
+    write_atomic(path, buf.getvalue())
 
 
 def load_state(path: str, kind: str, config: Config,

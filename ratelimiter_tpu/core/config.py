@@ -215,6 +215,76 @@ class PolicySpec:
 
 
 @dataclass(frozen=True)
+class PersistenceSpec:
+    """Durability subsystem configuration (ratelimiter_tpu/persistence/).
+
+    When ``dir`` is set, the limiter stack gains a write-ahead log for
+    every non-decision mutation (policy overrides, resets, dynamic
+    limit/window updates) plus async background snapshots, and recovery
+    on startup replays the WAL suffix past the newest snapshot's
+    watermark (docs/ADR/009). ``dir=None`` (the default) disables the
+    subsystem entirely — zero hot-path cost.
+
+    Deliberately EXCLUDED from the checkpoint config fingerprint
+    (checkpoint.config_fingerprint): these are operational knobs, not
+    state geometry — a snapshot taken at one cadence must restore under
+    another.
+    """
+
+    #: Directory holding WAL segments, snapshots, and the manifest.
+    #: None disables persistence.
+    dir: Optional[str] = None
+    #: Seconds between background snapshots (the crash-window bound on
+    #: lost decisions).
+    snapshot_interval: float = 30.0
+    #: Also snapshot after this many WAL mutations (0 = interval only).
+    snapshot_after_mutations: int = 0
+    #: Snapshots retained on disk (older ones + their WAL prefix are
+    #: pruned after each successful snapshot).
+    retain: int = 3
+    #: WAL fsync policy: "always" (fsync every append — mutations are
+    #: rare control-plane ops, so this is the default), "interval"
+    #: (fsync at most every ``wal_fsync_interval`` seconds), "never"
+    #: (leave it to the OS; a power loss may drop the tail).
+    wal_fsync: str = "always"
+    wal_fsync_interval: float = 0.05
+    #: WAL segment rotation threshold, bytes.
+    wal_max_bytes: int = 64 << 20
+
+    @property
+    def enabled(self) -> bool:
+        return self.dir is not None
+
+    def validate(self) -> None:
+        if self.dir is not None and not isinstance(self.dir, str):
+            raise InvalidConfigError(
+                f"persistence dir must be a path string or None, "
+                f"got {self.dir!r}")
+        if not (self.snapshot_interval > 0):
+            raise InvalidConfigError(
+                f"snapshot_interval must be > 0, "
+                f"got {self.snapshot_interval!r}")
+        if self.snapshot_after_mutations < 0:
+            raise InvalidConfigError(
+                f"snapshot_after_mutations must be >= 0, "
+                f"got {self.snapshot_after_mutations!r}")
+        if self.retain < 1:
+            raise InvalidConfigError(
+                f"retain must be >= 1, got {self.retain!r}")
+        if self.wal_fsync not in ("always", "interval", "never"):
+            raise InvalidConfigError(
+                f"wal_fsync must be 'always', 'interval' or 'never', "
+                f"got {self.wal_fsync!r}")
+        if not (self.wal_fsync_interval > 0):
+            raise InvalidConfigError(
+                f"wal_fsync_interval must be > 0, "
+                f"got {self.wal_fsync_interval!r}")
+        if self.wal_max_bytes < 4096:
+            raise InvalidConfigError(
+                f"wal_max_bytes must be >= 4096, got {self.wal_max_bytes!r}")
+
+
+@dataclass(frozen=True)
 class DenseParams:
     """Geometry of the dense (exact, slot-addressed) device backend."""
 
@@ -247,6 +317,9 @@ class Config:
         dense: dense-store geometry (dense backend only).
         policy: per-key override table geometry (the policy engine;
             every backend consults it inside its decision step).
+        persistence: durability subsystem knobs (WAL + async snapshots;
+            disabled unless ``persistence.dir`` is set). NOT part of the
+            checkpoint fingerprint — operational, not state geometry.
     """
 
     algorithm: Algorithm
@@ -258,6 +331,7 @@ class Config:
     sketch: SketchParams = field(default_factory=SketchParams)
     dense: DenseParams = field(default_factory=DenseParams)
     policy: PolicySpec = field(default_factory=PolicySpec)
+    persistence: PersistenceSpec = field(default_factory=PersistenceSpec)
 
     def validate(self) -> None:
         """Reference ``Config.Validate`` (``config.go:16-50``), same bounds."""
@@ -279,6 +353,7 @@ class Config:
         self.sketch.validate()
         self.dense.validate()
         self.policy.validate()
+        self.persistence.validate()
 
     def with_defaults(self) -> "Config":
         """Non-mutating defaulting (reference ``config.go:54-67``): returns a

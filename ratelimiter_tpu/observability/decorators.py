@@ -111,6 +111,19 @@ class LimiterDecorator(RateLimiter):
         # decorator's config property reflects it automatically).
         self.inner.update_limit(new_limit)
 
+    def update_window(self, new_window: float) -> None:
+        # Same: the base implementation would run against the decorator
+        # and try to assign its read-only config property.
+        self.inner.update_window(new_window)
+
+    def capture_state(self):
+        # Explicit (base defines it, so __getattr__ never fires): the
+        # durability subsystem snapshots the BACKEND's state.
+        return self.inner.capture_state()
+
+    def save(self, path: str) -> None:
+        self.inner.save(path)
+
     # Pass-through for backend extras (allow_hashed, inject_failure, ...) --
 
     def __getattr__(self, name: str):

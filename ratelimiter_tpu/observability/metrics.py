@@ -23,6 +23,18 @@ LATENCY_BUCKETS = (1e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
 #: Batch-size buckets for the micro-batcher (powers of two up to 64K).
 BATCH_BUCKETS = tuple(float(1 << i) for i in range(17))
 
+#: Snapshot-duration buckets, seconds — the durability subsystem's
+#: background captures span ~1 ms (tiny host state) to tens of seconds
+#: (multi-GiB sketch rings serialized off-lock). Families using them:
+#: rate_limiter_snapshot_duration_seconds plus the gauges/counters
+#: rate_limiter_last_snapshot_timestamp_seconds,
+#: rate_limiter_snapshot_capture_seconds, rate_limiter_snapshots_total,
+#: rate_limiter_snapshot_failures_total, rate_limiter_wal_records_total,
+#: rate_limiter_wal_bytes_total, rate_limiter_wal_seq
+#: (ratelimiter_tpu/persistence/).
+SNAPSHOT_DURATION_BUCKETS = (1e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25,
+                             0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))

@@ -1,0 +1,38 @@
+"""Durability subsystem: write-ahead log + async incremental snapshots
+with crash recovery (docs/ADR/009).
+
+The reference accepts "losing Redis loses all counters" (its ADR-001);
+this port's state lives in HBM and dies with the process, so durability
+is explicit: mutations (policy overrides, resets, dynamic config) are
+WAL-logged and recover exactly; decision counters are snapshotted in the
+background and recover to within one snapshot interval, under-counting —
+the documented fail-toward-allowing posture.
+
+    from ratelimiter_tpu.persistence import PersistenceManager
+
+    mgr = PersistenceManager(cfg.persistence)
+    lim = mgr.wrap(create_limiter(cfg, backend="sketch"))
+    mgr.attach([lim])
+    mgr.recover()      # before traffic
+    mgr.start()        # background snapshots
+"""
+
+from ratelimiter_tpu.persistence.manager import (
+    PersistenceManager,
+    PersistentLimiter,
+)
+from ratelimiter_tpu.persistence.recover import RecoveryReport, recover
+from ratelimiter_tpu.persistence.snapshotter import Snapshotter, read_manifest
+from ratelimiter_tpu.persistence.wal import WalRecord, WriteAheadLog, replay
+
+__all__ = [
+    "PersistenceManager",
+    "PersistentLimiter",
+    "RecoveryReport",
+    "recover",
+    "Snapshotter",
+    "read_manifest",
+    "WalRecord",
+    "WriteAheadLog",
+    "replay",
+]

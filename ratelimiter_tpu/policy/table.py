@@ -22,6 +22,14 @@ limit, legal effective window) plus a backend-supplied ``validator``
 re-running that backend's overflow/representability gates per entry —
 an override a backend cannot decide exactly is refused loudly, never
 silently misdecided (the same posture as ops/dense_kernels._check_gates).
+
+Durability: overrides ride checkpoints as the ``policy_*`` columns
+(snapshot_arrays/restore_arrays below) AND are the write-ahead log's
+main cargo — with persistence enabled every set/delete is WAL-logged
+before acknowledgment and recovers EXACTLY across kill -9, even when
+the mutation postdates the newest snapshot (ratelimiter_tpu/persistence/,
+docs/ADR/009). Replay re-enters through ``set``'s full validation, so a
+log can never smuggle in an entry this backend would refuse.
 """
 
 from __future__ import annotations

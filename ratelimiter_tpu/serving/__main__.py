@@ -116,6 +116,36 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--http-policy-token", default=None,
                     help="bearer token required by /v1/policy (implies "
                          "--http-policy); Authorization header only")
+    # Durability subsystem (ratelimiter_tpu/persistence/, ADR-009).
+    ap.add_argument("--snapshot-dir", default=None,
+                    help="enable the durability subsystem: write-ahead "
+                         "log for mutations (policy/reset/config) plus "
+                         "async background snapshots in this directory; "
+                         "on start, state recovers from the newest "
+                         "snapshot + WAL replay. Off by default")
+    ap.add_argument("--snapshot-interval", type=float, default=30.0,
+                    help="seconds between background snapshots (bounds "
+                         "the decisions lost to kill -9 at one "
+                         "interval of traffic, in the under-counting "
+                         "direction)")
+    ap.add_argument("--snapshot-after-mutations", type=int, default=0,
+                    help="also snapshot after this many WAL mutations "
+                         "(0 = interval only)")
+    ap.add_argument("--snapshot-retain", type=int, default=3,
+                    help="snapshots kept on disk; older ones and their "
+                         "WAL prefix are pruned")
+    ap.add_argument("--wal-fsync", default="always",
+                    choices=["always", "interval", "never"],
+                    help="WAL durability: fsync every mutation (default; "
+                         "mutations are rare control-plane ops), at most "
+                         "every 50ms, or never (OS flushing only)")
+    ap.add_argument("--http-snapshot-token", default=None,
+                    help="bearer token required by POST /v1/snapshot on "
+                         "the HTTP gateway (the trigger is wired "
+                         "whenever --snapshot-dir is set; without a "
+                         "token it is open — snapshots cost disk churn, "
+                         "so gate it on shared surfaces). Authorization "
+                         "header only")
     ap.add_argument("--grpc-port", type=int, default=None,
                     help="also serve the gRPC contract "
                          "(api/proto/ratelimiter.proto) on this port; "
@@ -243,6 +273,8 @@ def _configure_jax(args) -> None:
 async def amain(args) -> None:
     logging.basicConfig(level=args.log_level.upper())
     _configure_jax(args)
+    from ratelimiter_tpu import PersistenceSpec
+
     cfg = Config(
         algorithm=Algorithm(args.algorithm),
         limit=args.limit,
@@ -250,9 +282,23 @@ async def amain(args) -> None:
         fail_open=args.fail_open,
         sketch=SketchParams(depth=args.sketch_depth, width=args.sketch_width,
                             sub_windows=args.sub_windows),
+        persistence=PersistenceSpec(
+            dir=args.snapshot_dir,
+            snapshot_interval=args.snapshot_interval,
+            snapshot_after_mutations=args.snapshot_after_mutations,
+            retain=args.snapshot_retain,
+            wal_fsync=args.wal_fsync),
     )
+    persist = None
+    if cfg.persistence.enabled:
+        from ratelimiter_tpu.persistence import PersistenceManager
+
+        persist = PersistenceManager(cfg.persistence)
     limiter = build_limiter_stack(create_limiter(cfg, backend=args.backend),
                                   args)
+    if persist is not None:
+        # Outermost wrapper: every surface's mutations reach the WAL.
+        limiter = persist.wrap(limiter)
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
     dcn_secret = (args.dcn_secret
@@ -280,9 +326,18 @@ async def amain(args) -> None:
             dcn_secret=dcn_secret,
             # Clone shards get the same decorator stack as shard 0, so
             # /metrics and the breaker see all N shards' traffic (each
-            # under its own shard label).
-            shard_decorate=(lambda lim, i: build_limiter_stack(
-                lim, args, shard=i)))
+            # under its own shard label) — plus the persistence wrapper,
+            # so a mutation on ANY shard reaches the WAL.
+            shard_decorate=(lambda lim, i: (
+                persist.wrap(build_limiter_stack(lim, args, shard=i))
+                if persist is not None
+                else build_limiter_stack(lim, args, shard=i))))
+        if persist is not None:
+            # Recover BEFORE the listener opens: replayed mutations and
+            # the restored snapshot must precede the first decision.
+            persist.attach(server.shard_limiters, shard_of=server.shard_of)
+            persist.recover()
+            persist.start()
         server.start()
         if dcn_peers:
             # One pusher PER SHARD limiter: keys are hash-routed across
@@ -313,7 +368,8 @@ async def amain(args) -> None:
                                    if k == "decisions_total"},
                                 "policy_overrides":
                                     server.shard_limiters[0].override_count(),
-                                **_envelope_health(server.shard_limiters)},
+                                **_envelope_health(server.shard_limiters),
+                                **(persist.status() if persist else {})},
                 enable_reset=http_reset,
                 reset_token=args.http_reset_token,
                 # Overrides apply on every shard (keys hash-route).
@@ -321,7 +377,9 @@ async def amain(args) -> None:
                 policy_get=server.get_override_one,
                 policy_delete=server.delete_override_all,
                 enable_policy=http_policy,
-                policy_token=args.http_policy_token)
+                policy_token=args.http_policy_token,
+                snapshot=(persist.snapshot_now if persist else None),
+                snapshot_token=args.http_snapshot_token)
             gateway.start()
         grpc_srv = None
         if args.grpc_port is not None:
@@ -353,7 +411,16 @@ async def amain(args) -> None:
             gateway.shutdown()
         if grpc_srv is not None:
             grpc_srv.shutdown()
-        server.shutdown()
+        if persist is not None:
+            # Stop the C++ door FIRST (answers in-flight work), then the
+            # final snapshot: every acknowledged decision is captured —
+            # a graceful shutdown loses nothing. Shard clones close
+            # after the capture.
+            server.shutdown(close_limiters=False)
+            persist.stop()
+            server.close_shards()
+        else:
+            server.shutdown()
         limiter.close()
         return
     if args.shards > 1:
@@ -368,6 +435,10 @@ async def amain(args) -> None:
                                  secret=dcn_secret))
         for pu in pushers:
             pu.start()
+    if persist is not None:
+        persist.attach([limiter])
+        persist.recover()
+        persist.start()
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,
@@ -375,7 +446,8 @@ async def amain(args) -> None:
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                           if args.dispatch_timeout_ms else None),
         dcn=bool(args.dcn_listen or args.dcn_peer),
-        dcn_secret=dcn_secret)
+        dcn_secret=dcn_secret,
+        snapshot=(persist.snapshot_now if persist else None))
     await server.start()
 
     gateway = None
@@ -396,14 +468,17 @@ async def amain(args) -> None:
             health=lambda: {"serving": True,
                             "decisions_total": server.batcher.decisions_total,
                             "policy_overrides": limiter.override_count(),
-                            **_envelope_health([limiter])},
+                            **_envelope_health([limiter]),
+                            **(persist.status() if persist else {})},
             enable_reset=http_reset,
             reset_token=args.http_reset_token,
             policy_set=limiter.set_override,
             policy_get=limiter.get_override,
             policy_delete=limiter.delete_override,
             enable_policy=http_policy,
-            policy_token=args.http_policy_token)
+            policy_token=args.http_policy_token,
+            snapshot=(persist.snapshot_now if persist else None),
+            snapshot_token=args.http_snapshot_token)
         gateway.start()
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
@@ -434,6 +509,10 @@ async def amain(args) -> None:
     if grpc_srv is not None:
         grpc_srv.shutdown()
     await server.shutdown()
+    if persist is not None:
+        # After drain, before close: the final snapshot captures every
+        # answered decision — a graceful shutdown loses nothing.
+        persist.stop()
     limiter.close()
 
 

@@ -107,6 +107,18 @@ class Client:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)
 
+    def snapshot(self) -> tuple[int, int, float]:
+        """Trigger a durability snapshot now (persistence must be enabled
+        server-side; asyncio front door only — under --native use HTTP
+        POST /v1/snapshot, the same asymmetry as the policy frames);
+        returns (snapshot_id, wal_seq, duration_s)."""
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(
+            p.encode_simple(p.T_SNAPSHOT, req_id), req_id)
+        if type_ != p.T_SNAPSHOT_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_snapshot_r(body)
+
     # ------------------------------------------- policy overrides (tiers)
 
     def _policy_roundtrip(self, frame: bytes, req_id: int):
@@ -251,6 +263,16 @@ class AsyncClient:
         if type_ != p.T_METRICS_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)
+
+    async def snapshot(self) -> tuple[int, int, float]:
+        """Trigger a durability snapshot now; returns
+        (snapshot_id, wal_seq, duration_s)."""
+        req_id = next(self._ids)
+        type_, body = await self._request(
+            p.encode_simple(p.T_SNAPSHOT, req_id), req_id)
+        if type_ != p.T_SNAPSHOT_R:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_snapshot_r(body)
 
     # ------------------------------------------- policy overrides (tiers)
 

@@ -11,6 +11,9 @@ anything without the binary protocol) get drop-in rate limiting:
     GET/POST /v1/allow?key=K[&n=N]   -> 200 allowed / 429 denied,
                                         X-RateLimit-* + Retry-After
     POST     /v1/reset?key=K         -> 200 {"ok": true}
+    POST     /v1/snapshot            -> 200 {"ok": true, "wal_seq": ...}
+                                        (durability trigger; 403 unless
+                                        persistence is enabled)
     GET      /v1/policy?key=K        -> 200 override | 404 default tier
     POST/PUT /v1/policy?key=K&limit=N[&window_scale=S]
                                      -> 200 stored override
@@ -78,7 +81,9 @@ class HttpGateway:
                  policy_get: Optional[Callable] = None,
                  policy_delete: Optional[Callable] = None,
                  enable_policy: bool = False,
-                 policy_token: Optional[str] = None):
+                 policy_token: Optional[str] = None,
+                 snapshot: Optional[Callable[[], dict]] = None,
+                 snapshot_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -208,6 +213,25 @@ class HttpGateway:
                         self._send(200, {"ok": True})
                     elif url.path == "/v1/policy":
                         self._handle_policy(q)
+                    elif (url.path == "/v1/snapshot"
+                          and self.command == "POST"):
+                        # Durability trigger: bearer-gated like reset
+                        # (it costs a capture + disk churn, so an open
+                        # surface invites DoS-by-snapshot).
+                        if gateway.snapshot is None:
+                            self._send(403, {"error": "persistence is not "
+                                             "enabled on this server"})
+                            return
+                        if not self._bearer_ok(gateway.snapshot_token):
+                            self._send(403, {"error": "bad snapshot token"})
+                            return
+                        entry = gateway.snapshot()
+                        self._send(200, {
+                            "ok": True,
+                            "snapshot_id": int(entry.get("id", 0)),
+                            "wal_seq": int(entry.get("wal_seq", 0)),
+                            "duration_s": float(entry.get("duration_s",
+                                                          0.0))})
                     elif url.path == "/healthz":
                         self._send(200, gateway.health())
                     elif url.path == "/metrics":
@@ -246,6 +270,9 @@ class HttpGateway:
         # Policy needs both an explicit opt-in AND wired callables.
         self.enable_policy = bool(enable_policy and policy_set is not None)
         self.policy_token = policy_token
+        # Snapshot trigger is wired iff the embedding runs persistence.
+        self.snapshot = snapshot
+        self.snapshot_token = snapshot_token
         self.metrics_render = metrics_render if metrics_render else lambda: ""
         self.health = health if health else lambda: {"serving": True}
         self._httpd = ThreadingHTTPServer((host, port), Handler)

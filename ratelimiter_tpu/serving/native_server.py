@@ -349,8 +349,18 @@ class NativeRateLimitServer:
     def start(self) -> None:
         self.port = self._server.start(self.host, self.port)
 
-    def shutdown(self) -> None:
+    def shutdown(self, *, close_limiters: bool = True) -> None:
+        """Stop the C++ door (drains in-flight work) and, by default,
+        close the owned shard clones. Pass close_limiters=False when
+        something must still read limiter state after the listener stops
+        — the durability subsystem's final snapshot (serving/__main__.py)
+        captures AFTER the last decision is answered, so a graceful
+        shutdown loses nothing; call close_shards() afterwards."""
         self._server.shutdown()
+        if close_limiters:
+            self.close_shards()
+
+    def close_shards(self) -> None:
         # Shards beyond the caller's limiter are owned here.
         for lim in self._shard_limiters[1:]:
             lim.close()

@@ -29,6 +29,12 @@ Requests:
                      tiered per-key override (policy engine)
     POLICY_GET  (8): u16 key_len, key utf-8
     POLICY_DEL  (9): u16 key_len, key utf-8
+    SNAPSHOT   (10): - — trigger a durability snapshot now
+                     (persistence/); E_INVALID_CONFIG when the server
+                     runs without --snapshot-dir. Asyncio front door
+                     only (same asymmetry as POLICY_*): the native C++
+                     door answers unknown-type and manages snapshots
+                     over HTTP POST /v1/snapshot instead
 
 Responses:
     RESULT   (129): u8 flags (bit0 allowed, bit1 fail_open), i64 limit,
@@ -47,6 +53,8 @@ Responses:
                     POLICY_SET (the stored entry) and POLICY_GET
                     (found=0 means default tier); POLICY_DEL answers it
                     too (found=1 iff an override existed)
+    SNAPSHOT (135): u64 snapshot_id, u64 wal_seq (the watermark the
+                    snapshot captured), f64 duration_s
     ERROR    (255): u16 code, u16 msg_len, msg utf-8; for ALLOW_BATCH an
                     error response covers the whole frame
 
@@ -86,6 +94,7 @@ T_DCN_PUSH = 6
 T_POLICY_SET = 7
 T_POLICY_GET = 8
 T_POLICY_DEL = 9
+T_SNAPSHOT = 10
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
@@ -97,6 +106,7 @@ T_HEALTH_R = 131
 T_METRICS_R = 132
 T_RESULT_BATCH = 133
 T_POLICY_R = 134
+T_SNAPSHOT_R = 135
 T_ERROR = 255
 
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
@@ -235,6 +245,23 @@ def parse_policy_r(body: bytes):
     """-> (found, limit, window_scale)."""
     found, limit, scale = _POLICY_R_BODY.unpack(body)
     return bool(found), limit, scale
+
+
+# ------------------------------------------------- durability snapshots
+
+_SNAPSHOT_R_BODY = struct.Struct("<QQd")  # snapshot_id, wal_seq, duration_s
+
+
+def encode_snapshot_r(req_id: int, snapshot_id: int, wal_seq: int,
+                      duration_s: float) -> bytes:
+    body = _SNAPSHOT_R_BODY.pack(snapshot_id, wal_seq, float(duration_s))
+    return _HDR.pack(1 + 8 + len(body), T_SNAPSHOT_R, req_id) + body
+
+
+def parse_snapshot_r(body: bytes) -> Tuple[int, int, float]:
+    """-> (snapshot_id, wal_seq, duration_s)."""
+    snapshot_id, wal_seq, duration = _SNAPSHOT_R_BODY.unpack(body)
+    return snapshot_id, wal_seq, duration
 
 
 _BATCH_ITEM = struct.Struct("<IH")       # n, key_len (per request)

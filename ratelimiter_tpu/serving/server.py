@@ -45,7 +45,8 @@ class RateLimitServer:
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
                  registry: Optional[m.Registry] = None,
-                 dcn: bool = False, dcn_secret: Optional[str] = None):
+                 dcn: bool = False, dcn_secret: Optional[str] = None,
+                 snapshot: Optional[callable] = None):
         self.limiter = limiter
         self.host = host
         self.port = port
@@ -57,6 +58,9 @@ class RateLimitServer:
         #: (targeted false denies); see docs/OPERATIONS.md.
         self.dcn = dcn
         self.dcn_secret = dcn_secret
+        #: Durability trigger (persistence manager's snapshot_now);
+        #: None answers T_SNAPSHOT with E_INVALID_CONFIG.
+        self.snapshot = snapshot
         self.registry = registry if registry is not None else m.DEFAULT
         self.batcher = MicroBatcher(
             limiter, max_batch=max_batch, max_delay=max_delay,
@@ -251,6 +255,25 @@ class RateLimitServer:
                     self.batcher.decisions_total)
             elif type_ == p.T_METRICS:
                 out = p.encode_metrics(req_id, self.registry.render())
+            elif type_ == p.T_SNAPSHOT:
+                if self.snapshot is None:
+                    out = p.encode_error(
+                        req_id, p.E_INVALID_CONFIG,
+                        "persistence not enabled on this server "
+                        "(--snapshot-dir)")
+                else:
+                    try:
+                        # Off the event loop: capture takes the limiter
+                        # lock and the write fsyncs.
+                        entry = await asyncio.get_running_loop(
+                            ).run_in_executor(None, self.snapshot)
+                        out = p.encode_snapshot_r(
+                            req_id, int(entry.get("id", 0)),
+                            int(entry.get("wal_seq", 0)),
+                            float(entry.get("duration_s", 0.0)))
+                    except Exception as exc:
+                        out = p.encode_error(req_id, p.code_for(exc),
+                                             str(exc))
             elif type_ == p.T_DCN_PUSH:
                 if not self.dcn:
                     out = p.encode_error(

@@ -225,6 +225,123 @@ class TestValidation:
         lim2.close()
 
 
+class TestCrashAtomicSave:
+    """ISSUE-2 satellite: save_state is crash-atomic on its own — tmp
+    write + fsync(file) + os.replace + fsync(dir). A failure injected
+    mid-write must leave the previous snapshot byte-identical and no
+    tmp litter behind."""
+
+    def _good_snapshot(self, tmp_path):
+        path = str(tmp_path / "snap.npz")
+        mk, lim = pair(Algorithm.SLIDING_WINDOW, "exact")
+        lim.allow_n("a", 7)
+        lim.save(path)
+        with open(path, "rb") as f:
+            golden = f.read()
+        return path, mk, lim, golden
+
+    def test_fsync_failure_mid_write_keeps_old_snapshot(
+            self, tmp_path, monkeypatch):
+        import os as _os
+
+        path, mk, lim, golden = self._good_snapshot(tmp_path)
+        lim.allow_n("a", 1)                       # state changed since
+
+        real_fsync = _os.fsync
+
+        def boom(fd):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr("ratelimiter_tpu.checkpoint.os.fsync", boom)
+        with pytest.raises(OSError):
+            lim.save(path)
+        monkeypatch.setattr("ratelimiter_tpu.checkpoint.os.fsync",
+                            real_fsync)
+        with open(path, "rb") as f:
+            assert f.read() == golden              # old snapshot intact
+        assert [p for p in tmp_path.iterdir()
+                if ".tmp." in p.name] == []        # no tmp litter
+        restored = mk()
+        restored.restore(path)                     # and still loadable
+        assert not restored.allow_n("a", 4).allowed
+        restored.close()
+        lim.close()
+
+    def test_replace_failure_keeps_old_snapshot(self, tmp_path,
+                                                monkeypatch):
+        path, mk, lim, golden = self._good_snapshot(tmp_path)
+
+        def boom(src, dst):
+            raise OSError("injected replace failure")
+
+        monkeypatch.setattr("ratelimiter_tpu.checkpoint.os.replace", boom)
+        with pytest.raises(OSError, match="injected"):
+            lim.save(path)
+        monkeypatch.undo()
+        with open(path, "rb") as f:
+            assert f.read() == golden
+        assert [p for p in tmp_path.iterdir()
+                if ".tmp." in p.name] == []
+        lim.close()
+
+
+class TestGoldenFingerprint:
+    """ISSUE-2 satellite: config_fingerprint is pinned to a golden value.
+
+    Every existing snapshot carries its config's fingerprint; ANY change
+    to the hash inputs (renamed/added/removed Config fields, changed
+    serialization) strands all of them. If this test fails and the
+    change was ACCIDENTAL, fix the code until it passes. If the change
+    is INTENTIONAL (a new semantic config field must participate), bump
+    checkpoint.FORMAT_VERSION, update the golden values below in the
+    same commit, and say in the commit message that existing snapshots
+    are invalidated.
+    """
+
+    GOLDEN = "9ce0bf0e02550dc074f2925212dccb29"
+
+    def test_golden_value(self):
+        from ratelimiter_tpu.checkpoint import config_fingerprint
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                     window=60.0)
+        fp = config_fingerprint(cfg)
+        assert fp == self.GOLDEN, (
+            f"config_fingerprint drifted: {fp} != {self.GOLDEN}. This "
+            "STRANDS every existing snapshot (restore refuses on "
+            "fingerprint mismatch). If unintentional, revert the Config/"
+            "fingerprint change; if intentional, bump FORMAT_VERSION and "
+            "update TestGoldenFingerprint.GOLDEN in the same commit.")
+
+    def test_persistence_spec_is_excluded(self):
+        """Snapshot cadence is operational, not state geometry: changing
+        it must NOT strand snapshots."""
+        from ratelimiter_tpu import PersistenceSpec
+        from ratelimiter_tpu.checkpoint import config_fingerprint
+
+        base = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                      window=60.0)
+        tuned = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                       window=60.0,
+                       persistence=PersistenceSpec(
+                           dir="/elsewhere", snapshot_interval=1.0,
+                           retain=9, wal_fsync="never"))
+        assert config_fingerprint(base) == config_fingerprint(tuned)
+
+    def test_semantic_fields_do_participate(self):
+        from dataclasses import replace
+
+        from ratelimiter_tpu.checkpoint import config_fingerprint
+
+        base = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                      window=60.0)
+        for changed in (replace(base, limit=101),
+                        replace(base, window=61.0),
+                        replace(base, algorithm=Algorithm.FIXED_WINDOW),
+                        replace(base, sketch=SketchParams(width=1 << 17))):
+            assert config_fingerprint(changed) != config_fingerprint(base)
+
+
 class TestBackCompat:
     def test_bucket_checkpoint_without_acc_restores(self, tmp_path):
         """The v0.1 token-bucket snapshot had no `acc` (DCN export

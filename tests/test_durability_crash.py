@@ -1,0 +1,233 @@
+"""kill -9 crash recovery through the real serving binary (ISSUE-2
+acceptance criterion; NOT marked slow — this is the tier-1 durability
+gate and CI runs it on every push).
+
+A serving subprocess runs with persistence enabled under live traffic,
+is SIGKILLed mid-stream, and restarts on the same directory. Asserts:
+
+* counters under-count by at most one snapshot interval of traffic
+  (here: everything after the explicitly triggered snapshot — the
+  restored consumption is >= the pre-snapshot consumption and <= the
+  true total, so errors go toward ALLOWING, never over-denial);
+* policy overrides recover EXACTLY via WAL replay (set after the
+  snapshot, deleted after the snapshot — both effects survive);
+* a fingerprint-mismatched snapshot directory refuses to load with a
+  clear error (nonzero exit naming the mismatch).
+
+The exact backend keeps the subprocess JAX-free (instant startup), so
+this runs fast enough for the tier-1 lane; the same recovery machinery
+is exercised per backend in tests/test_persistence.py.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from netutil import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _spawn(port, snap_dir, limit=100, extra=()):
+    argv = [sys.executable, "-m", "ratelimiter_tpu.serving",
+            "--backend", "exact", "--algorithm", "sliding_window",
+            "--limit", str(limit), "--window", "600",
+            "--port", str(port), "--snapshot-dir", snap_dir,
+            # Interval far beyond the test: the explicitly triggered
+            # snapshot is deterministically the last one, so "within one
+            # snapshot interval of under-count" is exactly "everything
+            # after the trigger".
+            "--snapshot-interval", "500", "--no-prewarm", *extra]
+    return subprocess.Popen(argv, env=_env(), stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_banner(proc, timeout=60):
+    t0 = time.time()
+    lines = []
+    while time.time() - t0 < timeout:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("serving"):
+            return lines
+    raise AssertionError("server never served:\n" + "".join(lines))
+
+
+class TestKillNineRecovery:
+    def test_kill9_recovers_counters_and_overrides(self, tmp_path):
+        from ratelimiter_tpu.serving.client import Client
+
+        snap_dir = str(tmp_path / "durable")
+        port = free_port()
+        proc = _spawn(port, snap_dir)
+        try:
+            _wait_banner(proc)
+            c = Client(port=port, timeout=60.0)
+            # Pre-snapshot state: 30 consumed on "k", override on "vip".
+            assert c.allow_n("k", 30).allowed
+            c.set_override("vip", 42)
+            snap_id, wal_seq, _dur = c.snapshot()
+            assert snap_id >= 1 and wal_seq >= 1
+            # Crash window: more consumption + override churn, all under
+            # live background traffic so the SIGKILL lands mid-stream.
+            stop = threading.Event()
+
+            def hammer():
+                try:
+                    with Client(port=port, timeout=60.0) as hc:
+                        i = 0
+                        while not stop.is_set():
+                            hc.allow(f"bg:{i % 997}")
+                            i += 1
+                except (ConnectionError, OSError):
+                    pass          # the kill severs this stream mid-flight
+
+            t = threading.Thread(target=hammer, daemon=True)
+            t.start()
+            for _ in range(5):
+                assert c.allow_n("k", 10).allowed      # 50 more, lost-able
+            c.set_override("vip2", 9)
+            assert c.delete_override("vip") is True
+            time.sleep(0.2)                            # traffic in flight
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            stop.set()
+            t.join(timeout=10)
+            c.close()
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+        # Restart on the same directory.
+        proc2 = _spawn(port, snap_dir)
+        try:
+            lines = _wait_banner(proc2)
+            assert any("recovery" in ln for ln in lines)
+            with Client(port=port, timeout=60.0) as c2:
+                # Overrides recover EXACTLY via WAL replay: the one set
+                # after the snapshot exists, the one deleted after the
+                # snapshot stays deleted.
+                assert c2.get_override("vip2") == (9, 1.0)
+                assert c2.get_override("vip") is None
+                # Counters: consumed >= 30 (snapshot state restored) ...
+                assert not c2.allow_n("k", 71).allowed
+                # ... and <= 80 (under-count only — the limiter must
+                # never think MORE was consumed than actually was).
+                assert c2.allow_n("k", 20).allowed
+            proc2.send_signal(signal.SIGTERM)
+            assert proc2.wait(timeout=30) == 0
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait()
+
+    def test_fingerprint_mismatch_refuses_startup(self, tmp_path):
+        """Booting on a snapshot directory taken under different flags
+        must fail loudly, not silently reinterpret state."""
+        from ratelimiter_tpu.serving.client import Client
+
+        snap_dir = str(tmp_path / "durable")
+        port = free_port()
+        proc = _spawn(port, snap_dir, limit=100)
+        try:
+            _wait_banner(proc)
+            with Client(port=port, timeout=60.0) as c:
+                assert c.allow_n("k", 5).allowed
+                c.snapshot()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+        proc2 = _spawn(port, snap_dir, limit=101)      # drifted flag
+        out, _ = proc2.communicate(timeout=60)
+        assert proc2.returncode != 0
+        assert "fingerprint" in out
+        assert "limit=100" in out                      # names the original
+        assert "move the snapshot directory aside" in out
+
+    def test_wal_only_recovery_without_any_snapshot(self, tmp_path):
+        """Crash before the first snapshot: the whole WAL replays onto
+        fresh state — overrides still recover exactly."""
+        from ratelimiter_tpu.serving.client import Client
+
+        snap_dir = str(tmp_path / "durable")
+        port = free_port()
+        proc = _spawn(port, snap_dir)
+        try:
+            _wait_banner(proc)
+            with Client(port=port, timeout=60.0) as c:
+                c.set_override("vip", 17)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        proc2 = _spawn(port, snap_dir)
+        try:
+            _wait_banner(proc2)
+            with Client(port=port, timeout=60.0) as c2:
+                assert c2.get_override("vip") == (17, 1.0)
+            proc2.send_signal(signal.SIGTERM)
+            assert proc2.wait(timeout=30) == 0
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+                proc2.wait()
+
+
+class TestSnapshotRpcSurface:
+    def test_snapshot_rpc_refused_without_persistence(self):
+        """T_SNAPSHOT against a server without --snapshot-dir answers a
+        typed error, not a hang or a crash."""
+        from ratelimiter_tpu import (
+            Algorithm,
+            Config,
+            InvalidConfigError,
+            create_limiter,
+        )
+        from ratelimiter_tpu.serving.client import Client
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        import asyncio
+
+        async def run():
+            cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                         window=60.0)
+            lim = create_limiter(cfg, backend="exact")
+            srv = RateLimitServer(lim, port=0)
+            await srv.start()
+            try:
+                loop = asyncio.get_running_loop()
+
+                def probe():
+                    with Client(port=srv.port, timeout=30.0) as c:
+                        with pytest.raises(InvalidConfigError,
+                                           match="persistence not enabled"):
+                            c.snapshot()
+
+                await loop.run_in_executor(None, probe)
+            finally:
+                await srv.shutdown()
+                lim.close()
+
+        asyncio.run(run())

@@ -351,3 +351,68 @@ class TestServerBinaryHttp:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+class TestSnapshotEndpoint:
+    """POST /v1/snapshot: the durability trigger — wired only when the
+    embedding runs persistence, bearer-gated header-only like reset."""
+
+    def _gw(self, snapshot=None, token=None):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3,
+                     window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=clock)
+        gateway = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                              snapshot=snapshot, snapshot_token=token)
+        gateway.start()
+        return gateway, lim
+
+    def _post(self, url, headers=None):
+        req = urllib.request.Request(url, method="POST",
+                                     headers=headers or {})
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+
+    def test_unwired_gateway_answers_403(self):
+        gateway, lim = self._gw()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(f"http://127.0.0.1:{gateway.port}/v1/snapshot")
+            assert ei.value.code == 403
+            assert "not enabled" in json.loads(ei.value.read())["error"]
+        finally:
+            gateway.shutdown()
+            lim.close()
+
+    def test_trigger_and_token_gate(self):
+        calls = []
+
+        def snapshot():
+            calls.append(1)
+            return {"id": 3, "wal_seq": 17, "duration_s": 0.01}
+
+        gateway, lim = self._gw(snapshot=snapshot, token="st")
+        base = f"http://127.0.0.1:{gateway.port}"
+        try:
+            # No token / wrong token / query-string token: all 403, the
+            # trigger never fires.
+            for hdrs in ({}, {"Authorization": "Bearer nope"}):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._post(f"{base}/v1/snapshot", hdrs)
+                assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError):
+                self._post(f"{base}/v1/snapshot?token=st")
+            assert calls == []
+            status, body = self._post(
+                f"{base}/v1/snapshot",
+                {"Authorization": "Bearer st"})
+            assert status == 200 and body["ok"] is True
+            assert body["snapshot_id"] == 3 and body["wal_seq"] == 17
+            assert calls == [1]
+            # GET is not a trigger (POST only).
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/v1/snapshot")
+            assert ei.value.code == 404
+        finally:
+            gateway.shutdown()
+            lim.close()

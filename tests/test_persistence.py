@@ -1,0 +1,438 @@
+"""Durability subsystem: snapshotter, recovery, manager semantics
+(ratelimiter_tpu/persistence/, docs/ADR/009).
+
+The crash-window contract under test: policy overrides and dynamic
+config updates recover EXACTLY (WAL); decision counters recover to the
+newest snapshot (bounded under-count). The kill -9 integration test
+(tests/test_durability_crash.py) exercises the same contract through a
+real serving subprocess.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    CheckpointError,
+    Config,
+    ManualClock,
+    PersistenceSpec,
+    create_limiter,
+)
+from ratelimiter_tpu.persistence import (
+    PersistenceManager,
+    read_manifest,
+)
+from ratelimiter_tpu.persistence import wal as walmod
+
+T0 = 1_700_000_000.0
+
+
+def mk_cfg(d, algo=Algorithm.SLIDING_WINDOW, **pkw):
+    return Config(algorithm=algo, limit=10, window=60.0,
+                  persistence=PersistenceSpec(dir=str(d),
+                                              snapshot_interval=1000.0,
+                                              **pkw))
+
+
+def boot(d, backend="exact", algo=Algorithm.SLIDING_WINDOW, **pkw):
+    """(manager, wrapped limiter) recovered from directory d."""
+    cfg = mk_cfg(d, algo, **pkw)
+    mgr = PersistenceManager(cfg.persistence)
+    lim = mgr.wrap(create_limiter(cfg, backend=backend,
+                                  clock=ManualClock(T0)))
+    mgr.attach([lim])
+    mgr.recover()
+    return mgr, lim
+
+
+class TestCrashRecovery:
+    @pytest.mark.parametrize("backend", ["exact", "dense", "sketch"])
+    def test_counters_recover_to_snapshot_overrides_exactly(
+            self, backend, tmp_path):
+        mgr, lim = boot(tmp_path, backend)
+        assert lim.allow_n("a", 4).allowed
+        lim.set_override("vip", 7)
+        mgr.snapshot_now()
+        assert lim.allow_n("a", 3).allowed      # crash window: lost
+        lim.set_override("vip2", 9)             # crash window: WAL-exact
+        lim.delete_override("vip")              # crash window: WAL-exact
+        mgr.wal.close()                         # kill -9 (no final snapshot)
+
+        mgr2, lim2 = boot(tmp_path, backend)
+        assert lim2.get_override("vip") is None
+        assert lim2.get_override("vip2").limit == 9
+        # Counters: >= 4 consumed (snapshot), <= 7 consumed (real total).
+        assert not lim2.allow_n("a", 7).allowed
+        assert lim2.allow_n("a", 3).allowed
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+        lim.close()
+
+    def test_no_snapshot_full_wal_replay(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        lim.set_override("vip", 5)
+        lim.update_limit(20)
+        mgr.wal.close()
+
+        mgr2, lim2 = boot(tmp_path)
+        assert lim2.get_override("vip").limit == 5
+        assert lim2.config.limit == 20
+        assert mgr2.report.snapshot_id is None
+        assert mgr2.report.replayed == 2
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+        lim.close()
+
+    def test_update_window_replays(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        lim.update_window(30.0)
+        mgr.wal.close()
+        mgr2, lim2 = boot(tmp_path)
+        assert lim2.config.window == 30.0
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+        lim.close()
+
+    def test_graceful_stop_loses_nothing(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        assert lim.allow_n("a", 9).allowed
+        mgr.stop()                              # final snapshot
+        lim.close()
+        mgr2, lim2 = boot(tmp_path)
+        assert not lim2.allow_n("a", 2).allowed  # 9 consumed survived
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+
+    def test_replayed_mutations_are_not_relogged(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        lim.set_override("vip", 5)
+        assert mgr.wal.last_seq == 1
+        mgr.wal.close()
+        mgr2, lim2 = boot(tmp_path)
+        assert mgr2.report.replayed == 1
+        assert mgr2.wal.last_seq == 1           # replay appended nothing
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+        lim.close()
+
+    def test_decisions_are_not_logged(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        for i in range(50):
+            lim.allow(f"k{i}")
+        lim.allow_batch(["a", "b", "c"])
+        assert mgr.wal.last_seq == 0
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_noop_delete_is_not_logged(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        assert lim.delete_override("ghost") is False
+        assert mgr.wal.last_seq == 0
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+
+class TestSnapshotter:
+    def test_background_interval_snapshots(self, tmp_path):
+        cfg = mk_cfg(tmp_path)
+        mgr = PersistenceManager(
+            PersistenceSpec(dir=str(tmp_path), snapshot_interval=0.1))
+        lim = mgr.wrap(create_limiter(cfg, backend="exact",
+                                      clock=ManualClock(T0)))
+        mgr.attach([lim])
+        mgr.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (read_manifest(str(tmp_path)) or {}).get("snapshots"):
+                break
+            time.sleep(0.02)
+        mgr.stop(final_snapshot=False)
+        assert (read_manifest(str(tmp_path)) or {}).get("snapshots"), \
+            "background thread never snapshotted"
+        lim.close()
+
+    def test_mutation_count_trigger(self, tmp_path):
+        mgr = PersistenceManager(PersistenceSpec(
+            dir=str(tmp_path), snapshot_interval=1000.0,
+            snapshot_after_mutations=3))
+        lim = mgr.wrap(create_limiter(mk_cfg(tmp_path), backend="exact",
+                                      clock=ManualClock(T0)))
+        mgr.attach([lim])
+        mgr.start()
+        for i in range(3):
+            lim.set_override(f"vip{i}", 5)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            m = read_manifest(str(tmp_path))
+            if m and m["snapshots"]:
+                break
+            time.sleep(0.02)
+        mgr.stop(final_snapshot=False)
+        m = read_manifest(str(tmp_path))
+        assert m and m["snapshots"], "mutation trigger never fired"
+        lim.close()
+
+    def test_retention_prunes_snapshots_and_wal(self, tmp_path):
+        mgr = PersistenceManager(PersistenceSpec(
+            dir=str(tmp_path), snapshot_interval=1000.0, retain=2,
+            wal_max_bytes=4096))
+        lim = mgr.wrap(create_limiter(mk_cfg(tmp_path), backend="exact",
+                                      clock=ManualClock(T0)))
+        mgr.attach([lim])
+        for round_ in range(4):
+            for i in range(40):
+                lim.set_override(f"vip{round_}:{i}", 5)
+            mgr.snapshot_now()
+        m = read_manifest(str(tmp_path))
+        assert len(m["snapshots"]) == 2
+        snaps_on_disk = [f for f in os.listdir(tmp_path)
+                         if f.startswith("snap-")]
+        assert len(snaps_on_disk) == 2
+        # WAL segments wholly below the oldest retained watermark are gone.
+        oldest = min(e["wal_seq"] for e in m["snapshots"])
+        first_seg = walmod.segment_files(str(tmp_path))[0][0]
+        remaining = list(walmod.replay(str(tmp_path)))
+        if remaining:
+            assert remaining[-1].seq == 160
+        assert first_seg > 1 or oldest < 4096 // 60
+        mgr.stop(final_snapshot=False)
+        lim.close()
+        # The pruned directory still recovers cleanly.
+        mgr2, lim2 = boot(tmp_path)
+        assert lim2.get_override("vip3:39").limit == 5
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+
+    def test_watermark_sampled_before_capture(self, tmp_path):
+        """The manifest watermark never exceeds a seq the snapshot might
+        miss: a mutation landing mid-snapshot replays (idempotently)."""
+        mgr, lim = boot(tmp_path)
+        lim.set_override("vip", 5)
+        entry = mgr.snapshot_now()
+        assert entry["wal_seq"] == mgr.wal.last_seq == 1
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_snapshot_failure_leaves_disk_state(self, tmp_path,
+                                                monkeypatch):
+        mgr, lim = boot(tmp_path)
+        lim.allow_n("a", 4)
+        good = mgr.snapshot_now()
+        calls = {"n": 0}
+        orig = lim.inner.capture_state
+
+        def boom():
+            calls["n"] += 1
+            raise RuntimeError("capture exploded")
+
+        monkeypatch.setattr(lim.inner, "capture_state", boom)
+        with pytest.raises(RuntimeError):
+            mgr.snapshot_now()
+        assert calls["n"] == 1
+        m = read_manifest(str(tmp_path))
+        assert [e["id"] for e in m["snapshots"]] == [good["id"]]
+        monkeypatch.setattr(lim.inner, "capture_state", orig)
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_status_fields(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        st = mgr.status()
+        assert st["persistence"] is True and st["wal_seq"] == 0
+        mgr.snapshot_now()
+        st = mgr.status()
+        assert st["last_snapshot_id"] == 1
+        assert "last_snapshot_age_s" in st
+        assert "last_snapshot_duration_s" in st
+        assert "recovered" in st
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_metrics_emitted(self, tmp_path):
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        reg = Registry()
+        mgr = PersistenceManager(PersistenceSpec(
+            dir=str(tmp_path), snapshot_interval=1000.0), registry=reg)
+        lim = mgr.wrap(create_limiter(mk_cfg(tmp_path), backend="exact",
+                                      clock=ManualClock(T0)))
+        mgr.attach([lim])
+        lim.set_override("vip", 5)
+        mgr.snapshot_now()
+        text = reg.render()
+        assert "rate_limiter_snapshots_total 1" in text
+        assert "rate_limiter_wal_records_total 1" in text
+        assert "rate_limiter_wal_seq 1" in text
+        assert "rate_limiter_snapshot_duration_seconds_count 1" in text
+        assert "rate_limiter_last_snapshot_timestamp_seconds" in text
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+
+class TestRecoveryValidation:
+    def test_fingerprint_mismatch_refuses_with_clear_error(self, tmp_path):
+        """ISSUE-2 acceptance: a fingerprint-mismatched snapshot directory
+        refuses to load, naming the config it was taken under."""
+        mgr, lim = boot(tmp_path)
+        lim.allow("a")
+        mgr.snapshot_now()
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+        cfg2 = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=99,
+                      window=60.0,
+                      persistence=PersistenceSpec(dir=str(tmp_path)))
+        mgr2 = PersistenceManager(cfg2.persistence)
+        lim2 = mgr2.wrap(create_limiter(cfg2, backend="exact",
+                                        clock=ManualClock(T0)))
+        mgr2.attach([lim2])
+        with pytest.raises(CheckpointError) as ei:
+            mgr2.recover()
+        msg = str(ei.value)
+        assert "fingerprint" in msg and "limit=10" in msg
+        assert "move the snapshot directory aside" in msg
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+
+    def test_corrupt_newest_falls_back_to_older(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        lim.allow_n("a", 4)
+        first = mgr.snapshot_now()
+        lim.allow_n("a", 3)
+        second = mgr.snapshot_now()
+        mgr.stop(final_snapshot=False)
+        lim.close()
+        # Corrupt the newest snapshot file (torn write simulation —
+        # normally impossible thanks to write_atomic, but disks rot).
+        newest = os.path.join(str(tmp_path), second["files"][0])
+        with open(newest, "wb") as f:
+            f.write(b"not an npz")
+        mgr2, lim2 = boot(tmp_path)
+        assert mgr2.report.snapshot_id == first["id"]
+        # Older snapshot: only 4 consumed.
+        assert lim2.allow_n("a", 6).allowed
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+
+    def test_shard_count_mismatch_refuses(self, tmp_path):
+        cfg = mk_cfg(tmp_path)
+        mgr = PersistenceManager(cfg.persistence)
+        lims = [mgr.wrap(create_limiter(cfg, backend="exact",
+                                        clock=ManualClock(T0)))
+                for _ in range(2)]
+        mgr.attach(lims, shard_of=lambda k: hash(k) % 2)
+        mgr.snapshot_now()
+        mgr.stop(final_snapshot=False)
+        for lim in lims:
+            lim.close()
+        mgr2, lim2 = (None, None)
+        cfg2 = mk_cfg(tmp_path)
+        mgr2 = PersistenceManager(cfg2.persistence)
+        lim2 = mgr2.wrap(create_limiter(cfg2, backend="exact",
+                                        clock=ManualClock(T0)))
+        mgr2.attach([lim2])
+        with pytest.raises(CheckpointError, match="--shards 2"):
+            mgr2.recover()
+        mgr2.stop(final_snapshot=False)
+        lim2.close()
+
+    def test_partial_shard_restore_refuses_wal_replay(self, tmp_path):
+        """If NO retained entry restores fully but some shard already
+        took a partial entry's state, recovery refuses instead of
+        replaying the WAL over mixed shard state."""
+        cfg = mk_cfg(tmp_path)
+        mgr = PersistenceManager(cfg.persistence)
+        lims = [mgr.wrap(create_limiter(cfg, backend="exact",
+                                        clock=ManualClock(T0)))
+                for _ in range(2)]
+        mgr.attach(lims, shard_of=lambda k: 0)
+        entry = mgr.snapshot_now()
+        mgr.stop(final_snapshot=False)
+        for lim in lims:
+            lim.close()
+        # Shard 0's file stays good; shard 1's is garbage -> the (only)
+        # entry restores shard 0 then fails.
+        with open(os.path.join(str(tmp_path), entry["files"][1]), "wb") as f:
+            f.write(b"rotten")
+        cfg2 = mk_cfg(tmp_path)
+        mgr2 = PersistenceManager(cfg2.persistence)
+        lims2 = [mgr2.wrap(create_limiter(cfg2, backend="exact",
+                                          clock=ManualClock(T0)))
+                 for _ in range(2)]
+        mgr2.attach(lims2, shard_of=lambda k: 0)
+        with pytest.raises(CheckpointError, match="mixed state"):
+            mgr2.recover()
+        mgr2.stop(final_snapshot=False)
+        for lim in lims2:
+            lim.close()
+
+    def test_second_manager_on_live_directory_refused(self, tmp_path):
+        """Single-writer guard surfaces through the manager: a
+        double-started process fails loudly at construction."""
+        mgr, lim = boot(tmp_path)
+        with pytest.raises(CheckpointError, match="exactly one writer"):
+            PersistenceManager(mk_cfg(tmp_path).persistence)
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_unreadable_manifest_refuses(self, tmp_path):
+        with open(tmp_path / "manifest.json", "w") as f:
+            f.write("{broken")
+        with pytest.raises(CheckpointError, match="manifest"):
+            boot(tmp_path)
+
+    def test_sharded_reset_replays_to_owning_shard_only(self, tmp_path):
+        cfg = mk_cfg(tmp_path)
+        mgr = PersistenceManager(cfg.persistence)
+        lims = [mgr.wrap(create_limiter(cfg, backend="exact",
+                                        clock=ManualClock(T0)))
+                for _ in range(2)]
+        shard_of = lambda k: 1  # noqa: E731 — every key owned by shard 1
+        mgr.attach(lims, shard_of=shard_of)
+        lims[1].allow_n("k", 10)
+        mgr.snapshot_now()
+        lims[1].reset("k")
+        mgr.wal.close()
+
+        cfg2 = mk_cfg(tmp_path)
+        mgr2 = PersistenceManager(cfg2.persistence)
+        lims2 = [mgr2.wrap(create_limiter(cfg2, backend="exact",
+                                          clock=ManualClock(T0)))
+                 for _ in range(2)]
+        mgr2.attach(lims2, shard_of=shard_of)
+        rep = mgr2.recover()
+        assert rep.replayed == 1
+        assert lims2[1].allow_n("k", 10).allowed   # reset landed
+        mgr2.stop(final_snapshot=False)
+        for lim in lims + lims2:
+            lim.close()
+
+
+class TestManifest:
+    def test_manifest_is_valid_json_with_watermarks(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        lim.set_override("vip", 5)
+        mgr.snapshot_now()
+        with open(tmp_path / "manifest.json") as f:
+            m = json.load(f)
+        (entry,) = m["snapshots"]
+        assert entry["wal_seq"] == 1
+        assert entry["config"]["limit"] == 10
+        assert entry["files"] == ["snap-00000001-000.npz"]
+        mgr.stop(final_snapshot=False)
+        lim.close()
+
+    def test_snapshot_ids_continue_across_restarts(self, tmp_path):
+        mgr, lim = boot(tmp_path)
+        mgr.snapshot_now()
+        mgr.stop(final_snapshot=False)
+        lim.close()
+        mgr2, lim2 = boot(tmp_path)
+        entry = mgr2.snapshot_now()
+        assert entry["id"] == 2
+        mgr2.stop(final_snapshot=False)
+        lim2.close()

@@ -1,0 +1,40 @@
+"""Durability overhead smoke (ISSUE-2 satellite): background
+snapshotting must keep p99 allow latency within budget of the
+no-persistence baseline — guarding the off-lock serialization claim
+(persistence/snapshotter.py: only the device→host capture holds the
+limiter lock; serialization + fsync happen off-lock).
+
+Runs bench.py's phase E (measure_snapshot_overhead) at a small shape.
+The budget is deliberately generous — CI boxes are noisy and a single
+shared CPU makes even off-lock work steal cycles — but an on-lock
+serialization regression at this state size (~6 MB npz + fsync per
+snapshot, every 0.25 s) blocks dispatches for hundreds of ms and blows
+far past it.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_p99_within_budget_of_baseline(tmp_path):
+    from bench import measure_snapshot_overhead
+
+    out = measure_snapshot_overhead(
+        0.25, snapshot_dir=str(tmp_path), seconds=2.0,
+        depth=3, width=1 << 14, sub_windows=60)
+    base = out["baseline"]
+    snap = out["with_snapshots"]
+    assert snap["snapshots_taken"] >= 1, out     # the thread actually ran
+    assert base["dispatches"] > 50 and snap["dispatches"] > 50, out
+    budget_ms = max(5.0 * base["p99_ms"], base["p99_ms"] + 250.0)
+    assert snap["p99_ms"] <= budget_ms, (
+        f"background snapshotting pushed p99 from {base['p99_ms']}ms to "
+        f"{snap['p99_ms']}ms (budget {budget_ms:.1f}ms) — is "
+        f"serialization running under the limiter lock? {out}")
+    # The median must be essentially untouched: snapshots are rare
+    # events, so any broad shift means constant overhead leaked into
+    # the decision path.
+    assert snap["p50_ms"] <= 3.0 * base["p50_ms"] + 5.0, out
