@@ -31,7 +31,10 @@ D. End-to-end serving: a real ``python -m ratelimiter_tpu.serving``
    Python asyncio driver saturates its own event loop long before the
    server, so it measured the CLIENT, not the server (r3/r4 regression
    root cause). Falls back to the Python driver without g++; the
-   ``e2e_harness`` field says which one produced the number.
+   ``e2e_harness`` field says which one produced the number. The server
+   runs the PIPELINED launch/resolve hot path (``--inflight``, default
+   8; ADR-010) — ``e2e_pipelined_decisions_per_sec`` is the headline
+   and ``e2e_inflight`` records the window depth.
 
 Baseline: the reference's own single-instance sliding-window estimate,
 ~30,000 req/s (``docs/ARCHITECTURE.md:439``, SURVEY.md §6); north star:
@@ -188,6 +191,9 @@ def main() -> None:
                     help="also measure durability overhead (phase E): "
                          "p50/p99 allow latency with a background "
                          "snapshotter at this interval vs bare")
+    ap.add_argument("--inflight", type=int, default=8, metavar="N",
+                    help="pipelined dispatch window for the phase-D "
+                         "server (1 = the old synchronous path)")
     args = ap.parse_args()
 
     platform = jax.devices()[0].platform
@@ -358,17 +364,27 @@ def main() -> None:
             # sensitive to scheduler state (committed RESULTS_r05 notes
             # a leaked-process episode); the longer window cuts run-to-
             # run variance.
-            row = _run_native_loadgen(seconds=6.0, log=lambda *a: None)
+            row = _run_native_loadgen(seconds=6.0, log=lambda *a: None,
+                                      inflight=args.inflight)
             if "error" in row:
                 raise RuntimeError(row["error"])
+            pipelined = args.inflight > 1
             e2e = {
                 "e2e_server_decisions_per_sec": row["decisions_per_sec"],
+                "e2e_inflight": args.inflight,
                 "e2e_frame_p50_ms": row["frame_p50_ms"],
                 "e2e_frame_p99_ms": row["frame_p99_ms"],
-                "e2e_server_front_door": "native",
+                # --inflight 1 is the synchronous A/B baseline (EXAMPLES
+                # §16): the pipelined field/label must not claim it.
+                "e2e_server_front_door": (
+                    "native (pipelined launch/resolve, ADR-010)"
+                    if pipelined else "native (synchronous, --inflight 1)"),
                 "e2e_harness": "cpp_loadgen (6 conns x 8 pipelined "
                                "1024-key frames; latency is per frame)",
             }
+            if pipelined:
+                e2e["e2e_pipelined_decisions_per_sec"] = (
+                    row["decisions_per_sec"])
         else:
             from benchmarks.e2e import _drive, _spawn_server
             import asyncio

@@ -44,7 +44,8 @@ def _free_port() -> int:
 
 def _spawn_server(backend: str, *, platform: Optional[str] = None,
                   max_batch: int = 4096, max_delay_us: float = 500.0,
-                  native: bool = False, shards: int = 1):
+                  native: bool = False, shards: int = 1,
+                  inflight: int = 8):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
@@ -58,6 +59,7 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
          "--limit", "100", "--window", "60",
          "--max-batch", str(max_batch),
          "--max-delay-us", str(max_delay_us),
+         "--inflight", str(inflight),
          "--port", str(port)]
         + (["--native"] if native else [])
         + (["--shards", str(shards)] if shards > 1 else []),
@@ -160,10 +162,12 @@ def _run_variant(name: str, backend: str, *, platform=None, seconds=6.0,
     return out
 
 
-def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
+def _run_native_loadgen(*, seconds: float, log=print,
+                        inflight: int = 8) -> Dict:
     """Native server driven by the native C++ load generator
     (clients/cpp/loadgen.cpp) — removes the Python client from the loop,
-    so this is the true server+decide ceiling."""
+    so this is the true server+decide ceiling. ``inflight`` sets the
+    server's pipelined dispatch window (1 = the old synchronous path)."""
     import json
     import shutil
     import tempfile
@@ -182,9 +186,11 @@ def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
         # flat, so deeper coalescing amortizes the per-dispatch overhead
         # (r4: C++-side key prefixing + responder-thread encode overlap
         # moved the ceiling from ~300K to ~0.8-1M/s on this harness; the
-        # wall is the XLA-CPU step itself, see ADR-003).
+        # wall is the XLA-CPU step itself, see ADR-003). The pipelined
+        # launch/resolve window (ADR-010) overlaps that step with host
+        # encode/decode.
         proc, port = _spawn_server("sketch", platform="cpu", native=True,
-                                   max_batch=16384)
+                                   max_batch=16384, inflight=inflight)
         try:
             out = subprocess.run(
                 [binary, "127.0.0.1", str(port), str(seconds), "6", "8",
@@ -203,7 +209,9 @@ def _run_native_loadgen(*, seconds: float, log=print) -> Dict:
     row["connections"] = row.pop("threads")
     row["inflight_per_conn"] = (row.pop("inflight_frames")
                                 * row["keys_per_frame"])
-    log(f"e2e native+native: {row['decisions_per_sec']:.0f}/s")
+    row["server_inflight"] = inflight
+    log(f"e2e native+native (inflight={inflight}): "
+        f"{row['decisions_per_sec']:.0f}/s")
     return row
 
 

@@ -187,6 +187,39 @@ class RateLimiter(abc.ABC):
         t = self.clock.now() if now is None else float(now)
         return self._allow_batch(list(keys), ns_arr, t)
 
+    # -- pipelined dispatch (launch / resolve) -----------------------------
+    #
+    # The serving doors overlap host encode/decode with device compute by
+    # splitting each dispatch into a launch phase (enqueue, non-blocking)
+    # and a resolve phase (block on the oldest in-flight result) —
+    # ADR-010. Backends with an async device path (the sketch family)
+    # override with a real split and set ``pipelined = True``; the base
+    # fallback computes eagerly and returns a pre-resolved ticket so
+    # callers can target one API regardless of backend.
+
+    #: True when launch_batch genuinely defers device work (a door gains
+    #: nothing from pipelining a backend that resolves at launch).
+    pipelined = False
+
+    def launch_batch(self, keys: Sequence[str],
+                     ns: Optional[Sequence[int]] = None, *,
+                     now: Optional[float] = None):
+        """Launch a batched dispatch; resolve() returns its BatchResult.
+        Base fallback: decide eagerly, return a pre-resolved ticket."""
+        from ratelimiter_tpu.core.types import DispatchTicket
+
+        return DispatchTicket(result=self.allow_batch(keys, ns, now=now))
+
+    def resolve(self, ticket):
+        """Block until a launched dispatch lands; returns its BatchResult."""
+        if ticket.result is None:
+            from ratelimiter_tpu.core.errors import RateLimiterError
+
+            raise RateLimiterError(
+                "unresolved ticket reached the base resolve() — it was "
+                "launched by a pipelined backend and must be resolved by it")
+        return ticket.result
+
     # -- policy engine (tiered per-key overrides) --------------------------
     #
     # Backends that support overrides own a ``_policy_table``

@@ -32,6 +32,7 @@ from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import StorageUnavailableError
 from ratelimiter_tpu.core.types import (
     BatchResult,
+    DispatchTicket,
     Result,
     batch_fail_open,
 )
@@ -61,6 +62,7 @@ class SketchLimiter(RateLimiter):
         self._sub_us = sketch_kernels.sketch_geometry(self.config)[1]
         self._seed = self.config.sketch.seed
         self._lock = threading.Lock()
+        self._init_staging()
         # Host mirror of state["last_period"]; drives rollover dispatches
         # (sketch_kernels._rollover explains why this is host-side).
         self._host_period = sketch_kernels._NEVER
@@ -149,6 +151,17 @@ class SketchLimiter(RateLimiter):
         return hash_strings_u64(keys)
 
     # ------------------------------------------------------------ dispatch
+    #
+    # The hot path is split into a *launch* phase (stage into reusable
+    # padded buffers, enqueue the jitted step, return a DispatchTicket
+    # without blocking) and a *resolve* phase (block on the device
+    # result, one bulk fetch, assemble the BatchResult). Sequential
+    # semantics across in-flight tickets are carried by state threading:
+    # each launch consumes the previous launch's donated state buffers,
+    # so the device executes steps in launch order regardless of when
+    # (or on which thread) each ticket is resolved. The synchronous API
+    # (allow_hashed / allow_batch) is launch+resolve back to back, so
+    # both paths are decision-for-decision identical (ADR-010).
 
     def _padded_size(self, b: int) -> int:
         """Device batch size for b requests; subclasses align to mesh shape."""
@@ -160,51 +173,245 @@ class SketchLimiter(RateLimiter):
 
         return jnp.asarray(arr)
 
-    def _dispatch_hashed(self, h64: np.ndarray, ns: np.ndarray,
-                         now_us: int) -> BatchResult:
+    def _init_staging(self) -> None:
+        # Reusable pinned staging buffers per padded-size bucket: a launch
+        # pops a free (h1p, h2p, nsp) triple (allocating only when every
+        # slot is in flight — bounded by the door's in-flight window) and
+        # resolve returns it AFTER the device has consumed the transfer.
+        # Eliminates the three per-dispatch np.zeros allocations the
+        # pre-pipeline hot path paid (ISSUE-3 tentpole item 2).
+        self._staging: dict = {}
+        self._staging_lock = threading.Lock()
+        # Offered mass of launched-but-unresolved tickets: the strict
+        # overload gate counts it AS IF fully admitted (see
+        # _over_budget_locked) so a deep in-flight window cannot slip
+        # inflight*max_batch of admissions past the accuracy budget —
+        # pessimism errs toward denying, strict mode's direction.
+        self._inflight_mass = 0
+
+    def _acquire_staging(self, padded: int):
+        with self._staging_lock:
+            free = self._staging.get(padded)
+            if free:
+                return free.pop()
+        return (np.empty(padded, dtype=np.uint32),
+                np.empty(padded, dtype=np.uint32),
+                np.empty(padded, dtype=np.int32))
+
+    def _release_staging(self, padded: int, slot) -> None:
+        if slot is None:
+            return
+        with self._staging_lock:
+            self._staging.setdefault(padded, []).append(slot)
+
+    def _launch_hashed(self, h64: np.ndarray, ns: np.ndarray,
+                       now_us: int, t_sec: float) -> DispatchTicket:
         import jax.numpy as jnp
 
         b = h64.shape[0]
         padded = self._padded_size(b)
         h1, h2 = split_hash(h64, self._seed)
-        h1p = np.zeros(padded, dtype=np.uint32)
-        h2p = np.ones(padded, dtype=np.uint32)
-        np_ns = np.zeros(padded, dtype=np.int32)
+        slot = self._acquire_staging(padded)
+        h1p, h2p, nsp = slot
         h1p[:b] = h1
+        h1p[b:] = 0
         h2p[:b] = h2
-        np_ns[:b] = ns
+        h2p[b:] = 1
+        nsp[:b] = ns
+        nsp[b:] = 0
+        launched = False
+        try:
+            with self._lock:
+                if self._injected_failure is not None:
+                    raise self._injected_failure
+                self._sync_period(now_us)
+                if self._strict and self._over_budget_locked(now_us):
+                    # Strict overload policy: REJECT new admissions (no
+                    # state write, no dispatch) while admitted in-window
+                    # mass exceeds the geometry's accuracy budget — loud
+                    # bounded denials instead of silent unbounded
+                    # misaccounting. Clears as history ages out of the
+                    # ring.
+                    return DispatchTicket(result=self._deny_all(b, now_us))
+                self._state, outs = self._step(
+                    self._state, self._place(h1p), self._place(h2p),
+                    self._place(nsp), jnp.int64(now_us),
+                    self._policy_device())
+                # Inside the lock: a concurrent set/delete_override
+                # rebuilds the table's sorted views, and a torn read
+                # would mis-index.
+                limits = self._policy_limits(h64)
+                self._inflight_mass += int(ns.sum())
+            launched = True
+        finally:
+            # Any non-launch exit (injected failure, strict deny-all, a
+            # failing step/rollover) must return the slot to the pool —
+            # only a ticket-owned slot is recycled by _retire_ticket.
+            if not launched:
+                self._release_staging(padded, slot)
+        t = DispatchTicket()
+        # retry/reset float math runs ON DEVICE (finish kernels), queued
+        # behind the step — resolve does one bulk fetch, no NumPy per
+        # request (ISSUE-3 tentpole item 3).
+        t.outs = self._launch_finish(outs, now_us)
+        t.b = b
+        t.limit = self.config.limit
+        t.limits = limits
+        t.ns = np.asarray(ns)
+        t.now_us = now_us
+        t.t_sec = t_sec
+        t.slot = slot
+        t.padded = padded
+        return t
+
+    def _launch_finish(self, outs, now_us: int):
+        """Queue the device-side result-assembly kernel behind the step
+        (windowed form; the token-bucket subclass overrides)."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        allowed, remaining, _est = outs
+        return sketch_kernels.finish_window(
+            allowed, remaining, jnp.int64(now_us),
+            jnp.int64(self._window_us))
+
+    def _retire_ticket(self, t: DispatchTicket, admitted: int) -> None:
+        """Once per launched ticket (t.slot is the sentinel): recycle the
+        staging buffers — the step consumed the transfer once its result
+        is ready (or failed) — and, in ONE lock acquisition, swap the
+        ticket's offered mass out of the strict gate's in-flight
+        pessimism for its actual admitted mass. A two-step swap would
+        open a window where the batch counts as neither, letting a
+        concurrent launch slip past the budget."""
+        if t.slot is None:
+            return
+        self._release_staging(t.padded, t.slot)
+        t.slot = None
         with self._lock:
-            if self._injected_failure is not None:
-                raise self._injected_failure
-            self._sync_period(now_us)
-            if self._strict and self._over_budget_locked(now_us):
-                # Strict overload policy: REJECT new admissions (no
-                # state write, no dispatch) while admitted in-window
-                # mass exceeds the geometry's accuracy budget — loud
-                # bounded denials instead of silent unbounded
-                # misaccounting. Clears as history ages out of the ring.
-                return self._deny_all(b, now_us)
-            self._state, outs = self._step(
-                self._state, self._place(h1p), self._place(h2p),
-                self._place(np_ns), jnp.int64(now_us),
-                self._policy_device())
-            # Inside the lock: a concurrent set/delete_override rebuilds
-            # the table's sorted views, and a torn read would mis-index.
-            limits = self._policy_limits(h64)
-        res = self._finish(outs, b, now_us, limits=limits)
-        self._note_mass(int(np_ns[:b][res.allowed].sum()), now_us)
+            self._inflight_mass -= int(t.ns.sum())
+            self._note_mass_locked(admitted, t.now_us)
+
+    def _resolve_ticket(self, t: DispatchTicket) -> BatchResult:
+        if t.result is not None:
+            return t.result
+        import jax
+
+        try:
+            # block_until_ready releases the GIL while the device drains,
+            # so a completer thread resolving batch k never stalls the
+            # thread launching batch k+1.
+            jax.block_until_ready(t.outs)
+            allowed, remaining, retry, reset_at = jax.device_get(t.outs)
+        except BaseException:
+            self._retire_ticket(t, 0)
+            raise
+        b = t.b
+        res = BatchResult(
+            allowed=allowed[:b],
+            limit=t.limit,
+            remaining=remaining[:b],
+            retry_after=retry[:b],
+            reset_at=reset_at[:b],
+            limits=t.limits,
+        )
+        self._retire_ticket(t, int(t.ns[res.allowed].sum()))
+        t.result = res
+        t.outs = None
         return res
+
+    def _dispatch_hashed(self, h64: np.ndarray, ns: np.ndarray,
+                         now_us: int, t_sec: float = 0.0) -> BatchResult:
+        return self._resolve_ticket(self._launch_hashed(h64, ns, now_us,
+                                                        t_sec))
+
+    # ------------------------------------------------ pipelined public API
+
+    pipelined = True
+
+    def _launch_guarded(self, h64: np.ndarray, ns_arr: np.ndarray,
+                        t: float) -> DispatchTicket:
+        """Shared fail-open/fail-closed contract for both launch entry
+        points (mirrors allow_hashed): fail-open configs get a
+        pre-resolved fail-open ticket, fail-closed raise at launch."""
+        try:
+            return self._launch_hashed(h64, ns_arr, to_micros(t), t)
+        except Exception as exc:
+            if self.config.fail_open:
+                return DispatchTicket(result=batch_fail_open(
+                    h64.shape[0], self.config.limit,
+                    t + float(self.config.window)))
+            raise StorageUnavailableError(
+                f"sketch launch failed: {exc}") from exc
+
+    def launch_hashed(self, h64: np.ndarray,
+                      ns: Optional[np.ndarray] = None, *,
+                      now: Optional[float] = None) -> DispatchTicket:
+        """Launch phase of the pipelined hot path: stage pre-hashed keys,
+        enqueue the jitted step, and return a ticket WITHOUT blocking on
+        the device. Like allow_hashed, ns is trusted (the serving tier
+        validated at the wire)."""
+        self._check_open()
+        h64 = np.asarray(h64, dtype=np.uint64)
+        if ns is None:
+            ns_arr = np.ones(h64.shape[0], dtype=np.int64)
+        else:
+            ns_arr = np.asarray(ns, dtype=np.int64)
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_guarded(h64, ns_arr, t)
+
+    def launch_batch(self, keys: List[str],
+                     ns: Optional[np.ndarray] = None, *,
+                     now: Optional[float] = None) -> DispatchTicket:
+        """String-key launch: validate + hash host-side, then the hashed
+        launch path (the asyncio door's pipelined entry point)."""
+        self._check_open()
+        from ratelimiter_tpu.algorithms.base import check_key, check_n
+
+        keys = list(keys)
+        for k in keys:
+            check_key(k)
+        if ns is None:
+            ns_arr = np.ones(len(keys), dtype=np.int64)
+        else:
+            for n in ns:
+                check_n(int(n))
+            ns_arr = np.asarray(ns, dtype=np.int64)
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_guarded(self._hash(keys), ns_arr, t)
+
+    def resolve(self, ticket: DispatchTicket) -> BatchResult:
+        """Resolve phase: block on the launched dispatch and assemble its
+        BatchResult (idempotent — a resolved ticket returns its cached
+        result). Device errors surfacing at the fetch honor the same
+        fail-open/fail-closed contract as the synchronous path."""
+        try:
+            return self._resolve_ticket(ticket)
+        except Exception as exc:
+            if self.config.fail_open:
+                res = batch_fail_open(ticket.b, self.config.limit,
+                                      ticket.t_sec
+                                      + float(self.config.window))
+                ticket.result = res
+                ticket.outs = None
+                return res
+            raise StorageUnavailableError(
+                f"sketch dispatch failed: {exc}") from exc
 
     def _over_budget_locked(self, now_us: int) -> bool:
         """Prune + check the admitted-mass ledger; counts/warns once per
-        offending sub-window. Lock must be held."""
+        offending sub-window. Launched-but-unresolved tickets count at
+        their full offered mass (pessimistic — their true admitted mass
+        replaces the estimate at resolve), so the pipeline's in-flight
+        window cannot slip admissions past the budget. Lock must be
+        held."""
         p = now_us // self._sub_us
         if self._period_mass:
             p = max(p, max(self._period_mass))
         low = p - self._ring_sw
         for q in [q for q in self._period_mass if q <= low]:
             del self._period_mass[q]
-        mass = sum(self._period_mass.values())
+        mass = sum(self._period_mass.values()) + self._inflight_mass
         if mass <= self._mass_budget:
             return False
         if p > self._warned_period:
@@ -237,38 +444,39 @@ class SketchLimiter(RateLimiter):
 
     # ------------------------------------------------- accuracy envelope
 
-    def _note_mass(self, admitted: int, now_us: int) -> None:
+    def _note_mass_locked(self, admitted: int, now_us: int) -> None:
         """Track admitted in-window mass against the geometry's calibrated
         budget (SketchParams.mass_budget): collision error — and with it
         the false-deny rate — scales with this mass, so exceeding the
         budget means the geometry is undersized for the offered load.
-        Warns loudly once per sub-window while overloaded."""
+        Warns loudly once per sub-window while overloaded. Lock must be
+        held (callers pair this with the in-flight-mass bookkeeping in
+        one acquisition — _retire_ticket)."""
         p = now_us // self._sub_us
-        with self._lock:
-            # Clamp forward like the kernels clamp now_us: after a backward
-            # clock step the ledger would otherwise keep "future" periods
-            # alive past pruning, inflating the in-window mass and firing
-            # spurious undersized-geometry warnings.
-            if self._period_mass:
-                p = max(p, max(self._period_mass))
-            self._period_mass[p] = self._period_mass.get(p, 0) + admitted
-            low = p - self._ring_sw
-            for q in [q for q in self._period_mass if q <= low]:
-                del self._period_mass[q]
-            mass = sum(self._period_mass.values())
-            if mass > self._mass_budget and p > self._warned_period:
-                self._warned_period = p
-                self.overload_periods += 1
-                log.warning(
-                    "sketch geometry undersized: admitted in-window mass "
-                    "%d exceeds the d=%d w=%d budget of %d at limit=%d — "
-                    "collision error is at the ~1%% false-deny level and "
-                    "grows with load; size the geometry with "
-                    "SketchParams.for_load(limit=%d, "
-                    "expected_window_mass=%d)",
-                    mass, self.config.sketch.depth, self.config.sketch.width,
-                    self._mass_budget, self.config.limit, self.config.limit,
-                    mass)
+        # Clamp forward like the kernels clamp now_us: after a backward
+        # clock step the ledger would otherwise keep "future" periods
+        # alive past pruning, inflating the in-window mass and firing
+        # spurious undersized-geometry warnings.
+        if self._period_mass:
+            p = max(p, max(self._period_mass))
+        self._period_mass[p] = self._period_mass.get(p, 0) + admitted
+        low = p - self._ring_sw
+        for q in [q for q in self._period_mass if q <= low]:
+            del self._period_mass[q]
+        mass = sum(self._period_mass.values())
+        if mass > self._mass_budget and p > self._warned_period:
+            self._warned_period = p
+            self.overload_periods += 1
+            log.warning(
+                "sketch geometry undersized: admitted in-window mass "
+                "%d exceeds the d=%d w=%d budget of %d at limit=%d — "
+                "collision error is at the ~1%% false-deny level and "
+                "grows with load; size the geometry with "
+                "SketchParams.for_load(limit=%d, "
+                "expected_window_mass=%d)",
+                mass, self.config.sketch.depth, self.config.sketch.width,
+                self._mass_budget, self.config.limit, self.config.limit,
+                mass)
 
     def in_window_admitted_mass(self) -> int:
         """Admitted requests currently counted inside the sliding window
@@ -280,31 +488,12 @@ class SketchLimiter(RateLimiter):
     def mass_budget(self) -> int:
         return self._mass_budget
 
-    def _finish(self, outs, b: int, now_us: int, limits=None) -> BatchResult:
-        """Window-algorithm result assembly: retry-after is time to window
-        reset (``fixedwindow.go:107-112``). The token-bucket subclass
-        overrides with device-computed deficit/rate retry."""
-        allowed, remaining, _est = outs
-        allowed = np.asarray(allowed)[:b]
-        remaining = np.asarray(remaining)[:b]
-
-        cur_ws = (now_us // self._window_us) * self._window_us
-        reset_at = (cur_ws + self._window_us) / MICROS
-        retry = np.where(allowed, 0.0, (cur_ws + self._window_us - now_us) / MICROS)
-        return BatchResult(
-            allowed=allowed,
-            limit=self.config.limit,
-            remaining=remaining.astype(np.int64),
-            retry_after=retry.astype(np.float64),
-            reset_at=np.full(b, reset_at, dtype=np.float64),
-            limits=limits,
-        )
-
     def allow_hashed(self, h64: np.ndarray, ns: Optional[np.ndarray] = None,
                      *, now: Optional[float] = None) -> BatchResult:
         """Fast path: decide a batch of pre-hashed uint64 keys. This is the
         interface the serving tier and benchmarks use — host string handling
-        is out of the hot loop (SURVEY.md §7.4.4)."""
+        is out of the hot loop (SURVEY.md §7.4.4). Launch + resolve back to
+        back; the pipelined doors split the two phases (ADR-010)."""
         self._check_open()
         h64 = np.asarray(h64, dtype=np.uint64)
         if ns is None:
@@ -313,7 +502,7 @@ class SketchLimiter(RateLimiter):
             ns_arr = np.asarray(ns, dtype=np.int64)
         t = self.clock.now() if now is None else float(now)
         try:
-            return self._dispatch_hashed(h64, ns_arr, to_micros(t))
+            return self._dispatch_hashed(h64, ns_arr, to_micros(t), t)
         except Exception as exc:
             if self.config.fail_open:
                 return batch_fail_open(h64.shape[0], self.config.limit,
@@ -322,7 +511,8 @@ class SketchLimiter(RateLimiter):
 
     def _allow_batch(self, keys: list, ns: np.ndarray, now: float) -> BatchResult:
         try:
-            return self._dispatch_hashed(self._hash(keys), ns, to_micros(now))
+            return self._dispatch_hashed(self._hash(keys), ns, to_micros(now),
+                                         now)
         except Exception as exc:
             if self.config.fail_open:
                 return batch_fail_open(len(keys), self.config.limit,
@@ -512,8 +702,10 @@ class SketchTokenBucketLimiter(SketchLimiter):
         self._window_us = to_micros(self.config.window)
         self._seed = self.config.sketch.seed
         self._lock = threading.Lock()
+        self._init_staging()
         # The mass watchdog (and with it overload_policy="strict") is a
-        # windowed-sketch concept; debt decays continuously (_note_mass).
+        # windowed-sketch concept; debt decays continuously
+        # (_note_mass_locked).
         self._strict = False
         self._injected_failure: Optional[Exception] = None
         self._init_policy()
@@ -531,7 +723,7 @@ class SketchTokenBucketLimiter(SketchLimiter):
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
 
-    def _note_mass(self, admitted: int, now_us: int) -> None:
+    def _note_mass_locked(self, admitted: int, now_us: int) -> None:
         """No mass watchdog for the debt sketch: debt decays continuously
         (no sub-window ring to bucket mass into) and overestimated debt
         self-corrects as it drains; the windowed calibration does not
@@ -540,13 +732,13 @@ class SketchTokenBucketLimiter(SketchLimiter):
     def in_window_admitted_mass(self) -> int:
         raise NotImplementedError(
             "the admitted-mass watchdog applies to windowed sketches "
-            "only (debt decays continuously; see _note_mass)")
+            "only (debt decays continuously; see _note_mass_locked)")
 
     @property
     def mass_budget(self) -> int:
         raise NotImplementedError(
             "the admitted-mass watchdog applies to windowed sketches "
-            "only (debt decays continuously; see _note_mass)")
+            "only (debt decays continuously; see _note_mass_locked)")
 
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit: refill rate (limit/window) and capacity both
@@ -586,24 +778,19 @@ class SketchTokenBucketLimiter(SketchLimiter):
             self._window_us = to_micros(new_cfg.window)
             self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
 
-    def _finish(self, outs, b: int, now_us: int, limits=None) -> BatchResult:
-        """Token-bucket result assembly: retry-after = deficit / refill rate
-        computed exactly on device (``tokenbucket.go:122-130``); reset_at is
-        the reference's approximation now + window (time to refill the whole
-        bucket from empty, ``tokenbucket.go:159-165``)."""
+    def _launch_finish(self, outs, now_us: int):
+        """Token-bucket result assembly, on device: retry-after = deficit /
+        refill rate computed exactly by the step (``tokenbucket.go:122-130``);
+        reset_at is the reference's approximation now + window (time to
+        refill the whole bucket from empty, ``tokenbucket.go:159-165``)."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import bucket_kernels
+
         allowed, remaining, retry_us = outs
-        allowed = np.asarray(allowed)[:b]
-        remaining = np.asarray(remaining)[:b]
-        retry_us = np.asarray(retry_us)[:b]
-        return BatchResult(
-            allowed=allowed,
-            limit=self.config.limit,
-            remaining=remaining.astype(np.int64),
-            retry_after=(retry_us / MICROS).astype(np.float64),
-            reset_at=np.full(b, (now_us + self._window_us) / MICROS,
-                             dtype=np.float64),
-            limits=limits,
-        )
+        return bucket_kernels.finish_bucket(
+            allowed, remaining, retry_us, jnp.int64(now_us),
+            jnp.int64(self._window_us))
 
     # _reset is inherited: the base implementation's _sync_period call is a
     # no-op here, and the reset-step dispatch shape is identical.

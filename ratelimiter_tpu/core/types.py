@@ -130,3 +130,40 @@ def batch_fail_open(n: int, limit: int, reset_at: float) -> BatchResult:
         reset_at=np.full(n, reset_at, dtype=np.float64),
         fail_open=True,
     )
+
+
+class DispatchTicket:
+    """Handle to one *launched* batched dispatch (the pipelined serving hot
+    path, ADR-010).
+
+    ``limiter.launch_batch`` / ``launch_hashed`` stage the batch, enqueue
+    the jitted step, and return one of these WITHOUT blocking on the
+    device; ``limiter.resolve(ticket)`` blocks until that dispatch's
+    results are readable and assembles the BatchResult. Sequential
+    semantics across in-flight tickets are preserved by state threading
+    (each launch consumes the previous launch's donated state buffers),
+    not by host blocking — resolve order does not affect counters.
+
+    Backends without an async device path (exact) pre-resolve at launch:
+    ``result`` is already set and resolve just returns it.
+    """
+
+    __slots__ = ("outs", "b", "limit", "limits", "ns", "now_us", "t_sec",
+                 "slot", "padded", "result", "meta")
+
+    def __init__(self, result: "BatchResult | None" = None):
+        self.outs = None        # device-side (allowed, remaining, retry, reset)
+        self.b = len(result) if result is not None else 0
+        self.limit = result.limit if result is not None else 0
+        self.limits = None      # host per-request override limits (or None)
+        self.ns = None          # host ns[:b] (admitted-mass accounting)
+        self.now_us = 0
+        self.t_sec = 0.0
+        self.slot = None        # staging buffers to recycle at resolve
+        self.padded = 0
+        self.result = result    # set once resolved (or pre-resolved)
+        self.meta = None        # decorator/door bookkeeping rides along
+
+    @property
+    def resolved(self) -> bool:
+        return self.result is not None

@@ -20,15 +20,25 @@
 //                        work, drains up to max_batch keys, builds the
 //                        contiguous (blob, offsets, lengths, ns) buffers
 //                        WITH the key prefix prepended (so Python hashes
-//                        ready-made bytes), calls the Python callback
-//                        under PyGILState_Ensure, and hands results to
-//                        the responder.
+//                        ready-made bytes). Pipelined mode (launch +
+//                        resolve callbacks, ADR-010): calls the
+//                        non-blocking LAUNCH callback and pushes the
+//                        returned ticket onto a bounded in-flight queue
+//                        (blocking when full = backpressure), so up to
+//                        `inflight` device dispatches overlap. Legacy
+//                        mode calls the blocking decide callback.
+//   completer thread(s)  one per shard (pipelined mode): pops the OLDEST
+//                        in-flight ticket, calls the Python RESOLVE
+//                        callback (blocks on the device with the GIL
+//                        released), and hands results to the responder.
 //   responder thread     encodes RESULT / RESULT_BATCH frames and queues
 //                        them on connections — batch k's encode+send
 //                        overlaps batch k+1's Python decide. Split
 //                        batches (keys spanning shards) reassemble via
 //                        BatchJoin; the last shard sends the frame.
-//                        (SLO mode keeps the inline single-shard path.)
+//                        (SLO mode keeps the inline single-shard decide
+//                        path — an SLO needs one well-defined deadline
+//                        per dispatch, not a window of them.)
 //
 // Dispatch shards (num_shards > 1) decide on separate Python-side
 // limiter shards concurrently. NOTE: within ONE Python process the GIL
@@ -147,6 +157,9 @@ struct Conn {
   std::mutex wmx;
   std::atomic<bool> closed{false};
   bool want_write = false;          // io thread only
+  // This connection currently holds a DCN-sized receive-buffer grant
+  // (io thread only; counted in Server::dcn_conns).
+  bool dcn_big = false;
 };
 
 using ConnPtr = std::shared_ptr<Conn>;
@@ -205,9 +218,29 @@ struct Server {
   // parity with the asyncio batcher's dispatch_timeout (ADR-003).
   uint32_t slo_us = 0;
   bool fail_open = false;
-  int64_t limit = 0;      // for fail-open RESULT frames (may lag
-  double window_s = 60.0;  // update_limit; cosmetic fields only)
+  // Live limit/window for fail-open RESULT frames: refreshed from every
+  // successful decide/resolve result AND pushable from Python
+  // (set_limits), so responses stamped without a completed dispatch —
+  // SLO breaches, draining — carry the CURRENT limit, not the
+  // construction-time one (ISSUE-3 bugfix satellite).
+  std::atomic<int64_t> limit{0};
+  std::atomic<double> window_s{60.0};
+  // Bumped by every explicit set_limits push: a dispatch that STARTED
+  // before the push must not overwrite the fresher value when it
+  // completes (each refresh is gated on the epoch it captured at start).
+  // limit_mx serializes the check-then-store against the push itself —
+  // a lock-free gate would leave a load/store window where a racing
+  // push is still clobbered. Reads stay lock-free (atomics).
+  std::atomic<uint64_t> limit_epoch{0};
+  std::mutex limit_mx;
   std::atomic<bool> stop{false};
+
+  // Per-dispatch limit refresh, gated on the epoch captured when the
+  // dispatch started.
+  void refresh_limit(int64_t lim, uint64_t started_epoch) {
+    std::lock_guard<std::mutex> g(limit_mx);
+    if (limit_epoch.load() == started_epoch) limit.store(lim);
+  }
   std::atomic<bool> draining{false};
   std::atomic<uint64_t> decisions{0};
   std::atomic<uint64_t> slo_breaches{0};
@@ -235,6 +268,33 @@ struct Server {
   //: dispatcher inside a long Python decide will enqueue its Reply
   //: AFTER stop is set; exiting on stop+empty alone would drop it).
   std::atomic<uint32_t> live_dispatchers{0};
+
+  // Pipelined dispatch (launch/resolve callbacks set, SLO off): one
+  // bounded in-flight ticket queue + completer thread per shard. The
+  // dispatcher blocks on cv_space when `inflight` tickets are pending —
+  // that is the pipeline's backpressure, upstream of the socket reads.
+  struct InflightEntry {
+    std::vector<Pending> items;
+    PyObject* ticket = nullptr;
+    size_t total = 0;
+    uint64_t limit_epoch = 0;  // epoch observed at launch time
+  };
+  struct PipeQ {
+    std::mutex mx;
+    std::condition_variable cv_items, cv_space;
+    std::deque<InflightEntry> entries;
+  };
+  uint32_t inflight_window = 8;
+  bool pipelined = false;  // resolved at start(): launch+resolve, no SLO
+  std::vector<std::unique_ptr<PipeQ>> pipeqs;
+  std::vector<std::thread> completer_threads;
+  std::atomic<uint32_t> live_completers{0};
+
+  // DCN receive-buffer accounting (pre-screen, ADVICE r5): connections
+  // currently granted a slab-sized rbuf, bounded by max_dcn_conns.
+  bool dcn_auth_required = false;
+  uint32_t max_dcn_conns = 4;
+  std::atomic<uint32_t> dcn_conns{0};
 
   std::mutex ifmx;
   std::condition_variable ifcv;
@@ -266,6 +326,11 @@ struct Server {
   PyObject* cb_decide = nullptr;
   PyObject* cb_reset = nullptr;
   PyObject* cb_metrics = nullptr;
+  // Pipelined-mode callbacks (None = legacy blocking decide):
+  //   launch(shard, blob, offsets, lengths, ns) -> opaque ticket
+  //   resolve(shard, ticket) -> (flags, remaining, retry, reset_at, limit)
+  PyObject* cb_launch = nullptr;
+  PyObject* cb_resolve = nullptr;
   // DCN merge callback (None = T_DCN_PUSH rejected and the frame cap
   // stays at MAX_FRAME). Called with the raw push payload; the Python
   // side owns auth verification and the merge into every shard limiter.
@@ -335,12 +400,16 @@ void send_policy_answers(Server* s, const std::vector<Pending>& items) {
   // typed storage_unavailable error — ADR-003's SLO-breach policy.
   for (const auto& p : items) {
     if (s->fail_open) {
-      double reset_at = now_s() + s->window_s;
+      // Live limit/window (atomics refreshed by every completed
+      // dispatch + Python pushes): a breach after update_limit stamps
+      // the CURRENT limit.
+      int64_t lim = s->limit.load();
+      double reset_at = now_s() + s->window_s.load();
       if (!p.is_batch) {
         std::string out;
         frame_header(out, T_RESULT, p.req_id, 33);
         out.push_back((char)3);  // allowed | fail_open
-        put_i64(out, s->limit);
+        put_i64(out, lim);
         put_i64(out, 0);
         put_f64(out, 0.0);
         put_f64(out, reset_at);
@@ -349,7 +418,7 @@ void send_policy_answers(Server* s, const std::vector<Pending>& items) {
         uint32_t count = (uint32_t)p.keys.size();
         std::string out;
         frame_header(out, T_RESULT_BATCH, p.req_id, 12 + 25 * count);
-        put_i64(out, s->limit);
+        put_i64(out, lim);
         put_u32(out, count);
         for (uint32_t i = 0; i < count; ++i) {
           out.push_back((char)3);
@@ -393,23 +462,15 @@ void slo_main(Server* s) {
 
 // ---- dispatcher ----------------------------------------------------------
 
-// Calls the Python decide callback for a drained run of Pending items,
-// filling `r` with per-request results (or an error). Returns false if
-// the callback raised.
-bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
-                 Server::Reply& r) {
+// Build the contiguous (blob, offsets, lengths, ns) decide buffers for a
+// drained run; returns the total key count.
+size_t build_buffers(Server* s, const std::vector<Pending>& items,
+                     std::string& blob, std::vector<int64_t>& offsets,
+                     std::vector<int64_t>& lengths,
+                     std::vector<int64_t>& ns) {
   size_t total = 0;
   for (auto& p : items) total += p.keys.size();
-  if (total == 0) {
-    // Only empty ALLOW_BATCH frames: nothing to decide (and empty
-    // buffers would reach Python as None through Py_BuildValue y#).
-    r.limit = s->limit;
-    return true;
-  }
-
   const std::string& prefix = s->key_prefix;
-  std::string blob;
-  std::vector<int64_t> offsets, lengths, ns;
   offsets.reserve(total);
   lengths.reserve(total);
   ns.reserve(total);
@@ -422,18 +483,65 @@ bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
       ns.push_back(p.ns[i]);
     }
   }
+  return total;
+}
 
-  std::vector<uint8_t>& flags = r.flags;
-  std::vector<int64_t>& remaining = r.remaining;
-  std::vector<double>& retry = r.retry;
-  std::vector<double>& reset_at = r.reset_at;
-  flags.resize(total);
-  remaining.resize(total);
-  retry.resize(total);
-  reset_at.resize(total);
-  int64_t limit = 0;
-  uint16_t err_code = 0;
-  std::string err_msg;
+// Parse the (flags, remaining, retry, reset_at, limit) result tuple into
+// `r` (buffer protocol); sets r.err_* on malformed results. GIL held.
+void parse_result_tuple(PyObject* res, size_t total, Server::Reply& r,
+                        const char* what) {
+  PyObject *o_fl, *o_rem, *o_ret, *o_rst;
+  long long o_lim = 0;
+  if (!PyArg_ParseTuple(res, "OOOOL", &o_fl, &o_rem, &o_ret, &o_rst,
+                        &o_lim)) {
+    r.err_code = E_INTERNAL;
+    r.err_msg = std::string(what) + " returned a malformed tuple";
+    PyErr_Clear();
+    return;
+  }
+  r.limit = (int64_t)o_lim;
+  r.flags.resize(total);
+  r.remaining.resize(total);
+  r.retry.resize(total);
+  r.reset_at.resize(total);
+  Py_buffer bufs[4];
+  PyObject* objs[4] = {o_fl, o_rem, o_ret, o_rst};
+  int acquired = 0;  // bufs[0..acquired) hold views needing release
+  while (acquired < 4 &&
+         PyObject_GetBuffer(objs[acquired], &bufs[acquired],
+                            PyBUF_SIMPLE) == 0)
+    ++acquired;
+  bool ok = acquired == 4;
+  if (!ok || (size_t)bufs[0].len < total ||
+      (size_t)bufs[1].len < total * 8 ||
+      (size_t)bufs[2].len < total * 8 ||
+      (size_t)bufs[3].len < total * 8) {
+    r.err_code = E_INTERNAL;
+    r.err_msg = std::string(what) + " returned short buffers";
+    PyErr_Clear();
+  } else {
+    memcpy(r.flags.data(), bufs[0].buf, total);
+    memcpy(r.remaining.data(), bufs[1].buf, total * 8);
+    memcpy(r.retry.data(), bufs[2].buf, total * 8);
+    memcpy(r.reset_at.data(), bufs[3].buf, total * 8);
+  }
+  for (int i = 0; i < acquired; ++i) PyBuffer_Release(&bufs[i]);
+}
+
+// Calls the Python decide callback for a drained run of Pending items,
+// filling `r` with per-request results (or an error). Returns false if
+// the callback raised.
+bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
+                 Server::Reply& r) {
+  std::string blob;
+  std::vector<int64_t> offsets, lengths, ns;
+  size_t total = build_buffers(s, items, blob, offsets, lengths, ns);
+  if (total == 0) {
+    // Only empty ALLOW_BATCH frames: nothing to decide (and empty
+    // buffers would reach Python as None through Py_BuildValue y#).
+    r.limit = s->limit.load();
+    return true;
+  }
 
   {
     PyGILState_STATE g = PyGILState_Ensure();
@@ -448,54 +556,110 @@ bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
     if (res == nullptr) {
       // Python-side mapping: the bridge returns a typed code via the
       // exception's .rl_code when it can; default storage_unavailable.
-      err_code = fetch_py_error(err_msg, "decide callback failed",
-                                E_STORAGE_UNAVAILABLE);
+      r.err_code = fetch_py_error(r.err_msg, "decide callback failed",
+                                  E_STORAGE_UNAVAILABLE);
     } else {
-      // (flags, remaining, retry, reset_at, limit) — buffer protocol.
-      PyObject *o_fl, *o_rem, *o_ret, *o_rst;
-      long long o_lim = 0;
-      if (!PyArg_ParseTuple(res, "OOOOL", &o_fl, &o_rem, &o_ret, &o_rst,
-                            &o_lim)) {
-        err_code = E_INTERNAL;
-        err_msg = "decide returned a malformed tuple";
-        PyErr_Clear();
-      } else {
-        limit = (int64_t)o_lim;
-        Py_buffer bufs[4];
-        PyObject* objs[4] = {o_fl, o_rem, o_ret, o_rst};
-        int acquired = 0;  // bufs[0..acquired) hold views needing release
-        while (acquired < 4 &&
-               PyObject_GetBuffer(objs[acquired], &bufs[acquired],
-                                  PyBUF_SIMPLE) == 0)
-          ++acquired;
-        bool ok = acquired == 4;
-        if (!ok || (size_t)bufs[0].len < total ||
-            (size_t)bufs[1].len < total * 8 ||
-            (size_t)bufs[2].len < total * 8 ||
-            (size_t)bufs[3].len < total * 8) {
-          err_code = E_INTERNAL;
-          err_msg = "decide returned short buffers";
-          PyErr_Clear();
-        } else {
-          memcpy(flags.data(), bufs[0].buf, total);
-          memcpy(remaining.data(), bufs[1].buf, total * 8);
-          memcpy(retry.data(), bufs[2].buf, total * 8);
-          memcpy(reset_at.data(), bufs[3].buf, total * 8);
-        }
-        for (int i = 0; i < acquired; ++i) PyBuffer_Release(&bufs[i]);
-      }
+      parse_result_tuple(res, total, r, "decide");
       Py_DECREF(res);
     }
     PyGILState_Release(g);
   }
 
-  r.limit = limit;
   r.total = total;
-  r.err_code = err_code;
-  r.err_msg = std::move(err_msg);
   // decisions accounting is the CALLER's job: the SLO path must not
   // double-count a breached batch the watcher already counted.
-  return err_code == 0;
+  return r.err_code == 0;
+}
+
+// Launch phase (pipelined mode): stage + enqueue via the non-blocking
+// Python launch callback. Returns the ticket (new reference), or null
+// with r.err_* set when the callback raised.
+PyObject* launch_core(Server* s, uint32_t shard, std::vector<Pending>& items,
+                      Server::Reply& r, size_t* total_out) {
+  std::string blob;
+  std::vector<int64_t> offsets, lengths, ns;
+  size_t total = build_buffers(s, items, blob, offsets, lengths, ns);
+  *total_out = total;
+  if (total == 0) {
+    r.limit = s->limit.load();
+    return nullptr;  // err_code == 0: empty frame, answered directly
+  }
+  PyObject* ticket = nullptr;
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(Iy#y#y#y#)", (unsigned int)shard,
+        blob.data(), (Py_ssize_t)blob.size(),
+        (const char*)offsets.data(), (Py_ssize_t)(offsets.size() * 8),
+        (const char*)lengths.data(), (Py_ssize_t)(lengths.size() * 8),
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+    ticket = args ? PyObject_CallObject(s->cb_launch, args) : nullptr;
+    Py_XDECREF(args);
+    if (ticket == nullptr)
+      r.err_code = fetch_py_error(r.err_msg, "launch callback failed",
+                                  E_STORAGE_UNAVAILABLE);
+    PyGILState_Release(g);
+  }
+  return ticket;
+}
+
+// Completer (pipelined mode): resolve in-flight tickets OLDEST FIRST and
+// hand results to the responder. Outlives the dispatchers (a dispatcher
+// mid-launch at stop time pushes its ticket afterward) and drains the
+// queue fully before exiting, so every launched batch is answered and
+// every ticket reference released.
+void completer_main(Server* s, uint32_t shard) {
+  Server::PipeQ& q = *s->pipeqs[shard];
+  s->live_completers.fetch_add(1);
+  struct Depart {
+    Server* s;
+    ~Depart() {
+      s->live_completers.fetch_sub(1);
+      s->rcv.notify_all();  // responder re-checks its exit condition
+    }
+  } depart{s};
+  while (true) {
+    Server::InflightEntry e;
+    {
+      std::unique_lock<std::mutex> lk(q.mx);
+      q.cv_items.wait(lk, [&] {
+        return !q.entries.empty() ||
+               (s->stop.load() && s->live_dispatchers.load() == 0);
+      });
+      if (q.entries.empty()) return;  // stopped, launchers gone, drained
+      e = std::move(q.entries.front());
+      q.entries.pop_front();
+    }
+    q.cv_space.notify_one();
+    Server::Reply r;
+    {
+      PyGILState_STATE g = PyGILState_Ensure();
+      PyObject* res = PyObject_CallFunction(
+          s->cb_resolve, "IO", (unsigned int)shard, e.ticket);
+      Py_DECREF(e.ticket);
+      if (res == nullptr) {
+        r.err_code = fetch_py_error(r.err_msg, "resolve callback failed",
+                                    E_STORAGE_UNAVAILABLE);
+      } else {
+        parse_result_tuple(res, e.total, r, "resolve");
+        Py_DECREF(res);
+      }
+      PyGILState_Release(g);
+    }
+    r.total = e.total;
+    if (r.err_code == 0) {
+      s->decisions.fetch_add(r.total);
+      // Gated on the launch-time epoch: this dispatch's limit is stale
+      // relative to any set_limits push issued since it launched.
+      s->refresh_limit(r.limit, e.limit_epoch);
+    }
+    r.items = std::move(e.items);
+    {
+      std::lock_guard<std::mutex> g(s->rmx);
+      s->rqueue.push_back(std::move(r));
+    }
+    s->rcv.notify_one();
+  }
 }
 
 // Finalize one split batch: called by the LAST shard to contribute.
@@ -593,6 +757,7 @@ void emit_reply(Server* s, std::vector<Pending>& items,
 bool run_decide(Server* s, std::vector<Pending>& items,
                 std::atomic<bool>* gate) {
   Server::Reply r;
+  uint64_t ep = s->limit_epoch.load();
   bool ok = decide_core(s, 0, items, r);
   if (gate != nullptr && gate->exchange(true)) {
     // SLO watcher already answered (and counted) these waiters; the
@@ -600,7 +765,10 @@ bool run_decide(Server* s, std::vector<Pending>& items,
     // responses.
     return ok;
   }
-  if (ok) s->decisions.fetch_add(r.total);
+  if (ok) {
+    s->decisions.fetch_add(r.total);
+    if (r.total) s->refresh_limit(r.limit, ep);
+  }
   emit_reply(s, items, r);
   return ok;
 }
@@ -617,9 +785,10 @@ void responder_main(Server* s) {
       std::unique_lock<std::mutex> lk(s->rmx);
       s->rcv.wait(lk, [&] {
         return !s->rqueue.empty() ||
-               (s->stop.load() && s->live_dispatchers.load() == 0);
+               (s->stop.load() && s->live_dispatchers.load() == 0 &&
+                s->live_completers.load() == 0);
       });
-      if (s->rqueue.empty()) return;  // stopped, dispatchers gone, drained
+      if (s->rqueue.empty()) return;  // stopped, producers gone, drained
       r = std::move(s->rqueue.front());
       s->rqueue.pop_front();
     }
@@ -715,6 +884,7 @@ void dispatcher_main(Server* s, uint32_t shard) {
     ~Depart() {
       s->live_dispatchers.fetch_sub(1);
       s->rcv.notify_all();  // let the responder re-check its exit condition
+      for (auto& pq : s->pipeqs) pq->cv_items.notify_all();  // completers too
     }
   } depart{s};
   while (true) {
@@ -755,12 +925,50 @@ void dispatcher_main(Server* s, uint32_t shard) {
       }
     }
     if (decisions.empty()) continue;
+    if (s->pipelined) {
+      // Pipelined throughput path (ADR-010): non-blocking launch, then
+      // hand the ticket to the completer — this thread goes straight
+      // back to coalescing batch k+1 while the device still computes
+      // batch k (and k-1, ... up to `inflight`).
+      Server::Reply r;
+      size_t total = 0;
+      uint64_t ep = s->limit_epoch.load();
+      PyObject* ticket = launch_core(s, shard, decisions, r, &total);
+      if (ticket == nullptr) {
+        // Launch failed (typed error for every waiter) or the run held
+        // only empty frames — answer via the responder directly.
+        r.total = total;
+        r.items = std::move(decisions);
+        {
+          std::lock_guard<std::mutex> g(s->rmx);
+          s->rqueue.push_back(std::move(r));
+        }
+        s->rcv.notify_one();
+        continue;
+      }
+      Server::PipeQ& pq = *s->pipeqs[shard];
+      {
+        std::unique_lock<std::mutex> lk(pq.mx);
+        // Bounded window: block HERE (backpressure) when `inflight`
+        // tickets are unresolved; on stop, push anyway — the completer
+        // drains everything before exiting.
+        pq.cv_space.wait(lk, [&] {
+          return pq.entries.size() < s->inflight_window || s->stop.load();
+        });
+        pq.entries.push_back({std::move(decisions), ticket, total, ep});
+      }
+      pq.cv_items.notify_one();
+      continue;
+    }
     if (s->slo_us == 0) {
       // Throughput path: decide here, hand encode+send to the responder
       // so the next batch's decide starts immediately.
       Server::Reply r;
-      if (decide_core(s, shard, decisions, r))
+      uint64_t dep = s->limit_epoch.load();
+      if (decide_core(s, shard, decisions, r)) {
         s->decisions.fetch_add(r.total);
+        if (r.total) s->refresh_limit(r.limit, dep);
+      }
       r.items = std::move(decisions);
       {
         std::lock_guard<std::mutex> g(s->rmx);
@@ -792,6 +1000,10 @@ void dispatcher_main(Server* s, uint32_t shard) {
 
 void close_conn(Server* s, const ConnPtr& c) {
   if (c->closed.exchange(true)) return;
+  if (c->dcn_big) {
+    c->dcn_big = false;
+    s->dcn_conns.fetch_sub(1);
+  }
   epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
   s->conns.erase(c->fd);
@@ -836,12 +1048,40 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     // ONLY on a DCN-enabled server (mirrors protocol.parse_header's
     // allow_dcn).
     uint8_t type = (uint8_t)c->rbuf[off + 4];
+    uint64_t req_id;
+    memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     uint32_t cap =
         (s->dcn_enabled && type == T_DCN_PUSH) ? MAX_DCN_FRAME : MAX_FRAME;
     if (length > cap) return false;  // protocol error
+    if (s->dcn_enabled && type == T_DCN_PUSH && !c->dcn_big &&
+        (size_t)4 + length > c->rbuf.size() - off) {
+      // Incomplete DCN frame that will need slab-sized buffering:
+      // pre-screen BEFORE granting it (ADVICE r5). When the server
+      // requires push auth, the body must open with the RLA envelope
+      // magic — an oversized garbage stream labeled T_DCN_PUSH dies
+      // here, 4 bytes in, instead of buffering up to MAX_DCN_FRAME.
+      if (c->rbuf.size() - off < 17) break;  // need the first 4 body bytes
+      const char* bm = c->rbuf.data() + off + 13;
+      if (s->dcn_auth_required &&
+          !(bm[0] == 'R' && bm[1] == 'L' && bm[2] == 'A' &&
+            (bm[3] == '1' || bm[3] == '2')))
+        return false;
+      // Bound the number of connections holding DCN-sized buffers.
+      if (s->dcn_conns.fetch_add(1) >= s->max_dcn_conns) {
+        s->dcn_conns.fetch_sub(1);
+        // Best-effort DIRECT send: returning false closes the conn
+        // immediately, so the queued-write path would drop the typed
+        // refusal before the peer could read it.
+        std::string err = make_error(req_id, E_STORAGE_UNAVAILABLE,
+                                     "too many concurrent DCN transfers "
+                                     "(raise max_dcn_conns)");
+        ssize_t w = send(c->fd, err.data(), err.size(), MSG_NOSIGNAL);
+        (void)w;
+        return false;
+      }
+      c->dcn_big = true;
+    }
     if (c->rbuf.size() - off < 4 + length) break;
-    uint64_t req_id;
-    memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     const char* body = c->rbuf.data() + off + 13;
     uint32_t blen = length - 9;
     off += 4 + length;
@@ -992,6 +1232,11 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       Pending p{c, req_id, false, {std::string()}, {-2}};
       enqueue(std::move(p), 0, 0);
     } else if (type == T_DCN_PUSH) {
+      if (c->dcn_big) {
+        // Whole frame in hand: release the slab-sized buffer grant.
+        c->dcn_big = false;
+        s->dcn_conns.fetch_sub(1);
+      }
       if (!s->dcn_enabled) {
         conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
                                    "DCN exchange not enabled on this server"));
@@ -1050,21 +1295,30 @@ void io_main(Server* s) {
           continue;
         }
         if (events[i].events & EPOLLIN) {
-          // Backpressure bound on unparsed bytes. A DCN-enabled server
-          // must hold one whole in-flight push (up to MAX_DCN_FRAME, the
-          // same buffering the asyncio door accepts via readexactly) —
-          // with the 4 MiB bound a production-geometry slab frame would
-          // kill the connection mid-frame, before process_rbuf's
-          // type-aware cap ever saw the type byte.
-          const size_t rbuf_cap =
-              s->dcn_enabled ? 4ul + MAX_DCN_FRAME + 4ul * MAX_FRAME
-                             : 4ul * MAX_FRAME;
+          // Backpressure bound on unparsed bytes. The slab-sized cap
+          // (up to MAX_DCN_FRAME — the same buffering the asyncio door
+          // accepts via readexactly) is PER-CONNECTION GRANTED, not
+          // blanket: process_rbuf issues the grant only after the
+          // pre-screen (DCN frame header + RLA magic when auth is
+          // required, bounded concurrent holders) — an oversized
+          // garbage stream dies at the 4 MiB bound (ADVICE r5).
+          const size_t small_cap = 4ul * MAX_FRAME;
+          const size_t big_cap = 4ul + MAX_DCN_FRAME + 4ul * MAX_FRAME;
           bool dead = false;
           while (true) {
             ssize_t r = recv(fd, buf, sizeof(buf), 0);
             if (r > 0) {
               c->rbuf.append(buf, (size_t)r);
-              if (c->rbuf.size() > rbuf_cap) { dead = true; break; }
+              if (c->rbuf.size() > (c->dcn_big ? big_cap : small_cap)) {
+                // May be a legal DCN push outgrowing the small cap:
+                // parse what is buffered (grants dcn_big when the
+                // pre-screen passes), then re-check.
+                if (!process_rbuf(s, c)) { dead = true; break; }
+                if (c->rbuf.size() > (c->dcn_big ? big_cap : small_cap)) {
+                  dead = true;
+                  break;
+                }
+              }
             } else if (r == 0) {
               dead = true;
               break;
@@ -1131,9 +1385,21 @@ PyObject* server_start(PyObject* self, PyObject* args) {
   s->shardqs.clear();
   for (uint32_t i = 0; i < s->num_shards; ++i)
     s->shardqs.push_back(std::make_unique<Server::ShardQ>());
+  // Pipelined mode needs both callbacks and no SLO (the watcher's
+  // single-deadline contract assumes one dispatch in flight).
+  s->pipelined = s->cb_launch != nullptr && s->cb_launch != Py_None &&
+                 s->cb_resolve != nullptr && s->cb_resolve != Py_None &&
+                 s->slo_us == 0 && s->inflight_window > 1;
+  s->pipeqs.clear();
+  if (s->pipelined)
+    for (uint32_t i = 0; i < s->num_shards; ++i)
+      s->pipeqs.push_back(std::make_unique<Server::PipeQ>());
   s->io_thread = std::thread(io_main, s);
   for (uint32_t i = 0; i < s->num_shards; ++i)
     s->dispatch_threads.emplace_back(dispatcher_main, s, i);
+  if (s->pipelined)
+    for (uint32_t i = 0; i < s->num_shards; ++i)
+      s->completer_threads.emplace_back(completer_main, s, i);
   if (s->slo_us > 0) s->slo_thread = std::thread(slo_main, s);
   else s->resp_thread = std::thread(responder_main, s);
   return PyLong_FromLong(s->port);
@@ -1155,6 +1421,17 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
       if (empty) break;
       usleep(10000);
     }
+    // Let the completers resolve every in-flight ticket (pipelined
+    // mode) — an unresolved launch is an unanswered client.
+    for (int i = 0; i < 200; ++i) {
+      bool empty = true;
+      for (auto& pq : s->pipeqs) {
+        std::lock_guard<std::mutex> g(pq->mx);
+        empty = empty && pq->entries.empty();
+      }
+      if (empty) break;
+      usleep(10000);
+    }
     // Let the responder drain queued replies before stopping.
     for (int i = 0; i < 200; ++i) {
       {
@@ -1166,6 +1443,10 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     usleep(20000);  // let final responses flush
     s->stop.store(true);
     for (auto& q : s->shardqs) q->qcv.notify_all();
+    for (auto& pq : s->pipeqs) {
+      pq->cv_items.notify_all();
+      pq->cv_space.notify_all();
+    }
     s->ifcv.notify_all();
     s->rcv.notify_all();
     uint64_t one_ = 1;
@@ -1175,6 +1456,9 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     for (auto& t : s->dispatch_threads)
       if (t.joinable()) t.join();
     s->dispatch_threads.clear();
+    for (auto& t : s->completer_threads)
+      if (t.joinable()) t.join();
+    s->completer_threads.clear();
     if (s->slo_thread.joinable()) s->slo_thread.join();
     if (s->resp_thread.joinable()) s->resp_thread.join();
     Py_END_ALLOW_THREADS;
@@ -1188,11 +1472,37 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
 
 PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
   PyServer* ps = (PyServer*)self;
+  size_t depth = 0;
+  for (auto& pq : ps->s->pipeqs) {
+    std::lock_guard<std::mutex> g(pq->mx);
+    depth += pq->entries.size();
+  }
   return Py_BuildValue(
-      "{s:K,s:K,s:d}", "decisions_total",
+      "{s:K,s:K,s:d,s:K,s:I,s:O}", "decisions_total",
       (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
       (unsigned long long)ps->s->slo_breaches.load(), "uptime_s",
-      now_s() - ps->s->started_at);
+      now_s() - ps->s->started_at, "inflight_depth",
+      (unsigned long long)depth, "inflight_window", ps->s->inflight_window,
+      "pipelined", ps->s->pipelined ? Py_True : Py_False);
+}
+
+PyObject* server_set_limits(PyObject* self, PyObject* args) {
+  // Python push for the fail-open stamp fields (update_limit /
+  // update_window on the bridge): responses stamped WITHOUT a completed
+  // dispatch must carry the live limit.
+  PyServer* ps = (PyServer*)self;
+  long long limit;
+  double window_s;
+  if (!PyArg_ParseTuple(args, "Ld", &limit, &window_s)) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(ps->s->limit_mx);
+    ps->s->limit.store((int64_t)limit);
+    ps->s->window_s.store(window_s);
+    // Invalidate the per-batch refresh of every dispatch already
+    // started: their limit predates this push.
+    ps->s->limit_epoch.fetch_add(1);
+  }
+  Py_RETURN_NONE;
 }
 
 void server_dealloc(PyObject* self) {
@@ -1201,6 +1511,10 @@ void server_dealloc(PyObject* self) {
     if (ps->s->listen_fd >= 0) {
       ps->s->stop.store(true);
       for (auto& q : ps->s->shardqs) q->qcv.notify_all();
+      for (auto& pq : ps->s->pipeqs) {
+        pq->cv_items.notify_all();
+        pq->cv_space.notify_all();
+      }
       ps->s->ifcv.notify_all();
       ps->s->rcv.notify_all();
       uint64_t one = 1;
@@ -1213,6 +1527,9 @@ void server_dealloc(PyObject* self) {
       for (auto& t : ps->s->dispatch_threads)
         if (t.joinable()) t.join();
       ps->s->dispatch_threads.clear();
+      for (auto& t : ps->s->completer_threads)
+        if (t.joinable()) t.join();
+      ps->s->completer_threads.clear();
       if (ps->s->slo_thread.joinable()) ps->s->slo_thread.join();
       if (ps->s->resp_thread.joinable()) ps->s->resp_thread.join();
       Py_END_ALLOW_THREADS;
@@ -1224,6 +1541,8 @@ void server_dealloc(PyObject* self) {
     Py_XDECREF(ps->s->cb_reset);
     Py_XDECREF(ps->s->cb_metrics);
     Py_XDECREF(ps->s->cb_dcn);
+    Py_XDECREF(ps->s->cb_launch);
+    Py_XDECREF(ps->s->cb_resolve);
     delete ps->s;
   }
   Py_TYPE(self)->tp_free(self);
@@ -1232,7 +1551,10 @@ void server_dealloc(PyObject* self) {
 PyMethodDef server_methods[] = {
     {"start", server_start, METH_VARARGS, "start(host, port) -> bound port"},
     {"shutdown", server_shutdown, METH_NOARGS, "graceful drain + stop"},
-    {"stats", server_stats, METH_NOARGS, "{decisions_total, uptime_s}"},
+    {"stats", server_stats, METH_NOARGS,
+     "{decisions_total, uptime_s, inflight_depth, ...}"},
+    {"set_limits", server_set_limits, METH_VARARGS,
+     "set_limits(limit, window_s): refresh the fail-open stamp fields"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -1245,21 +1567,28 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   static const char* kwlist[] = {"decide",    "reset",        "metrics",
                                  "max_batch", "max_delay_us", "slo_us",
                                  "fail_open", "limit",        "window_s",
-                                 "key_prefix", "num_shards",  "dcn", nullptr};
+                                 "key_prefix", "num_shards",  "dcn",
+                                 "launch",    "resolve",      "inflight",
+                                 "dcn_auth_required", "max_dcn_conns",
+                                 nullptr};
   PyObject *decide, *reset, *metrics = Py_None, *dcn = Py_None;
+  PyObject *launch = Py_None, *resolve = Py_None;
   unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
   int fail_open = 0;
   long long limit = 0;
   double window_s = 60.0;
   const char* key_prefix = nullptr;
   Py_ssize_t key_prefix_len = 0;
-  unsigned int num_shards = 1;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IO",
+  unsigned int num_shards = 1, inflight = 8, max_dcn_conns = 4;
+  int dcn_auth_required = 0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpI",
                                    (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
                                    &window_s, &key_prefix, &key_prefix_len,
-                                   &num_shards, &dcn))
+                                   &num_shards, &dcn, &launch, &resolve,
+                                   &inflight, &dcn_auth_required,
+                                   &max_dcn_conns))
     return nullptr;
   if (num_shards < 1 || num_shards > 64) {
     PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
@@ -1277,19 +1606,26 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   ps->s->max_delay_us = max_delay_us;
   ps->s->slo_us = slo_us;
   ps->s->fail_open = fail_open != 0;
-  ps->s->limit = (int64_t)limit;
-  ps->s->window_s = window_s;
+  ps->s->limit.store((int64_t)limit);
+  ps->s->window_s.store(window_s);
   ps->s->num_shards = num_shards;
+  ps->s->inflight_window = inflight < 1 ? 1 : inflight;
+  ps->s->dcn_auth_required = dcn_auth_required != 0;
+  ps->s->max_dcn_conns = max_dcn_conns;
   if (key_prefix != nullptr && key_prefix_len > 0)
     ps->s->key_prefix.assign(key_prefix, (size_t)key_prefix_len);
   Py_INCREF(decide);
   Py_INCREF(reset);
   Py_INCREF(metrics);
   Py_INCREF(dcn);
+  Py_INCREF(launch);
+  Py_INCREF(resolve);
   ps->s->cb_decide = decide;
   ps->s->cb_reset = reset;
   ps->s->cb_metrics = metrics;
   ps->s->cb_dcn = dcn;
+  ps->s->cb_launch = launch;
+  ps->s->cb_resolve = resolve;
   ps->s->dcn_enabled = dcn != Py_None;
   return (PyObject*)ps;
 }
@@ -1312,7 +1648,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 4; }
+int64_t rl_server_abi_version() { return 5; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -102,6 +102,31 @@ class LimiterDecorator(RateLimiter):
             raise
         self._observe_op("reset", time.perf_counter() - t0)
 
+    # Pipelined dispatch (ADR-010): launch passes through unobserved (it
+    # only enqueues); the batch is observed ONCE, at resolve, where the
+    # decisions actually exist. Explicit delegation is required — the
+    # base class defines launch_batch/resolve, so __getattr__ would never
+    # fire and the decorator would run the base eager fallback instead of
+    # the backend's real pipelined path.
+
+    @property
+    def pipelined(self):  # type: ignore[override]
+        return getattr(self.inner, "pipelined", False)
+
+    def launch_batch(self, keys: Sequence[str], ns=None, *,
+                     now: Optional[float] = None):
+        return self.inner.launch_batch(keys, ns, now=now)
+
+    def resolve(self, ticket):
+        t0 = time.perf_counter()
+        try:
+            out = self.inner.resolve(ticket)
+        except Exception as exc:
+            self._observe_error("resolve", exc, time.perf_counter() - t0)
+            raise
+        self._observe_batch("resolve", out, None, time.perf_counter() - t0)
+        return out
+
     def close(self) -> None:
         self._closed = True
         self.inner.close()
@@ -309,6 +334,18 @@ class TracingDecorator(LimiterDecorator):
         with self._annotation("reset"):
             self.inner.reset(key)
 
+    def launch_batch(self, keys: Sequence[str], ns=None, *,
+                     now: Optional[float] = None):
+        # The pipelined hot path's two phases each get their own
+        # annotation — without these, the default serving path's device
+        # work would show up unattributed in xplane traces.
+        with self._annotation("launch"):
+            return self.inner.launch_batch(keys, ns, now=now)
+
+    def resolve(self, ticket):
+        with self._annotation("resolve"):
+            return self.inner.resolve(ticket)
+
     @contextmanager
     def capture(self, path: str):
         """Profile everything inside the with-block to ``path`` (xplane
@@ -465,6 +502,51 @@ class CircuitBreakerDecorator(LimiterDecorator):
                 self._clear_probe()
             raise
         self._note_result(out.fail_open, t, probe)
+        return out
+
+    # Pipelined path (ADR-010): the breaker admits (or short-circuits) at
+    # LAUNCH — an open breaker must not enqueue device work at all — and
+    # judges backend health at RESOLVE, where failure actually surfaces.
+    # Probe ownership rides the ticket's meta field between the phases.
+
+    def launch_batch(self, keys: Sequence[str], ns=None, *,
+                     now: Optional[float] = None):
+        t = self.inner.clock.now() if now is None else float(now)
+        probe = self._admit_call(t)
+        if probe is None:
+            from ratelimiter_tpu.core.types import DispatchTicket
+
+            return DispatchTicket(result=self._short_circuit(len(keys), t))
+        try:
+            ticket = self.inner.launch_batch(keys, ns, now=now)
+        except StorageUnavailableError:
+            self._note_result(True, t, probe)
+            raise
+        except BaseException:
+            if probe:
+                self._clear_probe()
+            raise
+        ticket.meta = ("breaker", t, probe)
+        return ticket
+
+    def resolve(self, ticket):
+        tag = None
+        if (isinstance(ticket.meta, tuple) and ticket.meta
+                and ticket.meta[0] == "breaker"):
+            tag = ticket.meta
+            ticket.meta = None
+        try:
+            out = self.inner.resolve(ticket)
+        except StorageUnavailableError:
+            if tag is not None:
+                self._note_result(True, tag[1], tag[2])
+            raise
+        except BaseException:
+            if tag is not None and tag[2]:
+                self._clear_probe()
+            raise
+        if tag is not None:
+            self._note_result(out.fail_open, tag[1], tag[2])
         return out
 
 

@@ -196,6 +196,19 @@ def _bucket_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
     return state, packed, denies
 
 
+@jax.jit
+def finish_bucket(allowed, remaining, retry_us, now_us, window_us):
+    """Device-side result assembly for the debt sketch: retry-after =
+    deficit / refill rate already computed exactly on device by the step
+    (``tokenbucket.go:122-130``); reset_at is the reference's now + window
+    approximation (``tokenbucket.go:159-165``). Same one-bulk-fetch
+    contract as sketch_kernels.finish_window (ADR-010)."""
+    reset = (now_us + window_us).astype(jnp.float64) / 1e6
+    return (allowed, remaining.astype(jnp.int64),
+            retry_us.astype(jnp.float64) / 1e6,
+            jnp.broadcast_to(reset, allowed.shape))
+
+
 _STEP_CACHE: Dict[tuple, Tuple[Callable, Callable]] = {}
 _SCAN_CACHE: Dict[tuple, Callable] = {}
 

@@ -460,6 +460,22 @@ def _sketch_reset(state: State, h1, h2, now_us, *,
     return out
 
 
+@jax.jit
+def finish_window(allowed, remaining, now_us, window_us):
+    """Device-side result assembly for windowed sketches (sliding and
+    fixed): retry-after is time to window reset (``fixedwindow.go:107-112``)
+    computed ON DEVICE, so the pipelined serving path's resolve phase does
+    one bulk device→host fetch per batch instead of per-request NumPy
+    float math after the blocking readback (ADR-010). Returns
+    ``(allowed bool[B], remaining int64[B], retry f64[B], reset f64[B])``."""
+    cur_ws = (now_us // window_us) * window_us
+    reset = (cur_ws + window_us).astype(jnp.float64) / 1e6
+    retry = jnp.where(allowed, jnp.float64(0.0),
+                      (cur_ws + window_us - now_us).astype(jnp.float64) / 1e6)
+    return (allowed, remaining.astype(jnp.int64), retry,
+            jnp.broadcast_to(reset, allowed.shape))
+
+
 def _pack_bits(mask):
     """(B,) bool -> (B/8,) uint8 little-endian bit packing, on device. Keeps
     per-decision results 1 bit wide so bulk readback is bandwidth-cheap."""

@@ -48,6 +48,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batcher coalescing window, microseconds")
     ap.add_argument("--dispatch-timeout-ms", type=float, default=None,
                     help="SLO per dispatch; breach triggers fail-open/closed")
+    ap.add_argument("--inflight", type=int, default=8,
+                    help="pipelined dispatch window (ADR-010): device "
+                         "dispatches kept in flight per shard, overlapping "
+                         "host encode/decode with device compute; 1 "
+                         "restores the synchronous launch->block path. "
+                         "Requires a sketch backend and no "
+                         "--dispatch-timeout-ms to take effect")
     ap.add_argument("--native", action="store_true",
                     help="use the C++ epoll front door (native/server.cpp) "
                          "instead of the asyncio server")
@@ -87,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="accept T_DCN_PUSH frames from peers (implied by "
                          "--dcn-peer); off by default so plain deployments "
                          "keep the 1 MiB per-frame bound")
+    ap.add_argument("--dcn-max-transfers", type=int, default=4,
+                    help="native door: connections allowed to hold a "
+                         "DCN-slab-sized receive buffer concurrently "
+                         "(size to your peer count; refused peers get a "
+                         "typed error and re-push next cycle)")
     ap.add_argument("--dcn-secret", default=None,
                     help="shared secret HMAC-gating T_DCN_PUSH frames "
                          "(both sides must set it; prefer the "
@@ -321,9 +333,11 @@ async def amain(args) -> None:
             max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6,
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                               if args.dispatch_timeout_ms else None),
+            inflight=args.inflight,
             shards=args.shards,
             dcn=bool(args.dcn_listen or args.dcn_peer),
             dcn_secret=dcn_secret,
+            max_dcn_conns=args.dcn_max_transfers,
             # Clone shards get the same decorator stack as shard 0, so
             # /metrics and the breaker see all N shards' traffic (each
             # under its own shard label) — plus the persistence wrapper,
@@ -445,6 +459,7 @@ async def amain(args) -> None:
         max_delay=args.max_delay_us * 1e-6,
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                           if args.dispatch_timeout_ms else None),
+        inflight=args.inflight,
         dcn=bool(args.dcn_listen or args.dcn_peer),
         dcn_secret=dcn_secret,
         snapshot=(persist.snapshot_now if persist else None))

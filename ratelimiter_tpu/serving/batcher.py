@@ -19,15 +19,25 @@ Policy knobs (ADR-002 analog at the dispatch layer):
   state update still lands (over-admission is bounded by the documented
   fail-open contract), and the batcher keeps serving.
 
-Thread model: the event loop owns the queue; the (single-threaded)
-executor owns device dispatches, so the loop never blocks on the TPU and
-dispatch k+1 coalesces while k is in flight.
+Thread model: the event loop owns the queue; a single-threaded *launch*
+executor owns the non-blocking half of each dispatch (stage + enqueue
+the jitted step via the limiter's launch/resolve API, ADR-010) and a
+single-threaded *resolve* executor blocks on the oldest in-flight
+result, so up to ``inflight`` dispatches overlap on the device while the
+loop keeps coalescing. Backends without a pipelined path (exact/dense)
+fall back to the original one-executor allow_batch dispatch.
+
+Coalescing is queue-depth-aware (continuous batching, Orca/vLLM style):
+``max_delay`` is the idle coalescing window; as the pending queue fills
+toward ``max_batch`` the flush timer is pulled earlier, so a deep queue
+never waits the full delay for a batch it could fill immediately.
 """
 
 from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -46,25 +56,65 @@ class MicroBatcher:
             (BASELINE config 3 serving shape: 4096).
         max_delay: flush this many seconds after the first pending request
             (the latency the batcher may add to coalesce; default 200 µs).
+            With ``adaptive_delay`` this is the IDLE window — a queue
+            filling toward max_batch flushes proportionally sooner.
         dispatch_timeout: SLO for one dispatch, seconds; None disables.
+        inflight: launched-but-unresolved dispatch window for pipelined
+            backends (launch/resolve API); launches past the window block
+            in the launch executor (backpressure). 1 disables overlap.
+        adaptive_delay: queue-depth-aware coalescing (on by default).
         registry: metrics registry for queue/batch/SLO gauges.
     """
 
     def __init__(self, limiter: RateLimiter, *, max_batch: int = 4096,
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
+                 inflight: int = 8, adaptive_delay: bool = True,
                  registry: Optional[m.Registry] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {inflight}")
         self.limiter = limiter
         self.max_batch = max_batch
         self.max_delay = max_delay
         self.dispatch_timeout = dispatch_timeout
+        self.inflight = inflight
+        self.adaptive_delay = adaptive_delay
         self._pending: List[Tuple[str, int, asyncio.Future]] = []
         self._timer: Optional[asyncio.TimerHandle] = None
+        self._first_ts = 0.0
+        self._armed_depth = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        #: Re-arm points for the adaptive timer (power-of-two-ish depths;
+        #: re-arming per submit would churn call_later on the hot loop).
+        #: Crossing detection, not equality: batch frames jump the depth
+        #: by whole frames and would hop over an exact-match check.
+        self._adaptive_marks = sorted(
+            {d for d in (max_batch // 8, max_batch // 4, max_batch // 2,
+                         (3 * max_batch) // 4) if d >= 2})
+        # Pipelining and the dispatch SLO are mutually exclusive (same
+        # rule as the native door): the SLO guarantee is "waiters are
+        # answered by the deadline even when the device hangs", and a
+        # launch blocked on a full in-flight window sits OUTSIDE any
+        # wait_for — its waiters would hang past the SLO.
+        self._pipelined = bool(getattr(limiter, "pipelined", False)
+                               and inflight > 1
+                               and dispatch_timeout is None)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rl-dispatch")
+        if self._pipelined:
+            # Separate single-thread stages keep launch order == resolve
+            # order (both executors are FIFO) while batch k's blocking
+            # resolve overlaps batch k+1's launch.
+            self._resolve_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="rl-resolve")
+            self._window = threading.Semaphore(inflight)
+        else:
+            self._resolve_pool = None
+            self._window = None
+        self._depth = 0
+        self._depth_lock = threading.Lock()
         self._inflight: set = set()
         self._draining = False
         self.decisions_total = 0
@@ -82,6 +132,23 @@ class MicroBatcher:
         self._slo_breaches = reg.counter(
             "rate_limiter_server_slo_breaches_total",
             "Dispatches that exceeded dispatch_timeout")
+        self._inflight_gauge = reg.gauge(
+            "rate_limiter_pipeline_inflight",
+            "Launched device dispatches not yet resolved (pipelined "
+            "serving hot path, ADR-010)")
+        self._launch_hist = reg.histogram(
+            "rate_limiter_pipeline_launch_seconds",
+            "Launch phase wall time (stage + enqueue, non-blocking)",
+            m.LATENCY_BUCKETS)
+        self._resolve_hist = reg.histogram(
+            "rate_limiter_pipeline_resolve_seconds",
+            "Resolve phase wall time (block on the oldest in-flight "
+            "result + host conversion)", m.LATENCY_BUCKETS)
+
+    def _depth_add(self, d: int) -> None:
+        with self._depth_lock:
+            self._depth += d
+            self._inflight_gauge.set(float(self._depth))
 
     # ------------------------------------------------------------ submit
 
@@ -96,8 +163,32 @@ class MicroBatcher:
     def _arm_timer(self, loop: asyncio.AbstractEventLoop) -> None:
         depth = len(self._pending)
         self._queue_depth.set(depth)
-        if depth and self._timer is None:
-            self._timer = loop.call_later(self.max_delay, self._flush)
+        if not depth:
+            return
+        if self._timer is None:
+            self._first_ts = loop.time()
+            self._armed_depth = depth
+            delay = self.max_delay
+            if self.adaptive_delay and depth > 1:
+                # A whole frame landing on an idle queue arms directly at
+                # its depth-scaled delay — same curve as the re-arm path.
+                delay = self.max_delay * max(0.0,
+                                             1.0 - depth / self.max_batch)
+            self._timer = loop.call_later(delay, self._flush)
+        elif self.adaptive_delay and any(
+                self._armed_depth < mk <= depth
+                for mk in self._adaptive_marks):
+            # Queue-depth-aware coalescing: pull the flush earlier as the
+            # queue fills — at depth d the wait shrinks to
+            # max_delay * (1 - d/max_batch) measured from the FIRST
+            # pending request, so a deep queue never idles out the full
+            # window it could already fill (continuous batching).
+            target = (self._first_ts
+                      + self.max_delay * (1.0 - depth / self.max_batch))
+            self._armed_depth = depth
+            self._timer.cancel()
+            self._timer = loop.call_later(max(0.0, target - loop.time()),
+                                          self._flush)
 
     def submit_nowait(self, key: str, n: int = 1) -> asyncio.Future:
         """Queue one decision and return its future WITHOUT awaiting —
@@ -152,14 +243,54 @@ class MicroBatcher:
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
+    def _launch_work(self, keys, ns):
+        """Launch stage (runs on the launch executor thread): acquire an
+        in-flight slot — blocking HERE is the pipeline's backpressure,
+        it stalls later launches, never the event loop — then stage +
+        enqueue without waiting on the device."""
+        self._window.acquire()
+        t0 = time.perf_counter()
+        try:
+            ticket = self.limiter.launch_batch(keys, ns)
+        except BaseException:
+            self._window.release()
+            raise
+        self._launch_hist.observe(time.perf_counter() - t0)
+        self._depth_add(1)
+        return ticket
+
+    def _resolve_work(self, ticket):
+        t0 = time.perf_counter()
+        try:
+            return self.limiter.resolve(ticket)
+        finally:
+            self._window.release()
+            self._depth_add(-1)
+            self._resolve_hist.observe(time.perf_counter() - t0)
+
     async def _dispatch(self, batch) -> None:
         keys = [k for k, _, _ in batch]
         ns = [n for _, n, _ in batch]
         self._dispatch_batch.observe(float(len(batch)))
         loop = asyncio.get_running_loop()
         t0 = time.perf_counter()
-        work = loop.run_in_executor(
-            self._pool, lambda: self.limiter.allow_batch(keys, ns))
+        if self._pipelined:
+            # Launch/resolve split (ADR-010): the launch executor stages
+            # and enqueues batch k+1 while the resolve executor blocks on
+            # batch k — the device always has work queued.
+            try:
+                ticket = await loop.run_in_executor(
+                    self._pool, self._launch_work, keys, ns)
+            except Exception as exc:
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                return
+            work = loop.run_in_executor(self._resolve_pool,
+                                        self._resolve_work, ticket)
+        else:
+            work = loop.run_in_executor(
+                self._pool, lambda: self.limiter.allow_batch(keys, ns))
         timed_out = False
         try:
             if self.dispatch_timeout is not None:
@@ -225,3 +356,5 @@ class MicroBatcher:
 
     def close(self) -> None:
         self._pool.shutdown(wait=True)
+        if self._resolve_pool is not None:
+            self._resolve_pool.shutdown(wait=True)

@@ -46,10 +46,15 @@ log = logging.getLogger("ratelimiter_tpu.serving.dcn")
 
 
 def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
-                       secret: Optional[str] = None) -> None:
+                       secret: Optional[str] = None,
+                       guard: Optional[p.DcnReplayGuard] = None) -> None:
     """Parse one T_DCN_PUSH body and merge it into every given limiter —
     the single receive path shared by the asyncio server (its one
     limiter) and the native front door (every shard limiter).
+
+    ``guard`` (per-server DcnReplayGuard) rejects stale/duplicate
+    sequenced envelopes BEFORE any mass merges — a replayed push is a
+    counter-mass injection, i.e. targeted false denies (ADR-007).
 
     With dispatch shards, the full foreign payload merges into EVERY
     shard: a key is only ever read on its owner shard, where the foreign
@@ -61,7 +66,7 @@ def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
     from ratelimiter_tpu.ops import sketch_kernels
     from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
 
-    body = p.unwrap_dcn_auth(body, secret)
+    body = p.unwrap_dcn_auth(body, secret, guard)
     lims = [undecorated(lim) for lim in limiters]
     lim0 = lims[0]
     if not isinstance(lim0, SketchLimiter):
@@ -163,11 +168,32 @@ class DcnPusher:
             raise ValueError(
                 "sketch geometry too large for the DCN debt transport "
                 f"(delta is {(sk.depth * sk.width * 8) >> 20} MiB)")
+        # Replay protection (RLA2 envelope): a random per-incarnation
+        # sender id plus a monotonic wall-clock-tracking sequence, both
+        # inside the HMAC. A restart mints a fresh sender id, so no
+        # receiver-side watermark can block the new incarnation; the
+        # guard's freshness window covers the old one (ADR-007).
+        import secrets as _secrets
+
+        self._sender = _secrets.randbits(64)
+        self._last_seq = 0
         self._ids = itertools.count(1)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.pushes_ok = 0
         self.pushes_failed = 0
+
+    def _next_seq(self) -> int:
+        """Strictly-increasing sequence that TRACKS wall-clock micros:
+        receivers use the seq as a coarse timestamp for the first-contact
+        freshness check (DcnReplayGuard), so a counter that merely
+        incremented would fall behind real time and a restarted (or
+        newly-joined) receiver would refuse every push from a
+        long-running sender as stale."""
+        import time as _time
+
+        self._last_seq = max(self._last_seq + 1, int(_time.time() * 1e6))
+        return self._last_seq
 
     # ------------------------------------------------------------- cycle
 
@@ -184,7 +210,9 @@ class DcnPusher:
             delta = dcn.export_debt(self.limiter)
             if not delta.any():
                 return 0
-            frame = p.encode_dcn_debt(req_id, delta, secret=self.secret)
+            frame = p.encode_dcn_debt(
+                req_id, delta, secret=self.secret, sender=self._sender,
+                seq=(self._next_seq() if self.secret is not None else None))
             for peer in self.peers:
                 try:
                     peer.push(frame, req_id)
@@ -248,7 +276,9 @@ class DcnPusher:
             for s0 in range(0, pp.shape[0], per_frame):
                 frame = p.encode_dcn_slabs(
                     req_id, pp[s0:s0 + per_frame], ss[s0:s0 + per_frame],
-                    self._sub_us, secret=self.secret)
+                    self._sub_us, secret=self.secret, sender=self._sender,
+                    seq=(self._next_seq()
+                         if self.secret is not None else None))
                 try:
                     peer.push(frame, req_id)
                     self.pushes_ok += 1

@@ -8,16 +8,24 @@ asyncio server (serving/server.py) — the asyncio server remains the
 reference implementation; this one is the throughput path
 (ROADMAP "server hot-path in C++").
 
-Hot path: the decide callback receives the batch as four flat buffers
-(key blob + offsets + lengths + ns). For sketch-family limiters the keys
-never become Python strings: the blob is prefix-packed with NumPy and
-bulk-hashed (native hasher) straight into ``allow_hashed``. Other
-backends decode to strings and use ``allow_batch``.
+Hot path: the decide/launch callbacks receive the batch as four flat
+buffers (key blob + offsets + lengths + ns). For sketch-family limiters
+the keys never become Python strings: the blob is prefix-packed with
+NumPy and bulk-hashed (native hasher) straight into ``allow_hashed`` /
+``launch_hashed``. Other backends decode to strings and use
+``allow_batch``.
+
+Pipelined mode (default for sketch backends without an SLO, ADR-010):
+the C++ dispatcher calls ``launch`` (non-blocking — stage + enqueue the
+jitted step) and a C++ completer thread calls ``resolve`` on the oldest
+in-flight ticket, so up to ``inflight`` device dispatches overlap with
+host encode/decode instead of the old launch→block→serialize lockstep.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -31,7 +39,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 4
+_ABI = 5
 
 
 def _load_extension():
@@ -110,26 +118,40 @@ class NativeRateLimitServer:
     Args mirror RateLimitServer, including ``dispatch_timeout``: a C++
     watcher thread answers waiters per the limiter's fail-open/closed
     policy when one batched dispatch exceeds the SLO, while the Python
-    decide completes in the background (state still converges). One
-    caveat vs the asyncio server: the ``limit`` field stamped into
-    fail-open responses is captured at server construction, so it can
-    lag a later ``update_limit`` (cosmetic — the decision fields are
-    policy-driven either way).
+    decide completes in the background (state still converges). The
+    ``limit``/``window`` stamped into fail-open responses are LIVE when
+    updated through THIS server's ``update_limit``/``update_window``
+    (eager push to the C++ atomics). A direct ``limiter.update_limit``
+    also converges after the next completed dispatch (results carry the
+    limit); a direct ``limiter.update_window`` does NOT — the result
+    tuple carries no window, so use the server wrapper for window
+    changes. Per-key policy-override limits are never reflected in
+    fail-open stamps (the dispatch that would resolve them never
+    completed; the decision fields are policy-driven either way).
+
+    ``inflight`` (default 8; >1 requires a sketch-family limiter and no
+    dispatch_timeout) enables the pipelined launch/resolve hot path:
+    that many device dispatches stay in flight per shard, with
+    backpressure upstream of the sockets when the window fills.
     """
 
     def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
                  port: int = 0, *, max_batch: int = 4096,
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
+                 inflight: int = 8,
                  registry: Optional[m.Registry] = None,
                  shards: int = 1, dcn: bool = False,
                  dcn_secret: Optional[str] = None,
+                 max_dcn_conns: int = 4,
                  shard_decorate=None):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
                 "native server extension unavailable (no g++?); use the "
                 "asyncio RateLimitServer")
+        if inflight < 1:
+            raise ValueError(f"inflight must be >= 1, got {inflight}")
         self.limiter = limiter
         self.host = host
         self.port = port
@@ -137,6 +159,20 @@ class NativeRateLimitServer:
         self._batch_hist = self.registry.histogram(
             "rate_limiter_server_batch_size",
             "Decisions per batched dispatch", m.BATCH_BUCKETS)
+        self._inflight_gauge = self.registry.gauge(
+            "rate_limiter_pipeline_inflight",
+            "Launched device dispatches not yet resolved (pipelined "
+            "serving hot path, ADR-010)")
+        self._launch_hist = self.registry.histogram(
+            "rate_limiter_pipeline_launch_seconds",
+            "Launch phase wall time (stage + enqueue, non-blocking)",
+            m.LATENCY_BUCKETS)
+        self._resolve_hist = self.registry.histogram(
+            "rate_limiter_pipeline_resolve_seconds",
+            "Resolve phase wall time (block on the oldest in-flight "
+            "result + host conversion)", m.LATENCY_BUCKETS)
+        self._depth = 0
+        self._depth_lock = threading.Lock()
 
         # Sketch-family limiters expose the hashed fast path; detect once.
         self._fast = hasattr(limiter, "allow_hashed")
@@ -184,6 +220,16 @@ class NativeRateLimitServer:
         # allow_batch applies the prefix itself, so C++ must not.
         self.dcn = bool(dcn)
         self.dcn_secret = dcn_secret
+        #: Replay guard for sequenced (RLA2) DCN pushes — per-sender
+        #: monotonic watermarks, shared by every shard (ADR-007).
+        self._dcn_guard = p.DcnReplayGuard() if dcn else None
+        # Pipelined launch/resolve needs the hashed fast path (the launch
+        # must be non-blocking, which the string slow path's allow_batch
+        # is not) and no SLO (the C++ watcher assumes one dispatch in
+        # flight); otherwise the legacy blocking decide runs.
+        self.inflight = inflight
+        self._pipelined = bool(self._fast and dispatch_timeout is None
+                               and inflight > 1)
         self._server = ext.create_server(
             decide=self._decide, reset=self._reset, metrics=self._metrics,
             max_batch=max_batch, max_delay_us=int(max_delay * 1e6),
@@ -193,27 +239,55 @@ class NativeRateLimitServer:
             window_s=float(limiter.config.window),
             key_prefix=self._prefix_bytes if self._fast else b"",
             num_shards=shards,
-            dcn=self._dcn if dcn else None)
+            dcn=self._dcn if dcn else None,
+            launch=self._launch if self._pipelined else None,
+            resolve=self._resolve if self._pipelined else None,
+            inflight=inflight,
+            dcn_auth_required=bool(dcn and dcn_secret),
+            # Size to the DCN peer set: each peer holding a slab-sized
+            # in-flight push needs a grant; the default covers small
+            # meshes, a refused peer gets a typed error and retries next
+            # cycle (watermarks re-send slabs; dcn_peer.py).
+            max_dcn_conns=max(1, int(max_dcn_conns)))
 
     # ------------------------------------------------------------ callbacks
 
-    def _decide(self, shard: int, blob: bytes, offsets_b: bytes,
-                lengths_b: bytes, ns_b: bytes):
+    def _hash_buffers(self, blob: bytes, offsets_b: bytes,
+                      lengths_b: bytes, ns_b: bytes):
+        """C++ buffers -> (h64, ns): the no-string bulk-hash fast path
+        (prefix already prepended by the C++ blob builder)."""
+        from ratelimiter_tpu.native import hash_packed
+
         offsets = np.frombuffer(offsets_b, dtype=np.int64)
         lengths = np.frombuffer(lengths_b, dtype=np.int64)
         ns = np.frombuffer(ns_b, dtype=np.int64)
-        b = offsets.shape[0]
+        buf = np.frombuffer(blob, dtype=np.uint8)
+        return hash_packed(buf, offsets, lengths), ns
+
+    def _pack_result(self, out):
+        flags = out.allowed.astype(np.uint8)
+        if out.fail_open:
+            flags |= 2
+        return (flags.tobytes(),
+                np.ascontiguousarray(out.remaining, dtype=np.int64).tobytes(),
+                np.ascontiguousarray(out.retry_after, dtype=np.float64).tobytes(),
+                np.ascontiguousarray(out.reset_at, dtype=np.float64).tobytes(),
+                int(out.limit))
+
+    def _decide(self, shard: int, blob: bytes, offsets_b: bytes,
+                lengths_b: bytes, ns_b: bytes):
+        b = len(offsets_b) // 8
         lim = self._shard_limiters[shard]
         try:
             if self._fast:
-                from ratelimiter_tpu.native import hash_packed
-
-                # Prefix already prepended by the C++ blob builder.
-                buf = np.frombuffer(blob, dtype=np.uint8)
-                h64 = hash_packed(buf, offsets, lengths)
+                h64, ns = self._hash_buffers(blob, offsets_b, lengths_b,
+                                             ns_b)
                 with self._locks[shard]:
                     out = lim.allow_hashed(h64, ns)
             else:
+                offsets = np.frombuffer(offsets_b, dtype=np.int64)
+                lengths = np.frombuffer(lengths_b, dtype=np.int64)
+                ns = np.frombuffer(ns_b, dtype=np.int64)
                 keys = [blob[o:o + l].decode("utf-8")
                         for o, l in zip(offsets.tolist(), lengths.tolist())]
                 with self._locks[shard]:
@@ -223,14 +297,45 @@ class NativeRateLimitServer:
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         self._batch_hist.observe(float(b))
-        flags = out.allowed.astype(np.uint8)
-        if out.fail_open:
-            flags |= 2
-        return (flags.tobytes(),
-                np.ascontiguousarray(out.remaining, dtype=np.int64).tobytes(),
-                np.ascontiguousarray(out.retry_after, dtype=np.float64).tobytes(),
-                np.ascontiguousarray(out.reset_at, dtype=np.float64).tobytes(),
-                int(out.limit))
+        return self._pack_result(out)
+
+    def _launch(self, shard: int, blob: bytes, offsets_b: bytes,
+                lengths_b: bytes, ns_b: bytes):
+        """Launch phase (pipelined hot path): hash + stage + enqueue the
+        jitted step WITHOUT blocking on the device; the returned ticket
+        is opaque to C++ and comes back through _resolve on the
+        completer thread."""
+        t0 = time.perf_counter()
+        lim = self._shard_limiters[shard]
+        try:
+            h64, ns = self._hash_buffers(blob, offsets_b, lengths_b, ns_b)
+            with self._locks[shard]:
+                ticket = lim.launch_hashed(h64, ns)
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        with self._depth_lock:
+            self._depth += 1
+            self._inflight_gauge.set(float(self._depth))
+        self._launch_hist.observe(time.perf_counter() - t0)
+        return ticket
+
+    def _resolve(self, shard: int, ticket):
+        """Resolve phase: block on the oldest in-flight dispatch (GIL
+        released while the device drains) and hand the flat result
+        buffers back to the C++ responder."""
+        t0 = time.perf_counter()
+        lim = self._shard_limiters[shard]
+        try:
+            out = lim.resolve(ticket)
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        finally:
+            with self._depth_lock:
+                self._depth -= 1
+                self._inflight_gauge.set(float(self._depth))
+        self._resolve_hist.observe(time.perf_counter() - t0)
+        self._batch_hist.observe(float(len(out)))
+        return self._pack_result(out)
 
     def _reset(self, shard: int, key_bytes: bytes) -> None:
         try:
@@ -244,12 +349,13 @@ class NativeRateLimitServer:
     def _dcn(self, payload: bytes) -> None:
         """T_DCN_PUSH receive path: merge the foreign payload into EVERY
         shard limiter (see dcn_peer.merge_push_payload for why that is
-        double-count-free)."""
+        double-count-free). The replay guard rejects stale/duplicate
+        sequenced envelopes before any mass merges."""
         from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
 
         try:
             merge_push_payload(self._shard_limiters, payload,
-                               self.dcn_secret)
+                               self.dcn_secret, self._dcn_guard)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
 
@@ -310,6 +416,36 @@ class NativeRateLimitServer:
             for (i, _, _), res in zip(items, out.results()):
                 results[i] = res
         return results
+
+    # ------------------------------------------------- dynamic config
+
+    def refresh_fail_open_params(self) -> None:
+        """Push the live default limit/window into the C++ door's atomic
+        fail-open stamp fields. Called by update_limit/update_window; the
+        C++ side ALSO refreshes the LIMIT from every completed dispatch
+        (so direct ``limiter.update_limit`` calls converge after the
+        next decide), but the window only moves through this push."""
+        from ratelimiter_tpu.observability.decorators import undecorated
+
+        cfg = undecorated(self._shard_limiters[0]).config
+        self._server.set_limits(int(cfg.limit), float(cfg.window))
+
+    def update_limit(self, new_limit: int) -> None:
+        """Dynamic limit change applied to EVERY shard limiter, then
+        pushed to the C++ fail-open stamp — an SLO-breach fail-open
+        response issued before any post-update dispatch completes still
+        carries the new limit (ISSUE-3 bugfix satellite)."""
+        for shard, lim in enumerate(self._shard_limiters):
+            with self._locks[shard]:
+                lim.update_limit(new_limit)
+        self.refresh_fail_open_params()
+
+    def update_window(self, new_window: float) -> None:
+        """Dynamic window change, every shard + C++ stamp refresh."""
+        for shard, lim in enumerate(self._shard_limiters):
+            with self._locks[shard]:
+                lim.update_window(new_window)
+        self.refresh_fail_open_params()
 
     # ------------------------------------------------- policy management
 

@@ -406,44 +406,137 @@ _S64 = struct.Struct("<q")
 #: Auth envelope for T_DCN_PUSH bodies. A push injects counter mass into
 #: the receiver's limiter, so an open serving port accepting pushes is a
 #: targeted false-deny lever for anyone with network reach; deployments
-#: that cannot firewall the port share a secret instead. The envelope is
-#: MAGIC + HMAC-SHA256(secret, body) + body; a kind byte is 1 or 2, so
-#: the magic ('R') is unambiguous. A server WITHOUT a secret accepts both
-#: forms (open by configuration); a server WITH one rejects untagged or
-#: mistagged pushes. See docs/OPERATIONS.md "Trust boundaries".
+#: that cannot firewall the port share a secret instead. Two envelope
+#: versions:
+#:
+#:   RLA1 (legacy): MAGIC + HMAC-SHA256(secret, body) + body — no replay
+#:        protection (a captured push re-sends forever).
+#:   RLA2:          MAGIC2 + HMAC-SHA256(secret, sender||seq||body)
+#:                  + u64 sender + u64 seq + body — the sender id and a
+#:        monotonic per-sender sequence are INSIDE the HMAC, so receivers
+#:        reject stale/duplicate values (DcnReplayGuard; ADR-007).
+#:
+#: A kind byte is 1 or 2, so the 'R' magic is unambiguous. A server
+#: WITHOUT a secret accepts all forms (open by configuration); a server
+#: WITH one accepts only valid RLA2 — untagged, mistagged, and LEGACY
+#: RLA1 pushes are rejected (RLA1's replayability is the hole RLA2
+#: closes). See docs/OPERATIONS.md "Trust boundaries".
 DCN_AUTH_MAGIC = b"RLA1"
+DCN_AUTH_MAGIC2 = b"RLA2"
 _DCN_TAG_LEN = 32
+_DCN_SEQ = struct.Struct("<QQ")   # sender id, sequence
 
 
-def wrap_dcn_auth(frame: bytes, secret: str) -> bytes:
-    """Re-frame a T_DCN_PUSH frame with the HMAC envelope on its body."""
+class DcnReplayGuard:
+    """Per-sender monotonic-sequence filter for T_DCN_PUSH (RLA2).
+
+    Sequences are wall-clock-seeded microseconds (DcnPusher), so a
+    sender's seq is also a coarse timestamp: a FIRST-CONTACT frame whose
+    seq is older than ``max_age_s`` is rejected too, bounding replay of a
+    dead sender incarnation's captured stream to that window (the
+    documented residual — receivers keep no cross-restart state; ADR-007
+    §replay). Thread-safe; only meaningful as a security control when
+    the frames are HMAC-verified (with no secret anyone can mint fresh
+    sender ids), but it still deduplicates accidental re-delivery there.
+    """
+
+    #: Sender-table bound: evicting the lowest-seq (oldest) sender keeps
+    #: an open receiver's memory O(1) under sender-id spray.
+    MAX_SENDERS = 4096
+
+    def __init__(self, max_age_s: float = 300.0, time_fn=None):
+        import threading
+        import time as _time
+
+        self.max_age_s = float(max_age_s)
+        self._time = time_fn if time_fn is not None else _time.time
+        self._last: dict = {}
+        self._lock = threading.Lock()
+        self.rejected = 0
+
+    def check(self, sender: int, seq: int) -> None:
+        """Record (sender, seq); raises InvalidConfigError (a typed wire
+        error) on a stale or duplicate sequence."""
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+
+        with self._lock:
+            last = self._last.get(sender)
+            if last is None:
+                floor = int((self._time() - self.max_age_s) * 1e6)
+                if seq < floor:
+                    self.rejected += 1
+                    raise InvalidConfigError(
+                        f"stale DCN push rejected (sender seq {seq} is "
+                        f"older than the {self.max_age_s:g}s replay window)")
+            elif seq <= last:
+                self.rejected += 1
+                raise InvalidConfigError(
+                    f"replayed DCN push rejected (seq {seq} <= last "
+                    f"accepted {last} for this sender)")
+            self._last[sender] = seq
+            if len(self._last) > self.MAX_SENDERS:
+                self._last.pop(min(self._last, key=self._last.get))
+
+
+def wrap_dcn_auth(frame: bytes, secret: str, *, sender=None,
+                  seq=None) -> bytes:
+    """Re-frame a T_DCN_PUSH frame with the HMAC envelope on its body:
+    RLA2 (sequenced — what DcnPusher sends) when ``sender``/``seq`` are
+    given, legacy RLA1 otherwise."""
     import hashlib
     import hmac as _hmac
 
     length, type_, req_id = _HDR.unpack_from(frame)
     body = frame[HEADER_SIZE:]
-    tag = _hmac.new(secret.encode(), body, hashlib.sha256).digest()
-    body = DCN_AUTH_MAGIC + tag + body
+    if sender is not None:
+        sb = _DCN_SEQ.pack(sender, seq)
+        tag = _hmac.new(secret.encode(), sb + body, hashlib.sha256).digest()
+        body = DCN_AUTH_MAGIC2 + tag + sb + body
+    else:
+        tag = _hmac.new(secret.encode(), body, hashlib.sha256).digest()
+        body = DCN_AUTH_MAGIC + tag + body
     return _HDR.pack(1 + 8 + len(body), type_, req_id) + body
 
 
-def unwrap_dcn_auth(body: bytes, secret) -> bytes:
+def unwrap_dcn_auth(body: bytes, secret, guard: "DcnReplayGuard | None" =
+                    None) -> bytes:
     """Verify/strip the auth envelope per the receiver's configuration.
-    Raises InvalidConfigError (a typed wire error) on missing or bad
-    tags when a secret is required."""
+    Raises InvalidConfigError (a typed wire error) on missing/bad tags
+    when a secret is required and on stale/duplicate sequences when a
+    replay guard is installed."""
     from ratelimiter_tpu.core.errors import InvalidConfigError
 
+    if body[:4] == DCN_AUTH_MAGIC2:
+        head = 4 + _DCN_TAG_LEN + _DCN_SEQ.size
+        if len(body) < head:
+            raise ProtocolError("truncated DCN auth envelope")
+        tag = body[4:4 + _DCN_TAG_LEN]
+        signed = body[4 + _DCN_TAG_LEN:]
+        sender, seq = _DCN_SEQ.unpack_from(signed)
+        if secret is not None:
+            import hashlib
+            import hmac as _hmac
+
+            want = _hmac.new(secret.encode(), signed, hashlib.sha256).digest()
+            if not _hmac.compare_digest(tag, want):
+                raise InvalidConfigError("DCN push auth tag mismatch")
+        # Sequence check AFTER authentication: a forged frame must not be
+        # able to advance (or poison) a genuine sender's watermark.
+        if guard is not None:
+            guard.check(sender, seq)
+        return body[head:]
     if body[:4] == DCN_AUTH_MAGIC:
         if len(body) < 4 + _DCN_TAG_LEN:
             raise ProtocolError("truncated DCN auth envelope")
         tag, rest = body[4:4 + _DCN_TAG_LEN], body[4 + _DCN_TAG_LEN:]
         if secret is not None:
-            import hashlib
-            import hmac as _hmac
-
-            want = _hmac.new(secret.encode(), rest, hashlib.sha256).digest()
-            if not _hmac.compare_digest(tag, want):
-                raise InvalidConfigError("DCN push auth tag mismatch")
+            # Legacy RLA1 carries no sequence, so a captured frame
+            # replays forever — a secret-requiring receiver rejects it
+            # outright (senders on this codebase always send RLA2 when
+            # they hold a secret).
+            raise InvalidConfigError(
+                "legacy unsequenced DCN envelope (RLA1) rejected: this "
+                "server requires replay-protected pushes (RLA2)")
         return rest
     if secret is not None:
         raise InvalidConfigError(
@@ -453,7 +546,7 @@ def unwrap_dcn_auth(body: bytes, secret) -> bytes:
 
 
 def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int,
-                     secret=None) -> bytes:
+                     secret=None, *, sender=None, seq=None) -> bytes:
     """periods int64[k] in sub_us units, slabs int32[k, d, w]
     (export_completed output)."""
     import numpy as np
@@ -464,17 +557,20 @@ def encode_dcn_slabs(req_id: int, periods, slabs, sub_us: int,
             + np.ascontiguousarray(periods, dtype=np.int64).tobytes()
             + np.ascontiguousarray(slabs, dtype=np.int32).tobytes())
     frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
-    return wrap_dcn_auth(frame, secret) if secret is not None else frame
+    return (wrap_dcn_auth(frame, secret, sender=sender, seq=seq)
+            if secret is not None else frame)
 
 
-def encode_dcn_debt(req_id: int, delta, secret=None) -> bytes:
+def encode_dcn_debt(req_id: int, delta, secret=None, *, sender=None,
+                    seq=None) -> bytes:
     """delta int64[d, w] (export_debt output)."""
     import numpy as np
 
     body = (_DCN_HEAD.pack(DCN_KIND_DEBT)
             + np.ascontiguousarray(delta, dtype=np.int64).tobytes())
     frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
-    return wrap_dcn_auth(frame, secret) if secret is not None else frame
+    return (wrap_dcn_auth(frame, secret, sender=sender, seq=seq)
+            if secret is not None else frame)
 
 
 def parse_dcn(body: bytes, d: int, w: int, sub_us: int):

@@ -44,6 +44,7 @@ class RateLimitServer:
                  port: int = 0, *, max_batch: int = 4096,
                  max_delay: float = 200e-6,
                  dispatch_timeout: Optional[float] = None,
+                 inflight: int = 8,
                  registry: Optional[m.Registry] = None,
                  dcn: bool = False, dcn_secret: Optional[str] = None,
                  snapshot: Optional[callable] = None):
@@ -64,7 +65,11 @@ class RateLimitServer:
         self.registry = registry if registry is not None else m.DEFAULT
         self.batcher = MicroBatcher(
             limiter, max_batch=max_batch, max_delay=max_delay,
-            dispatch_timeout=dispatch_timeout, registry=self.registry)
+            dispatch_timeout=dispatch_timeout, inflight=inflight,
+            registry=self.registry)
+        #: Replay guard for authenticated DCN pushes (sequenced RLA2
+        #: envelope — docs/ADR/007): per-sender monotonic sequence state.
+        self._dcn_guard = p.DcnReplayGuard() if dcn else None
         self._server: Optional[asyncio.AbstractServer] = None
         self._started_at = time.time()
         self._serving = False
@@ -200,7 +205,8 @@ class RateLimitServer:
         from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
 
         await asyncio.get_running_loop().run_in_executor(
-            None, merge_push_payload, [self.limiter], body, self.dcn_secret)
+            None, merge_push_payload, [self.limiter], body, self.dcn_secret,
+            self._dcn_guard)
         return p.encode_ok(req_id)
 
     async def _handle_policy(self, type_: int, req_id: int,

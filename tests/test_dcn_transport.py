@@ -367,6 +367,156 @@ class TestDcnAuth:
         a.close()
 
 
+class TestDcnReplay:
+    """Replay protection for authenticated pushes (ADR-007): the RLA2
+    envelope carries a per-sender monotonic sequence INSIDE the HMAC;
+    receivers reject stale/duplicate values — a replayed push is a
+    counter-mass injection lever (targeted false denies)."""
+
+    def _pod(self):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.TOKEN_BUCKET, limit=10, window=6.0,
+                     sketch=SketchParams(depth=3, width=256, sub_windows=6))
+        return create_limiter(cfg, backend="sketch", clock=clock)
+
+    def _push_frame(self, port, frame, req_id):
+        from ratelimiter_tpu.serving.dcn_peer import _PeerConn
+
+        peer = _PeerConn("127.0.0.1", port)
+        try:
+            peer.push(frame, req_id)
+        finally:
+            peer.close()
+
+    def test_replayed_frame_rejected(self):
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+        from ratelimiter_tpu.parallel import dcn
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 10)
+            delta = dcn.export_debt(a)
+            seq = int(time.time() * 1e6)
+            frame = p.encode_dcn_debt(1, delta, secret="s3cret",
+                                      sender=7777, seq=seq)
+            self._push_frame(srv.port, frame, 1)       # first copy lands
+            with pytest.raises(InvalidConfigError, match="replayed"):
+                self._push_frame(srv.port, frame, 1)   # byte-identical replay
+            assert srv._dcn_guard.rejected == 1
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_out_of_order_sequence_rejected(self):
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+        from ratelimiter_tpu.parallel import dcn
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 5)
+            delta = dcn.export_debt(a)
+            seq = int(time.time() * 1e6)
+            newer = p.encode_dcn_debt(1, delta, secret="s3cret",
+                                      sender=42, seq=seq)
+            older = p.encode_dcn_debt(2, delta, secret="s3cret",
+                                      sender=42, seq=seq - 10)
+            self._push_frame(srv.port, newer, 1)
+            with pytest.raises(InvalidConfigError, match="replayed"):
+                self._push_frame(srv.port, older, 2)
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_stale_first_contact_rejected(self):
+        """An unknown sender whose sequence is older than the freshness
+        window (a captured stream from a dead incarnation) is refused —
+        the documented residual is bounded to that window."""
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+        from ratelimiter_tpu.parallel import dcn
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 5)
+            delta = dcn.export_debt(a)
+            stale_seq = int((time.time() - 3600.0) * 1e6)
+            frame = p.encode_dcn_debt(1, delta, secret="s3cret",
+                                      sender=99, seq=stale_seq)
+            with pytest.raises(InvalidConfigError, match="stale"):
+                self._push_frame(srv.port, frame, 1)
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_legacy_unsequenced_envelope_rejected_by_secret_server(self):
+        """RLA1 (HMAC but no sequence) replays forever, so a receiver
+        that requires auth refuses it outright."""
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+        from ratelimiter_tpu.parallel import dcn
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            a.allow_n("k", 5)
+            delta = dcn.export_debt(a)
+            legacy = p.encode_dcn_debt(1, delta, secret="s3cret")  # no seq
+            with pytest.raises(InvalidConfigError, match="RLA1"):
+                self._push_frame(srv.port, legacy, 1)
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+    def test_long_running_sender_fresh_to_new_guard(self):
+        """The pusher's sequence must TRACK wall-clock micros, not just
+        increment: a receiver whose guard state is new (restart, late
+        join, eviction) applies the first-contact freshness floor, and a
+        sender that had merely counted up from its start time would look
+        permanently stale after max_age_s of uptime."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a = self._pod()
+        pusher = DcnPusher(a, [], secret="s3cret")
+        for _ in range(50):                      # long-running incarnation
+            pusher._next_seq()
+        guard = p.DcnReplayGuard(max_age_s=300.0)
+        guard.check(pusher._sender, pusher._next_seq())   # must not raise
+        assert guard.rejected == 0
+        # And still strictly increasing (replay of the previous frame is
+        # caught even when two frames share a microsecond).
+        s1, s2 = pusher._next_seq(), pusher._next_seq()
+        assert s2 > s1
+        a.close()
+
+    def test_pusher_cycles_pass_the_guard(self):
+        """A real DcnPusher's consecutive cycles carry strictly
+        increasing sequences, so the guard never trips on the happy
+        path — including multi-frame (chunked) cycles."""
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+
+        a, b = self._pod(), self._pod()
+        srv, loop, t = _server_on_thread(b)
+        srv.dcn_secret = "s3cret"
+        try:
+            pusher = DcnPusher(a, [("127.0.0.1", srv.port)],
+                               secret="s3cret")
+            a.allow_n("k", 4)
+            assert pusher.sync_once() == 1
+            a.allow_n("k2", 3)
+            assert pusher.sync_once() == 1
+            assert pusher.pushes_failed == 0
+            assert srv._dcn_guard.rejected == 0
+            pusher.stop()
+        finally:
+            _stop(srv, loop, t)
+        a.close()
+
+
 class TestNativeDcn:
     """The native (C++) front door receives T_DCN_PUSH via its dcn
     callback — a multi-pod deployment needs only --native servers

@@ -349,6 +349,236 @@ class TestNativeServer:
         lim.close()
 
 
+class TestPipelinedDoor:
+    """Launch/resolve pipeline through the C++ door (ADR-010): overlap
+    must not change decisions, snapshots must quiesce, and fail-open
+    stamps must carry the live limit."""
+
+    def test_pipelined_mode_engages_for_sketch(self):
+        lim, _ = _mk_limiter(algo=Algorithm.TPU_SKETCH, backend="sketch")
+        with running(lim, inflight=8) as (srv, _):
+            st = srv.stats()
+            assert st["pipelined"] and st["inflight_window"] == 8
+        lim.close()
+
+    def test_inflight_one_restores_synchronous_path(self):
+        lim, _ = _mk_limiter(algo=Algorithm.TPU_SKETCH, backend="sketch")
+        with running(lim, inflight=1) as (srv, port):
+            assert not srv.stats()["pipelined"]
+            with Client(port=port) as c:
+                assert c.allow("k").allowed
+        lim.close()
+
+    def test_interleaved_same_key_frames_match_oracle(self):
+        """Pipelined ALLOW_BATCH frames with duplicate hot keys decide
+        exactly like sequential single dispatches on a fresh limiter —
+        sequential semantics survive the in-flight window."""
+        import asyncio
+
+        from ratelimiter_tpu.serving import AsyncClient
+
+        lim, _ = _mk_limiter(limit=7, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        oracle, _ = _mk_limiter(limit=7, algo=Algorithm.TPU_SKETCH,
+                                backend="sketch")
+        frames = [["hot", "a", "hot"], ["hot", "hot"], ["b", "hot"],
+                  ["hot", "hot", "hot"]]
+        with running(lim, inflight=8, max_delay=1e-4) as (_, port):
+            async def drive():
+                c = await AsyncClient.connect(port=port)
+                # All frames in flight on one connection: the io thread
+                # parses them in order, so frame order == decide order.
+                futs = [asyncio.ensure_future(c.allow_batch(f))
+                        for f in frames]
+                out = await asyncio.gather(*futs)
+                await c.close()
+                return [[r.allowed for r in frame] for frame in out]
+
+            got = asyncio.run(drive())
+        want = [[bool(a) for a in oracle.allow_batch(f).allowed]
+                for f in frames]
+        assert got == want
+        lim.close()
+        oracle.close()
+
+    def test_snapshot_during_pipelined_traffic_is_consistent(self, tmp_path):
+        """capture_state under live pipelined load quiesces via the state
+        chain's data dependence: the snapshot's counters equal the sum
+        of every decision acknowledged before the capture returned."""
+        import threading as th
+
+        lim, _ = _mk_limiter(limit=10_000, algo=Algorithm.TPU_SKETCH,
+                             backend="sketch")
+        path = str(tmp_path / "live.npz")
+        with running(lim, inflight=8, max_delay=1e-4) as (_, port):
+            stop = th.Event()
+            sent = []
+
+            def traffic():
+                with Client(port=port) as c:
+                    while not stop.is_set():
+                        sent.append(sum(
+                            r.allowed for r in c.allow_batch(["hot"] * 8)))
+
+            t = th.Thread(target=traffic)
+            t.start()
+            import time as _t
+
+            _t.sleep(0.05)
+            # Sampled BEFORE the capture: every batch acked by now was
+            # launched before it, so it MUST appear in the snapshot.
+            acked_before = sum(sent)
+            lim.save(path)           # mid-flight capture
+            stop.set()
+            t.join(timeout=10)
+            acked_total = sum(sent)
+        restored, _ = _mk_limiter(limit=10_000, algo=Algorithm.TPU_SKETCH,
+                                  backend="sketch")
+        restored.restore(path)
+        remaining = int(restored.allow_batch(["hot"]).remaining[0])
+        captured = 10_000 - 1 - remaining
+        # Quiesce invariant: the capture holds a consistent PREFIX of the
+        # launch sequence — at least everything acked before it began,
+        # at most everything ever launched (all acked by join).
+        assert acked_before <= captured <= acked_total
+        lim.close()
+        restored.close()
+
+    def test_fail_open_stamps_live_limit_after_update(self):
+        """SLO-breach fail-open responses must carry the limit at
+        RESPONSE time, not construction time (the old docstring caveat,
+        fixed): update_limit through the server wrapper refreshes the
+        C++ stamp before any post-update dispatch completes."""
+        import time
+
+        lim, _ = _mk_limiter(limit=5, fail_open=True)
+        slow = _SlowOnce(lim, delay=0.3)
+        srv = NativeRateLimitServer(slow, "127.0.0.1", 0,
+                                    max_delay=1e-4, dispatch_timeout=0.03)
+        srv.start()
+        try:
+            srv.update_limit(42)     # before ANY dispatch completes
+            with Client(port=srv.port) as c:
+                res = c.allow("k")   # breaches the SLO -> fail-open stamp
+                assert res.allowed and res.fail_open
+                assert res.limit == 42
+                time.sleep(0.35)     # let the late dispatch land
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_fail_open_limit_converges_after_direct_update(self):
+        """Direct limiter.update_limit (not via the server wrapper) still
+        converges: the next completed dispatch refreshes the C++ stamp."""
+        import time
+
+        lim, _ = _mk_limiter(limit=5, fail_open=True)
+        slow = _SlowOnce(lim, delay=0.0)   # no delay yet
+        srv = NativeRateLimitServer(slow, "127.0.0.1", 0,
+                                    max_delay=1e-4, dispatch_timeout=0.05)
+        srv.start()
+        try:
+            lim.update_limit(17)
+            with Client(port=srv.port) as c:
+                c.allow("warm")            # completed dispatch -> refresh
+                slow._fired = False
+                slow._delay = 0.4          # now breach the SLO
+                res = c.allow("k")
+                assert res.fail_open and res.limit == 17
+                time.sleep(0.45)
+        finally:
+            srv.shutdown()
+        lim.close()
+
+
+class TestDcnPreScreen:
+    """Native door DCN pre-screen (ADVICE r5): an oversized garbage
+    stream labeled T_DCN_PUSH must die at the small buffer bound, and
+    only a bounded number of connections may hold slab-sized buffers."""
+
+    def _dcn_server(self, secret="s3cret"):
+        lim, _ = _mk_limiter(algo=Algorithm.TPU_SKETCH, backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, dcn=True,
+                                    dcn_secret=secret)
+        srv.start()
+        return lim, srv
+
+    def test_garbage_dcn_stream_killed_without_buffering(self):
+        import socket
+        import struct
+
+        from ratelimiter_tpu.serving import protocol as p
+
+        lim, srv = self._dcn_server()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port)) as sk:
+                # Claim an 80 MiB DCN frame, stream garbage (no RLA
+                # magic): the pre-screen must kill the connection within
+                # the SMALL buffer bound, never granting slab buffering.
+                claimed = 80 << 20
+                sk.sendall(struct.pack("<IBQ", claimed, p.T_DCN_PUSH, 1))
+                sk.settimeout(10)
+                sent = 0
+                chunk = b"\x00" * 65536
+                dead_after = None
+                try:
+                    while sent < claimed:
+                        sk.sendall(chunk)
+                        sent += len(chunk)
+                except (BrokenPipeError, ConnectionResetError):
+                    dead_after = sent
+                assert dead_after is not None, "garbage stream was buffered"
+                # The server kills at the first parse (4 bytes of body);
+                # the client-side count includes kernel socket buffers
+                # and RST propagation slack, so the discriminator is
+                # "died well before the claimed size" — the pre-fix
+                # server accepted the entire 80 MiB into its rbuf.
+                assert dead_after < claimed // 2
+            # The server is still healthy.
+            with Client(port=srv.port) as c:
+                assert c.allow("ok").allowed
+        finally:
+            srv.shutdown()
+        lim.close()
+
+    def test_concurrent_dcn_buffer_grants_bounded(self):
+        import socket
+        import struct
+
+        from ratelimiter_tpu.serving import protocol as p
+
+        lim, srv = self._dcn_server()
+        socks = []
+        try:
+            # 6 connections each open a magic-valid 8 MiB DCN frame and
+            # stall; only max_dcn_conns (4) may hold big buffers — the
+            # rest are refused.
+            refused = 0
+            for i in range(6):
+                sk = socket.create_connection(("127.0.0.1", srv.port))
+                socks.append(sk)
+                hdr = struct.pack("<IBQ", 8 << 20, p.T_DCN_PUSH, 10 + i)
+                sk.sendall(hdr + b"RLA2" + b"\x00" * 64)
+                sk.settimeout(1.0)
+                try:
+                    resp = sk.recv(13, socket.MSG_WAITALL)
+                    # Refusal surfaces as the typed error frame or an
+                    # immediate close; a granted connection just waits
+                    # for the rest of the frame (recv times out).
+                    if not resp or resp[4] == p.T_ERROR:
+                        refused += 1
+                except (TimeoutError, socket.timeout):
+                    pass                     # granted: no response yet
+                except ConnectionResetError:
+                    refused += 1
+            assert refused == 2
+        finally:
+            for sk in socks:
+                sk.close()
+            srv.shutdown()
+        lim.close()
+
+
 class TestShardedServer:
     """Dispatch shards: hash-routed keys, concurrent per-shard limiters,
     split-batch reassembly (the in-process Redis-Cluster analog)."""
