@@ -163,11 +163,13 @@ def _run_variant(name: str, backend: str, *, platform=None, seconds=6.0,
 
 
 def _run_native_loadgen(*, seconds: float, log=print,
-                        inflight: int = 8) -> Dict:
+                        inflight: int = 8, hashed: bool = False) -> Dict:
     """Native server driven by the native C++ load generator
     (clients/cpp/loadgen.cpp) — removes the Python client from the loop,
     so this is the true server+decide ceiling. ``inflight`` sets the
-    server's pipelined dispatch window (1 = the old synchronous path)."""
+    server's pipelined dispatch window (1 = the old synchronous path);
+    ``hashed`` drives the zero-copy ALLOW_HASHED lane (raw u64 ids,
+    device-side hashing, ADR-011) instead of string ALLOW_BATCH frames."""
     import json
     import shutil
     import tempfile
@@ -194,7 +196,7 @@ def _run_native_loadgen(*, seconds: float, log=print,
         try:
             out = subprocess.run(
                 [binary, "127.0.0.1", str(port), str(seconds), "6", "8",
-                 "1024", "100000"],
+                 "1024", "100000", "hashed" if hashed else "batch"],
                 capture_output=True, text=True, timeout=seconds + 60)
             row = json.loads(out.stdout.strip())
         finally:

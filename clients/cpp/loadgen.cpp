@@ -8,7 +8,10 @@
 // percentiles.
 //
 // Usage: rltpu_loadgen <host> <port> <seconds> <threads> <inflight>
-//                      <keys_per_frame> <n_keys>
+//                      <keys_per_frame> <n_keys> [mode]
+// mode: "batch" (default, string ALLOW_BATCH frames) or "hashed"
+// (columnar raw-u64-id ALLOW_HASHED frames — the zero-copy bulk lane,
+// ADR-011).
 // Output: one JSON line.
 
 #include <algorithm>
@@ -43,7 +46,7 @@ struct Shared {
 // Raw pipelined driver: hand-rolled frames on one socket (the Client
 // class is strictly request/response; pipelining needs direct IO).
 void worker(const char* host, int port, int inflight, int frame_keys,
-            int n_keys, int wid, Shared* sh) {
+            int n_keys, int wid, bool hashed, Shared* sh) {
   // The Client class is strictly request/response; pipelining needs
   // direct socket IO, so the frames are hand-rolled here.
   struct addrinfo hints {
@@ -78,20 +81,33 @@ void worker(const char* host, int port, int inflight, int frame_keys,
     std::string body;
     uint32_t count = (uint32_t)frame_keys;
     body.append((char*)&count, 4);
-    for (int i = 0; i < frame_keys; ++i) {
-      rng = rng * 1664525u + 1013904223u;
-      char key[32];
-      int klen = snprintf(key, sizeof(key), "user:%u", rng % (unsigned)n_keys);
+    if (hashed) {
+      // Columnar raw-id frame (ADR-011): u64 ids then u32 ns.
+      for (int i = 0; i < frame_keys; ++i) {
+        rng = rng * 1664525u + 1013904223u;
+        uint64_t id64 = rng % (unsigned)n_keys;
+        body.append((char*)&id64, 8);
+      }
       uint32_t n = 1;
-      uint16_t kl = (uint16_t)klen;
-      body.append((char*)&n, 4);
-      body.append((char*)&kl, 2);
-      body.append(key, klen);
+      for (int i = 0; i < frame_keys; ++i) body.append((char*)&n, 4);
+    } else {
+      for (int i = 0; i < frame_keys; ++i) {
+        rng = rng * 1664525u + 1013904223u;
+        char key[32];
+        int klen =
+            snprintf(key, sizeof(key), "user:%u", rng % (unsigned)n_keys);
+        uint32_t n = 1;
+        uint16_t kl = (uint16_t)klen;
+        body.append((char*)&n, 4);
+        body.append((char*)&kl, 2);
+        body.append(key, klen);
+      }
     }
     std::string frame;
     uint32_t length = (uint32_t)(1 + 8 + body.size());
     frame.append((char*)&length, 4);
-    frame.push_back((char)rltpu::T_ALLOW_BATCH);
+    frame.push_back(
+        (char)(hashed ? rltpu::T_ALLOW_HASHED : rltpu::T_ALLOW_BATCH));
     uint64_t id = req_id++;
     frame.append((char*)&id, 8);
     frame += body;
@@ -126,16 +142,26 @@ void worker(const char* host, int port, int inflight, int frame_keys,
       uint8_t type = (uint8_t)rbuf[off + 4];
       uint64_t rid;
       memcpy(&rid, rbuf.data() + off + 5, 8);
-      if (type == rltpu::T_RESULT_BATCH) {
+      if (type == rltpu::T_RESULT_BATCH || type == rltpu::T_RESULT_HASHED) {
         const char* body = rbuf.data() + off + 13;
         uint32_t count;
-        memcpy(&count, body + 8, 4);
+        // RESULT_BATCH: i64 limit | u32 count | 25B items.
+        // RESULT_HASHED: u8 flags | i64 limit | u32 count | bit mask |
+        // columnar i64/f64/f64.
+        bool h = type == rltpu::T_RESULT_HASHED;
+        memcpy(&count, body + (h ? 9 : 8), 4);
         double t1 = now_s();
         if (t1 >= sh->t_measure) {
           local_completed += count;
-          const char* items = body + 12;
-          for (uint32_t i = 0; i < count; ++i)
-            local_allowed += (uint8_t)items[i * 25] & 1;
+          if (h) {
+            const uint8_t* bits = (const uint8_t*)body + 13;
+            for (uint32_t i = 0; i < count; ++i)
+              local_allowed += (bits[i >> 3] >> (i & 7)) & 1;
+          } else {
+            const char* items = body + 12;
+            for (uint32_t i = 0; i < count; ++i)
+              local_allowed += (uint8_t)items[i * 25] & 1;
+          }
           double t0 = sent_at[rid % sent_at.size()];
           if (t0 > 0) local_lat.push_back(t1 - t0);
         }
@@ -161,10 +187,10 @@ void worker(const char* host, int port, int inflight, int frame_keys,
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 8) {
+  if (argc != 8 && argc != 9) {
     std::fprintf(stderr,
                  "usage: %s <host> <port> <seconds> <threads> <inflight> "
-                 "<keys_per_frame> <n_keys>\n",
+                 "<keys_per_frame> <n_keys> [batch|hashed]\n",
                  argv[0]);
     return 2;
   }
@@ -175,6 +201,7 @@ int main(int argc, char** argv) {
   int inflight = atoi(argv[5]);
   int frame_keys = atoi(argv[6]);
   int n_keys = atoi(argv[7]);
+  bool hashed = argc == 9 && std::strcmp(argv[8], "hashed") == 0;
 
   Shared sh;
   double warmup = 1.0;
@@ -183,7 +210,8 @@ int main(int argc, char** argv) {
 
   std::vector<std::thread> ts;
   for (int i = 0; i < threads; ++i)
-    ts.emplace_back(worker, host, port, inflight, frame_keys, n_keys, i, &sh);
+    ts.emplace_back(worker, host, port, inflight, frame_keys, n_keys, i,
+                    hashed, &sh);
   for (auto& t : ts) t.join();
 
   double span = seconds;
@@ -196,10 +224,11 @@ int main(int argc, char** argv) {
   std::printf(
       "{\"decisions_per_sec\": %.1f, \"completed\": %llu, "
       "\"allowed\": %llu, \"frame_p50_ms\": %.2f, \"frame_p99_ms\": %.2f, "
-      "\"threads\": %d, \"inflight_frames\": %d, \"keys_per_frame\": %d}\n",
+      "\"threads\": %d, \"inflight_frames\": %d, \"keys_per_frame\": %d, "
+      "\"mode\": \"%s\"}\n",
       (double)sh.completed.load() / span,
       (unsigned long long)sh.completed.load(),
       (unsigned long long)sh.allowed.load(), pct(0.50), pct(0.99), threads,
-      inflight, frame_keys);
+      inflight, frame_keys, hashed ? "hashed" : "batch");
   return 0;
 }

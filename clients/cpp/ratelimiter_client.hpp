@@ -42,11 +42,13 @@ enum : uint8_t {
   T_HEALTH = 3,
   T_METRICS = 4,
   T_ALLOW_BATCH = 5,
+  T_ALLOW_HASHED = 11,
   T_RESULT = 129,
   T_OK = 130,
   T_HEALTH_R = 131,
   T_METRICS_R = 132,
   T_RESULT_BATCH = 133,
+  T_RESULT_HASHED = 136,
   T_ERROR = 255,
 };
 

@@ -55,8 +55,17 @@ class SketchLimiter(RateLimiter):
         super().__init__(config, clock)
         from ratelimiter_tpu.ops import sketch_kernels
 
-        self._step, self._reset_step, self._rollover = (
+        # The serving step takes ONE uint64 operand per key: the (h1, h2)
+        # split happens inside the jitted step (build_hashed_step,
+        # ADR-011) so the host stages raw hashes and never runs per-key
+        # hash math. reset/rollover keep the (h1, h2) kernels — rare
+        # control-plane dispatches.
+        _, self._reset_step, self._rollover = (
             sketch_kernels.build_steps(self.config))
+        self._step = sketch_kernels.build_hashed_step(self.config)
+        # Lazy premix variant for the raw-u64-id wire lane (launch_ids):
+        # splitmix64 ALSO runs in-step there.
+        self._ids_step = None
         self._state = sketch_kernels.init_state(self.config)
         self._window_us = to_micros(self.config.window)
         self._sub_us = sketch_kernels.sketch_geometry(self.config)[1]
@@ -194,8 +203,10 @@ class SketchLimiter(RateLimiter):
             free = self._staging.get(padded)
             if free:
                 return free.pop()
-        return (np.empty(padded, dtype=np.uint32),
-                np.empty(padded, dtype=np.uint32),
+        # One u64 hash buffer + one n buffer per slot: the (h1, h2) split
+        # moved inside the jitted step (ADR-011), halving the staged
+        # arrays and making the hashed wire lane a single memcpy.
+        return (np.empty(padded, dtype=np.uint64),
                 np.empty(padded, dtype=np.int32))
 
     def _release_staging(self, padded: int, slot) -> None:
@@ -204,19 +215,29 @@ class SketchLimiter(RateLimiter):
         with self._staging_lock:
             self._staging.setdefault(padded, []).append(slot)
 
+    def _get_ids_step(self):
+        """The premix (raw-u64-id) step variant, built lazily: splitmix64
+        AND the (h1, h2) split run in-step (ADR-011)."""
+        if self._ids_step is None:
+            self._ids_step = self._build_ids_step()
+        return self._ids_step
+
+    def _build_ids_step(self):
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        return sketch_kernels.build_hashed_step(self.config, premix=True)
+
     def _launch_hashed(self, h64: np.ndarray, ns: np.ndarray,
-                       now_us: int, t_sec: float) -> DispatchTicket:
+                       now_us: int, t_sec: float, *, premix: bool = False,
+                       wire: bool = False) -> DispatchTicket:
         import jax.numpy as jnp
 
         b = h64.shape[0]
         padded = self._padded_size(b)
-        h1, h2 = split_hash(h64, self._seed)
         slot = self._acquire_staging(padded)
-        h1p, h2p, nsp = slot
-        h1p[:b] = h1
-        h1p[b:] = 0
-        h2p[:b] = h2
-        h2p[b:] = 1
+        h64p, nsp = slot
+        h64p[:b] = h64
+        h64p[b:] = 0
         nsp[:b] = ns
         nsp[b:] = 0
         launched = False
@@ -233,14 +254,22 @@ class SketchLimiter(RateLimiter):
                     # misaccounting. Clears as history ages out of the
                     # ring.
                     return DispatchTicket(result=self._deny_all(b, now_us))
-                self._state, outs = self._step(
-                    self._state, self._place(h1p), self._place(h2p),
-                    self._place(nsp), jnp.int64(now_us),
-                    self._policy_device())
+                step = self._get_ids_step() if premix else self._step
+                self._state, outs = step(
+                    self._state, self._place(h64p), self._place(nsp),
+                    jnp.int64(now_us), self._policy_device())
                 # Inside the lock: a concurrent set/delete_override
                 # rebuilds the table's sorted views, and a torn read
-                # would mis-index.
-                limits = self._policy_limits(h64)
+                # would mis-index. Raw-id launches finalize host-side
+                # ONLY when overrides exist (the common empty-table case
+                # stays hash-free on the host).
+                if premix:
+                    from ratelimiter_tpu.ops.hashing import splitmix64
+
+                    limits = (self._policy_limits(splitmix64(h64))
+                              if len(self._policy_table) else None)
+                else:
+                    limits = self._policy_limits(h64)
                 self._inflight_mass += int(ns.sum())
             launched = True
         finally:
@@ -254,6 +283,15 @@ class SketchLimiter(RateLimiter):
         # behind the step — resolve does one bulk fetch, no NumPy per
         # request (ISSUE-3 tentpole item 3).
         t.outs = self._launch_finish(outs, now_us)
+        if wire:
+            # Wire-lane tickets additionally pack the response ON DEVICE
+            # (bit-packed allow mask + one int64 word array) so resolve
+            # fetches two compact buffers and the responder frames them
+            # with three slice memcpys (ADR-011).
+            from ratelimiter_tpu.ops import sketch_kernels
+
+            t.outs = sketch_kernels.pack_wire(*t.outs)
+            t.wire = True
         t.b = b
         t.limit = self.config.limit
         t.limits = limits
@@ -302,19 +340,44 @@ class SketchLimiter(RateLimiter):
             # so a completer thread resolving batch k never stalls the
             # thread launching batch k+1.
             jax.block_until_ready(t.outs)
-            allowed, remaining, retry, reset_at = jax.device_get(t.outs)
+            if t.wire:
+                bits, words = jax.device_get(t.outs)
+            else:
+                allowed, remaining, retry, reset_at = jax.device_get(t.outs)
         except BaseException:
             self._retire_ticket(t, 0)
             raise
         b = t.b
-        res = BatchResult(
-            allowed=allowed[:b],
-            limit=t.limit,
-            remaining=remaining[:b],
-            retry_after=retry[:b],
-            reset_at=reset_at[:b],
-            limits=t.limits,
-        )
+        if t.wire:
+            # Device-packed wire buffers (sketch_kernels.pack_wire): the
+            # readback is B/8 + 3*B*8 bytes; host work is bit-unpack +
+            # three int64 slice VIEWS (floats recovered by bitcast view,
+            # not conversion).
+            padded = t.padded
+            allowed = np.unpackbits(bits, bitorder="little")[:b].astype(bool)
+            remaining = words[:b]
+            retry = words[padded:padded + b].view(np.float64)
+            reset_at = words[2 * padded:2 * padded + b].view(np.float64)
+            res = BatchResult(
+                allowed=allowed,
+                limit=t.limit,
+                remaining=remaining,
+                retry_after=retry,
+                reset_at=reset_at,
+                limits=t.limits,
+                # The packed buffers ride along so the wire encoder
+                # frames from them directly (no re-bit-packing).
+                wire_packed=(bits, words, padded),
+            )
+        else:
+            res = BatchResult(
+                allowed=allowed[:b],
+                limit=t.limit,
+                remaining=remaining[:b],
+                retry_after=retry[:b],
+                reset_at=reset_at[:b],
+                limits=t.limits,
+            )
         self._retire_ticket(t, int(t.ns[res.allowed].sum()))
         t.result = res
         t.outs = None
@@ -330,12 +393,14 @@ class SketchLimiter(RateLimiter):
     pipelined = True
 
     def _launch_guarded(self, h64: np.ndarray, ns_arr: np.ndarray,
-                        t: float) -> DispatchTicket:
-        """Shared fail-open/fail-closed contract for both launch entry
+                        t: float, *, premix: bool = False,
+                        wire: bool = False) -> DispatchTicket:
+        """Shared fail-open/fail-closed contract for the launch entry
         points (mirrors allow_hashed): fail-open configs get a
         pre-resolved fail-open ticket, fail-closed raise at launch."""
         try:
-            return self._launch_hashed(h64, ns_arr, to_micros(t), t)
+            return self._launch_hashed(h64, ns_arr, to_micros(t), t,
+                                       premix=premix, wire=wire)
         except Exception as exc:
             if self.config.fail_open:
                 return DispatchTicket(result=batch_fail_open(
@@ -359,6 +424,33 @@ class SketchLimiter(RateLimiter):
             ns_arr = np.asarray(ns, dtype=np.int64)
         t = self.clock.now() if now is None else float(now)
         return self._launch_guarded(h64, ns_arr, t)
+
+    def launch_ids(self, ids: np.ndarray,
+                   ns: Optional[np.ndarray] = None, *,
+                   now: Optional[float] = None,
+                   wire: bool = False) -> DispatchTicket:
+        """Raw-u64-id launch (the T_ALLOW_HASHED wire lane, ADR-011):
+        ids are tenant/key identifiers, NOT finalized hashes — the
+        splitmix64 finalizer and the (h1, h2) split both run inside the
+        jitted step, so the host's per-key work is one staging memcpy.
+        The id keyspace is disjoint from the string-key space (different
+        finalization); reset/policy control surfaces address string keys
+        only. ``wire=True`` additionally packs the response on device
+        (pack_wire) for the zero-copy responder path."""
+        self._check_open()
+        ids = np.asarray(ids, dtype=np.uint64)
+        if ns is None:
+            ns_arr = np.ones(ids.shape[0], dtype=np.int64)
+        else:
+            ns_arr = np.asarray(ns, dtype=np.int64)
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_guarded(ids, ns_arr, t, premix=True, wire=wire)
+
+    def allow_ids(self, ids: np.ndarray,
+                  ns: Optional[np.ndarray] = None, *,
+                  now: Optional[float] = None) -> BatchResult:
+        """Synchronous raw-u64-id decide: launch_ids + resolve."""
+        return self.resolve(self.launch_ids(ids, ns, now=now))
 
     def launch_batch(self, keys: List[str],
                      ns: Optional[np.ndarray] = None, *,
@@ -554,8 +646,11 @@ class SketchLimiter(RateLimiter):
         from ratelimiter_tpu.ops import sketch_kernels
 
         steps = sketch_kernels.build_steps(new_cfg)
+        step = sketch_kernels.build_hashed_step(new_cfg)
         with self._lock:
-            self._step, self._reset_step, self._rollover = steps
+            self._step = step
+            _, self._reset_step, self._rollover = steps
+            self._ids_step = None
             self._mass_budget = new_cfg.sketch.mass_budget(new_cfg.limit)
 
     def _apply_window(self, new_cfg: Config) -> None:
@@ -567,6 +662,7 @@ class SketchLimiter(RateLimiter):
 
         migrate = sketch_kernels.build_migrate(self.config, new_cfg)
         steps = sketch_kernels.build_steps(new_cfg)
+        step = sketch_kernels.build_hashed_step(new_cfg)
         new_sub = sketch_kernels.sketch_geometry(new_cfg)[1]
         new_sw = sketch_kernels.sketch_geometry(new_cfg)[2]
         import jax.numpy as jnp
@@ -575,7 +671,9 @@ class SketchLimiter(RateLimiter):
         with self._lock:
             old_sub = self._sub_us
             self._state = migrate(self._state, jnp.int64(now_us))
-            self._step, self._reset_step, self._rollover = steps
+            self._step = step
+            _, self._reset_step, self._rollover = steps
+            self._ids_step = None
             self._window_us = to_micros(new_cfg.window)
             self._sub_us = new_sub
             self._ring_sw = new_sw
@@ -697,7 +795,9 @@ class SketchTokenBucketLimiter(SketchLimiter):
         RateLimiter.__init__(self, config, clock)
         from ratelimiter_tpu.ops import bucket_kernels
 
-        self._step, self._reset_step = bucket_kernels.build_steps(self.config)
+        _, self._reset_step = bucket_kernels.build_steps(self.config)
+        self._step = bucket_kernels.build_hashed_step(self.config)
+        self._ids_step = None
         self._state = bucket_kernels.init_state(self.config)
         self._window_us = to_micros(self.config.window)
         self._seed = self.config.sketch.seed
@@ -722,6 +822,11 @@ class SketchTokenBucketLimiter(SketchLimiter):
 
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
+
+    def _build_ids_step(self):
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        return bucket_kernels.build_hashed_step(self.config, premix=True)
 
     def _note_mass_locked(self, admitted: int, now_us: int) -> None:
         """No mass watchdog for the debt sketch: debt decays continuously
@@ -754,9 +859,12 @@ class SketchTokenBucketLimiter(SketchLimiter):
         from ratelimiter_tpu.ops import bucket_kernels
 
         steps = bucket_kernels.build_steps(new_cfg)
+        step = bucket_kernels.build_hashed_step(new_cfg)
         cap = new_cfg.limit * _MICROS
         with self._lock:
-            self._step, self._reset_step = steps
+            self._step = step
+            _, self._reset_step = steps
+            self._ids_step = None
             self._state = dict(self._state,
                                debt=jnp.minimum(self._state["debt"], cap),
                                rem=jnp.asarray(0, jnp.int64))
@@ -773,8 +881,11 @@ class SketchTokenBucketLimiter(SketchLimiter):
         from ratelimiter_tpu.ops import bucket_kernels
 
         steps = bucket_kernels.build_steps(new_cfg)
+        step = bucket_kernels.build_hashed_step(new_cfg)
         with self._lock:
-            self._step, self._reset_step = steps
+            self._step = step
+            _, self._reset_step = steps
+            self._ids_step = None
             self._window_us = to_micros(new_cfg.window)
             self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
 

@@ -53,12 +53,18 @@ def config_fingerprint(config: Config) -> str:
 
     The ``persistence`` spec is excluded: snapshot cadence / fsync policy
     are operational knobs, and a snapshot taken at one cadence must
-    restore under another. Every OTHER field participates — changing this
-    function's output strands every existing snapshot, which is why
+    restore under another. ``sketch.kernels`` is excluded for the same
+    reason (ADR-011): the Pallas/jnp selection changes WHICH compiled
+    kernels decide, not what the state means — the two paths are pinned
+    bit-identical, so a snapshot taken under either must restore under
+    the other. Every OTHER field participates — changing this function's
+    output strands every existing snapshot, which is why
     tests/test_checkpoint.py pins a golden value.
     """
     fields = asdict(config)
     fields.pop("persistence", None)
+    if isinstance(fields.get("sketch"), dict):
+        fields["sketch"].pop("kernels", None)
     payload = json.dumps(
         {**fields, "algorithm": str(config.algorithm)},
         sort_keys=True, default=str)

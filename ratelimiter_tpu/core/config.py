@@ -67,6 +67,21 @@ class SketchParams:
     #: Either way ``overload_periods`` counts offending sub-windows and is
     #: exported via /metrics and healthz (docs/OPERATIONS.md §3).
     overload_policy: str = "warn"
+    #: Hot-loop kernel implementation (ADR-011):
+    #:   "auto"   (default) fused Pallas kernels on TPU backends (when the
+    #:            geometry fits the VMEM budget and no heavy-hitter side
+    #:            table is configured), the jnp/XLA reference path
+    #:            everywhere else;
+    #:   "pallas" force the fused kernels — on non-TPU backends they run
+    #:            in Pallas interpret mode (the CI parity lane), which is
+    #:            bit-identical but slow: a correctness tool, not a
+    #:            serving configuration;
+    #:   "jnp"    force the XLA reference path (the pre-ADR-011 kernels,
+    #:            kept as the parity oracle).
+    #: Decisions are bit-identical across the three (tier-1 enforced by
+    #: tests/test_pallas_parity.py). EXCLUDED from the checkpoint config
+    #: fingerprint — an execution knob, not state geometry.
+    kernels: str = "auto"
 
     def validate(self) -> None:
         if self.depth < 1 or self.depth > 16:
@@ -91,6 +106,10 @@ class SketchParams:
             raise InvalidConfigError(
                 f"overload_policy must be 'warn' or 'strict', "
                 f"got {self.overload_policy!r}")
+        if self.kernels not in ("auto", "pallas", "jnp"):
+            raise InvalidConfigError(
+                f"sketch kernels must be 'auto', 'pallas' or 'jnp', "
+                f"got {self.kernels!r}")
 
     # ------------------------------------------------- load-aware sizing
     #

@@ -97,6 +97,12 @@ class BatchResult:
     #: Per-request effective limits when policy overrides touched this
     #: batch (int64[B]); None means every request saw the uniform `limit`.
     limits: "np.ndarray | None" = None
+    #: Device-packed wire buffers ``(bits u8[padded/8], words
+    #: i64[3*padded], padded)`` when the dispatch was launched
+    #: ``wire=True`` (sketch_kernels.pack_wire, ADR-011):
+    #: protocol.encode_result_hashed frames straight from these with
+    #: slice memcpys instead of re-bit-packing the allow mask.
+    wire_packed: "tuple | None" = None
 
     def __len__(self) -> int:
         return int(self.allowed.shape[0])
@@ -149,7 +155,7 @@ class DispatchTicket:
     """
 
     __slots__ = ("outs", "b", "limit", "limits", "ns", "now_us", "t_sec",
-                 "slot", "padded", "result", "meta")
+                 "slot", "padded", "result", "meta", "wire")
 
     def __init__(self, result: "BatchResult | None" = None):
         self.outs = None        # device-side (allowed, remaining, retry, reset)
@@ -163,6 +169,8 @@ class DispatchTicket:
         self.padded = 0
         self.result = result    # set once resolved (or pre-resolved)
         self.meta = None        # decorator/door bookkeeping rides along
+        self.wire = False       # outs are device-packed (bits, words)
+        #                         wire buffers (sketch_kernels.pack_wire)
 
     @property
     def resolved(self) -> bool:

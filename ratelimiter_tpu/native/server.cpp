@@ -84,9 +84,21 @@ namespace {
 
 // ---- protocol constants (serving/protocol.py) ----
 constexpr uint8_t T_ALLOW_N = 1, T_RESET = 2, T_HEALTH = 3, T_METRICS = 4,
-                  T_ALLOW_BATCH = 5, T_DCN_PUSH = 6;
+                  T_ALLOW_BATCH = 5, T_DCN_PUSH = 6, T_ALLOW_HASHED = 11;
 constexpr uint8_t T_RESULT = 129, T_OK = 130, T_HEALTH_R = 131,
-                  T_METRICS_R = 132, T_RESULT_BATCH = 133, T_ERROR = 255;
+                  T_METRICS_R = 132, T_RESULT_BATCH = 133,
+                  T_RESULT_HASHED = 136, T_ERROR = 255;
+
+// splitmix64 finalizer — BIT-IDENTICAL to ops/hashing.splitmix64 (and
+// its device twin): the hashed wire lane's raw u64 ids are finalized
+// HERE, on the io threads, so the Python launch callback receives
+// ready-made hashes and stages them with one memcpy (ADR-011).
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
 constexpr uint16_t E_INVALID_N = 1, E_INVALID_KEY = 2,
                    E_STORAGE_UNAVAILABLE = 3, E_INVALID_CONFIG = 5,
                    E_INTERNAL = 7;
@@ -179,6 +191,7 @@ struct BatchJoin {
   std::atomic<uint16_t> err{0};
   std::mutex emx;  // guards err_msg only
   std::string err_msg;
+  bool hashed = false;  // respond with T_RESULT_HASHED (columnar)
   BatchJoin(uint32_t nsh, ConnPtr c, uint64_t rid, uint32_t cnt)
       : remaining(nsh), conn(std::move(c)), req_id(rid), count(cnt),
         flags(cnt), rem(cnt), retry(cnt), reset(cnt) {}
@@ -186,8 +199,10 @@ struct BatchJoin {
 using JoinPtr = std::shared_ptr<BatchJoin>;
 
 // One queued decision unit: a scalar ALLOW_N, a whole ALLOW_BATCH frame,
-// or one shard's slice of a split batch (join != null; pos holds each
-// key's index in the original frame).
+// one shard's slice of a split batch (join != null; pos holds each
+// key's index in the original frame), or — hashed lane (ADR-011) — an
+// ALLOW_HASHED frame/slice whose keys are finalized u64 hashes in `ids`
+// (keys stays empty; responses are columnar T_RESULT_HASHED).
 struct Pending {
   ConnPtr conn;
   uint64_t req_id;
@@ -196,7 +211,13 @@ struct Pending {
   std::vector<int64_t> ns;
   JoinPtr join;
   std::vector<uint32_t> pos;
+  bool hashed = false;
+  std::vector<uint64_t> ids;
 };
+
+inline size_t pending_count(const Pending& p) {
+  return p.hashed ? p.ids.size() : p.keys.size();
+}
 
 // The dispatch currently being decided, shared between the dispatcher
 // and the SLO watcher. Whoever flips `answered` first owns the response.
@@ -278,6 +299,7 @@ struct Server {
     PyObject* ticket = nullptr;
     size_t total = 0;
     uint64_t limit_epoch = 0;  // epoch observed at launch time
+    bool hashed = false;       // respond columnar (T_RESULT_HASHED)
   };
   struct PipeQ {
     std::mutex mx;
@@ -317,6 +339,7 @@ struct Server {
     int64_t limit = 0;
     uint16_t err_code = 0;
     std::string err_msg;
+    bool hashed = false;
   };
   std::mutex rmx;
   std::condition_variable rcv;
@@ -331,6 +354,13 @@ struct Server {
   //   resolve(shard, ticket) -> (flags, remaining, retry, reset_at, limit)
   PyObject* cb_launch = nullptr;
   PyObject* cb_resolve = nullptr;
+  // Hashed-lane callbacks (None = T_ALLOW_HASHED answered
+  // E_INVALID_CONFIG — non-sketch backends have no raw-id path):
+  //   decide_hashed(shard, ids, ns) -> result tuple  [blocking]
+  //   launch_hashed(shard, ids, ns) -> opaque ticket [pipelined]
+  PyObject* cb_decide_hashed = nullptr;
+  PyObject* cb_launch_hashed = nullptr;
+  bool hashed_enabled = false;
   // DCN merge callback (None = T_DCN_PUSH rejected and the frame cap
   // stays at MAX_FRAME). Called with the raw push payload; the Python
   // side owns auth verification and the merge into every shard limiter.
@@ -393,6 +423,31 @@ void conn_send(Server* s, const ConnPtr& c, std::string frame) {
   (void)r;
 }
 
+// Columnar T_RESULT_HASHED frame: bit-packed allow mask + three column
+// memcpys (the response shape the device packs, serving/protocol.py).
+void encode_hashed_frame(std::string& out, uint64_t req_id, int64_t limit,
+                         const uint8_t* flags, const int64_t* rem,
+                         const double* retry, const double* reset,
+                         uint32_t count) {
+  uint32_t nb = (count + 7) / 8;
+  frame_header(out, T_RESULT_HASHED, req_id, 13 + nb + 24 * count);
+  // Batch fail_open = OR over the items: a split (multi-shard) frame
+  // whose slices disagree — one shard failed open, another decided —
+  // must still report that SOME answers are fabricated.
+  uint8_t bflags = 0;
+  for (uint32_t i = 0; i < count; ++i) bflags |= (uint8_t)(flags[i] & 2);
+  out.push_back((char)bflags);
+  put_i64(out, limit);
+  put_u32(out, count);
+  std::string bits(nb, '\0');
+  for (uint32_t i = 0; i < count; ++i)
+    if (flags[i] & 1) bits[i >> 3] |= (char)(1u << (i & 7));
+  out += bits;
+  out.append((const char*)rem, (size_t)count * 8);
+  out.append((const char*)retry, (size_t)count * 8);
+  out.append((const char*)reset, (size_t)count * 8);
+}
+
 // ---- SLO watcher ---------------------------------------------------------
 
 void send_policy_answers(Server* s, const std::vector<Pending>& items) {
@@ -405,6 +460,18 @@ void send_policy_answers(Server* s, const std::vector<Pending>& items) {
       // the CURRENT limit.
       int64_t lim = s->limit.load();
       double reset_at = now_s() + s->window_s.load();
+      if (p.hashed) {
+        uint32_t count = (uint32_t)p.ids.size();
+        std::vector<uint8_t> fl(count, 3);  // allowed | fail_open
+        std::vector<int64_t> rem(count, 0);
+        std::vector<double> retry(count, 0.0), reset(count, reset_at);
+        std::string out;
+        encode_hashed_frame(out, p.req_id, lim, fl.data(), rem.data(),
+                            retry.data(), reset.data(), count);
+        conn_send(s, p.conn, std::move(out));
+        s->decisions.fetch_add(count);
+        continue;
+      }
       if (!p.is_batch) {
         std::string out;
         frame_header(out, T_RESULT, p.req_id, 33);
@@ -603,6 +670,85 @@ PyObject* launch_core(Server* s, uint32_t shard, std::vector<Pending>& items,
   return ticket;
 }
 
+// Hashed-lane buffers: finalized u64 ids + ns, contiguous per drained
+// run — two memcpy-built arrays, no blob, no offsets/lengths.
+size_t build_hashed_buffers(const std::vector<Pending>& items,
+                            std::vector<uint64_t>& ids,
+                            std::vector<int64_t>& ns) {
+  size_t total = 0;
+  for (auto& p : items) total += p.ids.size();
+  ids.reserve(total);
+  ns.reserve(total);
+  for (auto& p : items) {
+    ids.insert(ids.end(), p.ids.begin(), p.ids.end());
+    ns.insert(ns.end(), p.ns.begin(), p.ns.end());
+  }
+  return total;
+}
+
+// Blocking decide for a hashed run (legacy / SLO modes).
+bool decide_hashed_core(Server* s, uint32_t shard,
+                        std::vector<Pending>& items, Server::Reply& r) {
+  std::vector<uint64_t> ids;
+  std::vector<int64_t> ns;
+  size_t total = build_hashed_buffers(items, ids, ns);
+  r.hashed = true;
+  if (total == 0) {
+    r.limit = s->limit.load();
+    return true;
+  }
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(Iy#y#)", (unsigned int)shard,
+        (const char*)ids.data(), (Py_ssize_t)(ids.size() * 8),
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+    PyObject* res =
+        args ? PyObject_CallObject(s->cb_decide_hashed, args) : nullptr;
+    Py_XDECREF(args);
+    if (res == nullptr) {
+      r.err_code = fetch_py_error(r.err_msg, "decide_hashed callback failed",
+                                  E_STORAGE_UNAVAILABLE);
+    } else {
+      parse_result_tuple(res, total, r, "decide_hashed");
+      Py_DECREF(res);
+    }
+    PyGILState_Release(g);
+  }
+  r.total = total;
+  return r.err_code == 0;
+}
+
+// Non-blocking launch for a hashed run (pipelined mode).
+PyObject* launch_hashed_core(Server* s, uint32_t shard,
+                             std::vector<Pending>& items, Server::Reply& r,
+                             size_t* total_out) {
+  std::vector<uint64_t> ids;
+  std::vector<int64_t> ns;
+  size_t total = build_hashed_buffers(items, ids, ns);
+  *total_out = total;
+  r.hashed = true;
+  if (total == 0) {
+    r.limit = s->limit.load();
+    return nullptr;  // err_code == 0: empty frame, answered directly
+  }
+  PyObject* ticket = nullptr;
+  {
+    PyGILState_STATE g = PyGILState_Ensure();
+    PyObject* args = Py_BuildValue(
+        "(Iy#y#)", (unsigned int)shard,
+        (const char*)ids.data(), (Py_ssize_t)(ids.size() * 8),
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+    ticket = args ? PyObject_CallObject(s->cb_launch_hashed, args) : nullptr;
+    Py_XDECREF(args);
+    if (ticket == nullptr)
+      r.err_code = fetch_py_error(r.err_msg, "launch_hashed callback failed",
+                                  E_STORAGE_UNAVAILABLE);
+    PyGILState_Release(g);
+  }
+  return ticket;
+}
+
 // Completer (pipelined mode): resolve in-flight tickets OLDEST FIRST and
 // hand results to the responder. Outlives the dispatchers (a dispatcher
 // mid-launch at stop time pushes its ticket afterward) and drains the
@@ -632,6 +778,7 @@ void completer_main(Server* s, uint32_t shard) {
     }
     q.cv_space.notify_one();
     Server::Reply r;
+    r.hashed = e.hashed;
     {
       PyGILState_STATE g = PyGILState_Ensure();
       PyObject* res = PyObject_CallFunction(
@@ -680,6 +827,13 @@ void finish_join(Server* s, const JoinPtr& j) {
     return;
   }
   std::string out;
+  if (j->hashed) {
+    encode_hashed_frame(out, j->req_id, j->limit.load(), j->flags.data(),
+                        j->rem.data(), j->retry.data(), j->reset.data(),
+                        j->count);
+    conn_send(s, j->conn, std::move(out));
+    return;
+  }
   frame_header(out, T_RESULT_BATCH, j->req_id, 12 + 25 * j->count);
   put_i64(out, j->limit.load());
   put_u32(out, j->count);
@@ -718,7 +872,7 @@ void emit_reply(Server* s, std::vector<Pending>& items,
         }
         j->limit.store(r.limit);
       }
-      if (r.err_code != 0) idx += p.keys.size();
+      if (r.err_code != 0) idx += pending_count(p);
       if (j->remaining.fetch_sub(1) == 1) finish_join(s, j);
       continue;
     }
@@ -727,6 +881,17 @@ void emit_reply(Server* s, std::vector<Pending>& items,
       continue;
     }
     std::string out;
+    if (p.hashed) {
+      // Columnar hashed response: three slice memcpys straight out of
+      // the resolve buffers (ADR-011).
+      uint32_t count = (uint32_t)p.ids.size();
+      encode_hashed_frame(out, p.req_id, r.limit, r.flags.data() + idx,
+                          r.remaining.data() + idx, r.retry.data() + idx,
+                          r.reset_at.data() + idx, count);
+      idx += count;
+      conn_send(s, p.conn, std::move(out));
+      continue;
+    }
     if (!p.is_batch) {
       frame_header(out, T_RESULT, p.req_id, 33);
       out.push_back((char)r.flags[idx]);
@@ -755,10 +920,11 @@ void emit_reply(Server* s, std::vector<Pending>& items,
 // SLO-path wrapper (single-shard only): decide, then answer inline
 // unless the watcher beat us to it.
 bool run_decide(Server* s, std::vector<Pending>& items,
-                std::atomic<bool>* gate) {
+                std::atomic<bool>* gate, bool hashed = false) {
   Server::Reply r;
   uint64_t ep = s->limit_epoch.load();
-  bool ok = decide_core(s, 0, items, r);
+  bool ok = hashed ? decide_hashed_core(s, 0, items, r)
+                   : decide_core(s, 0, items, r);
   if (gate != nullptr && gate->exchange(true)) {
     // SLO watcher already answered (and counted) these waiters; the
     // (late) state update above still landed in the limiter — drop the
@@ -794,6 +960,69 @@ void responder_main(Server* s) {
     }
     emit_reply(s, r.items, r);
   }
+}
+
+// Dispatch one drained group (string or hashed) via the mode-appropriate
+// non-SLO path: pipelined launch when the matching launch callback is
+// installed, blocking decide handed to the responder otherwise. String
+// and hashed runs dispatch separately — their Python entry points (and
+// response encodings) differ — but share the shard's in-flight window.
+void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
+                    bool hashed) {
+  bool pipelined =
+      s->pipelined &&
+      (!hashed ||
+       (s->cb_launch_hashed != nullptr && s->cb_launch_hashed != Py_None));
+  if (pipelined) {
+    Server::Reply r;
+    size_t total = 0;
+    uint64_t ep = s->limit_epoch.load();
+    PyObject* ticket = hashed
+                           ? launch_hashed_core(s, shard, group, r, &total)
+                           : launch_core(s, shard, group, r, &total);
+    if (ticket == nullptr) {
+      // Launch failed (typed error for every waiter) or the run held
+      // only empty frames — answer via the responder directly.
+      r.total = total;
+      r.items = std::move(group);
+      {
+        std::lock_guard<std::mutex> g(s->rmx);
+        s->rqueue.push_back(std::move(r));
+      }
+      s->rcv.notify_one();
+      return;
+    }
+    Server::PipeQ& pq = *s->pipeqs[shard];
+    {
+      std::unique_lock<std::mutex> lk(pq.mx);
+      // Bounded window: block HERE (backpressure) when `inflight`
+      // tickets are unresolved; on stop, push anyway — the completer
+      // drains everything before exiting.
+      pq.cv_space.wait(lk, [&] {
+        return pq.entries.size() < s->inflight_window || s->stop.load();
+      });
+      pq.entries.push_back({std::move(group), ticket, total, ep, hashed});
+    }
+    pq.cv_items.notify_one();
+    return;
+  }
+  // Throughput path: decide here, hand encode+send to the responder so
+  // the next batch's decide starts immediately.
+  Server::Reply r;
+  r.hashed = hashed;
+  uint64_t dep = s->limit_epoch.load();
+  bool ok = hashed ? decide_hashed_core(s, shard, group, r)
+                   : decide_core(s, shard, group, r);
+  if (ok) {
+    s->decisions.fetch_add(r.total);
+    if (r.total) s->refresh_limit(r.limit, dep);
+  }
+  r.items = std::move(group);
+  {
+    std::lock_guard<std::mutex> g(s->rmx);
+    s->rqueue.push_back(std::move(r));
+  }
+  s->rcv.notify_one();
 }
 
 void handle_reset(Server* s, uint32_t shard, const Pending& p) {
@@ -905,94 +1134,60 @@ void dispatcher_main(Server* s, uint32_t shard) {
       if (s->stop.load() && q.queue.empty()) return;
       while (!q.queue.empty() && run_keys < s->max_batch) {
         // RESET/METRICS ride the same queue (keys empty or kind marker).
-        run_keys += q.queue.front().keys.size();
+        run_keys += pending_count(q.queue.front());
         run.push_back(std::move(q.queue.front()));
         q.queue.pop_front();
       }
       q.queued_keys -= std::min(q.queued_keys, run_keys);
     }
-    // Split control items (req_id flag via ns sentinel) from decisions.
-    std::vector<Pending> decisions;
+    // Split control items (req_id flag via ns sentinel) from decisions;
+    // hashed frames dispatch as their own group (different Python entry
+    // point + columnar response encoding, ADR-011).
+    std::vector<Pending> decisions, hashed;
     for (auto& p : run) {
-      if (p.ns.size() == 1 && p.ns[0] == -1) {
+      if (!p.hashed && p.ns.size() == 1 && p.ns[0] == -1) {
         handle_reset(s, shard, p);
-      } else if (p.ns.size() == 1 && p.ns[0] == -2) {
+      } else if (!p.hashed && p.ns.size() == 1 && p.ns[0] == -2) {
         handle_metrics(s, p);
-      } else if (p.ns.size() == 1 && p.ns[0] == -3) {
+      } else if (!p.hashed && p.ns.size() == 1 && p.ns[0] == -3) {
         handle_dcn(s, p);
+      } else if (p.hashed) {
+        hashed.push_back(std::move(p));
       } else {
         decisions.push_back(std::move(p));
       }
     }
-    if (decisions.empty()) continue;
-    if (s->pipelined) {
-      // Pipelined throughput path (ADR-010): non-blocking launch, then
-      // hand the ticket to the completer — this thread goes straight
-      // back to coalescing batch k+1 while the device still computes
-      // batch k (and k-1, ... up to `inflight`).
-      Server::Reply r;
-      size_t total = 0;
-      uint64_t ep = s->limit_epoch.load();
-      PyObject* ticket = launch_core(s, shard, decisions, r, &total);
-      if (ticket == nullptr) {
-        // Launch failed (typed error for every waiter) or the run held
-        // only empty frames — answer via the responder directly.
-        r.total = total;
-        r.items = std::move(decisions);
-        {
-          std::lock_guard<std::mutex> g(s->rmx);
-          s->rqueue.push_back(std::move(r));
-        }
-        s->rcv.notify_one();
-        continue;
-      }
-      Server::PipeQ& pq = *s->pipeqs[shard];
-      {
-        std::unique_lock<std::mutex> lk(pq.mx);
-        // Bounded window: block HERE (backpressure) when `inflight`
-        // tickets are unresolved; on stop, push anyway — the completer
-        // drains everything before exiting.
-        pq.cv_space.wait(lk, [&] {
-          return pq.entries.size() < s->inflight_window || s->stop.load();
-        });
-        pq.entries.push_back({std::move(decisions), ticket, total, ep});
-      }
-      pq.cv_items.notify_one();
-      continue;
-    }
+    if (decisions.empty() && hashed.empty()) continue;
     if (s->slo_us == 0) {
-      // Throughput path: decide here, hand encode+send to the responder
-      // so the next batch's decide starts immediately.
-      Server::Reply r;
-      uint64_t dep = s->limit_epoch.load();
-      if (decide_core(s, shard, decisions, r)) {
-        s->decisions.fetch_add(r.total);
-        if (r.total) s->refresh_limit(r.limit, dep);
-      }
-      r.items = std::move(decisions);
-      {
-        std::lock_guard<std::mutex> g(s->rmx);
-        s->rqueue.push_back(std::move(r));
-      }
-      s->rcv.notify_one();
+      // Pipelined (ADR-010) or legacy throughput path, per group.
+      if (!decisions.empty())
+        dispatch_group(s, shard, std::move(decisions), false);
+      if (!hashed.empty())
+        dispatch_group(s, shard, std::move(hashed), true);
       continue;
     }
-    {
-      std::lock_guard<std::mutex> g(s->ifmx);
-      s->inflight.items = std::move(decisions);
-      s->inflight.answered.store(false);
-      s->inflight.deadline = std::chrono::steady_clock::now() +
-                             std::chrono::microseconds(s->slo_us);
-      s->inflight.active = true;
+    // SLO path (single shard): one group at a time through the
+    // single-deadline watcher.
+    for (int grp = 0; grp < 2; ++grp) {
+      std::vector<Pending>& g = grp == 0 ? decisions : hashed;
+      if (g.empty()) continue;
+      {
+        std::lock_guard<std::mutex> lk(s->ifmx);
+        s->inflight.items = std::move(g);
+        s->inflight.answered.store(false);
+        s->inflight.deadline = std::chrono::steady_clock::now() +
+                               std::chrono::microseconds(s->slo_us);
+        s->inflight.active = true;
+      }
+      s->ifcv.notify_all();
+      run_decide(s, s->inflight.items, &s->inflight.answered, grp == 1);
+      {
+        std::lock_guard<std::mutex> lk(s->ifmx);
+        s->inflight.active = false;
+        s->inflight.items.clear();
+      }
+      s->ifcv.notify_all();
     }
-    s->ifcv.notify_all();
-    run_decide(s, s->inflight.items, &s->inflight.answered);
-    {
-      std::lock_guard<std::mutex> g(s->ifmx);
-      s->inflight.active = false;
-      s->inflight.items.clear();
-    }
-    s->ifcv.notify_all();
   }
 }
 
@@ -1203,6 +1398,84 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
             }
             size_t nk = part.keys.size();
             enqueue(std::move(part), nk, sh);
+          }
+        }
+      }
+    } else if (type == T_ALLOW_HASHED) {
+      // Zero-copy bulk lane (ADR-011): columnar u64 ids + u32 ns. The
+      // splitmix64 finalizer runs HERE (io thread, GIL-free) so the
+      // dispatcher's launch hands Python ready-made hashes.
+      if (blen < 4) return false;
+      uint32_t count;
+      memcpy(&count, body, 4);
+      if (count > (blen - 4) / 12 || blen != 4 + 12ull * count)
+        return false;
+      if (!s->hashed_enabled) {
+        conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
+                                   "the hashed bulk lane requires a "
+                                   "sketch-family backend"));
+      } else if (s->draining.load()) {
+        conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                                   "server is shutting down"));
+      } else {
+        const char* idp = body + 4;
+        const char* npp = body + 4 + 8ull * count;
+        bool bad_n = false;
+        Pending p{c, req_id, true, {}, {}};
+        p.hashed = true;
+        p.ids.reserve(count);
+        p.ns.reserve(count);
+        for (uint32_t i = 0; i < count; ++i) {
+          uint64_t raw;
+          uint32_t n;
+          memcpy(&raw, idp + 8ull * i, 8);
+          memcpy(&n, npp + 4ull * i, 4);
+          if (n == 0) bad_n = true;
+          p.ids.push_back(splitmix64(raw));
+          p.ns.push_back((int64_t)n);
+        }
+        if (bad_n) {
+          conn_send(s, c, make_error(req_id, E_INVALID_N,
+                                     "n must be a positive integer"));
+        } else if (s->num_shards == 1 || count == 0) {
+          enqueue(std::move(p), count, 0);
+        } else {
+          // Per-id shard routing on the FINALIZED hash (well mixed);
+          // Python mirror: NativeRateLimitServer.shard_of_id.
+          std::vector<uint32_t> shards_of(count);
+          uint32_t first_shard = (uint32_t)(p.ids[0] % s->num_shards);
+          bool mixed = false;
+          shards_of[0] = first_shard;
+          for (uint32_t i = 1; i < count; ++i) {
+            shards_of[i] = (uint32_t)(p.ids[i] % s->num_shards);
+            mixed |= shards_of[i] != first_shard;
+          }
+          if (!mixed) {
+            enqueue(std::move(p), count, first_shard);
+          } else {
+            std::vector<std::vector<uint32_t>> per(s->num_shards);
+            for (uint32_t i = 0; i < count; ++i)
+              per[shards_of[i]].push_back(i);
+            uint32_t involved = 0;
+            for (auto& v : per) involved += !v.empty();
+            JoinPtr j = std::make_shared<BatchJoin>(involved, c, req_id,
+                                                    count);
+            j->hashed = true;
+            for (uint32_t sh = 0; sh < s->num_shards; ++sh) {
+              if (per[sh].empty()) continue;
+              Pending part{c, req_id, true, {}, {}};
+              part.hashed = true;
+              part.join = j;
+              part.pos = std::move(per[sh]);
+              part.ids.reserve(part.pos.size());
+              part.ns.reserve(part.pos.size());
+              for (uint32_t at : part.pos) {
+                part.ids.push_back(p.ids[at]);
+                part.ns.push_back(p.ns[at]);
+              }
+              size_t nk = part.ids.size();
+              enqueue(std::move(part), nk, sh);
+            }
           }
         }
       }
@@ -1543,6 +1816,8 @@ void server_dealloc(PyObject* self) {
     Py_XDECREF(ps->s->cb_dcn);
     Py_XDECREF(ps->s->cb_launch);
     Py_XDECREF(ps->s->cb_resolve);
+    Py_XDECREF(ps->s->cb_decide_hashed);
+    Py_XDECREF(ps->s->cb_launch_hashed);
     delete ps->s;
   }
   Py_TYPE(self)->tp_free(self);
@@ -1570,9 +1845,11 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                                  "key_prefix", "num_shards",  "dcn",
                                  "launch",    "resolve",      "inflight",
                                  "dcn_auth_required", "max_dcn_conns",
+                                 "decide_hashed", "launch_hashed",
                                  nullptr};
   PyObject *decide, *reset, *metrics = Py_None, *dcn = Py_None;
   PyObject *launch = Py_None, *resolve = Py_None;
+  PyObject *decide_hashed = Py_None, *launch_hashed = Py_None;
   unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
   int fail_open = 0;
   long long limit = 0;
@@ -1581,14 +1858,15 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_ssize_t key_prefix_len = 0;
   unsigned int num_shards = 1, inflight = 8, max_dcn_conns = 4;
   int dcn_auth_required = 0;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpI",
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpIOO",
                                    (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
                                    &window_s, &key_prefix, &key_prefix_len,
                                    &num_shards, &dcn, &launch, &resolve,
                                    &inflight, &dcn_auth_required,
-                                   &max_dcn_conns))
+                                   &max_dcn_conns, &decide_hashed,
+                                   &launch_hashed))
     return nullptr;
   if (num_shards < 1 || num_shards > 64) {
     PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
@@ -1620,13 +1898,18 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_INCREF(dcn);
   Py_INCREF(launch);
   Py_INCREF(resolve);
+  Py_INCREF(decide_hashed);
+  Py_INCREF(launch_hashed);
   ps->s->cb_decide = decide;
   ps->s->cb_reset = reset;
   ps->s->cb_metrics = metrics;
   ps->s->cb_dcn = dcn;
   ps->s->cb_launch = launch;
   ps->s->cb_resolve = resolve;
+  ps->s->cb_decide_hashed = decide_hashed;
+  ps->s->cb_launch_hashed = launch_hashed;
   ps->s->dcn_enabled = dcn != Py_None;
+  ps->s->hashed_enabled = decide_hashed != Py_None;
   return (PyObject*)ps;
 }
 
@@ -1648,7 +1931,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 5; }
+int64_t rl_server_abi_version() { return 6; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

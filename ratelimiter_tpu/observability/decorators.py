@@ -127,6 +127,42 @@ class LimiterDecorator(RateLimiter):
         self._observe_batch("resolve", out, None, time.perf_counter() - t0)
         return out
 
+    # Hashed / raw-id lane (ADR-011): explicit delegation for the same
+    # reason as launch_batch/resolve — subclasses (the breaker) must be
+    # able to interpose, and the synchronous forms must be observed.
+    # The serving doors detect lane SUPPORT on the undecorated backend
+    # (hasattr on the decorator would now always be true), so these
+    # definitions never advertise a lane the inner limiter lacks.
+
+    def allow_hashed(self, h64, ns=None, *, now: Optional[float] = None):
+        t0 = time.perf_counter()
+        try:
+            out = self.inner.allow_hashed(h64, ns, now=now)
+        except Exception as exc:
+            self._observe_error("allow_hashed", exc,
+                                time.perf_counter() - t0)
+            raise
+        self._observe_batch("allow_hashed", out, ns,
+                            time.perf_counter() - t0)
+        return out
+
+    def allow_ids(self, ids, ns=None, *, now: Optional[float] = None):
+        t0 = time.perf_counter()
+        try:
+            out = self.inner.allow_ids(ids, ns, now=now)
+        except Exception as exc:
+            self._observe_error("allow_ids", exc, time.perf_counter() - t0)
+            raise
+        self._observe_batch("allow_ids", out, ns, time.perf_counter() - t0)
+        return out
+
+    def launch_hashed(self, h64, ns=None, *, now: Optional[float] = None):
+        return self.inner.launch_hashed(h64, ns, now=now)
+
+    def launch_ids(self, ids, ns=None, *, now: Optional[float] = None,
+                   wire: bool = False):
+        return self.inner.launch_ids(ids, ns, now=now, wire=wire)
+
     def close(self) -> None:
         self._closed = True
         self.inner.close()
@@ -528,6 +564,65 @@ class CircuitBreakerDecorator(LimiterDecorator):
             raise
         ticket.meta = ("breaker", t, probe)
         return ticket
+
+    # Hashed / raw-id lane (ADR-011): the breaker guards every dispatch
+    # entry point identically — an open breaker must not enqueue device
+    # work for hashed frames any more than for string batches.
+
+    def _guarded_sync(self, fn, b: int, now):
+        t = self.inner.clock.now() if now is None else float(now)
+        probe = self._admit_call(t)
+        if probe is None:
+            return self._short_circuit(b, t)
+        try:
+            out = fn()
+        except StorageUnavailableError:
+            self._note_result(True, t, probe)
+            raise
+        except BaseException:
+            if probe:
+                self._clear_probe()
+            raise
+        self._note_result(out.fail_open, t, probe)
+        return out
+
+    def _guarded_launch(self, fn, b: int, now):
+        t = self.inner.clock.now() if now is None else float(now)
+        probe = self._admit_call(t)
+        if probe is None:
+            from ratelimiter_tpu.core.types import DispatchTicket
+
+            return DispatchTicket(result=self._short_circuit(b, t))
+        try:
+            ticket = fn()
+        except StorageUnavailableError:
+            self._note_result(True, t, probe)
+            raise
+        except BaseException:
+            if probe:
+                self._clear_probe()
+            raise
+        ticket.meta = ("breaker", t, probe)
+        return ticket
+
+    def allow_hashed(self, h64, ns=None, *, now=None):
+        return self._guarded_sync(
+            lambda: self.inner.allow_hashed(h64, ns, now=now),
+            len(h64), now)
+
+    def allow_ids(self, ids, ns=None, *, now=None):
+        return self._guarded_sync(
+            lambda: self.inner.allow_ids(ids, ns, now=now), len(ids), now)
+
+    def launch_hashed(self, h64, ns=None, *, now=None):
+        return self._guarded_launch(
+            lambda: self.inner.launch_hashed(h64, ns, now=now),
+            len(h64), now)
+
+    def launch_ids(self, ids, ns=None, *, now=None, wire: bool = False):
+        return self._guarded_launch(
+            lambda: self.inner.launch_ids(ids, ns, now=now, wire=wire),
+            len(ids), now)
 
     def resolve(self, ticket):
         tag = None

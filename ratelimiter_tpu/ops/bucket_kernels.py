@@ -102,7 +102,7 @@ def _decay(state: State, now_us, *, rate_num: int, rate_den: int):
 def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
                  limit: int, rate_num: int, rate_den: int,
                  d: int, w: int, iters: int,
-                 axis_name: str | None = None):
+                 axis_name: str | None = None, use_pallas: bool = False):
     """One batched decision step. Returns (state, (allowed, remaining,
     retry_us)) — the limiter-side retry/reset plumbing is shared with the
     other sketch paths.
@@ -115,13 +115,23 @@ def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
     overridden keys burst to their own limit immediately and refill at
     the default rate. Errors stay toward denying."""
     decay, rem = _decay(state, now_us, rate_num=rate_num, rate_den=rate_den)
-    debt = jnp.maximum(jnp.int64(0), state["debt"] - decay)
+    # Fused-kernel path (ADR-011): decay applies on the fly inside the
+    # kernels (the decayed slab never materializes) and columns derive
+    # in-kernel; collective merges stay on the reference path.
+    use_pallas = use_pallas and axis_name is None
+    if use_pallas:
+        from ratelimiter_tpu.ops import pallas_sketch
 
-    cols = _columns(h1, h2, d, w)                       # (B, d)
-    est = None
-    for r in range(d):
-        (e_r,) = row_gather((debt[r],), cols[:, r])
-        est = e_r if est is None else jnp.minimum(est, e_r)
+        debt = None
+        cols = None
+        est = pallas_sketch.bucket_estimate(state["debt"], decay, h1, h2)
+    else:
+        debt = jnp.maximum(jnp.int64(0), state["debt"] - decay)
+        cols = _columns(h1, h2, d, w)                   # (B, d)
+        est = None
+        for r in range(d):
+            (e_r,) = row_gather((debt[r],), cols[:, r])
+            est = e_r if est is None else jnp.minimum(est, e_r)
 
     if policy is not None:
         q = policy_kernels.pack_halves(h1, h2)
@@ -135,18 +145,25 @@ def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, consumed = admit(sid, n_units, avail, iters)
 
-    hists = jnp.stack([row_histogram(cols[:, r], consumed, w)
-                       for r in range(d)])
-    if axis_name is not None:
-        # Multi-chip delta merge: replicated debt, psum of increments over
-        # ICI (same invariant as sketch_kernels' delta mode). The psum'd
-        # histogram IS the pod's local traffic, so `acc` stays
-        # export-correct on meshes too.
-        hists = jax.lax.psum(hists, axis_name)
-    debt = jnp.minimum(debt + hists, _DEBT_CAP)
+    if use_pallas:
+        from ratelimiter_tpu.ops import pallas_sketch
+
+        debt, acc = pallas_sketch.bucket_update(
+            state["debt"], state["acc"], decay, h1, h2, consumed)
+    else:
+        hists = jnp.stack([row_histogram(cols[:, r], consumed, w)
+                           for r in range(d)])
+        if axis_name is not None:
+            # Multi-chip delta merge: replicated debt, psum of increments
+            # over ICI (same invariant as sketch_kernels' delta mode). The
+            # psum'd histogram IS the pod's local traffic, so `acc` stays
+            # export-correct on meshes too.
+            hists = jax.lax.psum(hists, axis_name)
+        debt = jnp.minimum(debt + hists, _DEBT_CAP)
+        acc = jnp.minimum(state["acc"] + hists, _DEBT_CAP)
 
     new_state = {"debt": debt,
-                 "acc": jnp.minimum(state["acc"] + hists, _DEBT_CAP),
+                 "acc": acc,
                  "rem": rem,
                  "last": jnp.maximum(state["last"], now_us)}
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
@@ -222,14 +239,18 @@ def _params(cfg: Config) -> tuple:
 def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
     """Returns (step, reset) jitted callables, memoized per static config.
     ``step`` accepts an optional trailing ``policy`` operand."""
+    from ratelimiter_tpu.ops.sketch_kernels import _resolve_pallas
+
     ensure_x64()
-    limit, num, den, d, w, iters = key = _params(cfg)
+    limit, num, den, d, w, iters = _params(cfg)
+    use_pallas = _resolve_pallas(cfg, bucket=True)
+    key = (limit, num, den, d, w, iters, use_pallas)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_bucket_step, limit=limit, rate_num=num, rate_den=den,
-                d=d, w=w, iters=iters),
+                d=d, w=w, iters=iters, use_pallas=use_pallas),
         donate_argnums=(0,))
     reset = jax.jit(
         partial(_bucket_reset, rate_num=num, rate_den=den, d=d, w=w),
@@ -238,15 +259,56 @@ def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
     return step, reset
 
 
+_HASHED_CACHE: Dict[tuple, Callable] = {}
+
+
+def _bucket_step_h64(state: State, h64, n, now_us, policy=None, *,
+                     seed: int, premix: bool, **step_kw):
+    from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
+
+    h = h64
+    if premix:
+        h = splitmix64_dev(h)
+    h1, h2 = split_hash_dev(h, seed)
+    return _bucket_step(state, h1, h2, n, now_us, policy, **step_kw)
+
+
+def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
+    """Jitted ``step(state, h64, n, now_us, policy)`` with the (h1, h2)
+    split (and, with premix, the splitmix64 finalizer) ON DEVICE — the
+    bucket twin of sketch_kernels.build_hashed_step (ADR-011)."""
+    from ratelimiter_tpu.ops.sketch_kernels import _resolve_pallas
+
+    ensure_x64()
+    limit, num, den, d, w, iters = _params(cfg)
+    use_pallas = _resolve_pallas(cfg, bucket=True)
+    seed = cfg.sketch.seed
+    key = (limit, num, den, d, w, iters, use_pallas, seed, premix)
+    cached = _HASHED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step = jax.jit(
+        partial(_bucket_step_h64, seed=seed, premix=premix,
+                limit=limit, rate_num=num, rate_den=den,
+                d=d, w=w, iters=iters, use_pallas=use_pallas),
+        donate_argnums=(0,))
+    _HASHED_CACHE[key] = step
+    return step
+
+
 def build_scan(cfg: Config) -> Callable:
     """Jitted multi-step runner, one dispatch for T batches (bench shape)."""
+    from ratelimiter_tpu.ops.sketch_kernels import _resolve_pallas
+
     ensure_x64()
-    limit, num, den, d, w, iters = key = _params(cfg)
+    limit, num, den, d, w, iters = _params(cfg)
+    use_pallas = _resolve_pallas(cfg, bucket=True)
+    key = (limit, num, den, d, w, iters, use_pallas)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
         return cached
     step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
-                   iters=iters)
+                   iters=iters, use_pallas=use_pallas)
     scan = jax.jit(partial(_bucket_scan, step_kw=step_kw), donate_argnums=(0,))
     _SCAN_CACHE[key] = scan
     return scan

@@ -51,3 +51,36 @@ def split_hash(h64: np.ndarray, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
     h1 = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
     h2 = ((h >> np.uint64(32)).astype(np.uint32)) | np.uint32(1)
     return h1, h2
+
+
+# ------------------------------------------------------- device twins
+#
+# Bit-identical jnp forms of splitmix64 / split_hash, traced INSIDE the
+# jitted decision step (ops/sketch_kernels.build_hashed_step), so the
+# serving hot path stages one raw uint64 buffer per batch and the device
+# does all per-key mixing — the host never touches per-key hash math
+# (ADR-011). uint64 wrap-around semantics match NumPy exactly (jax x64
+# is enabled by every entry point via ops.ensure_x64); the host/device
+# agreement is fuzz-pinned by tests/test_hashing_device.py.
+
+def splitmix64_dev(x):
+    """jnp twin of splitmix64 (same constants, same wrap-around)."""
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def split_hash_dev(h64, seed: int = 0):
+    """jnp twin of split_hash; ``seed`` is trace-time static (it is baked
+    into the compiled step alongside the sketch geometry)."""
+    import jax.numpy as jnp
+
+    h = h64.astype(jnp.uint64)
+    if seed:
+        h = splitmix64_dev(h ^ jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+    h1 = (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    h2 = (h >> jnp.uint64(32)).astype(jnp.uint32) | jnp.uint32(1)
+    return h1, h2

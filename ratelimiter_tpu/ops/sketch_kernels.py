@@ -182,6 +182,38 @@ def _columns(h1, h2, d: int, w: int):
     return cols.astype(jnp.int32)
 
 
+def _boundary_weight(state: State, p, now_us, *, sub_us: int, SW: int,
+                     S: int, weighted: bool, pre=None):
+    """(frac, boundary) for the sliding-window boundary sub-window: the
+    rollover-boundary check (is the slab at slot p % S the period p-SW
+    slab?) and its remaining-overlap weight. ``pre`` short-circuits with
+    scan-hoisted values (see _sketch_scan); fixed-window mode returns
+    (0.0, None). Shared by the jnp and Pallas estimate paths so both see
+    the exact same scalar math."""
+    if not weighted:
+        return jnp.float32(0.0), None
+    if pre is not None:
+        # Scan path: (frac, boundary) precomputed OUTSIDE the loop
+        # body. Scalars derived from the loop carry defeat XLA's
+        # invariant hoisting, making the dynamic ring slice + dense
+        # combine re-run per iteration (measured 2 us -> 500+ us per
+        # step); the chunk precondition (one sub-window per chunk)
+        # makes the hoist exact. See _sketch_scan.
+        return pre
+    # Ring size S == SW, so the boundary period p-SW lives at
+    # slot p % S (the very slot the next rollover overwrites).
+    b_idx = (p % S).astype(jnp.int32)
+    boundary_valid = state["slab_period"][b_idx] == p - SW
+    elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
+    frac = jnp.where(
+        boundary_valid,
+        jnp.clip(1.0 - elapsed_in / jnp.float32(sub_us), 0.0, 1.0),
+        0.0)
+    boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
+                                            keepdims=False)
+    return frac, boundary
+
+
 def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
               weighted: bool = True, pre=None):
     """Min-over-rows window estimate at the given (B, d) columns, via
@@ -199,26 +231,8 @@ def _estimate(state: State, cols, p, now_us, *, sub_us: int, SW: int, S: int,
     B = cols.shape[0]
     w = state["totals"].shape[1]
     if weighted:
-        if pre is not None:
-            # Scan path: (frac, boundary) precomputed OUTSIDE the loop
-            # body. Scalars derived from the loop carry defeat XLA's
-            # invariant hoisting, making the dynamic ring slice + dense
-            # combine re-run per iteration (measured 2 us -> 500+ us per
-            # step); the chunk precondition (one sub-window per chunk)
-            # makes the hoist exact. See _sketch_scan.
-            frac, boundary = pre
-        else:
-            # Ring size S == SW, so the boundary period p-SW lives at
-            # slot p % S (the very slot the next rollover overwrites).
-            b_idx = (p % S).astype(jnp.int32)
-            boundary_valid = state["slab_period"][b_idx] == p - SW
-            elapsed_in = (now_us - p * sub_us).astype(jnp.float32)
-            frac = jnp.where(
-                boundary_valid,
-                jnp.clip(1.0 - elapsed_in / jnp.float32(sub_us), 0.0, 1.0),
-                0.0)
-            boundary = jax.lax.dynamic_index_in_dim(state["slabs"], b_idx,
-                                                    keepdims=False)
+        frac, boundary = _boundary_weight(state, p, now_us, sub_us=sub_us,
+                                          SW=SW, S=S, weighted=True, pre=pre)
         if not _use_sortmerge(B, w):
             # Direct-indexing regime: pre-combine the two tables DENSELY
             # (frac is a scalar) and gather once per row. Numerically
@@ -264,16 +278,36 @@ def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
                  iters: int, weighted: bool, conservative: bool,
                  hh: int = 0, hh_thresh: float = 0.0,
-                 axis_name: str | None = None, pre=None, pre_hh=None):
+                 axis_name: str | None = None, pre=None, pre_hh=None,
+                 use_pallas: bool = False):
     # Precondition (host-enforced via _sync_period): state.last_period is
     # the period of now_us. Clamp defends against clock skew backwards —
     # the reference has the same NTP caveat (``docs/ALGORITHMS.md:162``).
     now_us = jnp.maximum(now_us, state["last_period"] * sub_us)
     p = state["last_period"]
 
-    cols = _columns(h1, h2, d, w)                            # (B, d)
-    est, frac, boundary = _estimate(state, cols, p, now_us, sub_us=sub_us,
-                                    SW=SW, S=S, weighted=weighted, pre=pre)
+    # Fused-kernel path (ADR-011): columns derive INSIDE the Pallas
+    # kernels, so the (B, d) column matrix never materializes. Collective
+    # merges and the hh side table stay on the reference path (the psum'd
+    # histogram and private-cell reads are not fused).
+    use_pallas = use_pallas and axis_name is None and not hh
+    if use_pallas:
+        from ratelimiter_tpu.ops import pallas_sketch
+
+        cols = None
+        frac, boundary = _boundary_weight(state, p, now_us, sub_us=sub_us,
+                                          SW=SW, S=S, weighted=weighted,
+                                          pre=pre)
+        bop = (boundary if boundary is not None
+               else jnp.zeros_like(state["totals"]))
+        est = jnp.maximum(
+            pallas_sketch.window_estimate(state["totals"], bop, frac,
+                                          h1, h2), 0.0)
+    else:
+        cols = _columns(h1, h2, d, w)                        # (B, d)
+        est, frac, boundary = _estimate(state, cols, p, now_us,
+                                        sub_us=sub_us, SW=SW, S=S,
+                                        weighted=weighted, pre=pre)
 
     if hh:
         # Heavy-hitter side table (ROADMAP v0.2): a promoted key's NEW
@@ -335,26 +369,42 @@ def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
         # can undercount rows whose dense read exceeds the min-estimate —
         # both break the never-over-admit direction. Vanilla sums never do.
         target = jnp.where(allowed & not_mine, est + (avail - seen) + n_f, 0.0)
-        deltas = []
-        for r in range(d):
-            m_r = row_histogram_max(cols[:, r], target, w)
-            read_r = state["totals"][r].astype(jnp.float32)
-            if boundary is not None:
-                read_r = read_r + frac * boundary[r].astype(jnp.float32)
-            deltas.append(jnp.ceil(jnp.maximum(m_r - read_r, 0.0)))
-        hists = jnp.stack(deltas).astype(jnp.int32)
+        if use_pallas:
+            from ratelimiter_tpu.ops import pallas_sketch
+
+            totals, cur = pallas_sketch.cu_update(
+                state["totals"], state["cur"], bop, frac, h1, h2, target)
+        else:
+            deltas = []
+            for r in range(d):
+                m_r = row_histogram_max(cols[:, r], target, w)
+                read_r = state["totals"][r].astype(jnp.float32)
+                if boundary is not None:
+                    read_r = read_r + frac * boundary[r].astype(jnp.float32)
+                deltas.append(jnp.ceil(jnp.maximum(m_r - read_r, 0.0)))
+            hists = jnp.stack(deltas).astype(jnp.int32)
+            totals = state["totals"] + hists
+            cur = state["cur"] + hists
     else:
         add = jnp.where(allowed & not_mine, n, 0).astype(jnp.int32)  # (B,)
-        hists = jnp.stack([row_histogram(cols[:, r], add, w) for r in range(d)])
-        if axis_name is not None:
-            # Multi-chip delta merge: every chip adds the summed histogram,
-            # keeping the replicated-state invariant (ICI psum — the analog
-            # of all app servers sharing one Redis, SURVEY.md §2.6).
-            hists = jax.lax.psum(hists, axis_name)
+        if use_pallas:
+            from ratelimiter_tpu.ops import pallas_sketch
+
+            totals, cur = pallas_sketch.add_update(
+                state["totals"], state["cur"], h1, h2, add)
+        else:
+            hists = jnp.stack([row_histogram(cols[:, r], add, w)
+                               for r in range(d)])
+            if axis_name is not None:
+                # Multi-chip delta merge: every chip adds the summed
+                # histogram, keeping the replicated-state invariant (ICI
+                # psum — the analog of all app servers sharing one Redis,
+                # SURVEY.md §2.6).
+                hists = jax.lax.psum(hists, axis_name)
+            totals = state["totals"] + hists
+            cur = state["cur"] + hists
     # cur and totals share the same histogram so the "current sub-window
     # also counts in totals" invariant holds by construction.
-    totals = state["totals"] + hists
-    cur = state["cur"] + hists
 
     new_state = {"cur": cur, "slabs": state["slabs"], "totals": totals,
                  "slab_period": state["slab_period"],
@@ -571,15 +621,17 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
     hh, hh_thresh = _hh_params(cfg)
+    use_pallas = _resolve_pallas(cfg)
     key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
-           hh, hh_thresh)
+           hh, hh_thresh, use_pallas)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_sketch_step, limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                 iters=cfg.max_batch_admission_iters, weighted=weighted,
-                conservative=cu, hh=hh, hh_thresh=hh_thresh),
+                conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                use_pallas=use_pallas),
         donate_argnums=(0,))
     reset = jax.jit(
         partial(_sketch_reset, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
@@ -589,6 +641,85 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
         partial(_rollover, SW=SW, S=S), donate_argnums=(0,))
     _STEP_CACHE[key] = (step, reset, rollover)
     return step, reset, rollover
+
+
+def _resolve_pallas(cfg: Config, *, bucket: bool = False) -> bool:
+    """Static kernel selection for this config (ADR-011)."""
+    from ratelimiter_tpu.ops import pallas_sketch
+
+    return pallas_sketch.resolve_kernels(cfg, bucket=bucket) == "pallas"
+
+
+# ------------------------------------------------- hashed-operand steps
+#
+# The serving hot path stages ONE uint64 buffer per batch and the step
+# derives (h1, h2) ON DEVICE (ops/hashing.split_hash_dev) — the host
+# never runs per-key hash math after ingest (ADR-011). ``premix=True``
+# additionally applies the splitmix64 finalizer in-step: the raw-u64-id
+# wire lane (T_ALLOW_HASHED) ships tenant ids untouched and the device
+# does ALL the mixing.
+
+_HASHED_CACHE: Dict[tuple, Callable] = {}
+
+
+def _sketch_step_h64(state: State, h64, n, now_us, policy=None, *,
+                     seed: int, premix: bool, **step_kw):
+    from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
+
+    h = h64
+    if premix:
+        h = splitmix64_dev(h)
+    h1, h2 = split_hash_dev(h, seed)
+    return _sketch_step(state, h1, h2, n, now_us, policy, **step_kw)
+
+
+def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
+    """Jitted ``step(state, h64, n, now_us, policy)`` taking finalized
+    64-bit hashes (premix=False — string-key and pre-hashed traffic) or
+    raw u64 ids (premix=True — the hashed wire lane); memoized per static
+    config. Decision-identical to build_steps' (h1, h2) step by the
+    split_hash host/device bit-equality (tests/test_hashing_device.py)."""
+    ensure_x64()
+
+    W, sub_us, SW, S, limit = sketch_geometry(cfg)
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    from ratelimiter_tpu.core.types import Algorithm
+
+    weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+    cu = cfg.sketch.conservative_update
+    hh, hh_thresh = _hh_params(cfg)
+    use_pallas = _resolve_pallas(cfg)
+    seed = cfg.sketch.seed
+    key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
+           hh, hh_thresh, use_pallas, seed, premix)
+    cached = _HASHED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    step = jax.jit(
+        partial(_sketch_step_h64, seed=seed, premix=premix,
+                limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                iters=cfg.max_batch_admission_iters, weighted=weighted,
+                conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                use_pallas=use_pallas),
+        donate_argnums=(0,))
+    _HASHED_CACHE[key] = step
+    return step
+
+
+@jax.jit
+def pack_wire(allowed, remaining, retry, reset):
+    """Device-side response packing for the hashed wire lane (ADR-011):
+    the allow mask bit-packs to B/8 bytes and remaining/retry/reset ride
+    ONE (3B,) int64 array (floats bitcast), so resolve fetches two
+    compact buffers and the responder's frame build is three slice
+    memcpys — no per-request host math, no per-request Python objects."""
+    bits = _pack_bits(allowed)
+    words = jnp.concatenate([
+        remaining.astype(jnp.int64),
+        jax.lax.bitcast_convert_type(retry.astype(jnp.float64), jnp.int64),
+        jax.lax.bitcast_convert_type(reset.astype(jnp.float64), jnp.int64),
+    ])
+    return bits, words
 
 
 def _migrate_window(state: State, now_us, *, sub_o: int, SWo: int, So: int,
@@ -683,14 +814,16 @@ def build_scan(cfg: Config) -> Callable:
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
     hh, hh_thresh = _hh_params(cfg)
+    use_pallas = _resolve_pallas(cfg)
     key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
-           hh, hh_thresh)
+           hh, hh_thresh, use_pallas)
     cached = _SCAN_CACHE.get(key)
     if cached is not None:
         return cached
     step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                    iters=cfg.max_batch_admission_iters, weighted=weighted,
-                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                   use_pallas=use_pallas)
     scan = jax.jit(partial(_sketch_scan, step_kw=step_kw), donate_argnums=(0,))
     _SCAN_CACHE[key] = scan
     return scan

@@ -87,16 +87,28 @@ class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.merge = merge
         self.n_chips = int(np.prod(self.mesh.devices.shape))
-        # Replace the single-chip step with the mesh step; reset/rollover
-        # stay the plain replicated kernels (already built by super()).
-        self._step, self._reset_step, self._rollover = (
+        # Replace the single-chip step with the mesh step (hashed-operand
+        # form: the (h1, h2) split runs inside the shard_map'd body,
+        # ADR-011); reset/rollover stay the plain replicated kernels.
+        _, self._reset_step, self._rollover = (
             mesh_kernels.build_mesh_steps(self.config, self.mesh, merge))
+        self._step = mesh_kernels.build_mesh_hashed_step(
+            self.config, self.mesh, merge)
+        self._ids_step = None
         self._state = mesh_kernels.replicate_state(self._state, self.mesh)
+
+    def _build_ids_step(self):
+        return mesh_kernels.build_mesh_hashed_step(
+            self.config, self.mesh, self.merge, premix=True)
 
     def _apply_config(self, new_cfg):
         steps = mesh_kernels.build_mesh_steps(new_cfg, self.mesh, self.merge)
+        step = mesh_kernels.build_mesh_hashed_step(new_cfg, self.mesh,
+                                                   self.merge)
         with self._lock:
-            self._step, self._reset_step, self._rollover = steps
+            self._step = step
+            _, self._reset_step, self._rollover = steps
+            self._ids_step = None
 
     def _apply_window(self, new_cfg):
         """Dynamic window on a mesh: migrate the (replicated) ring with
@@ -105,8 +117,12 @@ class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
         single-chip kernels and drop the merge contract."""
         super()._apply_window(new_cfg)
         steps = mesh_kernels.build_mesh_steps(new_cfg, self.mesh, self.merge)
+        step = mesh_kernels.build_mesh_hashed_step(new_cfg, self.mesh,
+                                                   self.merge)
         with self._lock:
-            self._step, self._reset_step, self._rollover = steps
+            self._step = step
+            _, self._reset_step, self._rollover = steps
+            self._ids_step = None
             self._state = mesh_kernels.replicate_state(self._state, self.mesh)
 
 
@@ -124,9 +140,16 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.merge = merge
         self.n_chips = int(np.prod(self.mesh.devices.shape))
-        self._step, self._reset_step = mesh_kernels.build_mesh_bucket_steps(
+        _, self._reset_step = mesh_kernels.build_mesh_bucket_steps(
             self.config, self.mesh, merge)
+        self._step = mesh_kernels.build_mesh_hashed_bucket_step(
+            self.config, self.mesh, merge)
+        self._ids_step = None
         self._state = mesh_kernels.replicate_state(self._state, self.mesh)
+
+    def _build_ids_step(self):
+        return mesh_kernels.build_mesh_hashed_bucket_step(
+            self.config, self.mesh, self.merge, premix=True)
 
     def _apply_config(self, new_cfg):
         import jax.numpy as jnp
@@ -135,9 +158,13 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
 
         steps = mesh_kernels.build_mesh_bucket_steps(new_cfg, self.mesh,
                                                      self.merge)
+        step = mesh_kernels.build_mesh_hashed_bucket_step(
+            new_cfg, self.mesh, self.merge)
         cap = new_cfg.limit * _MICROS
         with self._lock:
-            self._step, self._reset_step = steps
+            self._step = step
+            _, self._reset_step = steps
+            self._ids_step = None
             self._state = dict(
                 self._state,
                 debt=jnp.minimum(self._state["debt"], cap),
@@ -153,8 +180,12 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
 
         steps = mesh_kernels.build_mesh_bucket_steps(new_cfg, self.mesh,
                                                      self.merge)
+        step = mesh_kernels.build_mesh_hashed_bucket_step(
+            new_cfg, self.mesh, self.merge)
         with self._lock:
-            self._step, self._reset_step = steps
+            self._step = step
+            _, self._reset_step = steps
+            self._ids_step = None
             self._window_us = _to_micros(new_cfg.window)
             self._state = dict(
                 self._state,

@@ -134,6 +134,106 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     return step, reset, rollover
 
 
+# ----------------------------------------------------- hashed-operand steps
+#
+# Mesh twins of sketch_kernels.build_hashed_step (ADR-011): the batch
+# shards carry ONE uint64 per key and the (h1, h2) split — plus, with
+# premix, the splitmix64 finalizer — runs inside the shard_map'd body
+# (elementwise, so sharding is preserved with no extra collective).
+
+_MESH_HASHED_CACHE: Dict[tuple, Callable] = {}
+
+
+def _hashed_body(body, seed: int, premix: bool, step_kw):
+    from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
+
+    def f(state, h64, n, now_us, policy):
+        h = splitmix64_dev(h64) if premix else h64
+        h1, h2 = split_hash_dev(h, seed)
+        return body(state, h1, h2, n, now_us, policy, step_kw=step_kw)
+
+    return f
+
+
+def build_mesh_hashed_step(cfg: Config, mesh: Mesh, merge: str = "gather",
+                           *, premix: bool = False) -> Callable:
+    """Jitted mesh ``step(state, h64, n, now_us, policy)`` — h64/n sharded
+    over AXIS, state and policy replicated (build_mesh_steps' contract)."""
+    if merge not in MERGE_MODES:
+        raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
+    W, sub_us, SW, S, limit = sketch_kernels.sketch_geometry(cfg)
+    from ratelimiter_tpu.core.types import Algorithm
+
+    d, w = cfg.sketch.depth, cfg.sketch.width
+    weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+    cu = cfg.sketch.conservative_update
+    hh, hh_thresh = sketch_kernels._hh_params(cfg)
+    seed = cfg.sketch.seed
+    mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
+    key = ("sketch", mesh_key, merge, limit, W, SW, d, w,
+           cfg.max_batch_admission_iters, weighted, cu, hh, hh_thresh,
+           seed, premix)
+    cached = _MESH_HASHED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                   iters=cfg.max_batch_admission_iters, weighted=weighted,
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
+    body = _gather_step if merge == "gather" else _delta_step
+
+    state_keys = ["cur", "slabs", "totals", "slab_period", "last_period"]
+    if hh:
+        state_keys += ["hh_owner", "hh_owner2", "hh_cur", "hh_slabs",
+                       "hh_totals", "hh_last"]
+    state_spec = {k: P() for k in state_keys}
+    policy_spec = {"key": P(), "limit": P()}
+    mapped = shard_map(
+        _hashed_body(body, seed, premix, step_kw),
+        mesh=mesh,
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(), policy_spec),
+        out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0,))
+    _MESH_HASHED_CACHE[key] = step
+    return step
+
+
+def build_mesh_hashed_bucket_step(cfg: Config, mesh: Mesh,
+                                  merge: str = "gather", *,
+                                  premix: bool = False) -> Callable:
+    """Bucket twin of build_mesh_hashed_step."""
+    from ratelimiter_tpu.ops import bucket_kernels
+
+    if merge not in MERGE_MODES:
+        raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
+    limit, num, den, d, w, iters = bucket_kernels._params(cfg)
+    seed = cfg.sketch.seed
+    mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
+    key = ("bucket", mesh_key, merge, limit, num, den, d, w, iters,
+           seed, premix)
+    cached = _MESH_HASHED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
+                   iters=iters)
+    body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
+    state_spec = {k: P() for k in ("debt", "acc", "rem", "last")}
+    policy_spec = {"key": P(), "limit": P()}
+    mapped = shard_map(
+        _hashed_body(body, seed, premix, step_kw),
+        mesh=mesh,
+        in_specs=(state_spec, P(AXIS), P(AXIS), P(), policy_spec),
+        out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0,))
+    _MESH_HASHED_CACHE[key] = step
+    return step
+
+
 # ------------------------------------------------------------ token bucket
 
 def _bucket_gather_step(state, h1, h2, n, now_us, policy, *, step_kw):

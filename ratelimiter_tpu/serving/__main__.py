@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--sketch-depth", type=int, default=4)
     ap.add_argument("--sketch-width", type=int, default=65536)
     ap.add_argument("--sub-windows", type=int, default=60)
+    ap.add_argument("--kernels", default="auto",
+                    choices=("auto", "pallas", "jnp"),
+                    help="sketch hot-loop kernels (ADR-011): fused Pallas "
+                         "TPU kernels, the jnp/XLA reference path, or "
+                         "auto (pallas on TPU, jnp elsewhere)")
     ap.add_argument("--max-batch", type=int, default=4096,
                     help="micro-batcher flush size")
     ap.add_argument("--max-delay-us", type=float, default=200.0,
@@ -253,6 +258,13 @@ def _prewarm(limiter, max_batch: int) -> None:
         size = min(size, max_batch)
         h = np.arange(size, dtype=np.uint64) + (1 << 62)
         limiter.allow_hashed(h, now=0.0)
+        from ratelimiter_tpu.observability.decorators import undecorated
+
+        if hasattr(undecorated(limiter), "allow_ids"):
+            # The hashed wire lane's premix step (splitmix64 in-jit,
+            # ADR-011) is a distinct compilation per shape — warm it too
+            # so the first ALLOW_HASHED frame never pays a compile.
+            limiter.allow_ids(h, now=0.0)
         if size >= max_batch:
             break
         size *= 2
@@ -293,7 +305,8 @@ async def amain(args) -> None:
         window=args.window,
         fail_open=args.fail_open,
         sketch=SketchParams(depth=args.sketch_depth, width=args.sketch_width,
-                            sub_windows=args.sub_windows),
+                            sub_windows=args.sub_windows,
+                            kernels=args.kernels),
         persistence=PersistenceSpec(
             dir=args.snapshot_dir,
             snapshot_interval=args.snapshot_interval,

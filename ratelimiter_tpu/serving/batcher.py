@@ -41,9 +41,20 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 from ratelimiter_tpu.algorithms.base import RateLimiter, check_key, check_n
-from ratelimiter_tpu.core.errors import StorageUnavailableError
-from ratelimiter_tpu.core.types import Result, fail_open_result
+from ratelimiter_tpu.core.errors import (
+    InvalidConfigError,
+    InvalidNError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import (
+    BatchResult,
+    Result,
+    batch_fail_open,
+    fail_open_result,
+)
 from ratelimiter_tpu.observability import metrics as m
 
 
@@ -101,6 +112,12 @@ class MicroBatcher:
         self._pipelined = bool(getattr(limiter, "pipelined", False)
                                and inflight > 1
                                and dispatch_timeout is None)
+        # Lane support is a property of the BACKEND, not the decorator
+        # stack (decorators delegate the whole raw-id surface, so a
+        # hasattr on the decorated limiter is always true).
+        from ratelimiter_tpu.observability.decorators import undecorated
+
+        self._hashed_lane = hasattr(undecorated(limiter), "allow_ids")
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rl-dispatch")
         if self._pipelined:
@@ -227,6 +244,117 @@ class MicroBatcher:
     async def submit(self, key: str, n: int = 1) -> Result:
         """Queue one decision; resolves when its batch's dispatch lands."""
         return await self.submit_nowait(key, n)
+
+    # ------------------------------------------------- hashed bulk lane
+
+    def submit_hashed_nowait(self, ids: np.ndarray,
+                             ns: np.ndarray) -> asyncio.Future:
+        """Queue one whole ALLOW_HASHED frame as its own dispatch (the
+        zero-copy bulk lane, ADR-011): the frame IS the batch — the raw
+        u64 ids stage straight into the limiter's pools (one memcpy) and
+        splitmix64 + the (h1, h2) split run on device inside the jitted
+        step. The future resolves to the frame's BatchResult. Rides the
+        SAME launch/resolve executors and in-flight window as the
+        coalesced string path, so pipelining, backpressure and FIFO state
+        threading are shared. Must run on the event loop thread; requires
+        a limiter exposing the raw-id lane (sketch-family backends)."""
+        if self._draining:
+            raise StorageUnavailableError("server is shutting down")
+        if not self._hashed_lane:
+            raise InvalidConfigError(
+                "the hashed bulk lane requires a sketch-family backend "
+                "(raw-id decisions need device-side hashing)")
+        if ids.shape[0] and int(ns.min()) <= 0:
+            raise InvalidNError("n must be a positive integer")
+        loop = asyncio.get_running_loop()
+        self._loop = loop
+        fut: asyncio.Future = loop.create_future()
+        if not ids.shape[0]:
+            # count == 0 frames are valid (empty RESULT_HASHED), no
+            # dispatch needed.
+            fut.set_result(BatchResult(
+                allowed=np.zeros(0, dtype=bool),
+                limit=self.limiter.config.limit,
+                remaining=np.zeros(0, dtype=np.int64),
+                retry_after=np.zeros(0, dtype=np.float64),
+                reset_at=np.zeros(0, dtype=np.float64)))
+            return fut
+        task = asyncio.ensure_future(self._dispatch_hashed(ids, ns, fut))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+        return fut
+
+    def _launch_hashed_work(self, ids, ns):
+        """Hashed-frame launch stage (launch executor thread): same
+        in-flight window as _launch_work; wire=True device-packs the
+        response buffers (sketch_kernels.pack_wire)."""
+        self._window.acquire()
+        t0 = time.perf_counter()
+        try:
+            ticket = self.limiter.launch_ids(ids, ns, wire=True)
+        except BaseException:
+            self._window.release()
+            raise
+        self._launch_hist.observe(time.perf_counter() - t0)
+        self._depth_add(1)
+        return ticket
+
+    async def _dispatch_hashed(self, ids, ns, fut: asyncio.Future) -> None:
+        b = int(ids.shape[0])
+        self._dispatch_batch.observe(float(b))
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        if self._pipelined and self._hashed_lane:
+            try:
+                ticket = await loop.run_in_executor(
+                    self._pool, self._launch_hashed_work, ids, ns)
+            except Exception as exc:
+                if not fut.done():
+                    fut.set_exception(exc)
+                return
+            work = loop.run_in_executor(self._resolve_pool,
+                                        self._resolve_work, ticket)
+        else:
+            work = loop.run_in_executor(
+                self._pool, lambda: self.limiter.allow_ids(ids, ns))
+        timed_out = False
+        try:
+            if self.dispatch_timeout is not None:
+                out = await asyncio.wait_for(
+                    asyncio.shield(work), self.dispatch_timeout)
+            else:
+                out = await work
+        except asyncio.TimeoutError:
+            timed_out = True
+        except Exception as exc:
+            if not fut.done():
+                fut.set_exception(exc)
+            return
+        finally:
+            self._dispatch_latency.observe(time.perf_counter() - t0)
+
+        if timed_out:
+            # Same SLO-breach policy as the string path (ADR-002 at the
+            # dispatch layer): answer NOW per fail-open/closed.
+            self._slo_breaches.inc()
+            cfg = self.limiter.config
+            if cfg.fail_open:
+                reset_at = self.limiter.clock.now() + float(cfg.window)
+                if not fut.done():
+                    fut.set_result(batch_fail_open(b, cfg.limit, reset_at))
+                self.decisions_total += b
+            else:
+                err = StorageUnavailableError(
+                    f"dispatch exceeded SLO "
+                    f"({self.dispatch_timeout * 1e3:.1f} ms)")
+                if not fut.done():
+                    fut.set_exception(err)
+            work.add_done_callback(lambda f: f.exception())
+            return
+
+        self.decisions_total += b
+        if not fut.done():
+            fut.set_result(out)
 
     # ------------------------------------------------------------- flush
 

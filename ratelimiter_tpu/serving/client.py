@@ -86,6 +86,18 @@ class Client:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
 
+    def allow_hashed(self, ids, ns=None):
+        """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
+        lane, ADR-011): columnar on the wire, hashed on device server-side;
+        returns the frame's BatchResult (frombuffer-view columns). The id
+        keyspace is disjoint from string keys; sketch-family servers only."""
+        req_id = next(self._ids)
+        type_, body = self._roundtrip(
+            p.encode_allow_hashed(req_id, ids, ns), req_id)
+        if type_ != p.T_RESULT_HASHED:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result_hashed(body)
+
     def reset(self, key: str) -> None:
         req_id = next(self._ids)
         type_, _ = self._roundtrip(p.encode_reset(req_id, key), req_id)
@@ -243,6 +255,17 @@ class AsyncClient:
         if type_ != p.T_RESULT_BATCH:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
+
+    async def allow_hashed(self, ids, ns=None):
+        """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
+        lane, ADR-011); returns the frame's BatchResult. Pipelines with
+        every other in-flight request on this connection."""
+        req_id = next(self._ids)
+        type_, body = await self._request(
+            p.encode_allow_hashed(req_id, ids, ns), req_id)
+        if type_ != p.T_RESULT_HASHED:
+            raise p.ProtocolError(f"unexpected response type {type_}")
+        return p.parse_result_hashed(body)
 
     async def reset(self, key: str) -> None:
         req_id = next(self._ids)

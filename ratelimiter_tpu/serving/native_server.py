@@ -39,7 +39,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 5
+_ABI = 6
 
 
 def _load_extension():
@@ -174,8 +174,12 @@ class NativeRateLimitServer:
         self._depth = 0
         self._depth_lock = threading.Lock()
 
-        # Sketch-family limiters expose the hashed fast path; detect once.
-        self._fast = hasattr(limiter, "allow_hashed")
+        # Sketch-family limiters expose the hashed fast path; detect once
+        # on the UNDECORATED backend (decorators delegate the whole
+        # hashed surface, so hasattr on the stack is always true).
+        from ratelimiter_tpu.observability.decorators import undecorated as _u
+
+        self._fast = hasattr(_u(limiter), "allow_hashed")
         prefix = limiter.config.prefix
         self._prefix_bytes = (f"{prefix}:".encode() if prefix else b"")
 
@@ -242,6 +246,13 @@ class NativeRateLimitServer:
             dcn=self._dcn if dcn else None,
             launch=self._launch if self._pipelined else None,
             resolve=self._resolve if self._pipelined else None,
+            # Hashed bulk lane (T_ALLOW_HASHED, ADR-011): the C++ door
+            # finalizes raw ids with splitmix64 on its io threads and
+            # hands COLUMNAR id/ns buffers straight to these callbacks —
+            # no blob, no offsets, no host hashing.
+            decide_hashed=self._decide_hashed if self._fast else None,
+            launch_hashed=(self._launch_hashed_cb
+                           if self._pipelined else None),
             inflight=inflight,
             dcn_auth_required=bool(dcn and dcn_secret),
             # Size to the DCN peer set: each peer holding a slab-sized
@@ -298,6 +309,40 @@ class NativeRateLimitServer:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         self._batch_hist.observe(float(b))
         return self._pack_result(out)
+
+    def _decide_hashed(self, shard: int, ids_b: bytes, ns_b: bytes):
+        """Hashed-lane blocking decide: the buffers are already finalized
+        u64 hashes (C++ splitmix64) — frombuffer views go straight into
+        allow_hashed's staging memcpy; zero host hash math."""
+        b = len(ids_b) // 8
+        lim = self._shard_limiters[shard]
+        try:
+            h64 = np.frombuffer(ids_b, dtype=np.uint64)
+            ns = np.frombuffer(ns_b, dtype=np.int64)
+            with self._locks[shard]:
+                out = lim.allow_hashed(h64, ns)
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        self._batch_hist.observe(float(b))
+        return self._pack_result(out)
+
+    def _launch_hashed_cb(self, shard: int, ids_b: bytes, ns_b: bytes):
+        """Hashed-lane launch phase (pipelined): stage + enqueue without
+        blocking; resolves through the same _resolve completer path."""
+        t0 = time.perf_counter()
+        lim = self._shard_limiters[shard]
+        try:
+            h64 = np.frombuffer(ids_b, dtype=np.uint64)
+            ns = np.frombuffer(ns_b, dtype=np.int64)
+            with self._locks[shard]:
+                ticket = lim.launch_hashed(h64, ns)
+        except Exception as exc:
+            raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        with self._depth_lock:
+            self._depth += 1
+            self._inflight_gauge.set(float(self._depth))
+        self._launch_hist.observe(time.perf_counter() - t0)
+        return ticket
 
     def _launch(self, shard: int, blob: bytes, offsets_b: bytes,
                 lengths_b: bytes, ns_b: bytes):
@@ -377,6 +422,16 @@ class NativeRateLimitServer:
             h ^= b
             h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
         return h % n_shards
+
+    def shard_of_id(self, raw_id: int) -> int:
+        """Python mirror of the C++ hashed-lane router (server.cpp
+        T_ALLOW_HASHED parse): finalized splitmix64(id) mod shards."""
+        n_shards = len(self._shard_limiters)
+        if n_shards == 1:
+            return 0
+        from ratelimiter_tpu.ops.hashing import splitmix64
+
+        return int(splitmix64(np.asarray([raw_id], np.uint64))[0] % n_shards)
 
     def decide_one(self, key: str, n: int = 1):
         """Single-key decision routed to the key's dispatch shard — the

@@ -24,6 +24,15 @@ Requests:
                      one frame, many decisions (the client-side batching
                      analog of Redis pipelining; decisions still coalesce
                      with every other connection in the micro-batcher)
+    ALLOW_HASHED (11): u32 count | u64 ids[count] | u32 ns[count] —
+                     the zero-copy bulk lane (ADR-011): COLUMNAR raw
+                     u64 key ids, parsed as np.frombuffer views and
+                     staged with one memcpy; splitmix64 + the (h1, h2)
+                     split run on device inside the jitted step. Only
+                     sketch-family backends serve it (E_INVALID_CONFIG
+                     elsewhere). The id keyspace is disjoint from the
+                     string-key space; RESET/POLICY address string keys
+                     only.
     POLICY_SET  (7): u8 flags (bit0 has_limit), i64 limit,
                      f64 window_scale, u16 key_len, key utf-8 —
                      tiered per-key override (policy engine)
@@ -55,6 +64,15 @@ Responses:
                     too (found=1 iff an override existed)
     SNAPSHOT (135): u64 snapshot_id, u64 wal_seq (the watermark the
                     snapshot captured), f64 duration_s
+    RESULT_HASHED (136): u8 batch_flags (bit1 fail_open, whole-batch),
+                    i64 limit (the DEFAULT limit, as in RESULT_BATCH),
+                    u32 count, u8 allowed_bits[ceil(count/8)]
+                    (little-endian bit order), then COLUMNAR
+                    i64 remaining[count] | f64 retry[count] |
+                    f64 reset[count]. The response shape the device
+                    packs directly (sketch_kernels.pack_wire): the
+                    server's encode is slice memcpys, the client's
+                    parse is np.frombuffer views.
     ERROR    (255): u16 code, u16 msg_len, msg utf-8; for ALLOW_BATCH an
                     error response covers the whole frame
 
@@ -95,6 +113,7 @@ T_POLICY_SET = 7
 T_POLICY_GET = 8
 T_POLICY_DEL = 9
 T_SNAPSHOT = 10
+T_ALLOW_HASHED = 11
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
@@ -107,6 +126,7 @@ T_METRICS_R = 132
 T_RESULT_BATCH = 133
 T_POLICY_R = 134
 T_SNAPSHOT_R = 135
+T_RESULT_HASHED = 136
 T_ERROR = 255
 
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
@@ -320,6 +340,108 @@ def parse_result_batch(body: bytes):
                           remaining=remaining, retry_after=retry,
                           reset_at=reset, fail_open=bool(flags & 2)))
     return out
+
+
+# ---------------------------------------------- hashed bulk lane (ADR-011)
+
+_HASHED_HEAD = _U32                        # count
+_HASHED_RES_HEAD = struct.Struct("<BqI")   # batch_flags, limit, count
+
+
+def encode_allow_hashed(req_id: int, ids, ns=None) -> bytes:
+    """Columnar raw-u64-id frame: the bulk lane's request encode is two
+    array ``tobytes`` calls — no per-request packing."""
+    import numpy as np
+
+    ids = np.ascontiguousarray(ids, dtype="<u8")
+    if ns is None:
+        ns_arr = np.ones(ids.shape[0], dtype="<u4")
+    else:
+        ns_arr = np.ascontiguousarray(ns, dtype="<u4")
+    if ns_arr.shape[0] != ids.shape[0]:
+        raise ValueError("ids and ns must have equal length")
+    body = (_HASHED_HEAD.pack(ids.shape[0]) + ids.tobytes()
+            + ns_arr.tobytes())
+    return _HDR.pack(1 + 8 + len(body), T_ALLOW_HASHED, req_id) + body
+
+
+def parse_allow_hashed(body: bytes):
+    """-> (ids uint64, ns uint32): zero-copy np.frombuffer VIEWS into the
+    frame body — no per-request Python objects anywhere on this path
+    (the columnar layout exists exactly so this is possible)."""
+    import numpy as np
+
+    if len(body) < 4:
+        raise ProtocolError("short ALLOW_HASHED body")
+    (count,) = _HASHED_HEAD.unpack_from(body)
+    if len(body) != 4 + 12 * count:
+        raise ProtocolError(
+            f"bad ALLOW_HASHED body ({len(body)}B for count={count})")
+    ids = np.frombuffer(body, dtype="<u8", count=count, offset=4)
+    ns = np.frombuffer(body, dtype="<u4", count=count,
+                       offset=4 + 8 * count)
+    return ids, ns
+
+
+def encode_result_hashed(req_id: int, res) -> bytes:
+    """Columnar response from a BatchResult. Wire-lane results arrive
+    DEVICE-packed (BatchResult.wire_packed, sketch_kernels.pack_wire) and
+    frame with four slice memcpys — the allow mask is never re-packed on
+    the host; results without packed buffers (fail-open, pre-resolved,
+    client-constructed) take the np.packbits path."""
+    import numpy as np
+
+    b = len(res)
+    flags = 2 if res.fail_open else 0
+    wp = getattr(res, "wire_packed", None)
+    if wp is not None:
+        bits_arr, words, padded = wp
+        nb = (b + 7) // 8
+        bits = bytearray(bits_arr[:nb].tobytes())
+        if b & 7 and nb:
+            # Zero the pad rows' bits in the final partial byte so the
+            # frame bytes are deterministic (pad rows can read allowed).
+            bits[-1] &= (1 << (b & 7)) - 1
+        body = (_HASHED_RES_HEAD.pack(flags, res.limit, b) + bytes(bits)
+                + words[:b].tobytes()
+                + words[padded:padded + b].tobytes()
+                + words[2 * padded:2 * padded + b].tobytes())
+        return _HDR.pack(1 + 8 + len(body), T_RESULT_HASHED, req_id) + body
+    bits = np.packbits(np.asarray(res.allowed, dtype=bool),
+                       bitorder="little")
+    body = (_HASHED_RES_HEAD.pack(flags, res.limit, b)
+            + bits.tobytes()
+            + np.ascontiguousarray(res.remaining, dtype="<i8").tobytes()
+            + np.ascontiguousarray(res.retry_after, dtype="<f8").tobytes()
+            + np.ascontiguousarray(res.reset_at, dtype="<f8").tobytes())
+    return _HDR.pack(1 + 8 + len(body), T_RESULT_HASHED, req_id) + body
+
+
+def parse_result_hashed(body: bytes):
+    """-> BatchResult with frombuffer-view columns (client side)."""
+    import numpy as np
+
+    from ratelimiter_tpu.core.types import BatchResult
+
+    if len(body) < _HASHED_RES_HEAD.size:
+        raise ProtocolError("short RESULT_HASHED body")
+    flags, limit, count = _HASHED_RES_HEAD.unpack_from(body)
+    nb = (count + 7) // 8
+    off = _HASHED_RES_HEAD.size
+    if len(body) != off + nb + 24 * count:
+        raise ProtocolError(
+            f"bad RESULT_HASHED body ({len(body)}B for count={count})")
+    bits = np.frombuffer(body, dtype=np.uint8, count=nb, offset=off)
+    allowed = np.unpackbits(bits, bitorder="little")[:count].astype(bool)
+    off += nb
+    remaining = np.frombuffer(body, dtype="<i8", count=count, offset=off)
+    off += 8 * count
+    retry = np.frombuffer(body, dtype="<f8", count=count, offset=off)
+    off += 8 * count
+    reset = np.frombuffer(body, dtype="<f8", count=count, offset=off)
+    return BatchResult(allowed=allowed, limit=limit, remaining=remaining,
+                       retry_after=retry, reset_at=reset,
+                       fail_open=bool(flags & 2))
 
 
 @dataclass

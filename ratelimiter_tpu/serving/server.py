@@ -138,6 +138,13 @@ class RateLimitServer:
             else:
                 write_out(p.encode_result(req_id, fut.result()))
 
+        def complete_hashed(req_id: int, fut: asyncio.Future) -> None:
+            exc = fut.exception()
+            if exc is not None:
+                write_out(p.encode_error(req_id, p.code_for(exc), str(exc)))
+            else:
+                write_out(p.encode_result_hashed(req_id, fut.result()))
+
         try:
             while True:
                 try:
@@ -162,6 +169,20 @@ class RateLimitServer:
                                                  str(exc)))
                         continue
                     fut.add_done_callback(partial(complete_allow, req_id))
+                    continue
+                if type_ == p.T_ALLOW_HASHED:
+                    # Zero-copy bulk lane (ADR-011): columnar frombuffer
+                    # views straight off the frame body, one dispatch per
+                    # frame, splitmix64/split_hash on device — no
+                    # per-request Python objects between socket and step.
+                    try:
+                        ids, ns = p.parse_allow_hashed(body)
+                        fut = self.batcher.submit_hashed_nowait(ids, ns)
+                    except Exception as exc:
+                        write_out(p.encode_error(req_id, p.code_for(exc),
+                                                 str(exc)))
+                        continue
+                    fut.add_done_callback(partial(complete_hashed, req_id))
                     continue
                 if type_ == p.T_ALLOW_BATCH:
                     try:

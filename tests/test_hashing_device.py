@@ -1,0 +1,160 @@
+"""Host/device hashing equivalence — fuzz-pinned bit-exactness (ADR-011).
+
+The hashed hot path now splits every batch's u64 hashes into (h1, h2)
+INSIDE the jitted step (ops/hashing.split_hash_dev), and the raw-id wire
+lane finalizes with splitmix64 either on device (asyncio door,
+premix=True) or in C++ (native door io threads). Four implementations of
+the same two functions therefore coexist — host NumPy, device jnp, C++
+(server.cpp), and whatever hash_strings_u64 feeds them — and ANY drift
+re-keys every sketch silently. This suite fuzzes random unicode keys and
+raw ids through every pairing and requires bit-exact agreement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu.ops.hashing import (
+    hash_strings_u64,
+    split_hash,
+    split_hash_dev,
+    splitmix64,
+    splitmix64_dev,
+)
+
+SEEDS = [0, 1, 0x5BD1E995, 0xFFFFFFFF]
+
+
+def _random_unicode_keys(rng, n):
+    pools = [
+        lambda: "".join(chr(rng.integers(0x20, 0x7F)) for _ in range(
+            rng.integers(1, 24))),
+        lambda: "".join(chr(rng.integers(0x80, 0x800)) for _ in range(
+            rng.integers(1, 12))),
+        lambda: "".join(chr(rng.integers(0x4E00, 0x9FFF)) for _ in range(
+            rng.integers(1, 8))),
+        lambda: "🔑" * int(rng.integers(1, 5)) + str(rng.integers(1 << 30)),
+    ]
+    return [pools[int(rng.integers(len(pools)))]() for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def jit_twins():
+    import jax
+    import jax.numpy as jnp
+
+    mix = jax.jit(splitmix64_dev)
+
+    def split(seed):
+        @jax.jit
+        def f(h):
+            return split_hash_dev(h, seed)
+
+        return f
+
+    return mix, split, jnp
+
+
+def test_splitmix64_host_device_bit_exact(jit_twins):
+    mix, _, jnp = jit_twins
+    rng = np.random.default_rng(7)
+    ids = np.concatenate([
+        rng.integers(0, 1 << 63, size=512, dtype=np.uint64),
+        np.array([0, 1, (1 << 64) - 1, 0x9E3779B97F4A7C15], np.uint64),
+    ])
+    np.testing.assert_array_equal(np.asarray(mix(jnp.asarray(ids))),
+                                  splitmix64(ids))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_split_hash_host_device_bit_exact(jit_twins, seed):
+    _, split, jnp = jit_twins
+    rng = np.random.default_rng(seed + 11)
+    h64 = rng.integers(0, 1 << 63, size=512, dtype=np.uint64) * np.uint64(3)
+    want1, want2 = split_hash(h64, seed)
+    got1, got2 = split(seed)(jnp.asarray(h64))
+    np.testing.assert_array_equal(np.asarray(got1), want1)
+    np.testing.assert_array_equal(np.asarray(got2), want2)
+    assert (np.asarray(got2) & 1).all()  # h2 odd: full-width strides
+
+
+@pytest.mark.parametrize("seed", [0, 0x5BD1E995])
+def test_unicode_keys_end_to_end(jit_twins, seed):
+    """String keys -> native/fallback bulk hash -> host split vs device
+    split: the exact path a sketch decision takes, fuzzz over unicode."""
+    _, split, jnp = jit_twins
+    rng = np.random.default_rng(23)
+    keys = _random_unicode_keys(rng, 256)
+    h64 = hash_strings_u64(keys)
+    want1, want2 = split_hash(h64, seed)
+    got1, got2 = split(seed)(jnp.asarray(h64))
+    np.testing.assert_array_equal(np.asarray(got1), want1)
+    np.testing.assert_array_equal(np.asarray(got2), want2)
+
+
+def test_native_hasher_agrees_with_fallback_on_unicode():
+    """hash_strings_u64 (C++ when available) vs the NumPy twin, over the
+    same fuzzed unicode keys — the native half of the wire contract."""
+    from ratelimiter_tpu.native import hash_packed, pack_keys
+    from ratelimiter_tpu.native.fallback import hash_packed_numpy
+
+    rng = np.random.default_rng(31)
+    keys = _random_unicode_keys(rng, 256)
+    buf, offsets, lengths = pack_keys(keys)
+    np.testing.assert_array_equal(
+        hash_packed(buf, offsets, lengths),
+        hash_packed_numpy(buf, offsets, lengths,
+                          __import__("ratelimiter_tpu.native",
+                                     fromlist=["DEFAULT_SEED"]).DEFAULT_SEED))
+
+
+def test_cpp_door_splitmix_matches_host():
+    """The C++ door finalizes raw wire ids with its own splitmix64
+    (server.cpp). Scalar transcription of that code must equal the NumPy
+    host (and by the tests above, the device) implementation."""
+    M64 = (1 << 64) - 1
+
+    def cpp_splitmix64(x: int) -> int:  # server.cpp, line for line
+        x = (x + 0x9E3779B97F4A7C15) & M64
+        x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & M64
+        x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & M64
+        return x ^ (x >> 31)
+
+    rng = np.random.default_rng(41)
+    ids = np.concatenate([
+        rng.integers(0, 1 << 63, size=256, dtype=np.uint64),
+        np.array([0, 1, (1 << 64) - 1], np.uint64),
+    ])
+    want = splitmix64(ids)
+    for raw, w in zip(ids.tolist(), want.tolist()):
+        assert cpp_splitmix64(raw) == w
+
+
+def test_raw_id_lane_equals_prefinalized_lane():
+    """allow_ids(raw) (device-side splitmix64+split) must decide exactly
+    like allow_hashed(splitmix64(raw)) (host finalize, device split) —
+    the asyncio door and the C++ door feed the same sketch cells."""
+    from ratelimiter_tpu import Algorithm, Config, SketchParams
+    from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+    from ratelimiter_tpu.core.clock import ManualClock
+
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=4, window=6.0,
+                 sketch=SketchParams(depth=3, width=128, sub_windows=6))
+    a = SketchLimiter(cfg, ManualClock(1_000_000.0))
+    b = SketchLimiter(cfg, ManualClock(1_000_000.0))
+    try:
+        rng = np.random.default_rng(3)
+        for _ in range(8):
+            ids = rng.integers(1, 40, size=64).astype(np.uint64)
+            ra = a.allow_ids(ids)
+            rb = b.allow_hashed(splitmix64(ids))
+            np.testing.assert_array_equal(ra.allowed, rb.allowed)
+            np.testing.assert_array_equal(ra.remaining, rb.remaining)
+            np.testing.assert_array_equal(ra.retry_after, rb.retry_after)
+            np.testing.assert_array_equal(ra.reset_at, rb.reset_at)
+            a.clock.advance(0.7)
+            b.clock.advance(0.7)
+    finally:
+        a.close()
+        b.close()
