@@ -21,8 +21,9 @@ test-all:        ## full suite including slow accuracy/scale gates
 test-serving:    ## serving tier only
 	$(PY) -m pytest tests/test_serving.py -q
 
-test-mesh:       ## mesh contract + multichip tests only
-	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py -q
+test-mesh:       ## mesh contract + multichip + slice-parallel serving tests
+	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py \
+	    tests/test_mesh_serving.py -q
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py
@@ -58,4 +59,5 @@ verify:          ## driver protocol: entry() compile + 8-device mesh dry run
 clean:           ## remove caches and build artifacts
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true
 	rm -f ratelimiter_tpu/native/_hasher.so ratelimiter_tpu/native/_hasher_r*.so
+	rm -f ratelimiter_tpu/native/_server.so ratelimiter_tpu/native/_server_r*.so
 	rm -rf .pytest_cache

@@ -181,6 +181,165 @@ def measure_snapshot_overhead(snapshot_interval: float, *,
     }
 
 
+def measure_mesh_step_rate(n_devices: int, *, seconds: float = 2.0,
+                           batch: int = 16384, window: int = 4,
+                           depth: int = 4, width: int = 1 << 16,
+                           sub_windows: int = 60) -> float:
+    """Aggregate per-device serving dispatch rate of the slice-parallel
+    mesh backend (ADR-012): one thread per device slice drives its own
+    pinned limiter through the REAL launch/resolve serving path
+    (staging pools, in-step hashing, device-side finish kernels) with a
+    ``window``-deep per-device in-flight chain. Decisions/s summed over
+    devices. Importable — tests/test_mesh_serving.py runs it tiny as the
+    CI scaling smoke."""
+    import threading
+
+    from ratelimiter_tpu import (
+        Algorithm as _Algorithm,
+        Config as _Config,
+        SketchParams as _SketchParams,
+    )
+    from ratelimiter_tpu.parallel.limiter import build_slices
+
+    cfg = _Config(
+        algorithm=_Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+        max_batch_admission_iters=1,
+        sketch=_SketchParams(depth=depth, width=width,
+                             sub_windows=sub_windows,
+                             conservative_update=True))
+    slices = build_slices(cfg, n_devices=n_devices)
+    rng = np.random.default_rng(0)
+    frames = [np.asarray(rng.integers(1, 1 << 40, size=batch), np.uint64)
+              for _ in range(4)]
+    for s in slices:
+        s.allow_hashed(frames[0])  # compile outside the timed window
+    counts = [0] * n_devices
+    barrier = threading.Barrier(n_devices + 1)
+
+    def drive(i: int) -> None:
+        s = slices[i]
+        barrier.wait()
+        stop = time.perf_counter() + seconds
+        tickets = [s.launch_hashed(frames[j % 4]) for j in range(window)]
+        k = 0
+        while time.perf_counter() < stop:
+            s.resolve(tickets.pop(0))
+            counts[i] += batch
+            tickets.append(s.launch_hashed(frames[k % 4]))
+            k += 1
+        for t in tickets:
+            s.resolve(t)
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(n_devices)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    for s in slices:
+        s.close()
+    return sum(counts) / elapsed
+
+
+def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
+                         e2e_seconds: float = 0.0, batch: int = 16384,
+                         log=lambda *a: None) -> dict:
+    """The multichip_scaling curve (ISSUE-5): device-step and e2e serving
+    rates of the sliced mesh backend at each device count. e2e rows
+    (``e2e_seconds > 0``) spawn a real ``--backend mesh --native`` server
+    per point and drive it with the C++ loadgen's hashed lane in
+    shard-affine mode (consistent-hash-LB traffic — the shape that
+    scales; one mixed-traffic row at the max count rides along for
+    honesty). Per-row ``e2e_device_gap`` = device step rate over the e2e
+    served rate at the SAME device count."""
+    rows = []
+    loadgen = None
+    td = None
+    if e2e_seconds > 0:
+        import shutil
+        import tempfile
+
+        if shutil.which("g++"):
+            from benchmarks.e2e import _build_loadgen
+
+            td = tempfile.mkdtemp()
+            try:
+                loadgen = _build_loadgen(td)
+            except Exception:
+                loadgen = None
+    try:
+        for n in device_counts:
+            row = {"n_devices": int(n)}
+            rate = measure_mesh_step_rate(n, seconds=seconds, batch=batch)
+            row["device_step_decisions_per_sec"] = round(rate, 1)
+            if e2e_seconds > 0 and loadgen is not None:
+                from benchmarks.e2e import run_mesh_loadgen
+
+                try:
+                    e2e = run_mesh_loadgen(n, seconds=e2e_seconds,
+                                           affine=True, loadgen=loadgen)
+                    if "error" in e2e:
+                        raise RuntimeError(e2e["error"])
+                    row["e2e_decisions_per_sec"] = e2e["decisions_per_sec"]
+                    row["e2e_frame_p50_ms"] = e2e["frame_p50_ms"]
+                    row["e2e_frame_p99_ms"] = e2e["frame_p99_ms"]
+                    row["e2e_device_gap"] = round(
+                        rate / max(float(e2e["decisions_per_sec"]), 1.0), 2)
+                except Exception as exc:
+                    row["e2e_error"] = str(exc)[:200]
+            rows.append(row)
+            log(f"mesh n={n}: device_step "
+                f"{row['device_step_decisions_per_sec']:.0f}/s"
+                + (f" e2e {row['e2e_decisions_per_sec']:.0f}/s"
+                   if "e2e_decisions_per_sec" in row else ""))
+        out = {
+            "backend": "mesh (slice-parallel serving tier, ADR-012: "
+                       "device-pinned slices, hash-routed keys, "
+                       "collective-free decide path)",
+            "device_batch": batch,
+            "rows": rows,
+        }
+        first, last = rows[0], rows[-1]
+        out["device_step_speedup"] = round(
+            last["device_step_decisions_per_sec"]
+            / max(first["device_step_decisions_per_sec"], 1.0), 2)
+        if "e2e_decisions_per_sec" in first and \
+                "e2e_decisions_per_sec" in last:
+            out["e2e_speedup"] = round(
+                float(last["e2e_decisions_per_sec"])
+                / max(float(first["e2e_decisions_per_sec"]), 1.0), 2)
+            out["e2e_harness"] = (
+                "cpp_loadgen hashed lane, 8 shard-affine conns x 8 "
+                "pipelined 2048-id frames (consistent-hash LB traffic "
+                "shape); server: --native --inflight 1 --max-batch 16384 "
+                "--max-delay-us 1000")
+        if e2e_seconds > 0 and loadgen is not None:
+            from benchmarks.e2e import run_mesh_loadgen
+
+            try:
+                mixed = run_mesh_loadgen(int(device_counts[-1]),
+                                         seconds=e2e_seconds, affine=False,
+                                         loadgen=loadgen)
+                out["e2e_mixed_decisions_per_sec_at_max"] = (
+                    mixed.get("decisions_per_sec", 0.0))
+                out["e2e_mixed_note"] = (
+                    "mixed frames fan out over every device and fork-join "
+                    "across their queues — latency-coupled on the CPU "
+                    "mesh; shard the keyspace at the LB (affine rows) to "
+                    "realize slice-parallel throughput")
+            except Exception as exc:
+                out["e2e_mixed_error"] = str(exc)[:200]
+        return out
+    finally:
+        if td is not None:
+            import shutil
+
+            shutil.rmtree(td, ignore_errors=True)
+
+
 def measure_host_phases(B: int = INGEST_BATCH, reps: int = 30) -> dict:
     """Per-frame host-phase breakdown (ISSUE-4 satellite): microseconds a
     server's host CPU spends per B-key frame in each phase — parse
@@ -260,7 +419,23 @@ def main() -> None:
     ap.add_argument("--inflight", type=int, default=8, metavar="N",
                     help="pipelined dispatch window for the phase-D "
                          "server (1 = the old synchronous path)")
+    ap.add_argument("--mesh-devices", type=int, default=None, metavar="N",
+                    help="also sweep the slice-parallel mesh backend "
+                         "(ADR-012) at n=1,2,4,..,N devices and emit the "
+                         "multichip_scaling curve (device step rate + e2e "
+                         "serving rate per count). On CPU this forces N "
+                         "virtual host devices")
     args = ap.parse_args()
+
+    if args.mesh_devices:
+        # Must land before the first jax.devices() call initializes the
+        # backend; on real accelerators the flag only affects the (then
+        # unused) host platform. Spawned e2e servers inherit it via env.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.mesh_devices}").strip()
 
     platform = jax.devices()[0].platform
     on_accel = platform != "cpu"
@@ -512,6 +687,22 @@ def main() -> None:
             serving_rps / max(float(e2e["e2e_server_decisions_per_sec"]),
                               1.0), 2)
 
+    # -------------------------------------- phase F: multichip scaling
+    # (opt-in, --mesh-devices N): the slice-parallel mesh backend's
+    # scaling curve — device step rate and e2e served rate at each
+    # device count, plus the per-count e2e_device_gap (ISSUE-5). The
+    # single-device JSON schema above is unchanged; this adds one key.
+    mesh_block: dict = {}
+    if args.mesh_devices:
+        avail = len(jax.devices())
+        counts = [1]
+        while counts[-1] * 2 <= min(args.mesh_devices, avail):
+            counts.append(counts[-1] * 2)
+        mesh_block = {"multichip_scaling": measure_mesh_scaling(
+            counts, seconds=float(os.environ.get("BENCH_MESH_SECONDS", "3")),
+            e2e_seconds=4.0,
+            log=lambda msg: print(msg, file=sys.stderr, flush=True))}
+
     # ------------------------------------------ phase E: durability cost
     snap_overhead: dict = {}
     if args.snapshot_interval is not None:
@@ -578,6 +769,7 @@ def main() -> None:
         "sketch_geometry": {"depth": cfg.sketch.depth, "width": cfg.sketch.width,
                             "sub_windows": 60, "conservative_update": True},
         **e2e,
+        **mesh_block,
         **snap_overhead,
     }))
 

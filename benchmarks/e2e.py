@@ -45,12 +45,15 @@ def _free_port() -> int:
 def _spawn_server(backend: str, *, platform: Optional[str] = None,
                   max_batch: int = 4096, max_delay_us: float = 500.0,
                   native: bool = False, shards: int = 1,
-                  inflight: int = 8):
+                  inflight: int = 8, mesh_devices: Optional[int] = None,
+                  extra_env: Optional[Dict[str, str]] = None):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
     if platform:
         env["JAX_PLATFORMS"] = platform
+    if extra_env:
+        env.update(extra_env)
     port = _free_port()
     algo = "sliding_window" if backend == "exact" else "tpu_sketch"
     proc = subprocess.Popen(
@@ -62,7 +65,9 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
          "--inflight", str(inflight),
          "--port", str(port)]
         + (["--native"] if native else [])
-        + (["--shards", str(shards)] if shards > 1 else []),
+        + (["--shards", str(shards)] if shards > 1 else [])
+        + (["--mesh-devices", str(mesh_devices)]
+           if mesh_devices is not None else []),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline()  # blocks until "serving ..." banner
     if "serving" not in line:
@@ -214,6 +219,71 @@ def _run_native_loadgen(*, seconds: float, log=print,
     row["server_inflight"] = inflight
     log(f"e2e native+native (inflight={inflight}): "
         f"{row['decisions_per_sec']:.0f}/s")
+    return row
+
+
+def _build_loadgen(td: str) -> str:
+    binary = os.path.join(td, "rltpu_loadgen")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(REPO, "clients", "cpp", "loadgen.cpp"),
+         "-o", binary, "-pthread"],
+        check=True, capture_output=True, timeout=180)
+    return binary
+
+
+def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
+                     affine: bool = True, loadgen: Optional[str] = None,
+                     platform: Optional[str] = None) -> Dict:
+    """One measured point of the slice-parallel serving curve (ADR-012):
+    a real ``--backend mesh --native`` server over ``n_devices`` pinned
+    slices, driven by the C++ loadgen's zero-copy hashed lane.
+
+    ``affine=True`` pins each connection's ids to one dispatch shard
+    (splitmix64(id) % n == conn % n) — the traffic shape a
+    consistent-hash LB produces in front of a sliced mesh, and the shape
+    that scales: frames complete independently per device. affine=False
+    sends mixed frames (every frame fans out over all devices and
+    fork-joins across their queues — latency-coupled, reported for
+    honesty). The server always routes every id itself either way.
+
+    ``--inflight 1`` (synchronous per-shard dispatch): on the CPU mesh
+    the jitted step executes synchronously inside launch, so pipelining
+    only fragments coalesced batches across window slots; each device's
+    dispatcher thread blocking in its own decide IS the parallelism
+    (the GIL is released while the device computes)."""
+    import json
+    import shutil
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return {"error": "no g++"}
+    with tempfile.TemporaryDirectory() as td:
+        binary = loadgen or _build_loadgen(td)
+        proc, port = _spawn_server(
+            "mesh", platform=platform, native=True, max_batch=16384,
+            max_delay_us=1000.0, inflight=1, mesh_devices=n_devices)
+        try:
+            # 16 conns x 8 x 2048 ids = 262K in flight: enough offered
+            # load to keep EIGHT devices' coalescers at max_batch depth
+            # (thin queues half-fill the per-device batches and flatten
+            # the top of the scaling curve).
+            args = [binary, "127.0.0.1", str(port), str(seconds), "16", "8",
+                    "2048", "1000000", "hashed"]
+            if affine:
+                args.append(str(n_devices))
+            out = subprocess.run(args, capture_output=True, text=True,
+                                 timeout=seconds + 120)
+            row = json.loads(out.stdout.strip())
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    row["n_devices"] = n_devices
+    row["traffic"] = ("shard-affine (consistent-hash LB shape)"
+                      if affine else "mixed (per-frame fan-out + join)")
     return row
 
 

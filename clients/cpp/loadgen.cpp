@@ -8,10 +8,18 @@
 // percentiles.
 //
 // Usage: rltpu_loadgen <host> <port> <seconds> <threads> <inflight>
-//                      <keys_per_frame> <n_keys> [mode]
+//                      <keys_per_frame> <n_keys> [mode] [affine_shards]
 // mode: "batch" (default, string ALLOW_BATCH frames) or "hashed"
 // (columnar raw-u64-id ALLOW_HASHED frames — the zero-copy bulk lane,
 // ADR-011).
+// affine_shards (hashed mode only, default 0 = off): each connection's
+// ids are drawn so they all route to ONE dispatch shard
+// (splitmix64(id) % affine_shards == thread % affine_shards) — the
+// traffic shape a consistent-hash LB produces in front of a
+// slice-parallel mesh deployment (ADR-012). The server still routes
+// every id itself; affinity only means a frame never fans out across
+// shards, so frames complete independently instead of fork-joining
+// across every device's queue.
 // Output: one JSON line.
 
 #include <algorithm>
@@ -35,6 +43,16 @@ double now_s() {
       .count();
 }
 
+// splitmix64 finalizer — BIT-IDENTICAL to ops/hashing.splitmix64 and the
+// server's router (native/server.cpp): affine mode must agree with the
+// door's per-id shard routing or the affinity is silently lost.
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
 struct Shared {
   std::atomic<uint64_t> completed{0};
   std::atomic<uint64_t> allowed{0};
@@ -46,7 +64,7 @@ struct Shared {
 // Raw pipelined driver: hand-rolled frames on one socket (the Client
 // class is strictly request/response; pipelining needs direct IO).
 void worker(const char* host, int port, int inflight, int frame_keys,
-            int n_keys, int wid, bool hashed, Shared* sh) {
+            int n_keys, int wid, bool hashed, int affine, Shared* sh) {
   // The Client class is strictly request/response; pipelining needs
   // direct socket IO, so the frames are hand-rolled here.
   struct addrinfo hints {
@@ -82,10 +100,18 @@ void worker(const char* host, int port, int inflight, int frame_keys,
     uint32_t count = (uint32_t)frame_keys;
     body.append((char*)&count, 4);
     if (hashed) {
-      // Columnar raw-id frame (ADR-011): u64 ids then u32 ns.
+      // Columnar raw-id frame (ADR-011): u64 ids then u32 ns. With
+      // affinity, rejection-sample until the id routes to this
+      // connection's shard (the consistent-hash-LB traffic shape;
+      // expected `affine` draws per id, LCG draws are ~free).
       for (int i = 0; i < frame_keys; ++i) {
-        rng = rng * 1664525u + 1013904223u;
-        uint64_t id64 = rng % (unsigned)n_keys;
+        uint64_t id64;
+        do {
+          rng = rng * 1664525u + 1013904223u;
+          id64 = rng % (unsigned)n_keys;
+        } while (affine > 0 &&
+                 splitmix64(id64) % (uint64_t)affine !=
+                     (uint64_t)(wid % affine));
         body.append((char*)&id64, 8);
       }
       uint32_t n = 1;
@@ -187,10 +213,11 @@ void worker(const char* host, int port, int inflight, int frame_keys,
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc != 8 && argc != 9) {
+  if (argc < 8 || argc > 10) {
     std::fprintf(stderr,
                  "usage: %s <host> <port> <seconds> <threads> <inflight> "
-                 "<keys_per_frame> <n_keys> [batch|hashed]\n",
+                 "<keys_per_frame> <n_keys> [batch|hashed] "
+                 "[affine_shards]\n",
                  argv[0]);
     return 2;
   }
@@ -201,7 +228,8 @@ int main(int argc, char** argv) {
   int inflight = atoi(argv[5]);
   int frame_keys = atoi(argv[6]);
   int n_keys = atoi(argv[7]);
-  bool hashed = argc == 9 && std::strcmp(argv[8], "hashed") == 0;
+  bool hashed = argc >= 9 && std::strcmp(argv[8], "hashed") == 0;
+  int affine = (argc == 10 && hashed) ? atoi(argv[9]) : 0;
 
   Shared sh;
   double warmup = 1.0;
@@ -211,7 +239,7 @@ int main(int argc, char** argv) {
   std::vector<std::thread> ts;
   for (int i = 0; i < threads; ++i)
     ts.emplace_back(worker, host, port, inflight, frame_keys, n_keys, i,
-                    hashed, &sh);
+                    hashed, affine, &sh);
   for (auto& t : ts) t.join();
 
   double span = seconds;

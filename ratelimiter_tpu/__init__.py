@@ -34,6 +34,7 @@ from ratelimiter_tpu.core.config import (
     Config,
     SketchParams,
     DenseParams,
+    MeshSpec,
     PersistenceSpec,
     DEFAULT_PREFIX,
 )
@@ -59,6 +60,7 @@ __all__ = [
     "Config",
     "SketchParams",
     "DenseParams",
+    "MeshSpec",
     "PersistenceSpec",
     "DEFAULT_PREFIX",
     "RateLimiterError",

@@ -307,6 +307,15 @@ class RateLimiter(abc.ABC):
         with lock:
             return fn()
 
+    def sub_limiters(self) -> "list[RateLimiter]":
+        """The independent dispatch units inside this limiter: ``[self]``
+        for every single-backend limiter; composite limiters (the sliced
+        mesh, ADR-012) return their per-device slices. Serving surfaces
+        that must touch EVERY unit — per-slice DCN pushers, DCN receive
+        merges, prewarm, the /healthz accuracy envelope — iterate this
+        seam instead of duck-typing composite internals."""
+        return [self]
+
     # -- durability (checkpoint / async snapshot seam) ---------------------
 
     def capture_state(self):

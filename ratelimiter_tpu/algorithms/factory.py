@@ -9,6 +9,10 @@ the algorithm (Config.algorithm) and the state backend:
 * ``dense``  — JAX device arrays, slot-addressed exact state, batched kernels.
 * ``sketch`` — count-min sketch + sub-window decay on device; approximate,
   unbounded keys (the BASELINE.json north star).
+* ``mesh``   — slice-parallel serving over every visible device (ADR-012):
+  one device-pinned sketch (or sketched token-bucket) slice per chip, keys
+  hash-routed to their owning slice, decide path collective-free. Cap the
+  device count via ``Config.mesh.devices`` or the ``n_devices`` kwarg.
 """
 
 from __future__ import annotations
@@ -21,7 +25,7 @@ from ratelimiter_tpu.core.errors import InvalidConfigError
 from ratelimiter_tpu.core.types import Algorithm
 from ratelimiter_tpu.algorithms.base import RateLimiter
 
-BACKENDS = ("exact", "dense", "sketch")
+BACKENDS = ("exact", "dense", "sketch", "mesh")
 
 
 def create_limiter(
@@ -49,4 +53,8 @@ def create_limiter(
         from ratelimiter_tpu.algorithms.sketch import SketchLimiter
 
         return SketchLimiter(config, clock, **kwargs)
+    if backend == "mesh":
+        from ratelimiter_tpu.parallel.limiter import SlicedMeshLimiter
+
+        return SlicedMeshLimiter(config, clock, **kwargs)
     raise InvalidConfigError(f"unknown backend {backend!r}; expected one of {BACKENDS}")

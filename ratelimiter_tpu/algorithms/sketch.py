@@ -51,8 +51,17 @@ def _pad_size(n: int) -> int:
 
 
 class SketchLimiter(RateLimiter):
-    def __init__(self, config: Config, clock: Optional[Clock] = None):
+    def __init__(self, config: Config, clock: Optional[Clock] = None, *,
+                 device=None):
+        """``device`` pins this limiter's state (and every staged batch)
+        to one specific ``jax.Device`` instead of the process default —
+        the slice seam of the slice-parallel serving tier (ADR-012,
+        parallel/limiter.py): computation follows the committed state
+        buffers, so N pinned limiters dispatch to N devices concurrently
+        with no collective and no cross-device traffic. None keeps the
+        default-device behavior bit-for-bit."""
         super().__init__(config, clock)
+        self._device = device
         from ratelimiter_tpu.ops import sketch_kernels
 
         # The serving step takes ONE uint64 operand per key: the (h1, h2)
@@ -66,7 +75,7 @@ class SketchLimiter(RateLimiter):
         # Lazy premix variant for the raw-u64-id wire lane (launch_ids):
         # splitmix64 ALSO runs in-step there.
         self._ids_step = None
-        self._state = sketch_kernels.init_state(self.config)
+        self._state = self._pin_state(sketch_kernels.init_state(self.config))
         self._window_us = to_micros(self.config.window)
         self._sub_us = sketch_kernels.sketch_geometry(self.config)[1]
         self._seed = self.config.sketch.seed
@@ -176,10 +185,25 @@ class SketchLimiter(RateLimiter):
         """Device batch size for b requests; subclasses align to mesh shape."""
         return _pad_size(b)
 
+    def _pin_state(self, state):
+        """Commit freshly-built state to the pinned device (no-op without
+        one): every later step follows these buffers, so a pinned limiter
+        never touches another slice's device."""
+        if self._device is None:
+            return state
+        import jax
+
+        return {k: jax.device_put(v, self._device) for k, v in state.items()}
+
     def _place(self, arr: np.ndarray):
-        """Host->device placement hook; mesh subclass shards over chips."""
+        """Host->device placement hook; mesh subclass shards over chips,
+        a pinned slice commits to its own device."""
         import jax.numpy as jnp
 
+        if self._device is not None:
+            import jax
+
+            return jax.device_put(arr, self._device)
         return jnp.asarray(arr)
 
     def _init_staging(self) -> None:
@@ -620,6 +644,10 @@ class SketchLimiter(RateLimiter):
         """Placement for inputs of replicated (non-sharded) computations."""
         import jax.numpy as jnp
 
+        if self._device is not None:
+            import jax
+
+            return jax.device_put(arr, self._device)
         return jnp.asarray(arr)
 
     def _reset(self, key: str) -> None:
@@ -725,12 +753,21 @@ class SketchLimiter(RateLimiter):
         """Replace device state with the snapshot at ``path``. Catch-up for
         elapsed time is automatic: the next dispatch's rollover sweep (or
         token-bucket decay) advances the restored state to 'now'."""
-        import jax
-
         from ratelimiter_tpu.checkpoint import load_state
 
         self._check_open()
         arrays, meta = load_state(path, self._CKPT_KIND, self.config)
+        self._restore_loaded(arrays, meta, label=path)
+
+    def _restore_loaded(self, arrays, meta, *,
+                        label: str = "snapshot") -> None:
+        """Apply already-loaded-and-validated snapshot arrays (the body
+        of restore(); the sliced mesh limiter feeds each slice its own
+        sub-dictionary of one combined snapshot — parallel/limiter.py).
+        ``label`` names the source in error messages (the path, or
+        path[sliceN] for a combined mesh snapshot)."""
+        import jax
+
         with self._lock:
             # Overrides ride the snapshot (policy_* columns; absent in
             # older checkpoints -> empty table).
@@ -745,7 +782,7 @@ class SketchLimiter(RateLimiter):
                 from ratelimiter_tpu.core.errors import CheckpointError
 
                 raise CheckpointError(
-                    f"{path}: state arrays {sorted(arrays)} != expected "
+                    f"{label}: state arrays {sorted(arrays)} != expected "
                     f"{sorted(self._state)}")
             # Preserve each buffer's placement (single-device or mesh-
             # replicated NamedSharding) — restore works identically for
@@ -791,14 +828,16 @@ class SketchTokenBucketLimiter(SketchLimiter):
     #: future exchange are unaffected).
     _CKPT_OPTIONAL = ("acc",)
 
-    def __init__(self, config: Config, clock: Optional[Clock] = None):
+    def __init__(self, config: Config, clock: Optional[Clock] = None, *,
+                 device=None):
         RateLimiter.__init__(self, config, clock)
+        self._device = device
         from ratelimiter_tpu.ops import bucket_kernels
 
         _, self._reset_step = bucket_kernels.build_steps(self.config)
         self._step = bucket_kernels.build_hashed_step(self.config)
         self._ids_step = None
-        self._state = bucket_kernels.init_state(self.config)
+        self._state = self._pin_state(bucket_kernels.init_state(self.config))
         self._window_us = to_micros(self.config.window)
         self._seed = self.config.sketch.seed
         self._lock = threading.Lock()
@@ -865,9 +904,10 @@ class SketchTokenBucketLimiter(SketchLimiter):
             self._step = step
             _, self._reset_step = steps
             self._ids_step = None
-            self._state = dict(self._state,
-                               debt=jnp.minimum(self._state["debt"], cap),
-                               rem=jnp.asarray(0, jnp.int64))
+            self._state = dict(
+                self._state,
+                debt=jnp.minimum(self._state["debt"], cap),
+                rem=self._place_replicated(np.asarray(0, np.int64)))
 
     def _apply_window(self, new_cfg: Config) -> None:
         """Dynamic window for the debt sketch: the window only sets the
@@ -876,8 +916,6 @@ class SketchTokenBucketLimiter(SketchLimiter):
         as the token-form backends). The decay remainder is denominated
         in the old rate fraction, so it resets (forfeits < 1 micro-token
         toward denying)."""
-        import jax.numpy as jnp
-
         from ratelimiter_tpu.ops import bucket_kernels
 
         steps = bucket_kernels.build_steps(new_cfg)
@@ -887,7 +925,9 @@ class SketchTokenBucketLimiter(SketchLimiter):
             _, self._reset_step = steps
             self._ids_step = None
             self._window_us = to_micros(new_cfg.window)
-            self._state = dict(self._state, rem=jnp.asarray(0, jnp.int64))
+            self._state = dict(
+                self._state,
+                rem=self._place_replicated(np.asarray(0, np.int64)))
 
     def _launch_finish(self, outs, now_us: int):
         """Token-bucket result assembly, on device: retry-after = deficit /

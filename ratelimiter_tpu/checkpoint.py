@@ -57,12 +57,17 @@ def config_fingerprint(config: Config) -> str:
     reason (ADR-011): the Pallas/jnp selection changes WHICH compiled
     kernels decide, not what the state means — the two paths are pinned
     bit-identical, so a snapshot taken under either must restore under
-    the other. Every OTHER field participates — changing this function's
+    the other. ``mesh`` (slice-parallel placement, ADR-012) is excluded
+    too: the device count is where state lives, not what it means — the
+    per-slice-count refusal lives in SlicedMeshLimiter.restore, which
+    can NAME the mismatch instead of reporting an opaque fingerprint
+    diff. Every OTHER field participates — changing this function's
     output strands every existing snapshot, which is why
     tests/test_checkpoint.py pins a golden value.
     """
     fields = asdict(config)
     fields.pop("persistence", None)
+    fields.pop("mesh", None)
     if isinstance(fields.get("sketch"), dict):
         fields["sketch"].pop("kernels", None)
     payload = json.dumps(

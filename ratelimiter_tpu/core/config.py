@@ -304,6 +304,34 @@ class PersistenceSpec:
 
 
 @dataclass(frozen=True)
+class MeshSpec:
+    """Slice-parallel serving deployment (``--backend mesh``, ADR-012).
+
+    ``devices`` caps how many visible accelerator devices the sliced mesh
+    limiter spans (None = all of them). Each device holds an independent,
+    device-pinned single-chip limiter slice; the serving tier routes every
+    key to its owning slice by hash, so the decide path is collective-free
+    and per-key decisions are bit-identical to a single-device limiter.
+
+    Deliberately EXCLUDED from the checkpoint config fingerprint: the
+    device count is a *placement* property, not state geometry — but a
+    sliced snapshot still refuses to restore onto a different slice count
+    (each slice's counters are only meaningful under the routing that
+    produced them; SlicedMeshLimiter.restore raises CheckpointError).
+    """
+
+    #: Devices to span (None = every visible device; must be >= 1).
+    devices: Optional[int] = None
+
+    def validate(self) -> None:
+        if self.devices is not None and (
+                not isinstance(self.devices, int) or self.devices < 1):
+            raise InvalidConfigError(
+                f"mesh devices must be a positive integer or None, "
+                f"got {self.devices!r}")
+
+
+@dataclass(frozen=True)
 class DenseParams:
     """Geometry of the dense (exact, slot-addressed) device backend."""
 
@@ -339,6 +367,10 @@ class Config:
         persistence: durability subsystem knobs (WAL + async snapshots;
             disabled unless ``persistence.dir`` is set). NOT part of the
             checkpoint fingerprint — operational, not state geometry.
+        mesh: slice-parallel serving placement (``--backend mesh``,
+            ADR-012). NOT part of the checkpoint fingerprint (placement,
+            not geometry); slice-count mismatches are refused separately
+            on restore.
     """
 
     algorithm: Algorithm
@@ -351,6 +383,7 @@ class Config:
     dense: DenseParams = field(default_factory=DenseParams)
     policy: PolicySpec = field(default_factory=PolicySpec)
     persistence: PersistenceSpec = field(default_factory=PersistenceSpec)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
 
     def validate(self) -> None:
         """Reference ``Config.Validate`` (``config.go:16-50``), same bounds."""
@@ -373,6 +406,7 @@ class Config:
         self.dense.validate()
         self.policy.validate()
         self.persistence.validate()
+        self.mesh.validate()
 
     def with_defaults(self) -> "Config":
         """Non-mutating defaulting (reference ``config.go:54-67``): returns a

@@ -48,6 +48,15 @@
 // CPU harness, shards=1 is fastest. Keys are routed by FNV-1a, so
 // per-key semantics are exact regardless.
 //
+// Slice-parallel serving (--backend mesh, ADR-012) mounts one
+// DEVICE-PINNED limiter slice per shard, making this shard router the
+// shard->device router: each shard's dispatcher+completer pair drives
+// its own chip's pipelined launch/resolve chain and the decide path is
+// collective-free. The Python callbacks release the GIL while their
+// device drains (jax blocks_until_ready), so N shards genuinely overlap
+// N devices. stats()["shard_decisions"] exposes the per-shard (and so
+// per-device) decision counts for balance monitoring.
+//
 // The Python side (serving/native_server.py) supplies three callbacks:
 //   decide(blob, offsets, lengths, ns) -> (flags, remaining, retry,
 //       reset_at, limit)            [bytes in, buffer-protocol out]
@@ -264,6 +273,10 @@ struct Server {
   }
   std::atomic<bool> draining{false};
   std::atomic<uint64_t> decisions{0};
+  // Per-shard decision counts (mesh mode: per-DEVICE; bounded by the
+  // num_shards <= 64 cap). Routing-balance observability for the
+  // slice-parallel serving tier (ADR-012).
+  std::atomic<uint64_t> shard_decisions[64]{};
   std::atomic<uint64_t> slo_breaches{0};
   double started_at = 0.0;
 
@@ -470,6 +483,7 @@ void send_policy_answers(Server* s, const std::vector<Pending>& items) {
                             retry.data(), reset.data(), count);
         conn_send(s, p.conn, std::move(out));
         s->decisions.fetch_add(count);
+        s->shard_decisions[0].fetch_add(count);  // SLO => single shard
         continue;
       }
       if (!p.is_batch) {
@@ -496,6 +510,7 @@ void send_policy_answers(Server* s, const std::vector<Pending>& items) {
         conn_send(s, p.conn, std::move(out));
       }
       s->decisions.fetch_add(p.keys.size());
+      s->shard_decisions[0].fetch_add(p.keys.size());  // SLO => one shard
     } else {
       conn_send(s, p.conn,
                 make_error(p.req_id, E_STORAGE_UNAVAILABLE,
@@ -796,6 +811,7 @@ void completer_main(Server* s, uint32_t shard) {
     r.total = e.total;
     if (r.err_code == 0) {
       s->decisions.fetch_add(r.total);
+      s->shard_decisions[shard].fetch_add(r.total);
       // Gated on the launch-time epoch: this dispatch's limit is stale
       // relative to any set_limits push issued since it launched.
       s->refresh_limit(r.limit, e.limit_epoch);
@@ -933,6 +949,7 @@ bool run_decide(Server* s, std::vector<Pending>& items,
   }
   if (ok) {
     s->decisions.fetch_add(r.total);
+    s->shard_decisions[0].fetch_add(r.total);  // SLO path: single shard
     if (r.total) s->refresh_limit(r.limit, ep);
   }
   emit_reply(s, items, r);
@@ -1015,6 +1032,7 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
                    : decide_core(s, shard, group, r);
   if (ok) {
     s->decisions.fetch_add(r.total);
+    s->shard_decisions[shard].fetch_add(r.total);
     if (r.total) s->refresh_limit(r.limit, dep);
   }
   r.items = std::move(group);
@@ -1750,13 +1768,29 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     std::lock_guard<std::mutex> g(pq->mx);
     depth += pq->entries.size();
   }
-  return Py_BuildValue(
-      "{s:K,s:K,s:d,s:K,s:I,s:O}", "decisions_total",
+  PyObject* per_shard = PyList_New(ps->s->num_shards);
+  if (per_shard == nullptr) return nullptr;
+  for (uint32_t i = 0; i < ps->s->num_shards; ++i) {
+    PyObject* v = PyLong_FromUnsignedLongLong(
+        (unsigned long long)ps->s->shard_decisions[i].load());
+    if (v == nullptr) {
+      Py_DECREF(per_shard);
+      return nullptr;
+    }
+    PyList_SET_ITEM(per_shard, i, v);
+  }
+  PyObject* out = Py_BuildValue(
+      "{s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O}", "decisions_total",
       (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
       (unsigned long long)ps->s->slo_breaches.load(), "uptime_s",
       now_s() - ps->s->started_at, "inflight_depth",
       (unsigned long long)depth, "inflight_window", ps->s->inflight_window,
-      "pipelined", ps->s->pipelined ? Py_True : Py_False);
+      "pipelined", ps->s->pipelined ? Py_True : Py_False,
+      // Shard routing observability (mesh mode: one shard == one
+      // device, so this is the per-device decision balance, ADR-012).
+      "num_shards", ps->s->num_shards, "shard_decisions", per_shard);
+  Py_DECREF(per_shard);  // Py_BuildValue "O" took its own reference
+  return out;
 }
 
 PyObject* server_set_limits(PyObject* self, PyObject* args) {
@@ -1931,7 +1965,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 6; }
+int64_t rl_server_abi_version() { return 7; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

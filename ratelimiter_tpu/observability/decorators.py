@@ -185,6 +185,34 @@ class LimiterDecorator(RateLimiter):
     def save(self, path: str) -> None:
         self.inner.save(path)
 
+    # Policy overrides: delegate wholesale rather than running the base
+    # implementation against a delegated ``_policy_table`` — backends
+    # that OVERRIDE the policy surface instead of owning a table (the
+    # sliced mesh limiter fans every mutation out to its device slices,
+    # ADR-012) must keep their semantics under any decorator stack.
+
+    def set_override(self, key: str, limit: Optional[int] = None, *,
+                     window_scale: float = 1.0):
+        return self.inner.set_override(key, limit,
+                                       window_scale=window_scale)
+
+    def get_override(self, key: str):
+        return self.inner.get_override(key)
+
+    def delete_override(self, key: str) -> bool:
+        return self.inner.delete_override(key)
+
+    def list_overrides(self):
+        return self.inner.list_overrides()
+
+    def override_count(self) -> int:
+        return self.inner.override_count()
+
+    def sub_limiters(self):
+        # The dispatch units live on the backend (a composite returns
+        # its slices); the base impl would wrongly answer [decorator].
+        return self.inner.sub_limiters()
+
     # Pass-through for backend extras (allow_hashed, inject_failure, ...) --
 
     def __getattr__(self, name: str):

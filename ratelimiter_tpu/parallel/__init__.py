@@ -23,10 +23,21 @@ Two merge modes (ratelimiter_tpu/parallel/mesh_kernels.py):
 
 Multi-host note: both collectives compile identically over DCN-connected
 meshes (jax.distributed); cadence over DCN is the accuracy/bandwidth knob.
+
+Serving note (ADR-012): the serving tier's ``--backend mesh`` uses the
+third deployment in this package — ``SlicedMeshLimiter``, one independent
+device-pinned limiter per chip with hash routing in the front door — so
+the decide path is collective-free and throughput scales with the slice.
+The collective limiters above remain the tool for un-routable workloads.
 """
 
 from ratelimiter_tpu.parallel.mesh import make_mesh, mesh_axis
-from ratelimiter_tpu.parallel.limiter import MeshSketchLimiter, MeshTokenBucketLimiter
+from ratelimiter_tpu.parallel.limiter import (
+    MeshSketchLimiter,
+    MeshTokenBucketLimiter,
+    SlicedMeshLimiter,
+    build_slices,
+)
 from ratelimiter_tpu.parallel.dcn import (
     DcnMirrorGroup,
     export_completed,
@@ -39,6 +50,8 @@ __all__ = [
     "DcnMirrorGroup",
     "MeshSketchLimiter",
     "MeshTokenBucketLimiter",
+    "SlicedMeshLimiter",
+    "build_slices",
     "export_completed",
     "export_debt",
     "make_mesh",

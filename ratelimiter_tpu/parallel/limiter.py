@@ -1,24 +1,37 @@
-"""MeshSketchLimiter — the multi-chip flagship limiter.
+"""Multi-chip limiters: the collective mesh tier and the sliced serving tier.
 
-Same RateLimiter contract and Config as the single-chip SketchLimiter
-(algorithms/sketch.py); the difference is deployment: the request batch is
-sharded over a ``jax.sharding.Mesh`` and the sketch state is replicated on
-every chip, kept coherent by the collectives in parallel/mesh_kernels.py.
+Two complementary multi-device deployments share this module:
+
+* ``MeshSketchLimiter`` / ``MeshTokenBucketLimiter`` — the collective
+  tier: state replicated on every chip of a ``jax.sharding.Mesh``, the
+  request batch sharded positionally, coherence via the all_gather/psum
+  merge modes in parallel/mesh_kernels.py. Any chip may see any key; a
+  decision pays a collective, never a network RTT.
+
+* ``SlicedMeshLimiter`` — the slice-parallel SERVING tier (ADR-012,
+  ``--backend mesh``): one independent, device-pinned single-chip limiter
+  per device, and every key routed to its owning slice by hash. The
+  decide path is COLLECTIVE-FREE — no cross-chip traffic at all — so
+  serving throughput scales with the slice, and each key's decisions are
+  bit-identical to a single-device limiter (the oracle property the
+  serving tier tests pin). The gather/delta merge modes above remain the
+  background-reconciliation story for workloads that cannot route.
 
 This is the capability analog of the reference's Redis Cluster scale-out
-(``docs/ARCHITECTURE.md:199-219``) with the opposite data placement: the
-reference shards *state* and moves every request to the owning node; here
-state is replicated and only compact write-deltas (or the compact request
-shards, in gather mode) cross ICI. A decision never pays a network RTT.
+(``docs/ARCHITECTURE.md:199-219``): the sliced tier shards *state* by key
+ownership exactly as Redis Cluster shards its keyspace — but the routing
+hop happens in the serving front door (C++ shard router / host hash), not
+as a per-decision network RTT.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ratelimiter_tpu.algorithms.base import RateLimiter
 from ratelimiter_tpu.algorithms.sketch import (
     SketchLimiter,
     SketchTokenBucketLimiter,
@@ -26,6 +39,8 @@ from ratelimiter_tpu.algorithms.sketch import (
 )
 from ratelimiter_tpu.core.clock import Clock
 from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.core.errors import CheckpointError
+from ratelimiter_tpu.core.types import Algorithm, BatchResult, DispatchTicket
 from ratelimiter_tpu.parallel import mesh_kernels
 from ratelimiter_tpu.parallel.mesh import make_mesh
 
@@ -190,3 +205,418 @@ class MeshTokenBucketLimiter(_MeshPlacement, SketchTokenBucketLimiter):
             self._state = dict(
                 self._state,
                 rem=self._place_replicated(jnp.asarray(0, jnp.int64)))
+
+
+# ===================================================================
+#                      slice-parallel serving tier
+# ===================================================================
+
+def build_slices(config: Config, clock: Optional[Clock] = None, *,
+                 n_devices: Optional[int] = None,
+                 devices: Optional[Sequence] = None) -> List[SketchLimiter]:
+    """One device-pinned single-chip limiter per device (the slices of
+    ``SlicedMeshLimiter``; the native front door mounts them directly as
+    its dispatch shards, ADR-012). Token-bucket configs get the sketched
+    token bucket, everything else the windowed sketch — the same
+    algorithm selection as ``create_limiter(backend="sketch")``."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    n = n_devices if n_devices is not None else config.mesh.devices
+    if n is not None:
+        if n < 1:
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"mesh needs at least 1 device, got {n}")
+        if n > len(devices):
+            from ratelimiter_tpu.core.errors import InvalidConfigError
+
+            raise InvalidConfigError(
+                f"mesh wants {n} devices but only {len(devices)} are "
+                f"visible (XLA_FLAGS=--xla_force_host_platform_device_"
+                f"count=N on CPU)")
+        devices = list(devices)[:n]
+    cls = (SketchTokenBucketLimiter
+           if config.algorithm is Algorithm.TOKEN_BUCKET else SketchLimiter)
+    return [cls(config, clock, device=d) for d in devices]
+
+
+class MeshDispatchTicket(DispatchTicket):
+    """Composite ticket for one frame split across slices.
+
+    ``subs`` holds (slice_index, positions, slice_ticket) triples;
+    resolve() scatters each slice's results back to the frame's original
+    positions. A frame fully owned by one slice skips the split (its
+    slice ticket passes through, preserving the device-packed wire
+    buffers). ``DispatchTicket.meta`` stays free for the decorator stack
+    (the circuit breaker parks judgment state there)."""
+
+    __slots__ = ("subs",)
+
+    def __init__(self, result=None):
+        super().__init__(result)
+        self.subs = None
+
+
+class SlicedMeshLimiter(RateLimiter):
+    """Slice-parallel serving limiter (``--backend mesh``, ADR-012).
+
+    One independent single-chip limiter (windowed sketch or sketched
+    token bucket, per ``config.algorithm``) is pinned to each of the
+    mesh's devices; every key is routed to its OWNING slice by hash:
+
+    * pre-hashed keys (``allow_hashed``/``launch_hashed``): owner =
+      ``h64 % n_slices``;
+    * raw u64 ids (``allow_ids``/``launch_ids``): owner =
+      ``splitmix64(id) % n_slices`` — the same router the native door's
+      T_ALLOW_HASHED parse applies, so both surfaces agree;
+    * string keys: hashed exactly as the single-chip limiter hashes them
+      (prefix + hash_strings_u64), then the ``h64`` rule.
+
+    The decide path is collective-free: a frame is partitioned host-side
+    (one ``argsort`` over the owner vector), each touched slice gets one
+    independent pipelined dispatch on its own device, and results scatter
+    back to frame order at resolve. Per-key decisions are therefore
+    BIT-IDENTICAL to a single-device limiter fed that key's traffic —
+    the oracle property tests/test_mesh_serving.py pins. Cross-slice
+    consistency needs none: slices share no keys by construction.
+
+    The collective MeshSketchLimiter (replicated state, gather/delta
+    merges) remains the right tool when requests CANNOT be routed (any
+    chip may see any key); see the module docstring and ADR-012 §4.
+    """
+
+    pipelined = True
+
+    def __init__(self, config: Config, clock: Optional[Clock] = None, *,
+                 n_devices: Optional[int] = None,
+                 devices: Optional[Sequence] = None):
+        super().__init__(config, clock)
+        self.slices = build_slices(self.config, self.clock,
+                                   n_devices=n_devices, devices=devices)
+        self.n_slices = len(self.slices)
+        self._CKPT_KIND = f"mesh:{self.slices[0]._CKPT_KIND}"
+        self._seed = self.config.sketch.seed
+
+    # ------------------------------------------------------------ routing
+
+    def _hash(self, keys: List[str]) -> np.ndarray:
+        """Prefix + hash exactly as the slices do (slice 0 is the
+        canonical implementation; all slices share one config)."""
+        return self.slices[0]._hash(list(keys))
+
+    def owner_of_hash(self, h64: np.ndarray) -> np.ndarray:
+        """Owning slice index per finalized u64 hash."""
+        return (np.asarray(h64, np.uint64)
+                % np.uint64(self.n_slices)).astype(np.int64)
+
+    def owner_of_id(self, ids: np.ndarray) -> np.ndarray:
+        """Owning slice index per RAW u64 id (the hashed wire lane):
+        finalize with splitmix64 first, exactly like the native door's
+        per-id shard router (server.cpp T_ALLOW_HASHED parse)."""
+        from ratelimiter_tpu.ops.hashing import splitmix64
+
+        return self.owner_of_hash(splitmix64(np.asarray(ids, np.uint64)))
+
+    def owner_of_key(self, key: str) -> int:
+        return int(self.owner_of_hash(self._hash([key]))[0])
+
+    # ----------------------------------------------------- split dispatch
+
+    def _launch_split(self, arrays: np.ndarray, ns: np.ndarray,
+                      owners: np.ndarray, now: float, *,
+                      premix: bool, wire: bool) -> MeshDispatchTicket:
+        """Partition one frame by owning slice and launch one pipelined
+        dispatch per touched slice. ``arrays`` holds finalized hashes
+        (premix=False) or raw ids (premix=True — the slice finalizes
+        in-step). Single-owner frames pass through unsplit, preserving
+        the slice ticket's device-packed wire buffers."""
+        b = int(arrays.shape[0])
+
+        def sub_launch(lim, a, n_arr):
+            if premix:
+                return lim.launch_ids(a, n_arr, now=now, wire=wire)
+            return lim.launch_hashed(a, n_arr, now=now)
+
+        first = int(owners[0]) if b else 0
+        if b == 0 or self.n_slices == 1 or not np.any(owners != first):
+            t = MeshDispatchTicket()
+            t.subs = [(first, None, sub_launch(self.slices[first],
+                                               arrays, ns))]
+            t.b = b
+            t.limit = self.config.limit
+            return t
+        # One argsort partitions the whole frame; per-slice position
+        # arrays come out contiguous (stable sort keeps frame order
+        # within a slice, so same-key sequencing inside the frame is
+        # preserved — a key's requests all land on its slice in order).
+        order = np.argsort(owners, kind="stable")
+        sorted_owners = owners[order]
+        bounds = np.searchsorted(sorted_owners, np.arange(self.n_slices + 1))
+        t = MeshDispatchTicket()
+        t.subs = []
+        for s in range(self.n_slices):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            if lo == hi:
+                continue
+            pos = order[lo:hi]
+            t.subs.append((s, pos, sub_launch(self.slices[s],
+                                              arrays[pos], ns[pos])))
+        t.b = b
+        t.limit = self.config.limit
+        return t
+
+    def resolve(self, ticket: DispatchTicket) -> BatchResult:
+        """Resolve every slice dispatch and scatter results back to the
+        frame's original positions. Failure semantics across slices are
+        non-transactional, the same contract as the native door's
+        multi-shard frames: a fail-closed error on one slice fails the
+        frame, but other slices' quota stands; fail-open slices answer
+        fail-open and the frame's flag ORs over slices."""
+        if ticket.result is not None:
+            return ticket.result
+        subs = getattr(ticket, "subs", None)
+        if subs is None:
+            from ratelimiter_tpu.core.errors import RateLimiterError
+
+            raise RateLimiterError(  # pragma: no cover - misuse guard
+                "foreign ticket reached SlicedMeshLimiter.resolve")
+        if len(subs) == 1 and subs[0][1] is None:
+            s, _, sub = subs[0]
+            res = self.slices[s].resolve(sub)
+            ticket.result = res
+            return res
+        b = ticket.b
+        allowed = np.zeros(b, dtype=bool)
+        remaining = np.zeros(b, dtype=np.int64)
+        retry = np.zeros(b, dtype=np.float64)
+        reset_at = np.zeros(b, dtype=np.float64)
+        limits = None
+        fail_open = False
+        err = None
+        for s, pos, sub in subs:
+            try:
+                res = self.slices[s].resolve(sub)
+            except Exception as exc:  # fail-closed slice: finish the rest
+                err = err if err is not None else exc
+                continue
+            allowed[pos] = res.allowed
+            remaining[pos] = res.remaining
+            retry[pos] = res.retry_after
+            reset_at[pos] = res.reset_at
+            fail_open = fail_open or res.fail_open
+            if res.limits is not None:
+                if limits is None:
+                    limits = np.full(b, self.config.limit, dtype=np.int64)
+                limits[pos] = res.limits
+        if err is not None:
+            raise err
+        res = BatchResult(allowed=allowed, limit=self.config.limit,
+                          remaining=remaining, retry_after=retry,
+                          reset_at=reset_at, fail_open=fail_open,
+                          limits=limits)
+        ticket.result = res
+        return res
+
+    # ------------------------------------------------- pipelined public API
+
+    def launch_hashed(self, h64: np.ndarray,
+                      ns: Optional[np.ndarray] = None, *,
+                      now: Optional[float] = None) -> MeshDispatchTicket:
+        self._check_open()
+        h64 = np.asarray(h64, dtype=np.uint64)
+        ns_arr = (np.ones(h64.shape[0], dtype=np.int64) if ns is None
+                  else np.asarray(ns, dtype=np.int64))
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_split(h64, ns_arr, self.owner_of_hash(h64), t,
+                                  premix=False, wire=False)
+
+    def launch_ids(self, ids: np.ndarray,
+                   ns: Optional[np.ndarray] = None, *,
+                   now: Optional[float] = None,
+                   wire: bool = False) -> MeshDispatchTicket:
+        self._check_open()
+        ids = np.asarray(ids, dtype=np.uint64)
+        ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
+                  else np.asarray(ns, dtype=np.int64))
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_split(ids, ns_arr, self.owner_of_id(ids), t,
+                                  premix=True, wire=wire)
+
+    def launch_batch(self, keys: Sequence[str],
+                     ns: Optional[Sequence[int]] = None, *,
+                     now: Optional[float] = None) -> MeshDispatchTicket:
+        self._check_open()
+        from ratelimiter_tpu.algorithms.base import check_key, check_n
+
+        keys = list(keys)
+        for k in keys:
+            check_key(k)
+        if ns is None:
+            ns_arr = np.ones(len(keys), dtype=np.int64)
+        else:
+            from ratelimiter_tpu.core.errors import InvalidNError
+
+            if len(ns) != len(keys):
+                raise InvalidNError(
+                    f"ns length {len(ns)} != keys length {len(keys)}")
+            for n in ns:
+                check_n(int(n))
+            ns_arr = np.asarray(ns, dtype=np.int64)
+        t = self.clock.now() if now is None else float(now)
+        h64 = self._hash(keys)
+        return self._launch_split(h64, ns_arr, self.owner_of_hash(h64), t,
+                                  premix=False, wire=False)
+
+    def allow_hashed(self, h64: np.ndarray,
+                     ns: Optional[np.ndarray] = None, *,
+                     now: Optional[float] = None) -> BatchResult:
+        return self.resolve(self.launch_hashed(h64, ns, now=now))
+
+    def allow_ids(self, ids: np.ndarray,
+                  ns: Optional[np.ndarray] = None, *,
+                  now: Optional[float] = None) -> BatchResult:
+        return self.resolve(self.launch_ids(ids, ns, now=now))
+
+    def _allow_batch(self, keys: list, ns: np.ndarray,
+                     now: float) -> BatchResult:
+        h64 = self._hash(keys)
+        return self.resolve(self._launch_split(
+            h64, ns, self.owner_of_hash(h64), now,
+            premix=False, wire=False))
+
+    def _allow_n(self, key: str, n: int, now: float):
+        return self.slices[self.owner_of_key(key)].allow_n(key, n, now=now)
+
+    # --------------------------------------------------- control plane
+
+    def _reset(self, key: str) -> None:
+        self.slices[self.owner_of_key(key)].reset(key)
+
+    def update_limit(self, new_limit: int) -> None:
+        self._check_open()
+        for s in self.slices:
+            s.update_limit(new_limit)
+        from dataclasses import replace
+
+        self.config = replace(self.config, limit=new_limit)
+
+    def update_window(self, new_window: float) -> None:
+        self._check_open()
+        for s in self.slices:
+            s.update_window(new_window)
+        from dataclasses import replace
+
+        self.config = replace(self.config, window=float(new_window))
+
+    # Policy overrides apply on EVERY slice (idempotent for non-owners —
+    # their copy is simply never queried for the key), the same rule as
+    # the native door's shard router; reads route to the owner.
+
+    def set_override(self, key: str, limit: Optional[int] = None, *,
+                     window_scale: float = 1.0):
+        self._check_open()
+        ov = None
+        for s in self.slices:
+            ov = s.set_override(key, limit, window_scale=window_scale)
+        return ov
+
+    def get_override(self, key: str):
+        self._check_open()
+        return self.slices[self.owner_of_key(key)].get_override(key)
+
+    def delete_override(self, key: str) -> bool:
+        self._check_open()
+        existed = False
+        for s in self.slices:
+            existed = s.delete_override(key) or existed
+        return existed
+
+    def list_overrides(self):
+        self._check_open()
+        return self.slices[0].list_overrides()
+
+    def override_count(self) -> int:
+        return self.slices[0].override_count()
+
+    # ------------------------------------------------- checkpoint seam
+
+    def capture_state(self):
+        """One combined snapshot over every slice: each slice captures
+        under its own lock (device→host only — the persistence tier
+        serializes and writes off-lock, ADR-009). Slices share no keys,
+        so per-key consistency holds; cross-key skew between slice
+        captures sits inside the documented one-interval staleness
+        envelope. The slice count rides in the extras and restore
+        REFUSES a different count — slice counters are only meaningful
+        under the routing that produced them."""
+        self._check_open()
+        arrays = {}
+        extras = []
+        for i, s in enumerate(self.slices):
+            _, a, e = s.capture_state()
+            arrays.update({f"slice{i}:{k}": v for k, v in a.items()})
+            extras.append(e)
+        return self._CKPT_KIND, arrays, {
+            "n_slices": self.n_slices,
+            "slice_extras": extras,
+            "saved_at": self.clock.now(),
+        }
+
+    def restore(self, path: str) -> None:
+        from ratelimiter_tpu.checkpoint import load_state
+
+        self._check_open()
+        arrays, meta = load_state(path, self._CKPT_KIND, self.config)
+        saved = int(meta.get("n_slices", -1))
+        if saved != self.n_slices:
+            raise CheckpointError(
+                f"{path}: snapshot holds {saved} slice(s) of key-routed "
+                f"state but this mesh runs {self.n_slices} device(s) — "
+                f"per-slice counters are only meaningful under the "
+                f"routing that produced them; restart with --mesh-devices "
+                f"{saved} (or accept the loss and start fresh)")
+        extras = meta.get("slice_extras") or [{}] * self.n_slices
+        for i, s in enumerate(self.slices):
+            prefix = f"slice{i}:"
+            sub = {k[len(prefix):]: v for k, v in arrays.items()
+                   if k.startswith(prefix)}
+            s._restore_loaded(sub, extras[i], label=f"{path}[slice{i}]")
+
+    # ------------------------------------------------- fault injection
+
+    def inject_failure(self, exc: Optional[Exception] = None) -> None:
+        for s in self.slices:
+            s.inject_failure(exc)
+
+    def heal(self) -> None:
+        for s in self.slices:
+            s.heal()
+
+    # ----------------------------------------------------- introspection
+
+    def sub_limiters(self):
+        """The per-device slices (the serving tier's per-unit seam:
+        DCN pushers/merges, prewarm, and the health envelope iterate
+        these)."""
+        return list(self.slices)
+
+    def memory_bytes(self) -> int:
+        return sum(s.memory_bytes() for s in self.slices)
+
+    def in_window_admitted_mass(self) -> int:
+        return sum(s.in_window_admitted_mass() for s in self.slices)
+
+    @property
+    def mass_budget(self) -> int:
+        return sum(s.mass_budget for s in self.slices)
+
+    @property
+    def overload_periods(self) -> int:
+        return sum(s.overload_periods for s in self.slices)
+
+    def _close(self) -> None:
+        for s in self.slices:
+            s.close()

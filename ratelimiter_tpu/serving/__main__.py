@@ -34,7 +34,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--algorithm", default="tpu_sketch",
                     choices=[a.value for a in Algorithm])
     ap.add_argument("--backend", default="sketch",
-                    choices=["exact", "dense", "sketch"])
+                    choices=["exact", "dense", "sketch", "mesh"],
+                    help="state backend; 'mesh' is slice-parallel serving "
+                         "(ADR-012): one device-pinned sketch slice per "
+                         "visible device, keys hash-routed to their owning "
+                         "slice, decide path collective-free")
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="--backend mesh: devices to span (default: all "
+                         "visible; on CPU set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N)")
     ap.add_argument("--limit", type=int, default=100)
     ap.add_argument("--window", type=float, default=60.0,
                     help="window seconds")
@@ -200,10 +208,12 @@ def _envelope_health(limiters) -> dict:
     dispatch shards, pass EVERY shard limiter: counters/mass sum across
     shards (each shard has its own budget, so the aggregate budget is
     per-shard x N) and ``shards_overloaded`` says how many are currently
-    past their own budget."""
+    past their own budget. A sliced mesh limiter expands to its
+    per-device slices (same aggregation, one series per device)."""
     from ratelimiter_tpu.observability.decorators import undecorated
 
     lims = [undecorated(lim) for lim in limiters]
+    lims = [sl for lim in lims for sl in lim.sub_limiters()]
     lims = [lim for lim in lims if hasattr(lim, "_period_mass")]
     if not lims:
         return {}
@@ -249,27 +259,35 @@ def _prewarm(limiter, max_batch: int) -> None:
     """Compile every batch pad shape the micro-batcher can produce (powers
     of two up to max_batch) BEFORE accepting traffic, so no client request
     ever pays a jit compile. With the persistent compilation cache this is
-    fast on every start after the first."""
+    fast on every start after the first. A sliced mesh limiter warms
+    EVERY device slice across the full shape range (a skewed frame can
+    hand any slice up to the whole batch, so partial per-slice warming
+    would leave compiles on the hot path)."""
     import numpy as np
 
-    t0 = time.time()
-    size = 8
-    while True:
-        size = min(size, max_batch)
-        h = np.arange(size, dtype=np.uint64) + (1 << 62)
-        limiter.allow_hashed(h, now=0.0)
-        from ratelimiter_tpu.observability.decorators import undecorated
+    from ratelimiter_tpu.observability.decorators import undecorated
 
-        if hasattr(undecorated(limiter), "allow_ids"):
-            # The hashed wire lane's premix step (splitmix64 in-jit,
-            # ADR-011) is a distinct compilation per shape — warm it too
-            # so the first ALLOW_HASHED frame never pays a compile.
-            limiter.allow_ids(h, now=0.0)
-        if size >= max_batch:
-            break
-        size *= 2
+    t0 = time.time()
+    targets = undecorated(limiter).sub_limiters()
+    for tgt in targets:
+        size = 8
+        while True:
+            size = min(size, max_batch)
+            h = np.arange(size, dtype=np.uint64) + (1 << 62)
+            tgt.allow_hashed(h, now=0.0)
+            if hasattr(undecorated(tgt), "allow_ids"):
+                # The hashed wire lane's premix step (splitmix64 in-jit,
+                # ADR-011) is a distinct compilation per shape — warm it
+                # too so the first ALLOW_HASHED frame never pays a
+                # compile.
+                tgt.allow_ids(h, now=0.0)
+            if size >= max_batch:
+                break
+            size *= 2
     logging.getLogger("ratelimiter_tpu.serving").info(
-        "prewarmed pad shapes up to %d in %.1fs", max_batch, time.time() - t0)
+        "prewarmed pad shapes up to %d (%d dispatch target%s) in %.1fs",
+        max_batch, len(targets), "s" if len(targets) != 1 else "",
+        time.time() - t0)
 
 
 def _configure_jax(args) -> None:
@@ -297,7 +315,7 @@ def _configure_jax(args) -> None:
 async def amain(args) -> None:
     logging.basicConfig(level=args.log_level.upper())
     _configure_jax(args)
-    from ratelimiter_tpu import PersistenceSpec
+    from ratelimiter_tpu import MeshSpec, PersistenceSpec
 
     cfg = Config(
         algorithm=Algorithm(args.algorithm),
@@ -313,19 +331,46 @@ async def amain(args) -> None:
             snapshot_after_mutations=args.snapshot_after_mutations,
             retain=args.snapshot_retain,
             wal_fsync=args.wal_fsync),
+        mesh=MeshSpec(devices=args.mesh_devices),
     )
+    if args.mesh_devices is not None and args.backend != "mesh":
+        raise SystemExit("--mesh-devices needs --backend mesh")
+    if args.backend == "mesh" and args.shards > 1:
+        raise SystemExit("--backend mesh routes one dispatch shard per "
+                         "device; use --mesh-devices, not --shards")
     persist = None
     if cfg.persistence.enabled:
         from ratelimiter_tpu.persistence import PersistenceManager
 
         persist = PersistenceManager(cfg.persistence)
-    limiter = build_limiter_stack(create_limiter(cfg, backend=args.backend),
-                                  args)
-    if persist is not None:
+
+    def decorate(lim, shard: int = 0):
+        lim = build_limiter_stack(lim, args, shard=shard)
         # Outermost wrapper: every surface's mutations reach the WAL.
-        limiter = persist.wrap(limiter)
+        return persist.wrap(lim) if persist is not None else lim
+
+    # --backend mesh behind the NATIVE door mounts the device-pinned
+    # slices directly as the C++ door's dispatch shards (one shard ==
+    # one device): the FNV/splitmix shard router becomes the
+    # shard→device router and each device runs its own pipelined
+    # launch/resolve chain, collective-free (ADR-012). The asyncio door
+    # serves the composite SlicedMeshLimiter instead — the micro-batcher
+    # pipelines whole frames and the limiter fans each frame out to its
+    # owning devices.
+    mesh_native = bool(args.backend == "mesh" and args.native)
+    slices = None
+    if mesh_native:
+        from ratelimiter_tpu.parallel.limiter import build_slices
+
+        slices = build_slices(cfg)
+        limiter = decorate(slices[0])
+    else:
+        limiter = decorate(create_limiter(cfg, backend=args.backend))
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
+        if slices is not None:
+            for i, s in enumerate(slices[1:], start=1):
+                _prewarm(s, args.max_batch)
     dcn_secret = (args.dcn_secret
                   or os.environ.get("RATELIMITER_TPU_DCN_SECRET") or None)
     http_reset = bool(args.http_reset or args.http_reset_token)
@@ -334,8 +379,12 @@ async def amain(args) -> None:
     if args.dcn_peer:
         from ratelimiter_tpu.serving.dcn_peer import parse_peer
 
-        if args.backend != "sketch":
-            raise SystemExit("--dcn-peer needs --backend sketch")
+        if args.backend not in ("sketch", "mesh"):
+            # The mesh backend's slices are plain sketch limiters, each
+            # exporting completed slabs / debt deltas (incl. promoted
+            # heavy hitters via hh_owner2) — one pusher per slice below.
+            raise SystemExit("--dcn-peer needs a sketch-family backend "
+                             "(--backend sketch or --backend mesh)")
         dcn_peers = [parse_peer(s) for s in args.dcn_peer]
     pushers = []
     if args.native:
@@ -347,18 +396,22 @@ async def amain(args) -> None:
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                               if args.dispatch_timeout_ms else None),
             inflight=args.inflight,
-            shards=args.shards,
+            shards=(len(slices) if mesh_native else args.shards),
             dcn=bool(args.dcn_listen or args.dcn_peer),
             dcn_secret=dcn_secret,
             max_dcn_conns=args.dcn_max_transfers,
+            # Mesh: the pre-built per-device slices ARE the shards, each
+            # wearing the same decorator stack (+ persistence wrapper)
+            # under its own shard label.
+            shard_limiters=([limiter] + [decorate(s, shard=i)
+                                         for i, s in enumerate(
+                                             slices[1:], start=1)]
+                            if mesh_native else None),
             # Clone shards get the same decorator stack as shard 0, so
             # /metrics and the breaker see all N shards' traffic (each
             # under its own shard label) — plus the persistence wrapper,
             # so a mutation on ANY shard reaches the WAL.
-            shard_decorate=(lambda lim, i: (
-                persist.wrap(build_limiter_stack(lim, args, shard=i))
-                if persist is not None
-                else build_limiter_stack(lim, args, shard=i))))
+            shard_decorate=(lambda lim, i: decorate(lim, shard=i)))
         if persist is not None:
             # Recover BEFORE the listener opens: replayed mutations and
             # the restored snapshot must precede the first decision.
@@ -457,9 +510,14 @@ async def amain(args) -> None:
         from ratelimiter_tpu.observability.decorators import undecorated
         from ratelimiter_tpu.serving.dcn_peer import DcnPusher
 
-        pushers.append(DcnPusher(undecorated(limiter), dcn_peers,
-                                 interval=args.dcn_interval,
-                                 secret=dcn_secret))
+        # Mesh composite: one pusher PER SLICE (keys hash-route across
+        # devices, so exporting one slice would hide (N-1)/N of local
+        # traffic from every peer — same rule as the native door's
+        # per-shard pushers).
+        for push_lim in undecorated(limiter).sub_limiters():
+            pushers.append(DcnPusher(push_lim, dcn_peers,
+                                     interval=args.dcn_interval,
+                                     secret=dcn_secret))
         for pu in pushers:
             pu.start()
     if persist is not None:

@@ -39,7 +39,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 6
+_ABI = 7
 
 
 def _load_extension():
@@ -133,6 +133,11 @@ class NativeRateLimitServer:
     dispatch_timeout) enables the pipelined launch/resolve hot path:
     that many device dispatches stay in flight per shard, with
     backpressure upstream of the sockets when the window fills.
+
+    ``shard_limiters`` mounts PRE-BUILT per-shard limiters instead of
+    cloning from ``limiter`` — the slice-parallel mesh backend passes
+    its device-pinned slices here, making one dispatch shard == one
+    device (ADR-012); ``limiter`` must then be element 0 of the list.
     """
 
     def __init__(self, limiter: RateLimiter, host: str = "127.0.0.1",
@@ -144,7 +149,8 @@ class NativeRateLimitServer:
                  shards: int = 1, dcn: bool = False,
                  dcn_secret: Optional[str] = None,
                  max_dcn_conns: int = 4,
-                 shard_decorate=None):
+                 shard_decorate=None,
+                 shard_limiters: Optional[list] = None):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -190,6 +196,18 @@ class NativeRateLimitServer:
         # reference's Redis-Cluster keyspace sharding; on a multi-chip
         # box each shard maps naturally onto its own device. Extra shard
         # limiters are owned (and closed) by this server.
+        #
+        # ``shard_limiters`` supplies the per-shard limiters PRE-BUILT
+        # instead of cloning — the slice-parallel mesh backend mounts
+        # its device-pinned slices here (one shard == one device,
+        # ADR-012), so the C++ shard router IS the shard→device router
+        # and every dispatch runs collective-free on its owning chip.
+        if shard_limiters is not None:
+            if shards not in (1, len(shard_limiters)):
+                raise ValueError(
+                    f"shards={shards} disagrees with "
+                    f"{len(shard_limiters)} supplied shard limiters")
+            shards = len(shard_limiters)
         if shards < 1:
             raise ValueError(f"shards must be >= 1, got {shards}")
         if shards > 1 and dispatch_timeout is not None:
@@ -204,17 +222,20 @@ class NativeRateLimitServer:
             raise ValueError(
                 "shards > 1 requires a sketch-family limiter (its state "
                 "is fully determined by the config)")
-        self._shard_limiters = [limiter]
-        for i in range(1, shards):
-            # Clones rebuilt from (config, clock); ``shard_decorate(lim,
-            # shard_index)`` (e.g. the server binary's decorator stack)
-            # wraps each one so observability sees EVERY shard's traffic
-            # — per-shard labeled, not just the 1/N of keys that land on
-            # the caller's limiter. Without it the clones are raw state
-            # shards (the pre-r5 behavior).
-            clone = type(base)(base.config, clock=base.clock)
-            self._shard_limiters.append(
-                shard_decorate(clone, i) if shard_decorate else clone)
+        if shard_limiters is not None:
+            self._shard_limiters = list(shard_limiters)
+        else:
+            self._shard_limiters = [limiter]
+            for i in range(1, shards):
+                # Clones rebuilt from (config, clock); ``shard_decorate(
+                # lim, shard_index)`` (e.g. the server binary's decorator
+                # stack) wraps each one so observability sees EVERY
+                # shard's traffic — per-shard labeled, not just the 1/N
+                # of keys that land on the caller's limiter. Without it
+                # the clones are raw state shards (the pre-r5 behavior).
+                clone = type(base)(base.config, clock=base.clock)
+                self._shard_limiters.append(
+                    shard_decorate(clone, i) if shard_decorate else clone)
         self._locks = [threading.Lock() for _ in range(shards)]
 
         # Fast path: C++ prepends the prefix while building the blob, so

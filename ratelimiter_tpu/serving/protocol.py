@@ -384,29 +384,20 @@ def parse_allow_hashed(body: bytes):
 
 
 def encode_result_hashed(req_id: int, res) -> bytes:
-    """Columnar response from a BatchResult. Wire-lane results arrive
-    DEVICE-packed (BatchResult.wire_packed, sketch_kernels.pack_wire) and
-    frame with four slice memcpys — the allow mask is never re-packed on
-    the host; results without packed buffers (fail-open, pre-resolved,
-    client-constructed) take the np.packbits path."""
+    """Columnar response from a BatchResult, as ONE bytes frame. Wire-lane
+    results arrive DEVICE-packed (BatchResult.wire_packed,
+    sketch_kernels.pack_wire) and frame via the shared view builder below
+    (one join, no per-column re-packing); results without packed buffers
+    (fail-open, pre-resolved, client-constructed) take the np.packbits
+    path."""
     import numpy as np
 
-    b = len(res)
-    flags = 2 if res.fail_open else 0
     wp = getattr(res, "wire_packed", None)
     if wp is not None:
-        bits_arr, words, padded = wp
-        nb = (b + 7) // 8
-        bits = bytearray(bits_arr[:nb].tobytes())
-        if b & 7 and nb:
-            # Zero the pad rows' bits in the final partial byte so the
-            # frame bytes are deterministic (pad rows can read allowed).
-            bits[-1] &= (1 << (b & 7)) - 1
-        body = (_HASHED_RES_HEAD.pack(flags, res.limit, b) + bytes(bits)
-                + words[:b].tobytes()
-                + words[padded:padded + b].tobytes()
-                + words[2 * padded:2 * padded + b].tobytes())
-        return _HDR.pack(1 + 8 + len(body), T_RESULT_HASHED, req_id) + body
+        return b"".join(bytes(v)
+                        for v in encode_result_hashed_views(req_id, res))
+    b = len(res)
+    flags = 2 if res.fail_open else 0
     bits = np.packbits(np.asarray(res.allowed, dtype=bool),
                        bitorder="little")
     body = (_HASHED_RES_HEAD.pack(flags, res.limit, b)
@@ -415,6 +406,45 @@ def encode_result_hashed(req_id: int, res) -> bytes:
             + np.ascontiguousarray(res.retry_after, dtype="<f8").tobytes()
             + np.ascontiguousarray(res.reset_at, dtype="<f8").tobytes())
     return _HDR.pack(1 + 8 + len(body), T_RESULT_HASHED, req_id) + body
+
+
+def encode_result_hashed_views(req_id: int, res) -> list:
+    """T_RESULT_HASHED frame as a writev-style buffer list (ADR-011
+    residual, ISSUE-5 satellite): header + allow-mask bytes in one small
+    bytes object, then the three value columns as zero-copy MEMORYVIEWS
+    straight over the device-fetched ``wire_packed`` words buffer. This
+    is the SINGLE source of the packed framing (pad-bit masking, column
+    offsets); encode_result_hashed joins these views for the one-buffer
+    form. The ENCODER makes zero copies of the columns; downstream, the
+    asyncio server hands the list to transport.writelines — a true
+    scatter-gather under uvloop, while stock asyncio transports still
+    concatenate once at the socket layer (the former per-column
+    ``tobytes`` copies and the encoder-level join are gone either way).
+    Results without packed buffers fall back to the single-buffer
+    encode.
+
+    tests/test_hashed_wire.py asserts the zero-copy property by buffer
+    identity: each returned column view shares memory with the resolve
+    fetch, byte for byte."""
+    wp = getattr(res, "wire_packed", None)
+    if wp is None:
+        return [encode_result_hashed(req_id, res)]
+    b = len(res)
+    flags = 2 if res.fail_open else 0
+    bits_arr, words, padded = wp
+    nb = (b + 7) // 8
+    bits = bytearray(bits_arr[:nb].tobytes())
+    if b & 7 and nb:
+        # Zero the pad rows' bits in the final partial byte so the frame
+        # bytes are deterministic (pad rows can read allowed).
+        bits[-1] &= (1 << (b & 7)) - 1
+    body_len = _HASHED_RES_HEAD.size + nb + 24 * b
+    head = (_HDR.pack(1 + 8 + body_len, T_RESULT_HASHED, req_id)
+            + _HASHED_RES_HEAD.pack(flags, res.limit, b) + bytes(bits))
+    return [head,
+            memoryview(words[:b]).cast("B"),
+            memoryview(words[padded:padded + b]).cast("B"),
+            memoryview(words[2 * padded:2 * padded + b]).cast("B")]
 
 
 def parse_result_hashed(body: bytes):

@@ -113,6 +113,15 @@ class RateLimitServer:
         if task is not None:
             self._conn_tasks.add(task)
 
+        def _check_backpressure() -> None:
+            transport = writer.transport
+            if (transport is not None and
+                    transport.get_write_buffer_size() > WRITE_BUFFER_LIMIT):
+                log.warning(
+                    "dropping slow-reader connection (%d bytes buffered)",
+                    transport.get_write_buffer_size())
+                transport.abort()
+
         def write_out(frame: bytes) -> None:
             # Done-callback writer: writes never block the loop; broken
             # pipes surface in the reader loop, which owns teardown. A
@@ -121,13 +130,19 @@ class RateLimitServer:
             # cannot await drain(), so the bound is enforced by closing.
             try:
                 writer.write(frame)
-                transport = writer.transport
-                if (transport is not None and
-                        transport.get_write_buffer_size() > WRITE_BUFFER_LIMIT):
-                    log.warning(
-                        "dropping slow-reader connection (%d bytes buffered)",
-                        transport.get_write_buffer_size())
-                    transport.abort()
+                _check_backpressure()
+            except (ConnectionResetError, BrokenPipeError, RuntimeError):
+                pass
+
+        def write_vec(bufs) -> None:
+            # writev-style multi-buffer frames (the hashed wire lane):
+            # the column memoryviews go to the transport as-is — the
+            # ENCODER never copies or joins them (ADR-011 residual);
+            # uvloop scatter-gathers the list, stock asyncio transports
+            # concatenate once at the socket layer.
+            try:
+                writer.writelines(bufs)
+                _check_backpressure()
             except (ConnectionResetError, BrokenPipeError, RuntimeError):
                 pass
 
@@ -143,7 +158,7 @@ class RateLimitServer:
             if exc is not None:
                 write_out(p.encode_error(req_id, p.code_for(exc), str(exc)))
             else:
-                write_out(p.encode_result_hashed(req_id, fut.result()))
+                write_vec(p.encode_result_hashed_views(req_id, fut.result()))
 
         try:
             while True:
@@ -223,10 +238,15 @@ class RateLimitServer:
                 self._conn_tasks.discard(task)
 
     async def _handle_dcn(self, req_id: int, body: bytes) -> bytes:
+        from ratelimiter_tpu.observability.decorators import undecorated
         from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
 
+        # A sliced mesh limiter merges the foreign payload into EVERY
+        # device slice (keys hash-route across slices; dcn_peer explains
+        # why the per-shard merge is double-count-free).
+        lims = undecorated(self.limiter).sub_limiters()
         await asyncio.get_running_loop().run_in_executor(
-            None, merge_push_payload, [self.limiter], body, self.dcn_secret,
+            None, merge_push_payload, lims, body, self.dcn_secret,
             self._dcn_guard)
         return p.encode_ok(req_id)
 

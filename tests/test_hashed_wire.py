@@ -57,6 +57,48 @@ def test_parse_allow_hashed_rejects_malformed():
         p.parse_allow_hashed(body)
 
 
+def test_result_hashed_views_are_zero_copy_and_frame_identical():
+    """ISSUE-5 satellite (the named ADR-011 residual): the writev-style
+    reply builder must frame the three value columns as MEMORYVIEWS
+    straight over the device-fetched wire_packed words buffer — buffer
+    identity asserted via np.shares_memory — with no intermediate
+    per-frame bytes join, and the concatenation of the views must be
+    byte-identical to the single-buffer encoder."""
+    lim = SketchLimiter(_cfg(), ManualClock(T0))
+    ids = np.arange(1, 42, dtype=np.uint64)  # 41 ids: partial mask byte
+    res = lim.resolve(lim.launch_ids(ids, wire=True))
+    assert res.wire_packed is not None
+    _bits, words, _padded = res.wire_packed
+
+    views = p.encode_result_hashed_views(9, res)
+    assert len(views) == 4
+    # Zero extra copies: every column view aliases the resolve fetch.
+    for v in views[1:]:
+        assert isinstance(v, memoryview)
+        assert np.shares_memory(np.frombuffer(v, dtype=np.uint8), words)
+    # And the scatter-gather list is the SAME frame the bytes encoder
+    # builds (parseable by the client untouched).
+    joined = b"".join(bytes(v) for v in views)
+    assert joined == p.encode_result_hashed(9, res)
+    parsed = p.parse_result_hashed(joined[p.HEADER_SIZE:])
+    np.testing.assert_array_equal(parsed.allowed, res.allowed)
+    np.testing.assert_array_equal(parsed.remaining, res.remaining)
+    lim.close()
+
+
+def test_result_hashed_views_fall_back_without_packed_buffers():
+    res = BatchResult(
+        allowed=np.array([True, False, True]),
+        limit=5,
+        remaining=np.array([4, 0, 3], dtype=np.int64),
+        retry_after=np.array([0.0, 1.5, 0.0]),
+        reset_at=np.array([T0 + 10] * 3),
+    )
+    views = p.encode_result_hashed_views(3, res)
+    assert len(views) == 1
+    assert bytes(views[0]) == p.encode_result_hashed(3, res)
+
+
 def test_result_hashed_roundtrip():
     res = BatchResult(
         allowed=np.array([True, False, True, True, False]),
