@@ -23,7 +23,7 @@ test-serving:    ## serving tier only
 
 test-mesh:       ## mesh contract + multichip + slice-parallel serving tests
 	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py \
-	    tests/test_mesh_serving.py -q
+	    tests/test_mesh_serving.py tests/test_scatter_gather.py -q
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -46,17 +46,21 @@ def _render_md(doc: dict) -> str:
                   "µs/call is wall clock and pays the full host↔device "
                   "round trip per dispatch (~100+ ms through the dev "
                   "tunnel); device µs/step is the scan-amortized on-device "
-                  "compute for the same batch shape (blank for host "
-                  "backends and scalar shapes).", "",
+                  "compute for the same batch shape (blank for scalar "
+                  "shapes; n/a where the cell could not be measured — "
+                  "host backends, or an RTT sample that swallowed the "
+                  "run; a silent 0.0 is never rendered).", "",
                   "| group | algorithm | backend | shape | µs/call "
                   "| device µs/step | decisions/s |",
                   "|---|---|---|---|---:|---:|---:|"]
         for r in doc["matrix"]:
-            dev = r.get("device_us")
+            if "device_us" not in r:
+                dev = ""  # not a measured column for this shape
+            else:
+                dev = r["device_us"] if r["device_us"] else "n/a"
             lines.append(
                 f"| {r['group']} | {r['algorithm']} | {r['backend']} | "
-                f"{r['shape']} | {r['us_per_call']} | "
-                f"{dev if dev is not None else ''} | "
+                f"{r['shape']} | {r['us_per_call']} | {dev} | "
                 f"{r['decisions_per_sec']:,} |")
         lines.append("")
     if "configs" in doc:

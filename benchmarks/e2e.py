@@ -233,19 +233,21 @@ def _build_loadgen(td: str) -> str:
 
 
 def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
-                     affine: bool = True, loadgen: Optional[str] = None,
+                     affine: bool = True, spread: Optional[int] = None,
+                     loadgen: Optional[str] = None,
                      platform: Optional[str] = None) -> Dict:
     """One measured point of the slice-parallel serving curve (ADR-012):
     a real ``--backend mesh --native`` server over ``n_devices`` pinned
     slices, driven by the C++ loadgen's zero-copy hashed lane.
 
-    ``affine=True`` pins each connection's ids to one dispatch shard
-    (splitmix64(id) % n == conn % n) — the traffic shape a
-    consistent-hash LB produces in front of a sliced mesh, and the shape
-    that scales: frames complete independently per device. affine=False
-    sends mixed frames (every frame fans out over all devices and
-    fork-joins across their queues — latency-coupled, reported for
-    honesty). The server always routes every id itself either way.
+    ``spread`` is the slice-spread knob (ADR-013): each connection's ids
+    route to a window of that many dispatch shards starting at its home
+    shard (splitmix64(id) % n). spread=1 is pure shard-affine traffic —
+    the shape a consistent-hash LB produces, frames never fan out;
+    spread=n is uniform MIXED traffic — every frame fans out over every
+    device and reassembles through the scatter-gather scheduler. When
+    ``spread`` is None, ``affine`` selects spread=1 (True) or spread=n
+    (False). The server always routes every id itself either way.
 
     ``--inflight 1`` (synchronous per-shard dispatch): on the CPU mesh
     the jitted step executes synchronously inside launch, so pipelining
@@ -258,6 +260,9 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
 
     if shutil.which("g++") is None:
         return {"error": "no g++"}
+    if spread is None:
+        spread = 1 if affine else n_devices
+    spread = max(1, min(int(spread), n_devices))
     with tempfile.TemporaryDirectory() as td:
         binary = loadgen or _build_loadgen(td)
         proc, port = _spawn_server(
@@ -269,9 +274,8 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
             # (thin queues half-fill the per-device batches and flatten
             # the top of the scaling curve).
             args = [binary, "127.0.0.1", str(port), str(seconds), "16", "8",
-                    "2048", "1000000", "hashed"]
-            if affine:
-                args.append(str(n_devices))
+                    "2048", "1000000", "hashed", str(n_devices),
+                    str(spread)]
             out = subprocess.run(args, capture_output=True, text=True,
                                  timeout=seconds + 120)
             row = json.loads(out.stdout.strip())
@@ -282,8 +286,11 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
             except subprocess.TimeoutExpired:
                 proc.kill()
     row["n_devices"] = n_devices
-    row["traffic"] = ("shard-affine (consistent-hash LB shape)"
-                      if affine else "mixed (per-frame fan-out + join)")
+    row["traffic"] = (
+        "shard-affine (consistent-hash LB shape)" if spread == 1
+        else ("mixed (uniform per-frame fan-out, scatter-gather "
+              "coalesced)" if spread >= n_devices
+              else f"partially mixed (slice-spread {spread}/{n_devices})"))
     return row
 
 

@@ -9,17 +9,23 @@
 //
 // Usage: rltpu_loadgen <host> <port> <seconds> <threads> <inflight>
 //                      <keys_per_frame> <n_keys> [mode] [affine_shards]
+//                      [spread]
 // mode: "batch" (default, string ALLOW_BATCH frames) or "hashed"
 // (columnar raw-u64-id ALLOW_HASHED frames — the zero-copy bulk lane,
 // ADR-011).
 // affine_shards (hashed mode only, default 0 = off): each connection's
-// ids are drawn so they all route to ONE dispatch shard
-// (splitmix64(id) % affine_shards == thread % affine_shards) — the
-// traffic shape a consistent-hash LB produces in front of a
-// slice-parallel mesh deployment (ADR-012). The server still routes
-// every id itself; affinity only means a frame never fans out across
-// shards, so frames complete independently instead of fork-joining
-// across every device's queue.
+// ids are drawn so they route only to a window of `spread` dispatch
+// shards starting at the connection's home shard
+// (thread % affine_shards) — the slice-spread knob (ADR-013):
+//   spread=1 (default)       pure shard-affine traffic, the shape a
+//                            consistent-hash LB produces (frames never
+//                            fan out; ADR-012's scaling shape);
+//   1 < spread < n           partially mixed — each frame fans out over
+//                            `spread` devices;
+//   spread >= affine_shards  uniform mixed — every frame fans out over
+//                            every device (the scatter-gather
+//                            scheduler's worst case).
+// The server still routes every id itself either way.
 // Output: one JSON line.
 
 #include <algorithm>
@@ -64,7 +70,8 @@ struct Shared {
 // Raw pipelined driver: hand-rolled frames on one socket (the Client
 // class is strictly request/response; pipelining needs direct IO).
 void worker(const char* host, int port, int inflight, int frame_keys,
-            int n_keys, int wid, bool hashed, int affine, Shared* sh) {
+            int n_keys, int wid, bool hashed, int affine, int spread,
+            Shared* sh) {
   // The Client class is strictly request/response; pipelining needs
   // direct socket IO, so the frames are hand-rolled here.
   struct addrinfo hints {
@@ -101,17 +108,20 @@ void worker(const char* host, int port, int inflight, int frame_keys,
     body.append((char*)&count, 4);
     if (hashed) {
       // Columnar raw-id frame (ADR-011): u64 ids then u32 ns. With
-      // affinity, rejection-sample until the id routes to this
-      // connection's shard (the consistent-hash-LB traffic shape;
-      // expected `affine` draws per id, LCG draws are ~free).
+      // affinity, rejection-sample until the id routes to the
+      // connection's `spread`-shard window starting at its home shard
+      // (spread=1: the consistent-hash-LB traffic shape; expected
+      // `affine / spread` draws per id, LCG draws are ~free).
+      bool constrain = affine > 0 && spread < affine;
+      uint64_t home = (uint64_t)(wid % (affine > 0 ? affine : 1));
       for (int i = 0; i < frame_keys; ++i) {
         uint64_t id64;
         do {
           rng = rng * 1664525u + 1013904223u;
           id64 = rng % (unsigned)n_keys;
-        } while (affine > 0 &&
-                 splitmix64(id64) % (uint64_t)affine !=
-                     (uint64_t)(wid % affine));
+        } while (constrain &&
+                 (splitmix64(id64) % (uint64_t)affine + (uint64_t)affine -
+                  home) % (uint64_t)affine >= (uint64_t)spread);
         body.append((char*)&id64, 8);
       }
       uint32_t n = 1;
@@ -213,11 +223,11 @@ void worker(const char* host, int port, int inflight, int frame_keys,
 }  // namespace
 
 int main(int argc, char** argv) {
-  if (argc < 8 || argc > 10) {
+  if (argc < 8 || argc > 11) {
     std::fprintf(stderr,
                  "usage: %s <host> <port> <seconds> <threads> <inflight> "
                  "<keys_per_frame> <n_keys> [batch|hashed] "
-                 "[affine_shards]\n",
+                 "[affine_shards] [spread]\n",
                  argv[0]);
     return 2;
   }
@@ -229,7 +239,9 @@ int main(int argc, char** argv) {
   int frame_keys = atoi(argv[6]);
   int n_keys = atoi(argv[7]);
   bool hashed = argc >= 9 && std::strcmp(argv[8], "hashed") == 0;
-  int affine = (argc == 10 && hashed) ? atoi(argv[9]) : 0;
+  int affine = (argc >= 10 && hashed) ? atoi(argv[9]) : 0;
+  int spread = (argc >= 11 && hashed) ? atoi(argv[10]) : 1;
+  if (spread < 1) spread = 1;
 
   Shared sh;
   double warmup = 1.0;
@@ -239,7 +251,7 @@ int main(int argc, char** argv) {
   std::vector<std::thread> ts;
   for (int i = 0; i < threads; ++i)
     ts.emplace_back(worker, host, port, inflight, frame_keys, n_keys, i,
-                    hashed, affine, &sh);
+                    hashed, affine, spread, &sh);
   for (auto& t : ts) t.join();
 
   double span = seconds;
@@ -253,10 +265,10 @@ int main(int argc, char** argv) {
       "{\"decisions_per_sec\": %.1f, \"completed\": %llu, "
       "\"allowed\": %llu, \"frame_p50_ms\": %.2f, \"frame_p99_ms\": %.2f, "
       "\"threads\": %d, \"inflight_frames\": %d, \"keys_per_frame\": %d, "
-      "\"mode\": \"%s\"}\n",
+      "\"mode\": \"%s\", \"affine_shards\": %d, \"spread\": %d}\n",
       (double)sh.completed.load() / span,
       (unsigned long long)sh.completed.load(),
       (unsigned long long)sh.allowed.load(), pct(0.50), pct(0.99), threads,
-      inflight, frame_keys, hashed ? "hashed" : "batch");
+      inflight, frame_keys, hashed ? "hashed" : "batch", affine, spread);
   return 0;
 }

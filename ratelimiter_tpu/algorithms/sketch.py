@@ -884,6 +884,58 @@ class SketchTokenBucketLimiter(SketchLimiter):
             "the admitted-mass watchdog applies to windowed sketches "
             "only (debt decays continuously; see _note_mass_locked)")
 
+    def debt_slab_stats(self) -> dict:
+        """Occupancy/collision visibility for the debt slab — the
+        token-bucket mirror of the windowed mass watchdog (ROADMAP item
+        5). Strict gating does not transfer here (_note_mass_locked:
+        debt decays continuously and overestimates self-correct as they
+        drain), but visibility does: rows running hot mean colliding
+        active keys are sharing refill, throttling hot keys toward one
+        key's worth of combined throughput — always toward denying; this
+        surface says how likely that is right now.
+
+        The lock is held for three REFERENCE reads only (jax arrays are
+        immutable, so a consistent (debt, rem, last) triple taken under
+        the lock reduces safely after release — the decide path never
+        waits on this scrape's device work), and the liveness count is
+        an on-device per-row reduction: /healthz and the /metrics
+        scrape hooks fetch ``d`` scalars, never the (d, w) slab
+        (0.5–24 MB at production widths). Per-row ``occupancy`` counts
+        cells whose EFFECTIVE debt is positive (stored debt minus the
+        global decay the next step would apply — stored cells go stale
+        the moment traffic stops, so raw nonzero counts would read idle
+        slabs as full). ``occupancy`` is the max over rows;
+        ``collision_p`` is the product over rows — the chance a fresh
+        key lands on an occupied cell in EVERY row, which is what it
+        takes for the min-over-rows read to overestimate its debt."""
+        import jax.numpy as jnp
+
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        _, num, den, d, w, _ = bucket_kernels._params(self.config)
+        with self._lock:
+            debt = self._state["debt"]
+            rem_ref = self._state["rem"]
+            last_ref = self._state["last"]
+        now_us = to_micros(self.clock.now())
+        # The SAME decay the next step would apply — _decay is the one
+        # source of the elapsed/clamp arithmetic (scalar-safe jnp ops,
+        # so the device refs feed it directly).
+        decay, _ = bucket_kernels._decay(
+            {"last": last_ref, "rem": rem_ref}, now_us,
+            rate_num=num, rate_den=den)
+        live_rows = np.asarray(jnp.sum(debt > decay, axis=1))
+        occ_rows = live_rows / float(w)
+        return {
+            "depth": int(d),
+            "width": int(w),
+            "cells": int(d * w),
+            "nonzero_cells": int(live_rows.sum()),
+            "occupancy_rows": [round(float(o), 6) for o in occ_rows],
+            "occupancy": round(float(occ_rows.max(initial=0.0)), 6),
+            "collision_p": round(float(np.prod(occ_rows)), 9),
+        }
+
     def _apply_config(self, new_cfg: Config) -> None:
         """Dynamic limit: refill rate (limit/window) and capacity both
         change; the debt slab carries over, CLAMPED to the new capacity —

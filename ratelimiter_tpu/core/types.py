@@ -101,7 +101,10 @@ class BatchResult:
     #: i64[3*padded], padded)`` when the dispatch was launched
     #: ``wire=True`` (sketch_kernels.pack_wire, ADR-011):
     #: protocol.encode_result_hashed frames straight from these with
-    #: slice memcpys instead of re-bit-packing the allow mask.
+    #: slice memcpys instead of re-bit-packing the allow mask. A
+    #: 4-tuple ``(bits, words, padded, row_off)`` is the row-window form
+    #: produced by ``rows()`` (ADR-013): the same buffers, framing the
+    #: ``row_off``-based sub-range.
     wire_packed: "tuple | None" = None
 
     def __len__(self) -> int:
@@ -120,6 +123,32 @@ class BatchResult:
 
     def results(self) -> list[Result]:
         return [self.result(i) for i in range(len(self))]
+
+    def rows(self, off: int, count: int) -> "BatchResult":
+        """A contiguous row-range VIEW of this result (the scatter-gather
+        scheduler's per-frame slice of a coalesced window, ADR-013): all
+        arrays are numpy views, and device-packed wire buffers ride
+        along as a row-offset form ``(bits, words, padded, off)`` so the
+        wire encoder still frames the sub-range zero-copy
+        (protocol.encode_result_hashed_views). ``fail_open`` is the
+        window's OR — a frame coalesced with a failed-open neighbor
+        reports conservatively that some answers may be fabricated."""
+        wp = self.wire_packed
+        if wp is not None:
+            bits, words, padded = wp[0], wp[1], wp[2]
+            base = wp[3] if len(wp) > 3 else 0
+            wp = (bits, words, padded, base + off)
+        return BatchResult(
+            allowed=self.allowed[off:off + count],
+            limit=self.limit,
+            remaining=self.remaining[off:off + count],
+            retry_after=self.retry_after[off:off + count],
+            reset_at=self.reset_at[off:off + count],
+            fail_open=self.fail_open,
+            limits=(self.limits[off:off + count]
+                    if self.limits is not None else None),
+            wire_packed=wp,
+        )
 
     @property
     def allow_count(self) -> int:

@@ -27,10 +27,12 @@
 //                        (blocking when full = backpressure), so up to
 //                        `inflight` device dispatches overlap. Legacy
 //                        mode calls the blocking decide callback.
-//   completer thread(s)  one per shard (pipelined mode): pops the OLDEST
-//                        in-flight ticket, calls the Python RESOLVE
-//                        callback (blocks on the device with the GIL
-//                        released), and hands results to the responder.
+//   completer thread(s)  one per shard (pipelined mode): drains EVERY
+//                        in-flight ticket per wake (completion batching,
+//                        ADR-013), calls the Python RESOLVE callback on
+//                        each OLDEST-FIRST (blocks on the device with
+//                        the GIL released), and hands results to the
+//                        responder.
 //   responder thread     encodes RESULT / RESULT_BATCH frames and queues
 //                        them on connections — batch k's encode+send
 //                        overlaps batch k+1's Python decide. Split
@@ -185,9 +187,15 @@ struct Conn {
 
 using ConnPtr = std::shared_ptr<Conn>;
 
-// Reassembly of one ALLOW_BATCH frame split across dispatch shards:
-// each shard writes its results at the original positions; the LAST
-// shard to finish encodes and sends the single response frame.
+// Reassembly of one ALLOW_BATCH / ALLOW_HASHED frame split across
+// dispatch units: each contributor writes its results at the original
+// positions; the LAST one to finish encodes and sends the single
+// response frame. `remaining` counts SEGMENTS, not shards (ADR-013):
+// besides the io thread's per-shard split of a mixed frame, the
+// dispatcher may carve a hashed segment at the max_batch boundary so a
+// coalesced run never overshoots the largest prewarmed pad shape — the
+// continuation registers itself with a fetch_add BEFORE its first half
+// can deposit, so the count can never hit zero early.
 struct BatchJoin {
   std::atomic<uint32_t> remaining;
   ConnPtr conn;
@@ -318,6 +326,17 @@ struct Server {
     std::mutex mx;
     std::condition_variable cv_items, cv_space;
     std::deque<InflightEntry> entries;
+    // Tickets the completer has swapped out of `entries` but not yet
+    // resolved (the batched-drain window). Counts toward the
+    // `inflight` bound — a swapped-out ticket is still a
+    // launched-but-unresolved device dispatch, so the dispatcher may
+    // not reuse its slot until the resolve lands — and graceful
+    // shutdown must wait on these too: the queue alone looks empty
+    // mid-batch. Guarded by `mx` (NOT atomic — every reader and writer
+    // must hold the lock anyway: the increment pairs with the swap,
+    // the decrement avoids the cv_space lost-wakeup race, and the
+    // readers need entries+resolving as one consistent sum).
+    uint64_t resolving = 0;
   };
   uint32_t inflight_window = 8;
   bool pipelined = false;  // resolved at start(): launch+resolve, no SLO
@@ -780,7 +799,17 @@ void completer_main(Server* s, uint32_t shard) {
     }
   } depart{s};
   while (true) {
-    Server::InflightEntry e;
+    // Completion batching (ADR-013): drain EVERY in-flight ticket in one
+    // wake — resolve order stays oldest-first (FIFO state threading),
+    // the whole batch leaves the queue in one cv_items acquisition, and
+    // a multi-segment frame whose slices resolved back-to-back finishes
+    // its BatchJoin within one wake instead of straddling several.
+    // Window slots free ONE PER RESOLVE below, not at swap time: a
+    // swapped-out ticket is still a launched-but-unresolved device
+    // dispatch, and releasing the whole window here would let the
+    // dispatcher run the outstanding depth to 2x the documented
+    // `inflight` bound.
+    std::deque<Server::InflightEntry> batch;
     {
       std::unique_lock<std::mutex> lk(q.mx);
       q.cv_items.wait(lk, [&] {
@@ -788,40 +817,49 @@ void completer_main(Server* s, uint32_t shard) {
                (s->stop.load() && s->live_dispatchers.load() == 0);
       });
       if (q.entries.empty()) return;  // stopped, launchers gone, drained
-      e = std::move(q.entries.front());
-      q.entries.pop_front();
+      batch.swap(q.entries);
+      q.resolving += batch.size();
     }
-    q.cv_space.notify_one();
-    Server::Reply r;
-    r.hashed = e.hashed;
-    {
-      PyGILState_STATE g = PyGILState_Ensure();
-      PyObject* res = PyObject_CallFunction(
-          s->cb_resolve, "IO", (unsigned int)shard, e.ticket);
-      Py_DECREF(e.ticket);
-      if (res == nullptr) {
-        r.err_code = fetch_py_error(r.err_msg, "resolve callback failed",
-                                    E_STORAGE_UNAVAILABLE);
-      } else {
-        parse_result_tuple(res, e.total, r, "resolve");
-        Py_DECREF(res);
+    for (auto& e : batch) {
+      Server::Reply r;
+      r.hashed = e.hashed;
+      {
+        PyGILState_STATE g = PyGILState_Ensure();
+        PyObject* res = PyObject_CallFunction(
+            s->cb_resolve, "IO", (unsigned int)shard, e.ticket);
+        Py_DECREF(e.ticket);
+        if (res == nullptr) {
+          r.err_code = fetch_py_error(r.err_msg, "resolve callback failed",
+                                      E_STORAGE_UNAVAILABLE);
+        } else {
+          parse_result_tuple(res, e.total, r, "resolve");
+          Py_DECREF(res);
+        }
+        PyGILState_Release(g);
       }
-      PyGILState_Release(g);
+      r.total = e.total;
+      if (r.err_code == 0) {
+        s->decisions.fetch_add(r.total);
+        s->shard_decisions[shard].fetch_add(r.total);
+        // Gated on the launch-time epoch: this dispatch's limit is stale
+        // relative to any set_limits push issued since it launched.
+        s->refresh_limit(r.limit, e.limit_epoch);
+      }
+      r.items = std::move(e.items);
+      {
+        std::lock_guard<std::mutex> g(s->rmx);
+        s->rqueue.push_back(std::move(r));
+      }
+      s->rcv.notify_one();
+      {
+        // Decrement under the lock so a dispatcher mid-predicate on
+        // cv_space can't miss the wakeup (the lost-notify race of
+        // signalling between its check and its block).
+        std::lock_guard<std::mutex> lk(q.mx);
+        q.resolving -= 1;
+      }
+      q.cv_space.notify_one();
     }
-    r.total = e.total;
-    if (r.err_code == 0) {
-      s->decisions.fetch_add(r.total);
-      s->shard_decisions[shard].fetch_add(r.total);
-      // Gated on the launch-time epoch: this dispatch's limit is stale
-      // relative to any set_limits push issued since it launched.
-      s->refresh_limit(r.limit, e.limit_epoch);
-    }
-    r.items = std::move(e.items);
-    {
-      std::lock_guard<std::mutex> g(s->rmx);
-      s->rqueue.push_back(std::move(r));
-    }
-    s->rcv.notify_one();
   }
 }
 
@@ -1013,10 +1051,14 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
     {
       std::unique_lock<std::mutex> lk(pq.mx);
       // Bounded window: block HERE (backpressure) when `inflight`
-      // tickets are unresolved; on stop, push anyway — the completer
-      // drains everything before exiting.
+      // tickets are unresolved — queued PLUS swapped out for the
+      // completer's batched drain, which are still unresolved device
+      // dispatches; on stop, push anyway — the completer drains
+      // everything before exiting.
       pq.cv_space.wait(lk, [&] {
-        return pq.entries.size() < s->inflight_window || s->stop.load();
+        return pq.entries.size() + pq.resolving <
+                   s->inflight_window ||
+               s->stop.load();
       });
       pq.entries.push_back({std::move(group), ticket, total, ep, hashed});
     }
@@ -1152,8 +1194,73 @@ void dispatcher_main(Server* s, uint32_t shard) {
       if (s->stop.load() && q.queue.empty()) return;
       while (!q.queue.empty() && run_keys < s->max_batch) {
         // RESET/METRICS ride the same queue (keys empty or kind marker).
-        run_keys += pending_count(q.queue.front());
-        run.push_back(std::move(q.queue.front()));
+        Pending& front = q.queue.front();
+        size_t nk = pending_count(front);
+        size_t room = s->max_batch - run_keys;
+        // Cut BEFORE crossing max_batch (never overshoot the largest
+        // prewarmed pad shape). Mid-run, string Pendings cut whole
+        // (the next run takes them); an oversized Pending — hashed
+        // anywhere in a run, string opening one — is carved at the
+        // boundary below. Only SLO mode still dispatches an oversized
+        // Pending whole: the SLO watcher answers per-Pending with no
+        // join awareness, and prewarm covers one pad shape past
+        // max_batch, so only an SLO-mode frame past 2*max_batch pays
+        // a hot-path compile.
+        if (nk > room && run_keys > 0 &&
+            (!front.hashed || s->slo_us > 0)) break;
+        if (nk > room && s->slo_us == 0) {
+          // Never let a dispatch overshoot max_batch: the Python side
+          // prewarms every pad shape up to max_batch, so a run of
+          // max_batch+1 items pads to the NEXT power of two and pays a
+          // full jit compile on the hot path — the multi-second stalls
+          // behind the r06 mixed-traffic collapse (ADR-013). Segments
+          // are position-indexed (`pos`), so carve off exactly `room`
+          // items and leave a continuation that reassembles through
+          // the same (extended) BatchJoin — the string lane rides the
+          // shard-split deposit path verbatim. (room >= 1 here: the
+          // loop condition guarantees run_keys < max_batch; a string
+          // Pending only reaches the carve opening a run — the
+          // whole-Pending cut above breaks first — so room is the
+          // full max_batch there.)
+          JoinPtr j = front.join;
+          if (j == nullptr) {
+            // Whole frame about to be segmented: wrap it in a join so
+            // the response still goes out as ONE frame.
+            uint32_t cnt = (uint32_t)pending_count(front);
+            j = std::make_shared<BatchJoin>(1, front.conn, front.req_id,
+                                            cnt);
+            j->hashed = front.hashed;
+            front.join = j;
+            front.pos.resize(cnt);
+            for (uint32_t i = 0; i < cnt; ++i) front.pos[i] = i;
+          }
+          // Register the continuation BEFORE the first half can ever
+          // deposit (both still belong to this thread here), so
+          // remaining cannot reach zero while a segment is outstanding.
+          j->remaining.fetch_add(1);
+          Pending head{front.conn, front.req_id, front.is_batch, {}, {}};
+          head.hashed = front.hashed;
+          head.join = j;
+          if (front.hashed) {
+            head.ids.assign(front.ids.begin(), front.ids.begin() + room);
+            front.ids.erase(front.ids.begin(), front.ids.begin() + room);
+          } else {
+            head.keys.assign(
+                std::make_move_iterator(front.keys.begin()),
+                std::make_move_iterator(front.keys.begin() + room));
+            front.keys.erase(front.keys.begin(),
+                             front.keys.begin() + room);
+          }
+          head.ns.assign(front.ns.begin(), front.ns.begin() + room);
+          head.pos.assign(front.pos.begin(), front.pos.begin() + room);
+          front.ns.erase(front.ns.begin(), front.ns.begin() + room);
+          front.pos.erase(front.pos.begin(), front.pos.begin() + room);
+          run_keys += room;
+          run.push_back(std::move(head));
+          break;  // run is exactly full
+        }
+        run_keys += nk;
+        run.push_back(std::move(front));
         q.queue.pop_front();
       }
       q.queued_keys -= std::min(q.queued_keys, run_keys);
@@ -1713,12 +1820,19 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
       usleep(10000);
     }
     // Let the completers resolve every in-flight ticket (pipelined
-    // mode) — an unresolved launch is an unanswered client.
+    // mode) — an unresolved launch is an unanswered client. A ticket a
+    // completer has swapped out for its batched drain counts too
+    // (`resolving`): the queue alone looks empty mid-batch. Read both
+    // under the queue's lock — the completer's swap and its
+    // resolving increment happen atomically under that lock, so an
+    // empty queue observed here implies any swapped batch is already
+    // counted (checking the counter before the lock could miss the
+    // transition and proceed mid-resolve).
     for (int i = 0; i < 200; ++i) {
       bool empty = true;
       for (auto& pq : s->pipeqs) {
         std::lock_guard<std::mutex> g(pq->mx);
-        empty = empty && pq->entries.empty();
+        empty = empty && pq->entries.empty() && pq->resolving == 0;
       }
       if (empty) break;
       usleep(10000);
@@ -1766,7 +1880,9 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
   size_t depth = 0;
   for (auto& pq : ps->s->pipeqs) {
     std::lock_guard<std::mutex> g(pq->mx);
-    depth += pq->entries.size();
+    // Queued plus swapped out for the completer's batched drain — both
+    // are launched-but-unresolved.
+    depth += pq->entries.size() + (size_t)pq->resolving;
   }
   PyObject* per_shard = PyList_New(ps->s->num_shards);
   if (per_shard == nullptr) return nullptr;
@@ -1965,7 +2081,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 7; }
+int64_t rl_server_abi_version() { return 8; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -313,6 +313,43 @@ class MetricsDecorator(LimiterDecorator):
                 "Admitted-mass level where collision error reaches ~1% "
                 "false denies for this geometry")
             self._budget_g.set(float(base.mass_budget), shard=self._shard)
+        # Debt-slab surface (token-bucket sketch only): the continuous-
+        # decay mirror of the mass watchdog (ROADMAP item 5 — strict
+        # gating doesn't transfer, visibility does). Reading it costs a
+        # device fetch under the backend lock, so the gauges refresh via
+        # a scrape-time collect hook, never per decision. A sliced mesh
+        # expands to its per-device slices, one series each.
+        self._debt_slabs = [
+            (i, sl) for i, sl in enumerate(base.sub_limiters())
+            if hasattr(sl, "debt_slab_stats")]
+        if self._debt_slabs:
+            self._debt_occ_g = reg.gauge(
+                "rate_limiter_debt_slab_occupancy",
+                "Max per-row fraction of debt cells with positive "
+                "effective debt (colliding active keys share refill; "
+                "hot rows throttle hot keys toward combined throughput)")
+            self._debt_coll_g = reg.gauge(
+                "rate_limiter_debt_slab_collision_probability",
+                "Chance a fresh key reads an overestimated debt (an "
+                "occupied cell in every sketch row) — errors are toward "
+                "denying")
+            reg.add_collect_hook(self._collect_debt_slab)
+
+    def _collect_debt_slab(self) -> None:
+        for i, sl in self._debt_slabs:
+            st = sl.debt_slab_stats()
+            self._debt_occ_g.set(st["occupancy"],
+                                 shard=self._shard, slice=str(i))
+            self._debt_coll_g.set(st["collision_p"],
+                                  shard=self._shard, slice=str(i))
+
+    def close(self) -> None:
+        # Unhook BEFORE closing: on the process-default registry a
+        # leftover collect hook would pin this decorator (and the closed
+        # backend's device arrays) forever and poke it on every scrape.
+        if self._debt_slabs:
+            self.registry.remove_collect_hook(self._collect_debt_slab)
+        super().close()
 
     def _observe_envelope(self) -> None:
         if self._sketch is not None:

@@ -163,6 +163,7 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        self._collect_hooks: list = []
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -188,7 +189,40 @@ class Registry:
     def get(self, name: str) -> Optional[_Metric]:
         return self._metrics.get(name)
 
+    def add_collect_hook(self, fn) -> None:
+        """Register a zero-arg callable run at the START of every
+        ``render()`` — i.e. at scrape time. This is how gauges whose
+        value costs real work (a device fetch under the backend lock,
+        e.g. the debt-slab occupancy surface) stay current without ever
+        touching the decision hot path: they refresh once per scrape,
+        not once per decision. Hooks must be idempotent; duplicates are
+        collapsed by identity of the bound callable."""
+        with self._lock:
+            if fn not in self._collect_hooks:
+                self._collect_hooks.append(fn)
+
+    def remove_collect_hook(self, fn) -> None:
+        """Unregister a collect hook (no-op if absent). Owners of hooked
+        resources MUST call this on close — on the process-default
+        registry a leftover hook would pin the closed backend (and its
+        device arrays) alive forever and run against it on every
+        scrape."""
+        with self._lock:
+            try:
+                self._collect_hooks.remove(fn)
+            except ValueError:
+                pass
+
     def render(self) -> str:
+        with self._lock:
+            hooks = list(self._collect_hooks)
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — a scrape must never fail
+                # because one collector's backend is mid-restart/closed;
+                # the gauge just keeps its last value.
+                pass
         lines: list[str] = []
         with self._lock:
             metrics = list(self._metrics.values())

@@ -366,15 +366,23 @@ class SlicedMeshLimiter(RateLimiter):
                                               arrays[pos], ns[pos])))
         t.b = b
         t.limit = self.config.limit
+        # Wire frames reassemble device-packed buffers at resolve (the
+        # scatter-back path) — only meaningful on the raw-id lane, the
+        # one surface whose sub-launches pack on device.
+        t.wire = bool(wire and premix)
         return t
 
     def resolve(self, ticket: DispatchTicket) -> BatchResult:
         """Resolve every slice dispatch and scatter results back to the
-        frame's original positions. Failure semantics across slices are
-        non-transactional, the same contract as the native door's
-        multi-shard frames: a fail-closed error on one slice fails the
-        frame, but other slices' quota stands; fail-open slices answer
-        fail-open and the frame's flag ORs over slices."""
+        frame's original positions — completion is ONE barrier per frame
+        (a single ``block_until_ready`` over every sub-dispatch, ADR-013),
+        not a per-slice wait chain, so the frame finishes when the
+        SLOWEST slice does regardless of resolution order. Failure
+        semantics across slices are non-transactional, the same contract
+        as the native door's multi-shard frames: a fail-closed error on
+        one slice fails the frame, but other slices' quota stands;
+        fail-open slices answer fail-open and the frame's flag ORs over
+        slices."""
         if ticket.result is not None:
             return ticket.result
         subs = getattr(ticket, "subs", None)
@@ -388,6 +396,19 @@ class SlicedMeshLimiter(RateLimiter):
             res = self.slices[s].resolve(sub)
             ticket.result = res
             return res
+        # Single completion barrier: wait for EVERY slice's device work
+        # in one call, then the per-slice resolves below are pure
+        # (already-hot) fetches + bookkeeping. Errors surface in the
+        # per-slice resolve, which owns the fail-open/closed contract.
+        outs = [sub.outs for _, _, sub in subs
+                if getattr(sub, "outs", None) is not None]
+        if outs:
+            try:
+                import jax
+
+                jax.block_until_ready(outs)
+            except Exception:
+                pass  # the owning slice's resolve reports it properly
         b = ticket.b
         allowed = np.zeros(b, dtype=bool)
         remaining = np.zeros(b, dtype=np.int64)
@@ -396,6 +417,7 @@ class SlicedMeshLimiter(RateLimiter):
         limits = None
         fail_open = False
         err = None
+        wire = bool(getattr(ticket, "wire", False))
         for s, pos, sub in subs:
             try:
                 res = self.slices[s].resolve(sub)
@@ -407,16 +429,32 @@ class SlicedMeshLimiter(RateLimiter):
             retry[pos] = res.retry_after
             reset_at[pos] = res.reset_at
             fail_open = fail_open or res.fail_open
+            wire = wire and res.wire_packed is not None
             if res.limits is not None:
                 if limits is None:
                     limits = np.full(b, self.config.limit, dtype=np.int64)
                 limits[pos] = res.limits
         if err is not None:
             raise err
+        wire_packed = None
+        if wire:
+            # Scatter-back of the device-packed wire buffers through the
+            # index maps (ADR-013): rebuild the frame-order packed form
+            # with three vectorized gathers + one packbits, so the wire
+            # encoder still frames from packed buffers (memoryview
+            # column slices, no per-row host math). The gather is the
+            # price of cross-slice reassembly; single-owner frames pass
+            # the slice's buffers through untouched above.
+            words = np.empty(3 * b, dtype=np.int64)
+            words[0:b] = remaining
+            words[b:2 * b] = retry.view(np.int64)
+            words[2 * b:3 * b] = reset_at.view(np.int64)
+            wire_packed = (np.packbits(allowed, bitorder="little"),
+                           words, b)
         res = BatchResult(allowed=allowed, limit=self.config.limit,
                           remaining=remaining, retry_after=retry,
                           reset_at=reset_at, fail_open=fail_open,
-                          limits=limits)
+                          limits=limits, wire_packed=wire_packed)
         ticket.result = res
         return res
 

@@ -227,6 +227,31 @@ def _envelope_health(limiters) -> dict:
             "overload_policy": lims[0].config.sketch.overload_policy}
 
 
+def _debt_slab_health(limiters) -> dict:
+    """Debt-slab occupancy/collision fields for /healthz (token-bucket
+    sketch only) — the continuous-decay mirror of `_envelope_health`
+    (ROADMAP item 5: strict gating doesn't transfer to the debt slab,
+    visibility does). Aggregation across dispatch shards / mesh slices:
+    occupancy and collision_p report the WORST unit (a hot slice hides
+    behind healthy ones under a mean), cell counts sum. Each call costs
+    one device fetch per unit — /healthz cadence, never the decide
+    path."""
+    from ratelimiter_tpu.observability.decorators import undecorated
+
+    lims = [undecorated(lim) for lim in limiters]
+    lims = [sl for lim in lims for sl in lim.sub_limiters()]
+    lims = [lim for lim in lims if hasattr(lim, "debt_slab_stats")]
+    if not lims:
+        return {}
+    stats = [lim.debt_slab_stats() for lim in lims]
+    return {"debt_slab": {
+        "occupancy": max(s["occupancy"] for s in stats),
+        "collision_p": max(s["collision_p"] for s in stats),
+        "nonzero_cells": sum(s["nonzero_cells"] for s in stats),
+        "cells": sum(s["cells"] for s in stats),
+        "units": len(stats)}}
+
+
 def make_threadsafe_decide(batcher, loop):
     """Single-decision bridge from gateway/gRPC worker threads into the
     event loop's micro-batcher: every surface shares device dispatches."""
@@ -256,23 +281,30 @@ def make_threadsafe_decide_many(batcher, loop):
 
 
 def _prewarm(limiter, max_batch: int) -> None:
-    """Compile every batch pad shape the micro-batcher can produce (powers
-    of two up to max_batch) BEFORE accepting traffic, so no client request
-    ever pays a jit compile. With the persistent compilation cache this is
-    fast on every start after the first. A sliced mesh limiter warms
-    EVERY device slice across the full shape range (a skewed frame can
-    hand any slice up to the whole batch, so partial per-slice warming
-    would leave compiles on the hot path)."""
+    """Compile every batch pad shape the serving tier can produce BEFORE
+    accepting traffic, so no client request ever pays a jit compile: the
+    powers of two up to max_batch, PLUS one shape past it — the native
+    door's coalescer cuts runs at max_batch (and segments hashed frames
+    across the boundary, ADR-013), but a single wire frame larger than
+    max_batch still dispatches alone and pads to the next shape. (The
+    r06 mixed-traffic collapse was exactly this: ragged coalesced runs
+    overshooting max_batch by a slice landed multi-second XLA compiles
+    on the hot path.) With the persistent compilation cache this is fast
+    on every start after the first. A sliced mesh limiter warms EVERY
+    device slice across the full shape range (a skewed frame can hand
+    any slice up to the whole batch, so partial per-slice warming would
+    leave compiles on the hot path)."""
     import numpy as np
 
     from ratelimiter_tpu.observability.decorators import undecorated
 
     t0 = time.time()
+    top = 2 * max_batch
     targets = undecorated(limiter).sub_limiters()
     for tgt in targets:
         size = 8
         while True:
-            size = min(size, max_batch)
+            size = min(size, top)
             h = np.arange(size, dtype=np.uint64) + (1 << 62)
             tgt.allow_hashed(h, now=0.0)
             if hasattr(undecorated(tgt), "allow_ids"):
@@ -281,12 +313,12 @@ def _prewarm(limiter, max_batch: int) -> None:
                 # too so the first ALLOW_HASHED frame never pays a
                 # compile.
                 tgt.allow_ids(h, now=0.0)
-            if size >= max_batch:
+            if size >= top:
                 break
             size *= 2
     logging.getLogger("ratelimiter_tpu.serving").info(
         "prewarmed pad shapes up to %d (%d dispatch target%s) in %.1fs",
-        max_batch, len(targets), "s" if len(targets) != 1 else "",
+        top, len(targets), "s" if len(targets) != 1 else "",
         time.time() - t0)
 
 
@@ -449,6 +481,7 @@ async def amain(args) -> None:
                                 "policy_overrides":
                                     server.shard_limiters[0].override_count(),
                                 **_envelope_health(server.shard_limiters),
+                                **_debt_slab_health(server.shard_limiters),
                                 **(persist.status() if persist else {})},
                 enable_reset=http_reset,
                 reset_token=args.http_reset_token,
@@ -555,6 +588,7 @@ async def amain(args) -> None:
                             "decisions_total": server.batcher.decisions_total,
                             "policy_overrides": limiter.override_count(),
                             **_envelope_health([limiter]),
+                            **_debt_slab_health([limiter]),
                             **(persist.status() if persist else {})},
             enable_reset=http_reset,
             reset_token=args.http_reset_token,

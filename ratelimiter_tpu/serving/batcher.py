@@ -93,6 +93,13 @@ class MicroBatcher:
         self.inflight = inflight
         self.adaptive_delay = adaptive_delay
         self._pending: List[Tuple[str, int, asyncio.Future]] = []
+        #: Queued ALLOW_HASHED frames awaiting the next coalescing window
+        #: (scatter-gather scheduling, ADR-013): (ids, ns, future) per
+        #: frame; flushed alongside the string queue into ONE launch per
+        #: window, each frame answered from its contiguous row range.
+        self._pending_hashed: List[Tuple[np.ndarray, np.ndarray,
+                                         asyncio.Future]] = []
+        self._pending_hashed_ids = 0
         self._timer: Optional[asyncio.TimerHandle] = None
         self._first_ts = 0.0
         self._armed_depth = 0
@@ -178,7 +185,10 @@ class MicroBatcher:
         return fut
 
     def _arm_timer(self, loop: asyncio.AbstractEventLoop) -> None:
-        depth = len(self._pending)
+        # Queue depth counts BOTH lanes in max_batch units: pending
+        # string decisions plus queued hashed-frame ids — the adaptive
+        # window reacts to total offered load, whichever door it enters.
+        depth = len(self._pending) + self._pending_hashed_ids
         self._queue_depth.set(depth)
         if not depth:
             return
@@ -249,15 +259,19 @@ class MicroBatcher:
 
     def submit_hashed_nowait(self, ids: np.ndarray,
                              ns: np.ndarray) -> asyncio.Future:
-        """Queue one whole ALLOW_HASHED frame as its own dispatch (the
-        zero-copy bulk lane, ADR-011): the frame IS the batch — the raw
-        u64 ids stage straight into the limiter's pools (one memcpy) and
-        splitmix64 + the (h1, h2) split run on device inside the jitted
-        step. The future resolves to the frame's BatchResult. Rides the
-        SAME launch/resolve executors and in-flight window as the
-        coalesced string path, so pipelining, backpressure and FIFO state
-        threading are shared. Must run on the event loop thread; requires
-        a limiter exposing the raw-id lane (sketch-family backends)."""
+        """Queue one whole ALLOW_HASHED frame into the current coalescing
+        window (the zero-copy bulk lane, ADR-011 + the scatter-gather
+        scheduler, ADR-013): every hashed frame queued within
+        ``max_delay`` (adaptive, shared with the string lane) merges into
+        ONE ``launch_ids`` dispatch — on a sliced mesh backend that is
+        one padded sub-dispatch per touched device per window instead of
+        one fork-join per frame. Each frame's future resolves to its
+        contiguous row range of the window's BatchResult (wire buffers
+        ride along zero-copy). Rides the SAME launch/resolve executors
+        and in-flight window as the string path, so pipelining,
+        backpressure and FIFO state threading are shared. Must run on
+        the event loop thread; requires a limiter exposing the raw-id
+        lane (sketch-family backends)."""
         if self._draining:
             raise StorageUnavailableError("server is shutting down")
         if not self._hashed_lane:
@@ -279,9 +293,54 @@ class MicroBatcher:
                 retry_after=np.zeros(0, dtype=np.float64),
                 reset_at=np.zeros(0, dtype=np.float64)))
             return fut
-        task = asyncio.ensure_future(self._dispatch_hashed(ids, ns, fut))
-        self._inflight.add(task)
-        task.add_done_callback(self._inflight.discard)
+        b = int(ids.shape[0])
+        if b > 2 * self.max_batch:
+            # A LONE frame past the largest prewarmed pad shape
+            # (2*max_batch) would land an XLA compile on the hot path —
+            # the same r06 collapse mode the window guard below
+            # prevents for concatenated windows, reachable here because
+            # the wire protocol admits frames up to MAX_FRAME (~87K
+            # ids) regardless of --max-batch. Mirror the native door's
+            # dispatcher carve: flush the pending window (arrival order
+            # across dispatches), dispatch max_batch segments in order
+            # through the same FIFO executors (same-key sequencing
+            # across segments is exactly sequential-dispatch order),
+            # and reassemble host-side (fail_open ORs over segments,
+            # same contract as the native BatchJoin; the merged result
+            # carries no device-packed wire buffers, so the encoder
+            # takes its packbits path — one host re-pack on a frame
+            # shape that is rare by construction).
+            if self._pending_hashed:
+                self._flush()
+            seg_futs: List[asyncio.Future] = []
+            for off in range(0, b, self.max_batch):
+                sfut: asyncio.Future = loop.create_future()
+                seg_futs.append(sfut)
+                task = asyncio.ensure_future(self._dispatch_hashed(
+                    ids[off:off + self.max_batch],
+                    ns[off:off + self.max_batch], sfut))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
+            join = asyncio.ensure_future(self._join_segments(seg_futs, fut))
+            self._inflight.add(join)
+            join.add_done_callback(self._inflight.discard)
+            return fut
+        if (self._pending_hashed
+                and self._pending_hashed_ids + b > 2 * self.max_batch):
+            # Coalescing must never produce a window larger than the
+            # largest prewarmed pad shape (2*max_batch — the allowance
+            # for a lone oversized wire frame): concatenating past it
+            # would land an XLA compile on the hot path, the exact r06
+            # collapse mode ADR-013 exists to prevent. Flush the current
+            # window first; the oversized frame then dispatches alone
+            # (arrival order across dispatches is preserved).
+            self._flush()
+        self._pending_hashed.append((ids, ns, fut))
+        self._pending_hashed_ids += b
+        if self._pending_hashed_ids >= self.max_batch:
+            self._flush()
+        else:
+            self._arm_timer(loop)
         return fut
 
     def _launch_hashed_work(self, ids, ns):
@@ -356,20 +415,91 @@ class MicroBatcher:
         if not fut.done():
             fut.set_result(out)
 
+    async def _join_segments(self, seg_futs: List[asyncio.Future],
+                             fut: asyncio.Future) -> None:
+        """Reassemble a carved oversized hashed frame (ADR-013): await
+        every segment dispatch and answer the frame's future with the
+        host-side concatenation. Any segment error fails the whole
+        frame (a partial answer would mis-align the columnar reply);
+        ``fail_open`` ORs over segments and per-request ``limits``
+        materialize wherever any segment carried overrides — both the
+        same contracts as the native door's multi-segment BatchJoin."""
+        outs = await asyncio.gather(*seg_futs, return_exceptions=True)
+        exc = next((o for o in outs if isinstance(o, BaseException)), None)
+        if exc is not None:
+            if not fut.done():
+                fut.set_exception(exc)
+            return
+        merged = BatchResult(
+            allowed=np.concatenate([o.allowed for o in outs]),
+            limit=outs[0].limit,
+            remaining=np.concatenate([o.remaining for o in outs]),
+            retry_after=np.concatenate([o.retry_after for o in outs]),
+            reset_at=np.concatenate([o.reset_at for o in outs]),
+            fail_open=any(o.fail_open for o in outs),
+            limits=(np.concatenate(
+                [o.limits if o.limits is not None
+                 else np.full(len(o), o.limit, dtype=np.int64)
+                 for o in outs])
+                if any(o.limits is not None for o in outs) else None))
+        if not fut.done():
+            fut.set_result(merged)
+
+    async def _dispatch_hashed_window(self, frames) -> None:
+        """Dispatch one coalescing window of hashed frames (ADR-013): a
+        single-frame window keeps the exact frame-as-batch path; a
+        multi-frame window concatenates in ARRIVAL order (same-key
+        sequencing across a connection's back-to-back frames is
+        preserved — in-batch segment ordering decides duplicates exactly
+        as sequential dispatches would), launches ONCE, and answers each
+        frame from its contiguous row range of the window result
+        (BatchResult.rows — numpy views + row-offset wire buffers, no
+        re-packing)."""
+        if len(frames) == 1:
+            ids, ns, fut = frames[0]
+            await self._dispatch_hashed(ids, ns, fut)
+            return
+        ids = np.concatenate([f[0] for f in frames])
+        ns = np.concatenate([f[1] for f in frames])
+        loop = asyncio.get_running_loop()
+        win: asyncio.Future = loop.create_future()
+        await self._dispatch_hashed(ids, ns, win)
+        exc = win.exception()
+        if exc is not None:
+            for _, _, fut in frames:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        out = win.result()
+        off = 0
+        for fids, _, fut in frames:
+            k = int(fids.shape[0])
+            if not fut.done():
+                fut.set_result(out.rows(off, k))
+            off += k
+
     # ------------------------------------------------------------- flush
 
     def _flush(self) -> None:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
-        if not self._pending:
+        if not self._pending and not self._pending_hashed:
             return
-        batch = self._pending
-        self._pending = []
         self._queue_depth.set(0)
-        task = asyncio.ensure_future(self._dispatch(batch))
-        self._inflight.add(task)
-        task.add_done_callback(self._inflight.discard)
+        if self._pending:
+            batch = self._pending
+            self._pending = []
+            task = asyncio.ensure_future(self._dispatch(batch))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+        if self._pending_hashed:
+            frames = self._pending_hashed
+            self._pending_hashed = []
+            self._pending_hashed_ids = 0
+            task = asyncio.ensure_future(self._dispatch_hashed_window(frames))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
 
     def _launch_work(self, keys, ns):
         """Launch stage (runs on the launch executor thread): acquire an

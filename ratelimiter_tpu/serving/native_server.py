@@ -39,7 +39,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 7
+_ABI = 8
 
 
 def _load_extension():

@@ -431,20 +431,41 @@ def encode_result_hashed_views(req_id: int, res) -> list:
         return [encode_result_hashed(req_id, res)]
     b = len(res)
     flags = 2 if res.fail_open else 0
-    bits_arr, words, padded = wp
+    bits_arr, words, padded = wp[0], wp[1], wp[2]
+    # Row-window form (BatchResult.rows, ADR-013): frame the sub-range
+    # [off, off+b) of a coalesced window's packed buffers — the value
+    # columns stay offset memoryviews either way; the mask is a byte
+    # slice when the frame landed byte-aligned in the window (the common
+    # case: frame sizes are multiples of 8) and a packbits re-pack of
+    # just this frame's bits otherwise.
+    off = wp[3] if len(wp) > 3 else 0
     nb = (b + 7) // 8
-    bits = bytearray(bits_arr[:nb].tobytes())
-    if b & 7 and nb:
-        # Zero the pad rows' bits in the final partial byte so the frame
-        # bytes are deterministic (pad rows can read allowed).
-        bits[-1] &= (1 << (b & 7)) - 1
+    if off & 7 == 0:
+        lo = off >> 3
+        bits = bytearray(bits_arr[lo:lo + nb].tobytes())
+        if b & 7 and nb:
+            # Zero the trailing bits in the final partial byte (pad rows
+            # or the next frame's rows) so frame bytes are deterministic.
+            bits[-1] &= (1 << (b & 7)) - 1
+    else:
+        import numpy as np
+
+        # Unpack only this frame's byte range (O(frame), not O(window)
+        # — a window of odd-sized frames would otherwise unpack the
+        # whole 2*max_batch-bit mask once per frame).
+        lo = off >> 3
+        chunk = np.asarray(bits_arr[lo:(off + b + 7) >> 3])
+        rows_bits = np.unpackbits(chunk, bitorder="little")[
+            off - 8 * lo:off - 8 * lo + b]
+        bits = bytearray(np.packbits(rows_bits, bitorder="little").tobytes())
     body_len = _HASHED_RES_HEAD.size + nb + 24 * b
     head = (_HDR.pack(1 + 8 + body_len, T_RESULT_HASHED, req_id)
             + _HASHED_RES_HEAD.pack(flags, res.limit, b) + bytes(bits))
     return [head,
-            memoryview(words[:b]).cast("B"),
-            memoryview(words[padded:padded + b]).cast("B"),
-            memoryview(words[2 * padded:2 * padded + b]).cast("B")]
+            memoryview(words[off:off + b]).cast("B"),
+            memoryview(words[padded + off:padded + off + b]).cast("B"),
+            memoryview(words[2 * padded + off:2 * padded + off + b])
+            .cast("B")]
 
 
 def parse_result_hashed(body: bytes):

@@ -160,16 +160,32 @@ class TestMeshPipeline:
 
     def test_single_owner_wire_frame_passes_device_packed_buffers(self):
         """A frame fully owned by one slice keeps the zero-copy
-        wire_packed buffers (the composite must not strip them)."""
+        wire_packed buffers (the composite must not strip them), and a
+        MIXED wire frame reassembles packed buffers through the index
+        maps (ADR-013 scatter-back) — the wire encoder frames either
+        from packed columns, never by re-packing per row."""
         mesh = SlicedMeshLimiter(_cfg(), ManualClock(T0), n_devices=4)
         ids = np.arange(1, 4000, dtype=np.uint64)
         owners = mesh.owner_of_id(ids)
         mine = ids[owners == 2][:64]
         res = mesh.resolve(mesh.launch_ids(mine, wire=True))
         assert res.wire_packed is not None
-        # A mixed frame reassembles host-side: no packed buffers.
+        # A mixed frame reassembles the packed form host-side via the
+        # scatter-back: buffers present and bit-consistent with the
+        # row-level columns.
         res2 = mesh.resolve(mesh.launch_ids(ids[:64], wire=True))
-        assert res2.wire_packed is None
+        assert res2.wire_packed is not None
+        bits, words, padded = res2.wire_packed
+        b = len(res2)
+        np.testing.assert_array_equal(
+            np.unpackbits(bits, bitorder="little")[:b].astype(bool),
+            res2.allowed)
+        np.testing.assert_array_equal(words[:b], res2.remaining)
+        np.testing.assert_array_equal(
+            words[padded:padded + b].view(np.float64), res2.retry_after)
+        np.testing.assert_array_equal(
+            words[2 * padded:2 * padded + b].view(np.float64),
+            res2.reset_at)
         mesh.close()
 
     def test_fail_open_split_frame_ors_the_flag(self):
