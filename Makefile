@@ -6,8 +6,8 @@ PY ?= python
 REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
-.PHONY: help test test-all test-serving test-mesh lint check native \
-        bench bench-quick bench-matrix serve verify clean
+.PHONY: help test test-all test-serving test-mesh test-tracing lint check \
+        native bench bench-quick bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -24,6 +24,9 @@ test-serving:    ## serving tier only
 test-mesh:       ## mesh contract + multichip + slice-parallel serving tests
 	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py \
 	    tests/test_mesh_serving.py tests/test_scatter_gather.py -q
+
+test-tracing:    ## flight-recorder span trees, both doors (ADR-014)
+	$(PY) -m pytest tests/test_tracing.py -q
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -369,6 +369,74 @@ def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
             shutil.rmtree(td, ignore_errors=True)
 
 
+def measure_stage_breakdown(*, seconds: float = 1.5, batch: int = 2048,
+                            depth: int = 3, width: int = 1 << 14) -> dict:
+    """``--trace`` block (ADR-014): drive a live in-process asyncio door
+    with the flight recorder on — traced ALLOW_HASHED and ALLOW_BATCH
+    frames — and reduce the recorder to a per-stage microsecond
+    breakdown (``stage_us``: io/route/coalesce/launch/device/resolve/
+    encode mean per span + counts), so BENCH_tpu_r01 (ROADMAP item 5)
+    lands with stage attribution from day one. Importable —
+    tests/test_tracing.py runs it tiny as the bench-lane smoke."""
+    import asyncio
+
+    from ratelimiter_tpu import Algorithm as _Alg, Config as _Cfg, \
+        SketchParams as _SP, create_limiter
+    from ratelimiter_tpu.observability import tracing
+    from ratelimiter_tpu.serving.client import AsyncClient
+    from ratelimiter_tpu.serving.server import RateLimitServer
+
+    was_on = tracing.RECORDER is not None
+    rec = tracing.enable()
+
+    async def run() -> int:
+        cfg = _Cfg(algorithm=_Alg.SLIDING_WINDOW, limit=100, window=60.0,
+                   max_batch_admission_iters=1,
+                   sketch=_SP(depth=depth, width=width, sub_windows=60))
+        lim = create_limiter(cfg, backend="sketch")
+        srv = RateLimitServer(lim, max_batch=batch, max_delay=500e-6)
+        await srv.start()
+        c = await AsyncClient.connect(srv.host, srv.port)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, 1 << 40, size=batch).astype(np.uint64)
+        keys = [f"user:{i}" for i in rng.integers(0, 1 << 20, size=256)]
+        # Warm the pad shapes outside the recorded window.
+        await c.allow_hashed(ids)
+        await c.allow_batch(keys)
+        done = 0
+        stop = time.perf_counter() + seconds
+        while time.perf_counter() < stop:
+            tid = tracing.new_trace_id()
+            t0 = tracing.now()
+            out = await c.allow_hashed(ids, trace_id=tid)
+            await c.allow_batch(keys, trace_id=tid)
+            tracing.record("client", t0, tracing.now(), trace_id=tid,
+                           batch=len(out) + len(keys))
+            done += len(out) + len(keys)
+        await c.close()
+        await srv.shutdown()
+        lim.close()
+        return done
+
+    decisions = asyncio.run(run())
+    summary = rec.stage_summary()
+    if not was_on:
+        tracing.disable()
+    order = ("io", "route", "queue", "coalesce", "launch", "device",
+             "resolve", "encode")
+    return {
+        "door": "asyncio (in-process; native-door per-stage aggregates "
+                "live in stats()['stage_ns'])",
+        "decisions": decisions,
+        "stage_us": {s: summary.get(s, {}).get("mean_us", 0.0)
+                     for s in order},
+        "stage_p99_us": {s: summary.get(s, {}).get("p99_us", 0.0)
+                         for s in order},
+        "stage_spans": {s: summary.get(s, {}).get("count", 0)
+                        for s in order},
+    }
+
+
 def measure_host_phases(B: int = INGEST_BATCH, reps: int = 30) -> dict:
     """Per-frame host-phase breakdown (ISSUE-4 satellite): microseconds a
     server's host CPU spends per B-key frame in each phase — parse
@@ -448,6 +516,11 @@ def main() -> None:
     ap.add_argument("--inflight", type=int, default=8, metavar="N",
                     help="pipelined dispatch window for the phase-D "
                          "server (1 = the old synchronous path)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also run the flight-recorder stage breakdown "
+                         "(ADR-014): a traced in-process serving run "
+                         "reduced to per-stage mean/p99 microseconds "
+                         "(stage_us block in the JSON)")
     ap.add_argument("--mesh-devices", type=int, default=None, metavar="N",
                     help="also sweep the slice-parallel mesh backend "
                          "(ADR-012) at n=1,2,4,..,N devices and emit the "
@@ -732,6 +805,14 @@ def main() -> None:
             e2e_seconds=4.0,
             log=lambda msg: print(msg, file=sys.stderr, flush=True))}
 
+    # --------------------------------------- phase G: stage attribution
+    # (opt-in, --trace): per-stage latency breakdown from the flight
+    # recorder over a traced in-process serving run (ADR-014).
+    trace_block: dict = {}
+    if args.trace:
+        trace_block = {"trace_stage_breakdown": measure_stage_breakdown(
+            seconds=1.5 if not on_accel else 3.0)}
+
     # ------------------------------------------ phase E: durability cost
     snap_overhead: dict = {}
     if args.snapshot_interval is not None:
@@ -800,6 +881,7 @@ def main() -> None:
         **e2e,
         **mesh_block,
         **snap_overhead,
+        **trace_block,
     }))
 
 

@@ -98,6 +98,10 @@ def main() -> None:
     ap.add_argument("--only", choices=["matrix", "configs", "e2e"],
                     default=None)
     ap.add_argument("--out", default=os.path.join(HERE, "RESULTS"))
+    ap.add_argument("--trace-sample", type=int, default=0, metavar="N",
+                    help="e2e loadgen: sample every Nth frame per "
+                         "connection with a wire trace id and record "
+                         "client spans (ADR-014; 0 = off)")
     args = ap.parse_args()
 
     import jax
@@ -131,7 +135,8 @@ def main() -> None:
     if args.only in (None, "e2e"):
         from benchmarks.e2e import run_e2e
 
-        doc["e2e"] = run_e2e(quick=args.quick, log=log)
+        doc["e2e"] = run_e2e(quick=args.quick,
+                             trace_sample=args.trace_sample, log=log)
 
     doc["meta"]["wall_seconds"] = round(time.time() - t_start, 1)
     with open(f"{args.out}.json", "w") as f:

@@ -77,7 +77,8 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
 
 
 async def _drive(port: int, *, seconds: float, conns: int, window: int,
-                 n_keys: int, warmup: float = 2.0) -> Dict:
+                 n_keys: int, warmup: float = 2.0,
+                 trace_sample: int = 0) -> Dict:
     """Two passes over a live server:
 
     1. Throughput: each connection keeps `window` decisions in flight via
@@ -86,7 +87,13 @@ async def _drive(port: int, *, seconds: float, conns: int, window: int,
     2. Latency: a single connection, ONE scalar request in flight — the
        uncontended per-request RTT (closed-loop saturated latency is just
        Little's law on the queue, so it is measured separately).
+
+    ``trace_sample`` (ADR-014): every Nth frame per connection carries a
+    fresh wire trace id and records a client-side "client" span — the
+    loadgen half of the flight-recorder story (0 = off).
     """
+    from ratelimiter_tpu.observability import tracing
+
     rng = np.random.default_rng(0)
 
     # ---- pass 1: saturated throughput via batch frames
@@ -104,8 +111,18 @@ async def _drive(port: int, *, seconds: float, conns: int, window: int,
         async def one():
             nonlocal counted, i
             keys = [f"user:{ids[(i + j) % 65536]}" for j in range(frame)]
+            tid = 0
+            if trace_sample and (i // frame) % trace_sample == 0 \
+                    and tracing.RECORDER is not None:
+                tid = tracing.new_trace_id()
             i += frame
-            await c.allow_batch(keys)
+            if tid:
+                t0 = tracing.now()
+                await c.allow_batch(keys, trace_id=tid)
+                tracing.record("client", t0, tracing.now(), trace_id=tid,
+                               batch=frame)
+            else:
+                await c.allow_batch(keys)
             if time.perf_counter() >= t_measure:
                 counted += frame
 
@@ -149,11 +166,13 @@ async def _drive(port: int, *, seconds: float, conns: int, window: int,
 
 
 def _run_variant(name: str, backend: str, *, platform=None, seconds=6.0,
-                 conns=4, window=2048, native=False, log=print) -> Dict:
+                 conns=4, window=2048, native=False, trace_sample=0,
+                 log=print) -> Dict:
     proc, port = _spawn_server(backend, platform=platform, native=native)
     try:
         out = asyncio.run(_drive(port, seconds=seconds, conns=conns,
-                                 window=window, n_keys=100_000))
+                                 window=window, n_keys=100_000,
+                                 trace_sample=trace_sample))
     finally:
         proc.terminate()
         try:
@@ -294,23 +313,35 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
     return row
 
 
-def run_e2e(quick: bool = False, log=print) -> List[Dict]:
+def run_e2e(quick: bool = False, trace_sample: int = 0,
+            log=print) -> List[Dict]:
+    """``trace_sample=N`` (ADR-014) turns on the loadgen's client-side
+    flight recorder and samples every Nth frame per connection with a
+    wire trace id: client spans land in the local recorder, and a
+    server started with ``--flight-recorder`` attributes its stages to
+    the same ids (``python -m benchmarks --only e2e --trace-sample N``)."""
+    from ratelimiter_tpu.observability import tracing
+
+    if trace_sample:
+        tracing.enable()
     seconds = 2.0 if quick else 6.0
     window = 512 if quick else 2048
     rows = []
     rows.append(_run_variant("host-only (exact backend)", "exact",
-                             seconds=seconds, window=window, log=log))
+                             seconds=seconds, window=window,
+                             trace_sample=trace_sample, log=log))
     rows.append(_run_variant("sketch on cpu device", "sketch",
                              platform="cpu", seconds=seconds, window=window,
-                             log=log))
+                             trace_sample=trace_sample, log=log))
     try:
         rows.append(_run_variant(
             "NATIVE server, host-only (exact backend)", "exact",
-            seconds=seconds, window=window, native=True, log=log))
+            seconds=seconds, window=window, native=True,
+            trace_sample=trace_sample, log=log))
         rows.append(_run_variant(
             "NATIVE server, sketch on cpu device", "sketch",
             platform="cpu", seconds=seconds, window=window, native=True,
-            log=log))
+            trace_sample=trace_sample, log=log))
         rows.append(_run_native_loadgen(seconds=seconds, log=log))
     except Exception as exc:  # no compiler -> skip, never fail the suite
         rows.append({"variant": "native server", "error": str(exc)})
@@ -322,4 +353,12 @@ def run_e2e(quick: bool = False, log=print) -> List[Dict]:
         except Exception as exc:  # tunnel flakiness must not kill the suite
             rows.append({"variant": "sketch on default platform",
                          "error": str(exc)})
+    if trace_sample and tracing.RECORDER is not None:
+        # Surface the sampled client spans so the run proves its own
+        # sampling: count + RTT stats across every variant's loadgen.
+        summary = tracing.RECORDER.stage_summary().get("client")
+        rows.append({"variant": f"loadgen trace sampling (1/{trace_sample} "
+                                "frames)",
+                     "client_spans": summary or {"count": 0}})
+        tracing.disable()
     return rows

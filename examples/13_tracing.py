@@ -1,0 +1,119 @@
+"""Flight-recorder tracing: follow one frame through every stage (ADR-014).
+
+The flight recorder stamps per-stage spans (io -> coalesce -> launch ->
+device -> barrier/slice -> resolve -> encode) into per-thread ring
+buffers at clock-read cost, and a caller-minted trace id rides the wire
+so ONE id connects the client span to every server-side stage it
+crossed. This example traces a mixed mesh frame end-to-end and writes a
+Perfetto-loadable dump. Run with a virtual mesh on any host:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_PLATFORMS=cpu \
+        python examples/13_tracing.py
+
+The served form (dump via the bearer-gated HTTP endpoint, §6 of
+docs/OPERATIONS.md):
+
+    python -m ratelimiter_tpu.serving --backend mesh --flight-recorder \
+        --http-port 8433 --debug-trace --debug-token s3cret
+    curl -H 'Authorization: Bearer s3cret' \
+        http://localhost:8433/debug/trace > trace.json   # -> ui.perfetto.dev
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+if len(jax.devices()) < 2:
+    print("SKIP: need >= 2 devices (see module docstring)")
+    raise SystemExit(0)
+
+import asyncio
+import json
+
+import numpy as np
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams
+from ratelimiter_tpu.observability import Registry, tracing
+from ratelimiter_tpu.parallel import SlicedMeshLimiter
+from ratelimiter_tpu.serving import AsyncClient, RateLimitServer
+
+# Tracing is OFF by default (zero overhead: hot paths check one module
+# global and skip everything). enable() turns it on process-wide;
+# attaching a registry also derives rate_limiter_stage_seconds{stage=..}
+# histograms — with trace-id exemplars — at scrape time.
+reg = Registry()
+rec = tracing.enable(capacity=4096, registry=reg)
+
+cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+             sketch=SketchParams(depth=2, width=1024, sub_windows=6))
+mesh = SlicedMeshLimiter(cfg, n_devices=2)
+
+
+async def traced_request():
+    srv = RateLimitServer(mesh, max_batch=4096, max_delay=200e-6)
+    await srv.start()
+    c = await AsyncClient.connect(srv.host, srv.port)
+
+    # The caller mints the id and samples the request by passing it:
+    # trace_id= flags a tiny extension onto the wire frame, and every
+    # stage the frame crosses stamps a span under that id. Wrap the
+    # call in a client span so the dump shows wire+server time too.
+    tid = tracing.new_trace_id()
+    ids = np.arange(1, 257, dtype=np.uint64)      # spans BOTH slices
+    t0 = tracing.now()
+    out = await c.allow_hashed(ids, trace_id=tid)
+    tracing.record("client", t0, tracing.now(), trace_id=tid,
+                   batch=len(out))
+    assert out.allowed.all()
+
+    await c.close()
+    await srv.shutdown()
+    return tid
+
+
+tid = asyncio.run(traced_request())
+
+# The span tree for that one frame: client > io > coalesce/queue/launch
+# > device > barrier (one per frame, ADR-013) + one slice span per
+# touched device > resolve > encode.
+mine = sorted((s for s in rec.dump() if s["trace_id"] == tid),
+              key=lambda s: s["t_start_ns"])
+t0 = mine[0]["t_start_ns"]
+print(f"trace {tid:016x}: {len(mine)} spans")
+for s in mine:
+    off = (s["t_start_ns"] - t0) / 1e3
+    dur = (s["t_end_ns"] - s["t_start_ns"]) / 1e3
+    shard = f" slice={s['shard']}" if s["shard"] >= 0 else ""
+    print(f"  +{off:8.1f}us  {s['stage']:<8} {dur:8.1f}us"
+          f"  batch={s['batch']}{shard}")
+assert {"client", "io", "launch", "device", "barrier", "slice",
+        "resolve", "encode"} <= {s["stage"] for s in mine}
+
+# chrome_trace() renders the Chrome trace-event JSON that Perfetto
+# (ui.perfetto.dev) and chrome://tracing open directly — the same
+# payload GET /debug/trace serves.
+path = "/tmp/ratelimiter_trace.json"
+with open(path, "w") as f:
+    json.dump(rec.chrome_trace(), f)
+print(f"Perfetto-loadable dump: {path}")
+
+# Aggregates ride the normal metrics scrape: stage_summary() for quick
+# looks, rate_limiter_stage_seconds{stage=...} on /metrics for fleets
+# (OpenMetrics rendering ties buckets to example trace ids).
+summary = rec.stage_summary()
+device = summary["device"]
+print(f"stage_summary: device mean {device['mean_us']}us "
+      f"over {device['count']} span(s)")
+text = reg.render(openmetrics=True)
+assert "rate_limiter_stage_seconds" in text
+
+mesh.close()
+tracing.disable()
+print("OK")

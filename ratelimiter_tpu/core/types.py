@@ -184,7 +184,7 @@ class DispatchTicket:
     """
 
     __slots__ = ("outs", "b", "limit", "limits", "ns", "now_us", "t_sec",
-                 "slot", "padded", "result", "meta", "wire")
+                 "slot", "padded", "result", "meta", "wire", "trace_id")
 
     def __init__(self, result: "BatchResult | None" = None):
         self.outs = None        # device-side (allowed, remaining, retry, reset)
@@ -200,6 +200,10 @@ class DispatchTicket:
         self.meta = None        # decorator/door bookkeeping rides along
         self.wire = False       # outs are device-packed (bits, words)
         #                         wire buffers (sketch_kernels.pack_wire)
+        self.trace_id = 0       # flight-recorder trace context (ADR-014);
+        #                         0 = unsampled. Set by the serving doors
+        #                         at launch so resolve-side spans (incl.
+        #                         mesh per-slice spans) link to the frame.
 
     @property
     def resolved(self) -> bool:

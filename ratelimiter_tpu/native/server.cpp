@@ -120,6 +120,21 @@ constexpr uint32_t MAX_FRAME = 1u << 20;
 // (serving/protocol.py MAX_DCN_FRAME).
 constexpr uint32_t MAX_DCN_FRAME = 96u << 20;
 constexpr uint32_t MAX_KEY_LEN = 4096;
+// Trace-context extension (ADR-014, serving/protocol.py TRACE_FLAG):
+// request frames with bit 6 set on the type byte prefix their body with
+// a u64 trace id. Stripped here at parse; the id rides each Pending to
+// the spans callback so the Python flight recorder can attribute every
+// pipeline stage of the dispatch that served the frame.
+constexpr uint8_t TRACE_FLAG = 0x40;
+
+// Span clock: CLOCK_MONOTONIC ns — the SAME domain as Python's
+// time.monotonic_ns(), so C++ io/dispatch stamps and Python device-side
+// spans interleave on one timeline in the dump.
+inline uint64_t mono_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
 
 // Keys are UTF-8 strings at the protocol level (the asyncio server
 // decodes them and rejects invalid byte sequences); validate here so
@@ -230,6 +245,10 @@ struct Pending {
   std::vector<uint32_t> pos;
   bool hashed = false;
   std::vector<uint64_t> ids;
+  // Flight-recorder stamps (ABI 9, ADR-014): io-thread enqueue time and
+  // the frame's wire-propagated trace id (0 = unsampled).
+  uint64_t t_io = 0;
+  uint64_t trace_id = 0;
 };
 
 inline size_t pending_count(const Pending& p) {
@@ -286,6 +305,18 @@ struct Server {
   // slice-parallel serving tier (ADR-012).
   std::atomic<uint64_t> shard_decisions[64]{};
   std::atomic<uint64_t> slo_breaches{0};
+  // Cumulative per-stage wall time (ns) across batched dispatches
+  // (ABI 9, ADR-014): io (enqueue -> drain), dispatch (drain -> launch
+  // or blocking decide returned), device + complete (pipelined resolve
+  // split), respond (responder encode+send). stats()["stage_ns"]
+  // surfaces them; per-ticket resolution goes through the spans
+  // callback instead.
+  std::atomic<uint64_t> stage_io_ns{0};
+  std::atomic<uint64_t> stage_dispatch_ns{0};
+  std::atomic<uint64_t> stage_device_ns{0};
+  std::atomic<uint64_t> stage_complete_ns{0};
+  std::atomic<uint64_t> stage_respond_ns{0};
+  std::atomic<uint64_t> stage_batches{0};
   double started_at = 0.0;
 
   std::thread io_thread, slo_thread;
@@ -321,6 +352,13 @@ struct Server {
     size_t total = 0;
     uint64_t limit_epoch = 0;  // epoch observed at launch time
     bool hashed = false;       // respond columnar (T_RESULT_HASHED)
+    // Per-ticket stage stamps (ABI 9, ADR-014): earliest io-thread
+    // enqueue over the run's items, dispatch window (drain -> launch
+    // callback returned), and the run's first sampled trace id.
+    uint64_t t_io = 0;
+    uint64_t t_d0 = 0;
+    uint64_t t_d1 = 0;
+    uint64_t trace_id = 0;
   };
   struct PipeQ {
     std::mutex mx;
@@ -398,6 +436,15 @@ struct Server {
   // side owns auth verification and the merge into every shard limiter.
   PyObject* cb_dcn = nullptr;
   bool dcn_enabled = false;
+  // Spans callback (ABI 9, ADR-014; None = per-ticket spans off):
+  //   spans(shard, count, trace_id, t_io, t_d0, t_d1, t_v0, t_v1)
+  // called from the completer (GIL already held for the resolve) with
+  // the ticket's CLOCK_MONOTONIC ns stamps — the Python side records
+  // io/dispatch/device/complete spans into the flight recorder.
+  // Pipelined mode only; the blocking decide path feeds the aggregate
+  // stage_ns counters instead.
+  PyObject* cb_spans = nullptr;
+  bool spans_enabled = false;
 };
 
 // FNV-1a over the raw key bytes: deterministic shard routing (need not
@@ -633,7 +680,7 @@ void parse_result_tuple(PyObject* res, size_t total, Server::Reply& r,
 // filling `r` with per-request results (or an error). Returns false if
 // the callback raised.
 bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
-                 Server::Reply& r) {
+                 Server::Reply& r, uint64_t trace_id) {
   std::string blob;
   std::vector<int64_t> offsets, lengths, ns;
   size_t total = build_buffers(s, items, blob, offsets, lengths, ns);
@@ -647,11 +694,12 @@ bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* args = Py_BuildValue(
-        "(Iy#y#y#y#)", (unsigned int)shard,
+        "(Iy#y#y#y#K)", (unsigned int)shard,
         blob.data(), (Py_ssize_t)blob.size(),
         (const char*)offsets.data(), (Py_ssize_t)(offsets.size() * 8),
         (const char*)lengths.data(), (Py_ssize_t)(lengths.size() * 8),
-        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8),
+        (unsigned long long)trace_id);
     PyObject* res = args ? PyObject_CallObject(s->cb_decide, args) : nullptr;
     Py_XDECREF(args);
     if (res == nullptr) {
@@ -676,7 +724,8 @@ bool decide_core(Server* s, uint32_t shard, std::vector<Pending>& items,
 // Python launch callback. Returns the ticket (new reference), or null
 // with r.err_* set when the callback raised.
 PyObject* launch_core(Server* s, uint32_t shard, std::vector<Pending>& items,
-                      Server::Reply& r, size_t* total_out) {
+                      Server::Reply& r, size_t* total_out,
+                      uint64_t trace_id) {
   std::string blob;
   std::vector<int64_t> offsets, lengths, ns;
   size_t total = build_buffers(s, items, blob, offsets, lengths, ns);
@@ -689,11 +738,12 @@ PyObject* launch_core(Server* s, uint32_t shard, std::vector<Pending>& items,
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* args = Py_BuildValue(
-        "(Iy#y#y#y#)", (unsigned int)shard,
+        "(Iy#y#y#y#K)", (unsigned int)shard,
         blob.data(), (Py_ssize_t)blob.size(),
         (const char*)offsets.data(), (Py_ssize_t)(offsets.size() * 8),
         (const char*)lengths.data(), (Py_ssize_t)(lengths.size() * 8),
-        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8),
+        (unsigned long long)trace_id);
     ticket = args ? PyObject_CallObject(s->cb_launch, args) : nullptr;
     Py_XDECREF(args);
     if (ticket == nullptr)
@@ -722,7 +772,8 @@ size_t build_hashed_buffers(const std::vector<Pending>& items,
 
 // Blocking decide for a hashed run (legacy / SLO modes).
 bool decide_hashed_core(Server* s, uint32_t shard,
-                        std::vector<Pending>& items, Server::Reply& r) {
+                        std::vector<Pending>& items, Server::Reply& r,
+                        uint64_t trace_id) {
   std::vector<uint64_t> ids;
   std::vector<int64_t> ns;
   size_t total = build_hashed_buffers(items, ids, ns);
@@ -734,9 +785,10 @@ bool decide_hashed_core(Server* s, uint32_t shard,
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* args = Py_BuildValue(
-        "(Iy#y#)", (unsigned int)shard,
+        "(Iy#y#K)", (unsigned int)shard,
         (const char*)ids.data(), (Py_ssize_t)(ids.size() * 8),
-        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8),
+        (unsigned long long)trace_id);
     PyObject* res =
         args ? PyObject_CallObject(s->cb_decide_hashed, args) : nullptr;
     Py_XDECREF(args);
@@ -756,7 +808,7 @@ bool decide_hashed_core(Server* s, uint32_t shard,
 // Non-blocking launch for a hashed run (pipelined mode).
 PyObject* launch_hashed_core(Server* s, uint32_t shard,
                              std::vector<Pending>& items, Server::Reply& r,
-                             size_t* total_out) {
+                             size_t* total_out, uint64_t trace_id) {
   std::vector<uint64_t> ids;
   std::vector<int64_t> ns;
   size_t total = build_hashed_buffers(items, ids, ns);
@@ -770,9 +822,10 @@ PyObject* launch_hashed_core(Server* s, uint32_t shard,
   {
     PyGILState_STATE g = PyGILState_Ensure();
     PyObject* args = Py_BuildValue(
-        "(Iy#y#)", (unsigned int)shard,
+        "(Iy#y#K)", (unsigned int)shard,
         (const char*)ids.data(), (Py_ssize_t)(ids.size() * 8),
-        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8));
+        (const char*)ns.data(), (Py_ssize_t)(ns.size() * 8),
+        (unsigned long long)trace_id);
     ticket = args ? PyObject_CallObject(s->cb_launch_hashed, args) : nullptr;
     Py_XDECREF(args);
     if (ticket == nullptr)
@@ -823,17 +876,33 @@ void completer_main(Server* s, uint32_t shard) {
     for (auto& e : batch) {
       Server::Reply r;
       r.hashed = e.hashed;
+      uint64_t t_v0 = mono_ns(), t_v1 = t_v0;
       {
         PyGILState_STATE g = PyGILState_Ensure();
         PyObject* res = PyObject_CallFunction(
             s->cb_resolve, "IO", (unsigned int)shard, e.ticket);
         Py_DECREF(e.ticket);
+        t_v1 = mono_ns();
         if (res == nullptr) {
           r.err_code = fetch_py_error(r.err_msg, "resolve callback failed",
                                       E_STORAGE_UNAVAILABLE);
         } else {
           parse_result_tuple(res, e.total, r, "resolve");
           Py_DECREF(res);
+        }
+        if (s->spans_enabled) {
+          // Per-ticket stage stamps into the Python flight recorder
+          // (ABI 9, ADR-014) — the GIL is already held for the resolve,
+          // so the callback costs no extra acquisition. Failures must
+          // never break serving: clear and move on.
+          PyObject* sres = PyObject_CallFunction(
+              s->cb_spans, "IKKKKKKK", (unsigned int)shard,
+              (unsigned long long)e.total,
+              (unsigned long long)e.trace_id, (unsigned long long)e.t_io,
+              (unsigned long long)e.t_d0, (unsigned long long)e.t_d1,
+              (unsigned long long)t_v0, (unsigned long long)t_v1);
+          if (sres == nullptr) PyErr_Clear();
+          else Py_DECREF(sres);
         }
         PyGILState_Release(g);
       }
@@ -845,6 +914,11 @@ void completer_main(Server* s, uint32_t shard) {
         // relative to any set_limits push issued since it launched.
         s->refresh_limit(r.limit, e.limit_epoch);
       }
+      if (e.t_io && e.t_d0 >= e.t_io) s->stage_io_ns.fetch_add(e.t_d0 - e.t_io);
+      s->stage_dispatch_ns.fetch_add(e.t_d1 - e.t_d0);
+      s->stage_device_ns.fetch_add(t_v1 - t_v0);
+      s->stage_complete_ns.fetch_add(mono_ns() - t_v1);
+      s->stage_batches.fetch_add(1);
       r.items = std::move(e.items);
       {
         std::lock_guard<std::mutex> g(s->rmx);
@@ -977,8 +1051,11 @@ bool run_decide(Server* s, std::vector<Pending>& items,
                 std::atomic<bool>* gate, bool hashed = false) {
   Server::Reply r;
   uint64_t ep = s->limit_epoch.load();
-  bool ok = hashed ? decide_hashed_core(s, 0, items, r)
-                   : decide_core(s, 0, items, r);
+  uint64_t trace = 0;
+  for (const auto& p : items)
+    if (p.trace_id) { trace = p.trace_id; break; }
+  bool ok = hashed ? decide_hashed_core(s, 0, items, r, trace)
+                   : decide_core(s, 0, items, r, trace);
   if (gate != nullptr && gate->exchange(true)) {
     // SLO watcher already answered (and counted) these waiters; the
     // (late) state update above still landed in the limiter — drop the
@@ -1013,7 +1090,12 @@ void responder_main(Server* s) {
       r = std::move(s->rqueue.front());
       s->rqueue.pop_front();
     }
+    uint64_t t0 = mono_ns();
     emit_reply(s, r.items, r);
+    // Respond stage aggregate (ABI 9): encode + socket handoff time —
+    // per-ticket span resolution stops at the completer (this thread is
+    // deliberately GIL-free), so the responder reports in stats() only.
+    s->stage_respond_ns.fetch_add(mono_ns() - t0);
   }
 }
 
@@ -1028,13 +1110,21 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
       s->pipelined &&
       (!hashed ||
        (s->cb_launch_hashed != nullptr && s->cb_launch_hashed != Py_None));
+  // Per-run stage stamps (ABI 9): earliest io enqueue and the first
+  // sampled trace id over the drained items.
+  uint64_t run_io = 0, run_trace = 0;
+  for (const auto& p : group) {
+    if (p.t_io && (run_io == 0 || p.t_io < run_io)) run_io = p.t_io;
+    if (run_trace == 0 && p.trace_id) run_trace = p.trace_id;
+  }
+  uint64_t t_d0 = mono_ns();
   if (pipelined) {
     Server::Reply r;
     size_t total = 0;
     uint64_t ep = s->limit_epoch.load();
-    PyObject* ticket = hashed
-                           ? launch_hashed_core(s, shard, group, r, &total)
-                           : launch_core(s, shard, group, r, &total);
+    PyObject* ticket =
+        hashed ? launch_hashed_core(s, shard, group, r, &total, run_trace)
+               : launch_core(s, shard, group, r, &total, run_trace);
     if (ticket == nullptr) {
       // Launch failed (typed error for every waiter) or the run held
       // only empty frames — answer via the responder directly.
@@ -1060,7 +1150,8 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
                    s->inflight_window ||
                s->stop.load();
       });
-      pq.entries.push_back({std::move(group), ticket, total, ep, hashed});
+      pq.entries.push_back({std::move(group), ticket, total, ep, hashed,
+                            run_io, t_d0, mono_ns(), run_trace});
     }
     pq.cv_items.notify_one();
     return;
@@ -1070,13 +1161,18 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
   Server::Reply r;
   r.hashed = hashed;
   uint64_t dep = s->limit_epoch.load();
-  bool ok = hashed ? decide_hashed_core(s, shard, group, r)
-                   : decide_core(s, shard, group, r);
+  bool ok = hashed ? decide_hashed_core(s, shard, group, r, run_trace)
+                   : decide_core(s, shard, group, r, run_trace);
   if (ok) {
     s->decisions.fetch_add(r.total);
     s->shard_decisions[shard].fetch_add(r.total);
     if (r.total) s->refresh_limit(r.limit, dep);
   }
+  // Blocking path: decide covers dispatch+device in one span — feed the
+  // aggregates (per-ticket spans are a pipelined-mode surface).
+  if (run_io && t_d0 >= run_io) s->stage_io_ns.fetch_add(t_d0 - run_io);
+  s->stage_dispatch_ns.fetch_add(mono_ns() - t_d0);
+  s->stage_batches.fetch_add(1);
   r.items = std::move(group);
   {
     std::lock_guard<std::mutex> g(s->rmx);
@@ -1241,6 +1337,8 @@ void dispatcher_main(Server* s, uint32_t shard) {
           Pending head{front.conn, front.req_id, front.is_batch, {}, {}};
           head.hashed = front.hashed;
           head.join = j;
+          head.t_io = front.t_io;
+          head.trace_id = front.trace_id;
           if (front.hashed) {
             head.ids.assign(front.ids.begin(), front.ids.begin() + room);
             front.ids.erase(front.ids.begin(), front.ids.begin() + room);
@@ -1366,13 +1464,17 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     // The type byte is already in hand (>= 13 bytes buffered), so the
     // per-frame cap can be type-aware: DCN pushes get the slab-sized cap
     // ONLY on a DCN-enabled server (mirrors protocol.parse_header's
-    // allow_dcn).
-    uint8_t type = (uint8_t)c->rbuf[off + 4];
+    // allow_dcn). The trace-context flag (ADR-014) is stripped first:
+    // flagged requests prefix their body with a u64 trace id.
+    uint8_t rawtype = (uint8_t)c->rbuf[off + 4];
+    bool traced = (rawtype & TRACE_FLAG) != 0 && rawtype < 0x80;
+    uint8_t type = traced ? (uint8_t)(rawtype & ~TRACE_FLAG) : rawtype;
     uint64_t req_id;
     memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     uint32_t cap =
         (s->dcn_enabled && type == T_DCN_PUSH) ? MAX_DCN_FRAME : MAX_FRAME;
     if (length > cap) return false;  // protocol error
+    size_t tskip = traced ? 8 : 0;
     if (s->dcn_enabled && type == T_DCN_PUSH && !c->dcn_big &&
         (size_t)4 + length > c->rbuf.size() - off) {
       // Incomplete DCN frame that will need slab-sized buffering:
@@ -1380,8 +1482,10 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       // requires push auth, the body must open with the RLA envelope
       // magic — an oversized garbage stream labeled T_DCN_PUSH dies
       // here, 4 bytes in, instead of buffering up to MAX_DCN_FRAME.
-      if (c->rbuf.size() - off < 17) break;  // need the first 4 body bytes
-      const char* bm = c->rbuf.data() + off + 13;
+      // A traced push shifts the envelope past the 8-byte trace id.
+      if (c->rbuf.size() - off < 17 + tskip)
+        break;  // need the first 4 body bytes
+      const char* bm = c->rbuf.data() + off + 13 + tskip;
       if (s->dcn_auth_required &&
           !(bm[0] == 'R' && bm[1] == 'L' && bm[2] == 'A' &&
             (bm[3] == '1' || bm[3] == '2')))
@@ -1405,6 +1509,13 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     const char* body = c->rbuf.data() + off + 13;
     uint32_t blen = length - 9;
     off += 4 + length;
+    uint64_t trace_id = 0;
+    if (traced) {
+      if (blen < 8) return false;  // short trace-id extension
+      memcpy(&trace_id, body, 8);
+      body += 8;
+      blen -= 8;
+    }
 
     auto enqueue = [&](Pending&& p, size_t nkeys, uint32_t shard) {
       Server::ShardQ& q = *s->shardqs[shard];
@@ -1437,6 +1548,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         std::string key(body + 6, klen);
         uint32_t shard = key_shard(s, key);
         Pending p{c, req_id, false, {std::move(key)}, {(int64_t)n}};
+        p.t_io = mono_ns();
+        p.trace_id = trace_id;
         enqueue(std::move(p), 1, shard);
       }
     } else if (type == T_ALLOW_BATCH) {
@@ -1447,6 +1560,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       // larger is malformed — reject BEFORE reserving (alloc bound).
       if (count > (blen - 4) / 6) return false;
       Pending p{c, req_id, true, {}, {}};
+      p.t_io = mono_ns();
+      p.trace_id = trace_id;
       p.keys.reserve(count);
       p.ns.reserve(count);
       size_t pos = 4;
@@ -1513,6 +1628,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
           for (uint32_t sh = 0; sh < s->num_shards; ++sh) {
             if (per[sh].empty()) continue;
             Pending part{c, req_id, true, {}, {}};
+            part.t_io = p.t_io;
+            part.trace_id = p.trace_id;
             part.join = j;
             part.pos = std::move(per[sh]);
             part.keys.reserve(part.pos.size());
@@ -1547,6 +1664,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         const char* npp = body + 4 + 8ull * count;
         bool bad_n = false;
         Pending p{c, req_id, true, {}, {}};
+        p.t_io = mono_ns();
+        p.trace_id = trace_id;
         p.hashed = true;
         p.ids.reserve(count);
         p.ns.reserve(count);
@@ -1589,6 +1708,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
             for (uint32_t sh = 0; sh < s->num_shards; ++sh) {
               if (per[sh].empty()) continue;
               Pending part{c, req_id, true, {}, {}};
+              part.t_io = p.t_io;
+              part.trace_id = p.trace_id;
               part.hashed = true;
               part.join = j;
               part.pos = std::move(per[sh]);
@@ -1895,8 +2016,24 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     }
     PyList_SET_ITEM(per_shard, i, v);
   }
+  // Cumulative per-stage wall time (ABI 9, ADR-014): ns each pipeline
+  // stage has consumed across batched dispatches, plus the dispatch
+  // count — enough to derive mean per-stage cost without any Python
+  // callback in the loop.
+  PyObject* stage_ns = Py_BuildValue(
+      "{s:K,s:K,s:K,s:K,s:K,s:K}",
+      "io", (unsigned long long)ps->s->stage_io_ns.load(),
+      "dispatch", (unsigned long long)ps->s->stage_dispatch_ns.load(),
+      "device", (unsigned long long)ps->s->stage_device_ns.load(),
+      "complete", (unsigned long long)ps->s->stage_complete_ns.load(),
+      "respond", (unsigned long long)ps->s->stage_respond_ns.load(),
+      "batches", (unsigned long long)ps->s->stage_batches.load());
+  if (stage_ns == nullptr) {
+    Py_DECREF(per_shard);
+    return nullptr;
+  }
   PyObject* out = Py_BuildValue(
-      "{s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O}", "decisions_total",
+      "{s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O,s:O}", "decisions_total",
       (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
       (unsigned long long)ps->s->slo_breaches.load(), "uptime_s",
       now_s() - ps->s->started_at, "inflight_depth",
@@ -1904,8 +2041,10 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
       "pipelined", ps->s->pipelined ? Py_True : Py_False,
       // Shard routing observability (mesh mode: one shard == one
       // device, so this is the per-device decision balance, ADR-012).
-      "num_shards", ps->s->num_shards, "shard_decisions", per_shard);
+      "num_shards", ps->s->num_shards, "shard_decisions", per_shard,
+      "stage_ns", stage_ns);
   Py_DECREF(per_shard);  // Py_BuildValue "O" took its own reference
+  Py_DECREF(stage_ns);
   return out;
 }
 
@@ -1968,6 +2107,7 @@ void server_dealloc(PyObject* self) {
     Py_XDECREF(ps->s->cb_resolve);
     Py_XDECREF(ps->s->cb_decide_hashed);
     Py_XDECREF(ps->s->cb_launch_hashed);
+    Py_XDECREF(ps->s->cb_spans);
     delete ps->s;
   }
   Py_TYPE(self)->tp_free(self);
@@ -1996,10 +2136,12 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                                  "launch",    "resolve",      "inflight",
                                  "dcn_auth_required", "max_dcn_conns",
                                  "decide_hashed", "launch_hashed",
+                                 "spans",
                                  nullptr};
   PyObject *decide, *reset, *metrics = Py_None, *dcn = Py_None;
   PyObject *launch = Py_None, *resolve = Py_None;
   PyObject *decide_hashed = Py_None, *launch_hashed = Py_None;
+  PyObject *spans = Py_None;
   unsigned int max_batch = 4096, max_delay_us = 200, slo_us = 0;
   int fail_open = 0;
   long long limit = 0;
@@ -2008,7 +2150,7 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_ssize_t key_prefix_len = 0;
   unsigned int num_shards = 1, inflight = 8, max_dcn_conns = 4;
   int dcn_auth_required = 0;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpIOO",
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpIOOO",
                                    (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
@@ -2016,7 +2158,7 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                                    &num_shards, &dcn, &launch, &resolve,
                                    &inflight, &dcn_auth_required,
                                    &max_dcn_conns, &decide_hashed,
-                                   &launch_hashed))
+                                   &launch_hashed, &spans))
     return nullptr;
   if (num_shards < 1 || num_shards > 64) {
     PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
@@ -2050,6 +2192,7 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_INCREF(resolve);
   Py_INCREF(decide_hashed);
   Py_INCREF(launch_hashed);
+  Py_INCREF(spans);
   ps->s->cb_decide = decide;
   ps->s->cb_reset = reset;
   ps->s->cb_metrics = metrics;
@@ -2058,8 +2201,10 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   ps->s->cb_resolve = resolve;
   ps->s->cb_decide_hashed = decide_hashed;
   ps->s->cb_launch_hashed = launch_hashed;
+  ps->s->cb_spans = spans;
   ps->s->dcn_enabled = dcn != Py_None;
   ps->s->hashed_enabled = decide_hashed != Py_None;
+  ps->s->spans_enabled = spans != Py_None;
   return (PyObject*)ps;
 }
 
@@ -2081,7 +2226,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 8; }
+int64_t rl_server_abi_version() { return 9; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

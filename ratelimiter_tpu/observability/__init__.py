@@ -1,6 +1,8 @@
 """Observability: metrics registry + decorator wrappers (reference L4,
-``docs/ADR/003-decorator-pattern-for-observability.md``)."""
+``docs/ADR/003-decorator-pattern-for-observability.md``) + the
+flight-recorder tracing subsystem (ADR-014, ``tracing.py``)."""
 
+from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.observability.metrics import (
     BATCH_BUCKETS,
     Counter,
@@ -17,12 +19,14 @@ from ratelimiter_tpu.observability.decorators import (
     MetricsDecorator,
     TracingDecorator,
 )
+from ratelimiter_tpu.observability.tracing import FlightRecorder
 
 __all__ = [
     "BATCH_BUCKETS",
     "CircuitBreakerDecorator",
     "Counter",
     "DEFAULT",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS",
@@ -31,4 +35,5 @@ __all__ = [
     "MetricsDecorator",
     "Registry",
     "TracingDecorator",
+    "tracing",
 ]

@@ -13,6 +13,8 @@ Prometheus scraper would accept. No external deps, O(1) hot-path cost
 from __future__ import annotations
 
 import threading
+import time
+from bisect import bisect_left
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
 #: Default histogram buckets, seconds — spans 10 µs host overhead to multi-
@@ -40,8 +42,17 @@ def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted(labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Escape a label value per the Prometheus text exposition spec
+    (backslash, double-quote, and newline must be escaped INSIDE the
+    quotes) — user-derived values (keys, algorithm strings) would
+    otherwise corrupt the whole scrape with one embedded quote."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(items: Iterable[Tuple[str, str]]) -> str:
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
     return "{" + inner + "}" if inner else ""
 
 
@@ -66,14 +77,30 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        # Under the lock: a bare dict read races inc()'s read-modify-
+        # write and (on resize) dict mutation — cheap, and value() is
+        # never on the decide path.
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
-    def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} counter"]
+    def render(self, om: bool = False) -> list[str]:
+        # OpenMetrics requires the counter FAMILY name without the
+        # `_total` suffix (HELP/TYPE lines) while the sample keeps it —
+        # `# TYPE x_total counter` fails Prometheus's strict OM parser,
+        # which would reject the whole scrape. Classic text exposition
+        # uses the full name in both places.
+        family = self.name
+        sample = self.name
+        if om:
+            if family.endswith("_total"):
+                family = family[:-len("_total")]
+            else:
+                sample = family + "_total"
+        lines = [f"# HELP {family} {self.help}",
+                 f"# TYPE {family} counter"]
         with self._lock:
             for key, v in sorted(self._values.items()):
-                lines.append(f"{self.name}{_fmt_labels(key)} {v:g}")
+                lines.append(f"{sample}{_fmt_labels(key)} {v:g}")
         return lines
 
 
@@ -94,7 +121,8 @@ class Gauge(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, **labels: str) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
 
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
@@ -114,30 +142,39 @@ class Histogram(_Metric):
         self.buckets = tuple(sorted(buckets))
         self._counts: Dict[tuple, list] = {}   # key -> per-bucket counts + inf
         self._sums: Dict[tuple, float] = {}
+        #: (key, bucket_index) -> (exemplar trace id, value, unix ts):
+        #: the LAST sampled observation that landed in that bucket.
+        #: Rendered only by the OpenMetrics exposition (render_om) —
+        #: classic Prometheus text has no exemplar syntax.
+        self._exemplars: Dict[tuple, tuple] = {}
 
-    def observe(self, value: float, **labels: str) -> None:
+    def observe(self, value: float, *, exemplar: Optional[str] = None,
+                **labels: str) -> None:
         key = _label_key(labels)
+        # bisect instead of a linear scan: this runs per decision on
+        # 16-bucket latency families (bisect_left on "first ub >= value"
+        # is exactly the old `value <= ub` bucket rule).
+        i = bisect_left(self.buckets, value)
         with self._lock:
             counts = self._counts.get(key)
             if counts is None:
                 counts = [0] * (len(self.buckets) + 1)
                 self._counts[key] = counts
                 self._sums[key] = 0.0
-            for i, ub in enumerate(self.buckets):
-                if value <= ub:
-                    counts[i] += 1
-                    break
-            else:
-                counts[-1] += 1
+            counts[i if i < len(self.buckets) else -1] += 1
             self._sums[key] += value
+            if exemplar is not None:
+                self._exemplars[(key, i)] = (exemplar, value, time.time())
 
     def count(self, **labels: str) -> int:
-        return sum(self._counts.get(_label_key(labels), []))
+        with self._lock:
+            return sum(self._counts.get(_label_key(labels), []))
 
     def sum(self, **labels: str) -> float:
-        return self._sums.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._sums.get(_label_key(labels), 0.0)
 
-    def render(self) -> list[str]:
+    def render(self, om: bool = False) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
         with self._lock:
@@ -145,11 +182,29 @@ class Histogram(_Metric):
                 cum = 0
                 for i, ub in enumerate(self.buckets):
                     cum += counts[i]
-                    lines.append(
-                        f"{self.name}_bucket{_fmt_labels(key + (('le', f'{ub:g}'),))} {cum}")
+                    line = (f"{self.name}_bucket"
+                            f"{_fmt_labels(key + (('le', f'{ub:g}'),))} {cum}")
+                    ex = self._exemplars.get((key, i)) if om else None
+                    if ex is not None:
+                        # OpenMetrics exemplar: ties this le-bucket to a
+                        # trace id recorded by the flight recorder
+                        # (ADR-014) — `# {trace_id="..."} value ts`.
+                        line += (f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+                                 f" {ex[2]:.3f}")
+                    lines.append(line)
                 cum += counts[-1]
-                lines.append(
-                    f"{self.name}_bucket{_fmt_labels(key + (('le', '+Inf'),))} {cum}")
+                line = (f"{self.name}_bucket"
+                        f"{_fmt_labels(key + (('le', '+Inf'),))} {cum}")
+                # The overflow bucket keeps its exemplar too — the
+                # slowest observations are exactly the ones worth a
+                # trace id (observe() stores them at index
+                # len(self.buckets)).
+                ex = (self._exemplars.get((key, len(self.buckets)))
+                      if om else None)
+                if ex is not None:
+                    line += (f' # {{trace_id="{ex[0]}"}} {ex[1]:g}'
+                             f" {ex[2]:.3f}")
+                lines.append(line)
                 lines.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]:g}")
                 lines.append(f"{self.name}_count{_fmt_labels(key)} {cum}")
         return lines
@@ -213,7 +268,7 @@ class Registry:
             except ValueError:
                 pass
 
-    def render(self) -> str:
+    def render(self, *, openmetrics: bool = False) -> str:
         with self._lock:
             hooks = list(self._collect_hooks)
         for hook in hooks:
@@ -227,8 +282,22 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            lines.extend(m.render())
+            if openmetrics and isinstance(m, (Histogram, Counter)):
+                lines.extend(m.render(om=True))
+            else:
+                lines.extend(m.render())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics-flavored exposition: same families, plus
+        histogram bucket EXEMPLARS (`# {trace_id="..."} v ts`) tying
+        `rate_limiter_*_seconds` buckets to the flight-recorder trace
+        ids that landed in them (ADR-014), and the `# EOF` terminator.
+        The HTTP gateway serves this for
+        `Accept: application/openmetrics-text` scrapes."""
+        return self.render(openmetrics=True)
 
 
 #: Process-default registry (the serving tier exposes it over /metrics).

@@ -41,6 +41,7 @@ from ratelimiter_tpu.core.clock import Clock
 from ratelimiter_tpu.core.config import Config
 from ratelimiter_tpu.core.errors import CheckpointError
 from ratelimiter_tpu.core.types import Algorithm, BatchResult, DispatchTicket
+from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.parallel import mesh_kernels
 from ratelimiter_tpu.parallel.mesh import make_mesh
 
@@ -352,6 +353,8 @@ class SlicedMeshLimiter(RateLimiter):
         # arrays come out contiguous (stable sort keeps frame order
         # within a slice, so same-key sequencing inside the frame is
         # preserved — a key's requests all land on its slice in order).
+        rec = tracing.RECORDER
+        t_r0 = tracing.now() if rec is not None else 0
         order = np.argsort(owners, kind="stable")
         sorted_owners = owners[order]
         bounds = np.searchsorted(sorted_owners, np.arange(self.n_slices + 1))
@@ -370,6 +373,13 @@ class SlicedMeshLimiter(RateLimiter):
         # scatter-back path) — only meaningful on the raw-id lane, the
         # one surface whose sub-launches pack on device.
         t.wire = bool(wire and premix)
+        if rec is not None:
+            # "route": the owner partition + per-slice sub-launches.
+            # The frame's trace id is stamped on the ticket AFTER launch
+            # returns (the door owns it), so this span carries 0 — it
+            # still appears on the frame's thread between "launch" start
+            # and the device spans.
+            rec.record("route", t_r0, tracing.now(), batch=b)
         return t
 
     def resolve(self, ticket: DispatchTicket) -> BatchResult:
@@ -400,15 +410,25 @@ class SlicedMeshLimiter(RateLimiter):
         # in one call, then the per-slice resolves below are pure
         # (already-hot) fetches + bookkeeping. Errors surface in the
         # per-slice resolve, which owns the fail-open/closed contract.
+        rec = tracing.RECORDER
+        trace = getattr(ticket, "trace_id", 0)
         outs = [sub.outs for _, _, sub in subs
                 if getattr(sub, "outs", None) is not None]
         if outs:
+            t_b0 = tracing.now() if rec is not None else 0
             try:
                 import jax
 
                 jax.block_until_ready(outs)
             except Exception:
                 pass  # the owning slice's resolve reports it properly
+            if rec is not None:
+                # The frame's ONE completion barrier (ADR-013): every
+                # per-slice span below links to it through the shared
+                # trace id — the parent→slice→device tree the span
+                # oracle walks (ADR-014).
+                rec.record("barrier", t_b0, tracing.now(), trace_id=trace,
+                           batch=ticket.b)
         b = ticket.b
         allowed = np.zeros(b, dtype=bool)
         remaining = np.zeros(b, dtype=np.int64)
@@ -419,11 +439,21 @@ class SlicedMeshLimiter(RateLimiter):
         err = None
         wire = bool(getattr(ticket, "wire", False))
         for s, pos, sub in subs:
+            t_s0 = tracing.now() if rec is not None else 0
             try:
                 res = self.slices[s].resolve(sub)
             except Exception as exc:  # fail-closed slice: finish the rest
+                if rec is not None:
+                    rec.record("slice", t_s0, tracing.now(),
+                               trace_id=trace, shard=s,
+                               outcome=tracing.ERROR)
                 err = err if err is not None else exc
                 continue
+            if rec is not None:
+                rec.record("slice", t_s0, tracing.now(), trace_id=trace,
+                           shard=s, batch=len(res),
+                           outcome=tracing.FAIL_OPEN if res.fail_open
+                           else tracing.OK)
             allowed[pos] = res.allowed
             remaining[pos] = res.remaining
             retry[pos] = res.retry_after

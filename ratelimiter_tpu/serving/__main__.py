@@ -93,6 +93,29 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--trace", action="store_true",
                     help="wrap in TracingDecorator (jax.profiler "
                          "annotations on every dispatch)")
+    # Flight-recorder tracing subsystem (ADR-014).
+    ap.add_argument("--flight-recorder", action="store_true",
+                    help="turn on the flight recorder (ADR-014): "
+                         "per-thread ring buffers of per-stage spans "
+                         "stamped on the serving hot path at clock-read "
+                         "cost; dump via /debug/trace (needs "
+                         "--debug-trace + --http-port) or the "
+                         "rate_limiter_stage_seconds histograms on "
+                         "/metrics. Off by default = zero overhead")
+    ap.add_argument("--flight-recorder-capacity", type=int, default=8192,
+                    help="span ring capacity PER THREAD (records; "
+                         "rounded up to a power of two). At 32 B/record "
+                         "the default is 256 KiB per serving thread")
+    ap.add_argument("--debug-trace", action="store_true",
+                    help="expose GET /debug/trace (Perfetto/Chrome-trace "
+                         "dump of recent spans) and /debug/profile "
+                         "(on-demand jax.profiler capture) on the HTTP "
+                         "gateway. OFF by default: traces reveal key "
+                         "traffic timing — gate like /v1/policy")
+    ap.add_argument("--debug-token", default=None,
+                    help="bearer token required by the /debug endpoints "
+                         "(implies --debug-trace); Authorization header "
+                         "only, like every other token")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the MetricsDecorator (on by default)")
     # Cross-pod DCN exchange (parallel/dcn.py over serving/dcn_peer.py).
@@ -254,10 +277,13 @@ def _debt_slab_health(limiters) -> dict:
 
 def make_threadsafe_decide(batcher, loop):
     """Single-decision bridge from gateway/gRPC worker threads into the
-    event loop's micro-batcher: every surface shares device dispatches."""
-    def decide(key: str, n: int):
+    event loop's micro-batcher: every surface shares device dispatches.
+    Trace-aware (ADR-014): a sampled HTTP/gRPC request's trace id rides
+    into the batcher so its coalesced dispatch records under it."""
+    def decide(key: str, n: int, trace_id: int = 0):
         return asyncio.run_coroutine_threadsafe(
-            batcher.submit(key, n), loop).result(timeout=30)
+            batcher.submit(key, n, trace_id=trace_id),
+            loop).result(timeout=30)
 
     return decide
 
@@ -348,6 +374,14 @@ async def amain(args) -> None:
     logging.basicConfig(level=args.log_level.upper())
     _configure_jax(args)
     from ratelimiter_tpu import MeshSpec, PersistenceSpec
+    from ratelimiter_tpu.observability import tracing
+
+    if args.flight_recorder:
+        # Before any serving thread starts; the registry hookup derives
+        # rate_limiter_stage_seconds at scrape time (ADR-014).
+        tracing.enable(args.flight_recorder_capacity,
+                       registry=obs_metrics.DEFAULT)
+    http_debug = bool(args.debug_trace or args.debug_token)
 
     cfg = Config(
         algorithm=Algorithm(args.algorithm),
@@ -492,7 +526,9 @@ async def amain(args) -> None:
                 enable_policy=http_policy,
                 policy_token=args.http_policy_token,
                 snapshot=(persist.snapshot_now if persist else None),
-                snapshot_token=args.http_snapshot_token)
+                snapshot_token=args.http_snapshot_token,
+                enable_debug=http_debug,
+                debug_token=args.debug_token)
             gateway.start()
         grpc_srv = None
         if args.grpc_port is not None:
@@ -598,7 +634,9 @@ async def amain(args) -> None:
             enable_policy=http_policy,
             policy_token=args.http_policy_token,
             snapshot=(persist.snapshot_now if persist else None),
-            snapshot_token=args.http_snapshot_token)
+            snapshot_token=args.http_snapshot_token,
+            enable_debug=http_debug,
+            debug_token=args.debug_token)
         gateway.start()
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer

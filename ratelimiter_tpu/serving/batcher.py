@@ -56,6 +56,7 @@ from ratelimiter_tpu.core.types import (
     fail_open_result,
 )
 from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.observability import tracing
 
 
 class MicroBatcher:
@@ -94,12 +95,19 @@ class MicroBatcher:
         self.adaptive_delay = adaptive_delay
         self._pending: List[Tuple[str, int, asyncio.Future]] = []
         #: Queued ALLOW_HASHED frames awaiting the next coalescing window
-        #: (scatter-gather scheduling, ADR-013): (ids, ns, future) per
-        #: frame; flushed alongside the string queue into ONE launch per
-        #: window, each frame answered from its contiguous row range.
-        self._pending_hashed: List[Tuple[np.ndarray, np.ndarray,
-                                         asyncio.Future]] = []
+        #: (scatter-gather scheduling, ADR-013): (ids, ns, future,
+        #: trace_id) per frame; flushed alongside the string queue into
+        #: ONE launch per window, each frame answered from its
+        #: contiguous row range. Residency is traced at WINDOW level
+        #: (_q_t0) — per-frame residency spans would overlap on the
+        #: event-loop thread and break the per-thread span invariant.
+        self._pending_hashed: List[tuple] = []
         self._pending_hashed_ids = 0
+        #: Flight-recorder window context (ADR-014): first-enqueue stamp
+        #: and the first sampled trace id of the current coalescing
+        #: window. Zero cost while tracing is off (RECORDER is None).
+        self._q_t0 = 0
+        self._q_trace = 0
         self._timer: Optional[asyncio.TimerHandle] = None
         self._first_ts = 0.0
         self._armed_depth = 0
@@ -176,10 +184,19 @@ class MicroBatcher:
 
     # ------------------------------------------------------------ submit
 
+    def _note_window(self, trace_id: int) -> None:
+        """Window trace context: stamp the first-enqueue time once per
+        coalescing window and keep the first sampled trace id."""
+        if tracing.RECORDER is not None and not self._q_t0:
+            self._q_t0 = tracing.now()
+        if trace_id and not self._q_trace:
+            self._q_trace = trace_id
+
     def _enqueue(self, loop: asyncio.AbstractEventLoop, key: str,
-                 n: int) -> asyncio.Future:
+                 n: int, trace_id: int = 0) -> asyncio.Future:
         fut: asyncio.Future = loop.create_future()
         self._pending.append((key, n, fut))
+        self._note_window(trace_id)
         if len(self._pending) >= self.max_batch:
             self._flush()
         return fut
@@ -217,24 +234,28 @@ class MicroBatcher:
             self._timer = loop.call_later(max(0.0, target - loop.time()),
                                           self._flush)
 
-    def submit_nowait(self, key: str, n: int = 1) -> asyncio.Future:
+    def submit_nowait(self, key: str, n: int = 1,
+                      trace_id: int = 0) -> asyncio.Future:
         """Queue one decision and return its future WITHOUT awaiting —
         the zero-task fast path the server's reader loop uses (a done
         callback writes the response; no coroutine per request).
         Validation happens here, before batching, so malformed requests
         fail fast and never poison a batch (reference pre-Redis guards,
-        ``tokenbucket.go:91-93``). Must run on the event loop thread."""
+        ``tokenbucket.go:91-93``). Must run on the event loop thread.
+        ``trace_id`` (ADR-014) samples the window this decision joins
+        into the flight recorder."""
         if self._draining:
             raise StorageUnavailableError("server is shutting down")
         check_key(key)
         check_n(n)
         loop = asyncio.get_running_loop()
         self._loop = loop
-        fut = self._enqueue(loop, key, n)
+        fut = self._enqueue(loop, key, n, trace_id)
         self._arm_timer(loop)
         return fut
 
-    def submit_many_nowait(self, pairs) -> List[asyncio.Future]:
+    def submit_many_nowait(self, pairs,
+                           trace_id: int = 0) -> List[asyncio.Future]:
         """Queue a whole frame of (key, n) decisions atomically: every
         pair is validated BEFORE any is queued, so a bad pair mid-frame
         cannot leave earlier pairs consuming quota with nobody reading
@@ -247,18 +268,19 @@ class MicroBatcher:
             check_n(n)
         loop = asyncio.get_running_loop()
         self._loop = loop
-        futs = [self._enqueue(loop, key, n) for key, n in pairs]
+        futs = [self._enqueue(loop, key, n, trace_id) for key, n in pairs]
         self._arm_timer(loop)
         return futs
 
-    async def submit(self, key: str, n: int = 1) -> Result:
+    async def submit(self, key: str, n: int = 1, *,
+                     trace_id: int = 0) -> Result:
         """Queue one decision; resolves when its batch's dispatch lands."""
-        return await self.submit_nowait(key, n)
+        return await self.submit_nowait(key, n, trace_id)
 
     # ------------------------------------------------- hashed bulk lane
 
-    def submit_hashed_nowait(self, ids: np.ndarray,
-                             ns: np.ndarray) -> asyncio.Future:
+    def submit_hashed_nowait(self, ids: np.ndarray, ns: np.ndarray,
+                             trace_id: int = 0) -> asyncio.Future:
         """Queue one whole ALLOW_HASHED frame into the current coalescing
         window (the zero-copy bulk lane, ADR-011 + the scatter-gather
         scheduler, ADR-013): every hashed frame queued within
@@ -318,7 +340,7 @@ class MicroBatcher:
                 seg_futs.append(sfut)
                 task = asyncio.ensure_future(self._dispatch_hashed(
                     ids[off:off + self.max_batch],
-                    ns[off:off + self.max_batch], sfut))
+                    ns[off:off + self.max_batch], sfut, trace_id))
                 self._inflight.add(task)
                 task.add_done_callback(self._inflight.discard)
             join = asyncio.ensure_future(self._join_segments(seg_futs, fut))
@@ -335,19 +357,22 @@ class MicroBatcher:
             # window first; the oversized frame then dispatches alone
             # (arrival order across dispatches is preserved).
             self._flush()
-        self._pending_hashed.append((ids, ns, fut))
+        self._pending_hashed.append((ids, ns, fut, trace_id))
         self._pending_hashed_ids += b
+        self._note_window(trace_id)
         if self._pending_hashed_ids >= self.max_batch:
             self._flush()
         else:
             self._arm_timer(loop)
         return fut
 
-    def _launch_hashed_work(self, ids, ns):
+    def _launch_hashed_work(self, ids, ns, trace_id=0, t_q=0):
         """Hashed-frame launch stage (launch executor thread): same
         in-flight window as _launch_work; wire=True device-packs the
         response buffers (sketch_kernels.pack_wire)."""
         self._window.acquire()
+        rec = tracing.RECORDER
+        tq0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
         try:
             ticket = self.limiter.launch_ids(ids, ns, wire=True)
@@ -355,18 +380,44 @@ class MicroBatcher:
             self._window.release()
             raise
         self._launch_hist.observe(time.perf_counter() - t0)
+        if rec is not None:
+            # "queue" = waiting for the FIFO launch executor + window
+            # slot; "launch" = stage + enqueue of the jitted step.
+            if t_q:
+                rec.record("queue", t_q, tq0, trace_id=trace_id,
+                           batch=int(ids.shape[0]))
+            rec.record("launch", tq0, tracing.now(), trace_id=trace_id,
+                       batch=int(ids.shape[0]))
+        ticket.trace_id = trace_id
         self._depth_add(1)
         return ticket
 
-    async def _dispatch_hashed(self, ids, ns, fut: asyncio.Future) -> None:
+    def _allow_work(self, keys, ns, trace_id=0, hashed=False):
+        """Blocking decide (non-pipelined backends): one "device" span
+        covers the whole synchronous dispatch."""
+        rec = tracing.RECORDER
+        t0 = tracing.now() if rec is not None else 0
+        out = (self.limiter.allow_ids(keys, ns) if hashed
+               else self.limiter.allow_batch(keys, ns))
+        if rec is not None:
+            rec.record("device", t0, tracing.now(), trace_id=trace_id,
+                       batch=len(out),
+                       outcome=tracing.FAIL_OPEN if out.fail_open
+                       else tracing.OK)
+        return out
+
+    async def _dispatch_hashed(self, ids, ns, fut: asyncio.Future,
+                               trace_id: int = 0) -> None:
         b = int(ids.shape[0])
         self._dispatch_batch.observe(float(b))
         loop = asyncio.get_running_loop()
+        t_q = tracing.now() if tracing.RECORDER is not None else 0
         t0 = time.perf_counter()
         if self._pipelined and self._hashed_lane:
             try:
                 ticket = await loop.run_in_executor(
-                    self._pool, self._launch_hashed_work, ids, ns)
+                    self._pool, self._launch_hashed_work, ids, ns,
+                    trace_id, t_q)
             except Exception as exc:
                 if not fut.done():
                     fut.set_exception(exc)
@@ -375,7 +426,8 @@ class MicroBatcher:
                                         self._resolve_work, ticket)
         else:
             work = loop.run_in_executor(
-                self._pool, lambda: self.limiter.allow_ids(ids, ns))
+                self._pool,
+                lambda: self._allow_work(ids, ns, trace_id, hashed=True))
         timed_out = False
         try:
             if self.dispatch_timeout is not None:
@@ -456,23 +508,32 @@ class MicroBatcher:
         (BatchResult.rows — numpy views + row-offset wire buffers, no
         re-packing)."""
         if len(frames) == 1:
-            ids, ns, fut = frames[0]
-            await self._dispatch_hashed(ids, ns, fut)
+            ids, ns, fut, tid = frames[0]
+            await self._dispatch_hashed(ids, ns, fut, tid)
             return
+        rec = tracing.RECORDER
+        tid = next((f[3] for f in frames if f[3]), 0)
+        t_r0 = tracing.now() if rec is not None else 0
         ids = np.concatenate([f[0] for f in frames])
         ns = np.concatenate([f[1] for f in frames])
+        if rec is not None:
+            # "route": window assembly — frame concatenation in arrival
+            # order (the mesh composite records its per-slice partition
+            # under the same stage at launch).
+            rec.record("route", t_r0, tracing.now(), trace_id=tid,
+                       batch=int(ids.shape[0]))
         loop = asyncio.get_running_loop()
         win: asyncio.Future = loop.create_future()
-        await self._dispatch_hashed(ids, ns, win)
+        await self._dispatch_hashed(ids, ns, win, tid)
         exc = win.exception()
         if exc is not None:
-            for _, _, fut in frames:
+            for _, _, fut, _ in frames:
                 if not fut.done():
                     fut.set_exception(exc)
             return
         out = win.result()
         off = 0
-        for fids, _, fut in frames:
+        for fids, _, fut, _ in frames:
             k = int(fids.shape[0])
             if not fut.done():
                 fut.set_result(out.rows(off, k))
@@ -487,10 +548,20 @@ class MicroBatcher:
         if not self._pending and not self._pending_hashed:
             return
         self._queue_depth.set(0)
+        rec = tracing.RECORDER
+        trace = self._q_trace
+        if rec is not None and self._q_t0:
+            # "coalesce": the window's residency — first enqueue to
+            # flush, in max_batch units across both lanes.
+            rec.record("coalesce", self._q_t0, tracing.now(),
+                       trace_id=trace,
+                       batch=len(self._pending) + self._pending_hashed_ids)
+        self._q_t0 = 0
+        self._q_trace = 0
         if self._pending:
             batch = self._pending
             self._pending = []
-            task = asyncio.ensure_future(self._dispatch(batch))
+            task = asyncio.ensure_future(self._dispatch(batch, trace))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
         if self._pending_hashed:
@@ -501,12 +572,14 @@ class MicroBatcher:
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
 
-    def _launch_work(self, keys, ns):
+    def _launch_work(self, keys, ns, trace_id=0, t_q=0):
         """Launch stage (runs on the launch executor thread): acquire an
         in-flight slot — blocking HERE is the pipeline's backpressure,
         it stalls later launches, never the event loop — then stage +
         enqueue without waiting on the device."""
         self._window.acquire()
+        rec = tracing.RECORDER
+        tq0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
         try:
             ticket = self.limiter.launch_batch(keys, ns)
@@ -514,23 +587,53 @@ class MicroBatcher:
             self._window.release()
             raise
         self._launch_hist.observe(time.perf_counter() - t0)
+        if rec is not None:
+            if t_q:
+                rec.record("queue", t_q, tq0, trace_id=trace_id,
+                           batch=len(keys))
+            rec.record("launch", tq0, tracing.now(), trace_id=trace_id,
+                       batch=len(keys))
+        ticket.trace_id = trace_id
         self._depth_add(1)
         return ticket
 
     def _resolve_work(self, ticket):
+        rec = tracing.RECORDER
+        tn0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
         try:
-            return self.limiter.resolve(ticket)
+            out = self.limiter.resolve(ticket)
+            if rec is not None:
+                tn1 = tracing.now()
+                tid = getattr(ticket, "trace_id", 0)
+                # "device": the blocking wait on the oldest in-flight
+                # dispatch (for a mesh composite this span ENCLOSES its
+                # barrier + per-slice spans — the span tree the oracle
+                # test walks); "resolve": the host bookkeeping tail.
+                rec.record("device", tn0, tn1, trace_id=tid,
+                           batch=len(out),
+                           outcome=tracing.FAIL_OPEN if out.fail_open
+                           else tracing.OK)
+                rec.record("resolve", tn1, tracing.now(), trace_id=tid,
+                           batch=len(out))
+            return out
+        except Exception:
+            if rec is not None:
+                rec.record("device", tn0, tracing.now(),
+                           trace_id=getattr(ticket, "trace_id", 0),
+                           outcome=tracing.ERROR)
+            raise
         finally:
             self._window.release()
             self._depth_add(-1)
             self._resolve_hist.observe(time.perf_counter() - t0)
 
-    async def _dispatch(self, batch) -> None:
+    async def _dispatch(self, batch, trace_id: int = 0) -> None:
         keys = [k for k, _, _ in batch]
         ns = [n for _, n, _ in batch]
         self._dispatch_batch.observe(float(len(batch)))
         loop = asyncio.get_running_loop()
+        t_q = tracing.now() if tracing.RECORDER is not None else 0
         t0 = time.perf_counter()
         if self._pipelined:
             # Launch/resolve split (ADR-010): the launch executor stages
@@ -538,7 +641,7 @@ class MicroBatcher:
             # batch k — the device always has work queued.
             try:
                 ticket = await loop.run_in_executor(
-                    self._pool, self._launch_work, keys, ns)
+                    self._pool, self._launch_work, keys, ns, trace_id, t_q)
             except Exception as exc:
                 for _, _, fut in batch:
                     if not fut.done():
@@ -548,7 +651,7 @@ class MicroBatcher:
                                         self._resolve_work, ticket)
         else:
             work = loop.run_in_executor(
-                self._pool, lambda: self.limiter.allow_batch(keys, ns))
+                self._pool, lambda: self._allow_work(keys, ns, trace_id))
         timed_out = False
         try:
             if self.dispatch_timeout is not None:

@@ -64,36 +64,48 @@ class Client:
 
     # ------------------------------------------------------------- surface
 
-    def allow(self, key: str) -> Result:
-        return self.allow_n(key, 1)
+    def allow(self, key: str, *, trace_id: int = 0) -> Result:
+        return self.allow_n(key, 1, trace_id=trace_id)
 
-    def allow_n(self, key: str, n: int) -> Result:
+    def allow_n(self, key: str, n: int, *, trace_id: int = 0) -> Result:
+        """``trace_id`` (nonzero) samples this request into the server's
+        flight recorder via the wire trace extension (ADR-014); pair it
+        with a client-side ``tracing.record("client", ...)`` span to get
+        the full client → door → device tree in one dump."""
         req_id = next(self._ids)
-        type_, body = self._roundtrip(p.encode_allow_n(req_id, key, n), req_id)
+        frame = p.encode_allow_n(req_id, key, n)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = self._roundtrip(frame, req_id)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result(body)
 
     def allow_batch(self, keys: Sequence[str],
-                    ns: Optional[Sequence[int]] = None) -> list:
+                    ns: Optional[Sequence[int]] = None, *,
+                    trace_id: int = 0) -> list:
         """One ALLOW_BATCH frame; results in request order."""
         if ns is None:
             ns = [1] * len(keys)
         req_id = next(self._ids)
-        type_, body = self._roundtrip(
-            p.encode_allow_batch(req_id, keys, ns), req_id)
+        frame = p.encode_allow_batch(req_id, keys, ns)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = self._roundtrip(frame, req_id)
         if type_ != p.T_RESULT_BATCH:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
 
-    def allow_hashed(self, ids, ns=None):
+    def allow_hashed(self, ids, ns=None, *, trace_id: int = 0):
         """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
         lane, ADR-011): columnar on the wire, hashed on device server-side;
         returns the frame's BatchResult (frombuffer-view columns). The id
         keyspace is disjoint from string keys; sketch-family servers only."""
         req_id = next(self._ids)
-        type_, body = self._roundtrip(
-            p.encode_allow_hashed(req_id, ids, ns), req_id)
+        frame = p.encode_allow_hashed(req_id, ids, ns)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = self._roundtrip(frame, req_id)
         if type_ != p.T_RESULT_HASHED:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_hashed(body)
@@ -222,12 +234,16 @@ class AsyncClient:
             raise p.exception_for(code, msg)
         return type_, body
 
-    async def allow(self, key: str) -> Result:
-        return await self.allow_n(key, 1)
+    async def allow(self, key: str, *, trace_id: int = 0) -> Result:
+        return await self.allow_n(key, 1, trace_id=trace_id)
 
-    async def allow_n(self, key: str, n: int) -> Result:
+    async def allow_n(self, key: str, n: int, *,
+                      trace_id: int = 0) -> Result:
         req_id = next(self._ids)
-        type_, body = await self._request(p.encode_allow_n(req_id, key, n), req_id)
+        frame = p.encode_allow_n(req_id, key, n)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = await self._request(frame, req_id)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result(body)
@@ -243,26 +259,31 @@ class AsyncClient:
             return_exceptions=True)
 
     async def allow_batch(self, keys: Sequence[str],
-                          ns: Optional[Sequence[int]] = None) -> list:
+                          ns: Optional[Sequence[int]] = None, *,
+                          trace_id: int = 0) -> list:
         """One ALLOW_BATCH frame for the whole sequence (amortized framing;
         decisions still coalesce with other connections server-side).
         Returns results in request order."""
         if ns is None:
             ns = [1] * len(keys)
         req_id = next(self._ids)
-        type_, body = await self._request(
-            p.encode_allow_batch(req_id, keys, ns), req_id)
+        frame = p.encode_allow_batch(req_id, keys, ns)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = await self._request(frame, req_id)
         if type_ != p.T_RESULT_BATCH:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
 
-    async def allow_hashed(self, ids, ns=None):
+    async def allow_hashed(self, ids, ns=None, *, trace_id: int = 0):
         """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
         lane, ADR-011); returns the frame's BatchResult. Pipelines with
         every other in-flight request on this connection."""
         req_id = next(self._ids)
-        type_, body = await self._request(
-            p.encode_allow_hashed(req_id, ids, ns), req_id)
+        frame = p.encode_allow_hashed(req_id, ids, ns)
+        if trace_id:
+            frame = p.with_trace(frame, trace_id)
+        type_, body = await self._request(frame, req_id)
         if type_ != p.T_RESULT_HASHED:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_hashed(body)

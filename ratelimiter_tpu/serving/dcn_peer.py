@@ -39,6 +39,7 @@ from ratelimiter_tpu.algorithms.sketch import (
     SketchLimiter,
     SketchTokenBucketLimiter,
 )
+from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.ops.sketch_kernels import sketch_geometry
 from ratelimiter_tpu.serving import protocol as p
 
@@ -206,16 +207,34 @@ class DcnPusher:
 
         req_id = next(self._ids)
         delivered = 0
+        # Trace context across the DCN wire (ADR-014): with the flight
+        # recorder on, every push cycle mints one trace id and sends it
+        # via the frame-level trace extension (OUTSIDE the HMAC envelope
+        # — verification is untouched); receivers strip it like any
+        # traced request, so one id ties the sender's push span to the
+        # receiver's merge on a shared dump.
+        rec = tracing.RECORDER
+        cycle_trace = tracing.new_trace_id() if rec is not None else 0
+
+        def traced(frame: bytes) -> bytes:
+            return p.with_trace(frame, cycle_trace) if cycle_trace else frame
+
+        def push_span(peer, frame) -> None:
+            t0 = tracing.now() if rec is not None else 0
+            peer.push(frame, req_id)
+            if rec is not None:
+                rec.record("dcn", t0, tracing.now(), trace_id=cycle_trace)
+
         if self._bucket:
             delta = dcn.export_debt(self.limiter)
             if not delta.any():
                 return 0
-            frame = p.encode_dcn_debt(
+            frame = traced(p.encode_dcn_debt(
                 req_id, delta, secret=self.secret, sender=self._sender,
-                seq=(self._next_seq() if self.secret is not None else None))
+                seq=(self._next_seq() if self.secret is not None else None)))
             for peer in self.peers:
                 try:
-                    peer.push(frame, req_id)
+                    push_span(peer, frame)
                     delivered += 1
                     self.pushes_ok += 1
                 except Exception as exc:
@@ -274,13 +293,13 @@ class DcnPusher:
             ok = True
             sent_up_to = self._watermarks[i]
             for s0 in range(0, pp.shape[0], per_frame):
-                frame = p.encode_dcn_slabs(
+                frame = traced(p.encode_dcn_slabs(
                     req_id, pp[s0:s0 + per_frame], ss[s0:s0 + per_frame],
                     self._sub_us, secret=self.secret, sender=self._sender,
                     seq=(self._next_seq()
-                         if self.secret is not None else None))
+                         if self.secret is not None else None)))
                 try:
-                    peer.push(frame, req_id)
+                    push_span(peer, frame)
                     self.pushes_ok += 1
                     # Periods are sorted ascending: the watermark tracks
                     # the last DELIVERED chunk, so a partial failure

@@ -40,6 +40,7 @@ from ratelimiter_tpu.core.errors import (
     StorageUnavailableError,
 )
 from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.observability import tracing
 
 log = logging.getLogger("ratelimiter_tpu.serving.grpc")
 
@@ -138,16 +139,40 @@ class GrpcRateLimitServer:
         self.decide = decide
         self.decide_many = decide_many
         self.reset = reset
+        # Trace context (ADR-014): callers propagate W3C traceparent as
+        # gRPC metadata; trace-aware decide callables (the in-repo
+        # doors) receive the id, plain lambdas keep working.
+        from ratelimiter_tpu.serving.http_gateway import _accepts_trace
+
+        self._decide_trace = _accepts_trace(decide)
+        self._trace_ctx = threading.local()
         self._default_limit = default_limit or (lambda: 0)
         self._decisions_total = decisions_total or (lambda: 0)
         self._started_at = time.time()
         grpc_mod = grpc
 
         def guard(fn):
-            """Run one RPC body, mapping core errors to gRPC status."""
+            """Run one RPC body, mapping core errors to gRPC status.
+            A ``traceparent`` metadata entry samples the RPC into the
+            flight recorder (ADR-014) and rides into trace-aware decide
+            callables via the thread-local ``_trace_ctx``."""
             def wrapped(request, context):
+                tid = 0
+                rec = tracing.RECORDER
+                if rec is not None:
+                    try:
+                        meta = dict(context.invocation_metadata())
+                        tid = tracing.parse_traceparent(
+                            meta.get("traceparent"))
+                    except Exception:  # noqa: BLE001 — attribution only
+                        tid = 0
+                t0 = tracing.now() if rec is not None else 0
+                self._trace_ctx.tid = tid
                 try:
-                    return fn(request)
+                    out = fn(request)
+                    if rec is not None:
+                        rec.record("grpc", t0, tracing.now(), trace_id=tid)
+                    return out
                 except (InvalidKeyError, InvalidNError,
                         InvalidConfigError) as exc:
                     context.abort(grpc_mod.StatusCode.INVALID_ARGUMENT,
@@ -164,11 +189,17 @@ class GrpcRateLimitServer:
                     context.abort(grpc_mod.StatusCode.INTERNAL, str(exc))
             return wrapped
 
+        def call_decide(key, n):
+            tid = getattr(self._trace_ctx, "tid", 0)
+            if tid and self._decide_trace:
+                return self.decide(key, n, trace_id=tid)
+            return self.decide(key, n)
+
         def allow(req):
-            return _to_pb(pb2, self.decide(req.key, 1))
+            return _to_pb(pb2, call_decide(req.key, 1))
 
         def allow_n(req):
-            return _to_pb(pb2, self.decide(req.key, int(req.n)))
+            return _to_pb(pb2, call_decide(req.key, int(req.n)))
 
         def allow_batch(req):
             # Request order is preserved either way; in-batch same-key

@@ -19,7 +19,15 @@ anything without the binary protocol) get drop-in rate limiting:
                                      -> 200 stored override
     DELETE   /v1/policy?key=K        -> 200 {"ok": true, "deleted": ...}
     GET      /healthz                -> 200 {"serving": true, ...}
-    GET      /metrics                -> Prometheus text
+    GET      /metrics                -> Prometheus text (OpenMetrics with
+                                        exemplars when the scraper sends
+                                        Accept: application/openmetrics-text)
+    GET      /debug/trace            -> recent flight-recorder spans as
+                                        Perfetto/Chrome-trace JSON
+                                        (ADR-014; bearer-gated like
+                                        /v1/policy, off by default)
+    GET/POST /debug/profile?seconds=N -> on-demand jax.profiler capture
+                                        (same gate; one at a time)
 
 Reset is a quota-erase lever and the policy endpoint is a quota-GRANT
 lever, so on a broad plain-HTTP surface both are bypass risks: the
@@ -45,6 +53,7 @@ to a limiter. The gRPC shape of this same surface is checked in at
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import threading
@@ -59,8 +68,29 @@ from ratelimiter_tpu.core.errors import (
     StorageUnavailableError,
 )
 from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.observability import tracing
 
 log = logging.getLogger("ratelimiter_tpu.serving.http")
+
+#: /debug/profile upper bound: an on-demand jax.profiler capture holds a
+#: handler thread (and profiler overhead) for its whole duration.
+MAX_PROFILE_SECONDS = 30.0
+
+
+def _accepts_kw(fn, name: str) -> bool:
+    """Does this callable accept keyword ``name``? Checked ONCE at
+    construction: embeddings wiring plain ``lambda key, n`` callables
+    keep working; the in-repo doors opt in."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    return any(p.name == name or p.kind is p.VAR_KEYWORD
+               for p in sig.parameters.values())
+
+
+def _accepts_trace(fn) -> bool:
+    return _accepts_kw(fn, "trace_id")
 
 
 def _policy_unsupported(*_a, **_kw):
@@ -83,7 +113,9 @@ class HttpGateway:
                  enable_policy: bool = False,
                  policy_token: Optional[str] = None,
                  snapshot: Optional[Callable[[], dict]] = None,
-                 snapshot_token: Optional[str] = None):
+                 snapshot_token: Optional[str] = None,
+                 enable_debug: bool = False,
+                 debug_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -154,6 +186,88 @@ class HttpGateway:
                     self._send(405, {"error": f"method {self.command} not "
                                      "allowed on /v1/policy"})
 
+            def _handle_debug_trace(self) -> None:
+                """Flight-recorder dump as Perfetto/Chrome-trace JSON
+                (ADR-014). A trace exposes keys' traffic timing and
+                thread structure, so the trust boundary is the same as
+                /v1/policy: disabled unless the embedding opted in,
+                bearer token in the header only."""
+                if not gateway.enable_debug:
+                    self._send(403, {"error": "debug endpoints are "
+                                     "disabled on this gateway"})
+                    return
+                if not self._bearer_ok(gateway.debug_token):
+                    self._send(403, {"error": "bad debug token"})
+                    return
+                rec = tracing.RECORDER
+                if rec is None:
+                    self._send(200, {"enabled": False, "traceEvents": [],
+                                     "hint": "start the server with "
+                                     "--flight-recorder (or call "
+                                     "tracing.enable())"})
+                    return
+                payload = rec.chrome_trace()
+                payload["enabled"] = True
+                self._send(200, payload)
+
+            def _handle_debug_profile(self, q) -> None:
+                """On-demand ``jax.profiler`` capture
+                (GET/POST /debug/profile?seconds=N): starts a device
+                trace, holds THIS handler thread for N seconds while
+                traffic keeps flowing, and reports the artifact
+                directory (xplane format — open with Perfetto or
+                tensorboard's profile plugin). One capture at a time;
+                same gate as /debug/trace."""
+                if not gateway.enable_debug:
+                    self._send(403, {"error": "debug endpoints are "
+                                     "disabled on this gateway"})
+                    return
+                if not self._bearer_ok(gateway.debug_token):
+                    self._send(403, {"error": "bad debug token"})
+                    return
+                seconds = min(float(q.get("seconds", ["1.0"])[0]),
+                              MAX_PROFILE_SECONDS)
+                if seconds <= 0:
+                    self._send(400, {"error": "seconds must be > 0"})
+                    return
+                if not gateway._profile_lock.acquire(blocking=False):
+                    self._send(409, {"error": "a profile capture is "
+                                     "already running"})
+                    return
+                try:
+                    import os
+                    import tempfile
+                    import time as _time
+
+                    import jax.profiler
+
+                    out_dir = tempfile.mkdtemp(prefix="rl_profile_")
+                    # NOTE: the first capture of a process pays several
+                    # seconds of profiler-server init on top of N —
+                    # budget the client timeout accordingly.
+                    jax.profiler.start_trace(out_dir)
+                    try:
+                        _time.sleep(seconds)
+                    finally:
+                        jax.profiler.stop_trace()
+                    files = sorted(
+                        os.path.relpath(os.path.join(root, f), out_dir)
+                        for root, _, fs in os.walk(out_dir) for f in fs)
+                except Exception as exc:  # noqa: BLE001 — profiler is
+                    # best-effort (unsupported platform, concurrent
+                    # capture by another tool): report, never crash.
+                    log.exception("debug profile capture failed")
+                    self._send(503, {"error": f"profiler unavailable: "
+                                     f"{exc}"})
+                    return
+                finally:
+                    gateway._profile_lock.release()
+                # Send OUTSIDE the capture try: a client that gave up
+                # mid-capture must not be misreported as a profiler
+                # failure (the broken pipe surfaces in _handle's guard).
+                self._send(200, {"ok": True, "dir": out_dir,
+                                 "seconds": seconds, "files": files})
+
             def _handle(self):
                 # Drain any request body first: HTTP/1.1 keep-alive means
                 # unread body bytes would be parsed as the next request
@@ -178,12 +292,32 @@ class HttpGateway:
                             self._send(400, {"error": "missing key (query "
                                              "param or X-User-ID header)"})
                             return
-                        res = gateway.decide(key, n)
+                        # W3C trace context (ADR-014): a caller's
+                        # traceparent samples this decision into the
+                        # flight recorder under its trace id, and the
+                        # id propagates into the decide path when the
+                        # wired callable is trace-aware (the in-repo
+                        # doors are; plain lambdas keep working).
+                        tid = tracing.parse_traceparent(
+                            self.headers.get("traceparent"))
+                        rec = tracing.RECORDER
+                        t0 = tracing.now() if rec is not None else 0
+                        if tid and gateway._decide_trace:
+                            res = gateway.decide(key, n, trace_id=tid)
+                        else:
+                            res = gateway.decide(key, n)
+                        if rec is not None:
+                            rec.record("http", t0, tracing.now(),
+                                       trace_id=tid)
                         headers = [
                             ("X-RateLimit-Limit", str(res.limit)),
                             ("X-RateLimit-Remaining", str(res.remaining)),
                             ("X-RateLimit-Reset", str(int(res.reset_at))),
                         ]
+                        if tid:
+                            headers.append(
+                                ("traceparent",
+                                 self.headers.get("traceparent")))
                         body = {"allowed": bool(res.allowed),
                                 "limit": int(res.limit),
                                 "remaining": int(res.remaining),
@@ -232,13 +366,30 @@ class HttpGateway:
                             "wal_seq": int(entry.get("wal_seq", 0)),
                             "duration_s": float(entry.get("duration_s",
                                                           0.0))})
+                    elif url.path == "/debug/trace":
+                        self._handle_debug_trace()
+                    elif url.path == "/debug/profile":
+                        self._handle_debug_profile(q)
                     elif url.path == "/healthz":
                         self._send(200, gateway.health())
                     elif url.path == "/metrics":
-                        text = gateway.metrics_render().encode()
+                        # Content negotiation: an OpenMetrics scraper
+                        # (Accept: application/openmetrics-text) gets the
+                        # exemplar-carrying exposition — histogram
+                        # buckets annotated with the flight-recorder
+                        # trace ids that landed in them (ADR-014).
+                        accept = self.headers.get("Accept", "")
+                        om = "application/openmetrics-text" in accept
+                        text = gateway.metrics_render(
+                            openmetrics=True).encode() if (
+                            om and gateway._metrics_om) else \
+                            gateway.metrics_render().encode()
                         self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "text/plain; version=0.0.4")
+                        self.send_header(
+                            "Content-Type",
+                            "application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8" if om and gateway._metrics_om
+                            else "text/plain; version=0.0.4")
                         self.send_header("Content-Length", str(len(text)))
                         self.end_headers()
                         self.wfile.write(text)
@@ -273,7 +424,17 @@ class HttpGateway:
         # Snapshot trigger is wired iff the embedding runs persistence.
         self.snapshot = snapshot
         self.snapshot_token = snapshot_token
+        # Debug surface (ADR-014): /debug/trace + /debug/profile, gated
+        # like /v1/policy (explicit opt-in + header-only bearer).
+        self.enable_debug = bool(enable_debug)
+        self.debug_token = debug_token
+        self._profile_lock = threading.Lock()
+        self._decide_trace = _accepts_trace(decide)
         self.metrics_render = metrics_render if metrics_render else lambda: ""
+        # OpenMetrics negotiation needs a renderer that takes the
+        # openmetrics kwarg (Registry.render does; plain lambdas don't).
+        self._metrics_om = (metrics_render is not None
+                            and _accepts_kw(metrics_render, "openmetrics"))
         self.health = health if health else lambda: {"serving": True}
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -296,7 +457,9 @@ class HttpGateway:
 
 def gateway_for_limiter(limiter, *, host: str = "127.0.0.1",
                         port: int = 0, enable_policy: bool = False,
-                        policy_token: Optional[str] = None) -> HttpGateway:
+                        policy_token: Optional[str] = None,
+                        enable_debug: bool = False,
+                        debug_token: Optional[str] = None) -> HttpGateway:
     """Standalone embedding: the gateway calls the limiter directly
     (the limiter's own lock serializes; for coalescing with binary
     traffic use the server binary's --http-port instead)."""
@@ -312,4 +475,6 @@ def gateway_for_limiter(limiter, *, host: str = "127.0.0.1",
         policy_get=limiter.get_override,
         policy_delete=limiter.delete_override,
         enable_policy=enable_policy,
-        policy_token=policy_token)
+        policy_token=policy_token,
+        enable_debug=enable_debug,
+        debug_token=debug_token)

@@ -36,10 +36,11 @@ from ratelimiter_tpu.core.errors import (
     InvalidNError,
 )
 from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 8
+_ABI = 9
 
 
 def _load_extension():
@@ -274,6 +275,11 @@ class NativeRateLimitServer:
             decide_hashed=self._decide_hashed if self._fast else None,
             launch_hashed=(self._launch_hashed_cb
                            if self._pipelined else None),
+            # Per-ticket stage timestamps (ABI 9, ADR-014): the completer
+            # reports io/dispatch/device/complete stamps per resolved
+            # dispatch; _spans records them into the flight recorder
+            # (no-op when tracing is off — one None check per dispatch).
+            spans=self._spans if self._pipelined else None,
             inflight=inflight,
             dcn_auth_required=bool(dcn and dcn_secret),
             # Size to the DCN peer set: each peer holding a slab-sized
@@ -306,8 +312,28 @@ class NativeRateLimitServer:
                 np.ascontiguousarray(out.reset_at, dtype=np.float64).tobytes(),
                 int(out.limit))
 
+    def _spans(self, shard: int, count: int, trace_id: int, t_io: int,
+               t_d0: int, t_d1: int, t_v0: int, t_v1: int):
+        """ABI 9 spans callback (ADR-014): per-ticket CLOCK_MONOTONIC
+        stage stamps from the C++ completer — io (enqueue→drain),
+        dispatch (drain→launch returned), device (resolve blocking) and
+        complete (resolve→now) — recorded into the flight recorder on
+        the completer thread. Same clock domain as tracing.now()."""
+        rec = tracing.RECORDER
+        if rec is None:
+            return
+        if t_io and t_d0 >= t_io:
+            rec.record("io", t_io, t_d0, trace_id=trace_id, shard=shard,
+                       batch=count)
+        rec.record("dispatch", t_d0, t_d1, trace_id=trace_id, shard=shard,
+                   batch=count)
+        rec.record("device", t_v0, t_v1, trace_id=trace_id, shard=shard,
+                   batch=count)
+        rec.record("complete", t_v1, tracing.now(), trace_id=trace_id,
+                   shard=shard, batch=count)
+
     def _decide(self, shard: int, blob: bytes, offsets_b: bytes,
-                lengths_b: bytes, ns_b: bytes):
+                lengths_b: bytes, ns_b: bytes, trace_id: int = 0):
         b = len(offsets_b) // 8
         lim = self._shard_limiters[shard]
         try:
@@ -331,7 +357,8 @@ class NativeRateLimitServer:
         self._batch_hist.observe(float(b))
         return self._pack_result(out)
 
-    def _decide_hashed(self, shard: int, ids_b: bytes, ns_b: bytes):
+    def _decide_hashed(self, shard: int, ids_b: bytes, ns_b: bytes,
+                       trace_id: int = 0):
         """Hashed-lane blocking decide: the buffers are already finalized
         u64 hashes (C++ splitmix64) — frombuffer views go straight into
         allow_hashed's staging memcpy; zero host hash math."""
@@ -347,7 +374,8 @@ class NativeRateLimitServer:
         self._batch_hist.observe(float(b))
         return self._pack_result(out)
 
-    def _launch_hashed_cb(self, shard: int, ids_b: bytes, ns_b: bytes):
+    def _launch_hashed_cb(self, shard: int, ids_b: bytes, ns_b: bytes,
+                          trace_id: int = 0):
         """Hashed-lane launch phase (pipelined): stage + enqueue without
         blocking; resolves through the same _resolve completer path."""
         t0 = time.perf_counter()
@@ -359,6 +387,7 @@ class NativeRateLimitServer:
                 ticket = lim.launch_hashed(h64, ns)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        ticket.trace_id = trace_id
         with self._depth_lock:
             self._depth += 1
             self._inflight_gauge.set(float(self._depth))
@@ -366,7 +395,7 @@ class NativeRateLimitServer:
         return ticket
 
     def _launch(self, shard: int, blob: bytes, offsets_b: bytes,
-                lengths_b: bytes, ns_b: bytes):
+                lengths_b: bytes, ns_b: bytes, trace_id: int = 0):
         """Launch phase (pipelined hot path): hash + stage + enqueue the
         jitted step WITHOUT blocking on the device; the returned ticket
         is opaque to C++ and comes back through _resolve on the
@@ -379,6 +408,7 @@ class NativeRateLimitServer:
                 ticket = lim.launch_hashed(h64, ns)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        ticket.trace_id = trace_id
         with self._depth_lock:
             self._depth += 1
             self._inflight_gauge.set(float(self._depth))
@@ -454,7 +484,7 @@ class NativeRateLimitServer:
 
         return int(splitmix64(np.asarray([raw_id], np.uint64))[0] % n_shards)
 
-    def decide_one(self, key: str, n: int = 1):
+    def decide_one(self, key: str, n: int = 1, *, trace_id: int = 0):
         """Single-key decision routed to the key's dispatch shard — the
         HTTP/gRPC gateways' decide callable when this server fronts
         traffic. Observability covers every shard when the server was
@@ -464,10 +494,20 @@ class NativeRateLimitServer:
         the shard's wire batches — fine for the interop surfaces these
         gateways exist for (curl, sidecars, admin); bulk traffic belongs
         on the binary protocol, whose micro-batching this path cannot
-        join (the C++ batcher owns the coalescing window)."""
+        join (the C++ batcher owns the coalescing window).
+
+        ``trace_id`` (ADR-014): a sampled gateway request (HTTP
+        ``traceparent`` / gRPC metadata) records its synchronous device
+        dispatch into the flight recorder under the owning shard."""
         shard = self.shard_of(key)
+        rec = tracing.RECORDER
+        t0 = tracing.now() if rec is not None else 0
         with self._locks[shard]:
-            return self._shard_limiters[shard].allow_n(key, n)
+            res = self._shard_limiters[shard].allow_n(key, n)
+        if rec is not None:
+            rec.record("device", t0, tracing.now(), trace_id=trace_id,
+                       shard=shard)
+        return res
 
     def reset_one(self, key: str) -> None:
         """Reset routed to the key's dispatch shard (resetting shard 0's

@@ -129,6 +129,45 @@ T_SNAPSHOT_R = 135
 T_RESULT_HASHED = 136
 T_ERROR = 255
 
+# --------------------------------------------- trace context (ADR-014)
+#
+# Optional caller trace propagation: setting bit 6 (0x40) on any REQUEST
+# type byte means the body is prefixed with a u64 trace id (little-
+# endian). Request types are 1..11 and response types >= 128, so the
+# flagged range 0x41..0x4B collides with nothing; responses never carry
+# the flag (the request id already correlates them). Servers that
+# predate the flag drop the connection on the unknown type — the flag is
+# only sent by callers that opted into tracing against a known server.
+# For T_DCN_PUSH the trace id rides OUTSIDE the HMAC envelope (the
+# envelope wraps the body; the trace prefix is framing), so sampled DCN
+# pushes need no key rotation and verification is unchanged.
+TRACE_FLAG = 0x40
+_TRACE_ID = struct.Struct("<Q")
+
+
+def with_trace(frame: bytes, trace_id: int) -> bytes:
+    """Re-frame a request with the trace-id extension (flag bit on the
+    type byte + u64 id prefixed to the body)."""
+    length, type_, req_id = _HDR.unpack_from(frame)
+    if type_ & TRACE_FLAG or type_ >= 128:
+        raise ProtocolError(f"type {type_} cannot carry a trace id")
+    body = _TRACE_ID.pack(trace_id & 0xFFFFFFFFFFFFFFFF) \
+        + frame[HEADER_SIZE:]
+    return _HDR.pack(1 + 8 + len(body), type_ | TRACE_FLAG, req_id) + body
+
+
+def split_trace(type_: int, body: bytes):
+    """(base_type, trace_id, body) from a possibly-flagged request frame
+    — servers call this once per frame; unflagged frames pass through
+    with trace_id 0 and zero copies."""
+    if not (type_ & TRACE_FLAG) or type_ >= 128:
+        return type_, 0, body
+    if len(body) < _TRACE_ID.size:
+        raise ProtocolError("short trace-id extension")
+    (trace_id,) = _TRACE_ID.unpack_from(body)
+    return type_ & ~TRACE_FLAG, trace_id, body[_TRACE_ID.size:]
+
+
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
 E_INVALID_N = 1
 E_INVALID_KEY = 2
@@ -514,7 +553,10 @@ def parse_header(buf: bytes, *, allow_dcn: bool = False) -> Tuple[int, int, int]
     any client could force MAX_DCN_FRAME-sized buffering per connection
     just by labeling frames (memory DoS on plain deployments)."""
     length, type_, req_id = _HDR.unpack_from(buf)
-    cap = MAX_DCN_FRAME if (allow_dcn and type_ == T_DCN_PUSH) else MAX_FRAME
+    # The size cap keys on the BASE type: a traced DCN push (TRACE_FLAG,
+    # ADR-014) still deserves the slab-sized cap on a DCN-enabled server.
+    base = type_ & ~TRACE_FLAG if type_ < 128 else type_
+    cap = MAX_DCN_FRAME if (allow_dcn and base == T_DCN_PUSH) else MAX_FRAME
     if length < 9 or length > cap:
         raise ProtocolError(f"bad frame length {length}")
     return length, type_, req_id

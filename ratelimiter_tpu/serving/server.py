@@ -28,6 +28,7 @@ from typing import Optional
 
 from ratelimiter_tpu.algorithms.base import RateLimiter
 from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
 from ratelimiter_tpu.serving.batcher import MicroBatcher
 
@@ -146,19 +147,32 @@ class RateLimitServer:
             except (ConnectionResetError, BrokenPipeError, RuntimeError):
                 pass
 
-        def complete_allow(req_id: int, fut: asyncio.Future) -> None:
+        def complete_allow(req_id: int, trace_id: int,
+                           fut: asyncio.Future) -> None:
             exc = fut.exception()
             if exc is not None:
                 write_out(p.encode_error(req_id, p.code_for(exc), str(exc)))
             else:
+                rec = tracing.RECORDER
+                t0 = tracing.now() if rec is not None else 0
                 write_out(p.encode_result(req_id, fut.result()))
+                if rec is not None:
+                    rec.record("encode", t0, tracing.now(),
+                               trace_id=trace_id)
 
-        def complete_hashed(req_id: int, fut: asyncio.Future) -> None:
+        def complete_hashed(req_id: int, trace_id: int,
+                            fut: asyncio.Future) -> None:
             exc = fut.exception()
             if exc is not None:
                 write_out(p.encode_error(req_id, p.code_for(exc), str(exc)))
             else:
-                write_vec(p.encode_result_hashed_views(req_id, fut.result()))
+                rec = tracing.RECORDER
+                t0 = tracing.now() if rec is not None else 0
+                res = fut.result()
+                write_vec(p.encode_result_hashed_views(req_id, res))
+                if rec is not None:
+                    rec.record("encode", t0, tracing.now(),
+                               trace_id=trace_id, batch=len(res))
 
         try:
             while True:
@@ -170,20 +184,30 @@ class RateLimitServer:
                     length, type_, req_id = p.parse_header(
                         hdr, allow_dcn=self.dcn)
                     body = await reader.readexactly(length - 9)
+                    # Trace-context extension (ADR-014): flagged request
+                    # frames prefix a u64 trace id; unflagged frames pass
+                    # through untouched (trace_id 0 = unsampled).
+                    type_, trace_id, body = p.split_trace(type_, body)
                 except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
                     log.warning("protocol error, dropping connection: %s", exc)
                     break
+                rec = tracing.RECORDER
+                t_io = tracing.now() if rec is not None else 0
                 if type_ == p.T_ALLOW_N:
                     # Zero-task fast path: queue into the shared batcher,
                     # write the response from the future's done callback.
                     try:
                         key, n = p.parse_allow_n(body)
-                        fut = self.batcher.submit_nowait(key, n)
+                        fut = self.batcher.submit_nowait(key, n, trace_id)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
                         continue
-                    fut.add_done_callback(partial(complete_allow, req_id))
+                    if rec is not None:
+                        rec.record("io", t_io, tracing.now(),
+                                   trace_id=trace_id)
+                    fut.add_done_callback(
+                        partial(complete_allow, req_id, trace_id))
                     continue
                 if type_ == p.T_ALLOW_HASHED:
                     # Zero-copy bulk lane (ADR-011): columnar frombuffer
@@ -192,34 +216,53 @@ class RateLimitServer:
                     # per-request Python objects between socket and step.
                     try:
                         ids, ns = p.parse_allow_hashed(body)
-                        fut = self.batcher.submit_hashed_nowait(ids, ns)
+                        fut = self.batcher.submit_hashed_nowait(ids, ns,
+                                                                trace_id)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
                         continue
-                    fut.add_done_callback(partial(complete_hashed, req_id))
+                    if rec is not None:
+                        rec.record("io", t_io, tracing.now(),
+                                   trace_id=trace_id,
+                                   batch=int(ids.shape[0]))
+                    fut.add_done_callback(
+                        partial(complete_hashed, req_id, trace_id))
                     continue
                 if type_ == p.T_ALLOW_BATCH:
                     try:
                         keys, ns = p.parse_allow_batch(body)
-                        futs = self.batcher.submit_many_nowait(zip(keys, ns))
+                        futs = self.batcher.submit_many_nowait(
+                            zip(keys, ns), trace_id)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
                         continue
+                    if rec is not None:
+                        rec.record("io", t_io, tracing.now(),
+                                   trace_id=trace_id, batch=len(keys))
 
-                    def complete_batch(req_id, agg: asyncio.Future) -> None:
+                    def complete_batch(req_id, trace_id,
+                                       agg: asyncio.Future) -> None:
                         exc = agg.exception()
                         if exc is not None:
                             write_out(p.encode_error(
                                 req_id, p.code_for(exc), str(exc)))
                         else:
+                            rec = tracing.RECORDER
+                            t0 = tracing.now() if rec is not None else 0
+                            results = agg.result()
                             write_out(p.encode_result_batch(
                                 req_id, self.limiter.config.limit,
-                                agg.result()))
+                                results))
+                            if rec is not None:
+                                rec.record("encode", t0, tracing.now(),
+                                           trace_id=trace_id,
+                                           batch=len(results))
 
                     agg = asyncio.gather(*futs)
-                    agg.add_done_callback(partial(complete_batch, req_id))
+                    agg.add_done_callback(
+                        partial(complete_batch, req_id, trace_id))
                     continue
                 # Slow-path frames (rare): one task each.
                 t = asyncio.ensure_future(self._handle_frame(

@@ -188,9 +188,9 @@ class TestCoalescedOrderingOracle:
             sizes = []
             orig = b._dispatch_hashed
 
-            async def spy(ids, ns, fut):
+            async def spy(ids, ns, fut, trace_id=0):
                 sizes.append(int(ids.shape[0]))
-                await orig(ids, ns, fut)
+                await orig(ids, ns, fut, trace_id)
 
             b._dispatch_hashed = spy
             futs = [b.submit_hashed_nowait(
@@ -245,9 +245,9 @@ class TestCoalescedOrderingOracle:
             sizes = []
             orig = b._dispatch_hashed
 
-            async def spy(ids, ns, fut):
+            async def spy(ids, ns, fut, trace_id=0):
                 sizes.append(int(ids.shape[0]))
-                await orig(ids, ns, fut)
+                await orig(ids, ns, fut, trace_id)
 
             b._dispatch_hashed = spy
             fut = b.submit_hashed_nowait(

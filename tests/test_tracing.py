@@ -1,0 +1,773 @@
+"""Flight-recorder tracing subsystem (ADR-014) + metrics satellites.
+
+Covers, per ISSUE 7:
+
+* recorder mechanics: ring wraparound, Chrome-trace/Perfetto dump shape;
+* span-tree completeness oracle: one MIXED mesh frame through EACH front
+  door yields a connected trace (client span -> door stages -> per-slice
+  dispatch -> device), with monotone timestamps and no same-stage
+  overlap per thread;
+* wire propagation: the flagged trace-id extension survives client ->
+  server on both doors (and the DCN envelope), HTTP carries
+  ``traceparent``;
+* tracing-off = zero-overhead smoke: RECORDER is None by default and
+  decisions are identical with the recorder on vs off;
+* metrics.py satellites: label-value escaping per the Prometheus spec,
+  locked reads, the bisect bucket scan, OpenMetrics exemplars;
+* the /debug/trace and /debug/profile endpoints' trust boundary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.observability import tracing
+from ratelimiter_tpu.parallel import SlicedMeshLimiter
+from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving.client import AsyncClient, Client
+from ratelimiter_tpu.serving.http_gateway import HttpGateway
+from ratelimiter_tpu.serving.native_server import (
+    NativeRateLimitServer,
+    native_server_available,
+)
+from ratelimiter_tpu.serving.server import RateLimitServer
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture
+def recorder():
+    """Fresh process recorder per test; always off afterwards so the
+    rest of the suite keeps the zero-overhead default."""
+    tracing.disable()
+    rec = tracing.enable(1024)
+    try:
+        yield rec
+    finally:
+        tracing.disable()
+
+
+def _sketch_cfg(**kw):
+    return Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                  window=60.0,
+                  sketch=SketchParams(depth=2, width=2048, sub_windows=8),
+                  **kw)
+
+
+# ---------------------------------------------------------------- recorder
+
+
+class TestRecorder:
+    def test_record_and_dump(self, recorder):
+        t0 = tracing.now()
+        recorder.record("io", t0, t0 + 1000, trace_id=7, shard=3, batch=5)
+        spans = recorder.dump()
+        assert len(spans) == 1
+        s = spans[0]
+        assert s["stage"] == "io" and s["trace_id"] == 7
+        assert s["shard"] == 3 and s["batch"] == 5
+        assert s["t_end_ns"] - s["t_start_ns"] == 1000
+
+    def test_ring_wraparound_keeps_latest(self, recorder):
+        cap = recorder.capacity
+        base = tracing.now()
+        for i in range(cap + 40):
+            recorder.record("io", base + i, base + i + 1, trace_id=i + 1)
+        spans = [s for s in recorder.dump() if s["stage"] == "io"]
+        assert len(spans) == cap
+        # The oldest 40 fell off; what remains is the newest cap records
+        # in monotone order.
+        ids = [s["trace_id"] for s in spans]
+        assert ids == list(range(41, cap + 41))
+
+    def test_per_thread_rings_no_interleave_corruption(self, recorder):
+        def worker(k):
+            for i in range(500):
+                t = tracing.now()
+                recorder.record("launch", t, t + 1, trace_id=k)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in (1, 2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = recorder.dump()
+        per = {k: sum(1 for s in spans if s["trace_id"] == k)
+               for k in (1, 2, 3)}
+        assert per == {1: 500, 2: 500, 3: 500}
+
+    def test_chrome_trace_is_json_with_events(self, recorder):
+        t0 = tracing.now()
+        recorder.record("device", t0, t0 + 5000, trace_id=9, batch=2)
+        payload = recorder.chrome_trace()
+        text = json.dumps(payload)          # Perfetto-loadable JSON
+        back = json.loads(text)
+        ev = back["traceEvents"][0]
+        assert ev["ph"] == "X" and ev["name"] == "device"
+        assert ev["args"]["trace_id"] == f"{9:016x}"
+        assert ev["dur"] == pytest.approx(5.0)
+
+    def test_off_by_default_and_module_record_noop(self):
+        tracing.disable()
+        assert tracing.RECORDER is None
+        # Guarded module-level record is a no-op, not an error.
+        tracing.record("io", 0, 1, trace_id=1)
+
+    def test_stage_summary(self, recorder):
+        t0 = tracing.now()
+        for i in range(10):
+            recorder.record("encode", t0, t0 + 10_000)
+        summary = recorder.stage_summary()
+        assert summary["encode"]["count"] == 10
+        assert summary["encode"]["mean_us"] == pytest.approx(10.0)
+
+
+class TestTraceparent:
+    def test_parse_roundtrip(self):
+        tid = tracing.new_trace_id()
+        hdr = tracing.format_traceparent(tid)
+        assert tracing.parse_traceparent(hdr) == tid
+
+    def test_parse_garbage(self):
+        assert tracing.parse_traceparent(None) == 0
+        assert tracing.parse_traceparent("") == 0
+        assert tracing.parse_traceparent("00-zz-yy-01") == 0
+        assert tracing.parse_traceparent("nonsense") == 0
+
+
+# ------------------------------------------------------------ wire framing
+
+
+class TestWireTraceExtension:
+    def test_with_trace_split_trace_roundtrip(self):
+        frame = p.encode_allow_n(17, "user:1", 2)
+        tid = tracing.new_trace_id()
+        traced = p.with_trace(frame, tid)
+        length, type_, req_id = p.parse_header(traced[:p.HEADER_SIZE])
+        assert type_ == p.T_ALLOW_N | p.TRACE_FLAG and req_id == 17
+        base, got_tid, body = p.split_trace(
+            type_, traced[p.HEADER_SIZE:])
+        assert base == p.T_ALLOW_N and got_tid == tid
+        key, n = p.parse_allow_n(body)
+        assert key == "user:1" and n == 2
+
+    def test_untraced_passthrough(self):
+        frame = p.encode_allow_n(1, "k", 1)
+        _, type_, _ = p.parse_header(frame[:p.HEADER_SIZE])
+        base, tid, body = p.split_trace(type_, frame[p.HEADER_SIZE:])
+        assert base == p.T_ALLOW_N and tid == 0
+        assert body == frame[p.HEADER_SIZE:]
+
+    def test_response_types_cannot_carry_trace(self):
+        ok = p.encode_ok(1)
+        with pytest.raises(p.ProtocolError):
+            p.with_trace(ok, 5)
+
+    def test_traced_dcn_push_keeps_cap_and_hmac(self):
+        # The trace prefix rides OUTSIDE the HMAC envelope: verification
+        # is unchanged and the DCN size cap still applies to the base
+        # type.
+        delta = np.ones((2, 4), dtype=np.int64)
+        frame = p.encode_dcn_debt(3, delta, secret="s3", sender=9,
+                                  seq=123)
+        traced = p.with_trace(frame, 77)
+        length, type_, _ = p.parse_header(traced[:p.HEADER_SIZE],
+                                          allow_dcn=True)
+        base, tid, body = p.split_trace(type_, traced[p.HEADER_SIZE:])
+        assert base == p.T_DCN_PUSH and tid == 77
+        payload = p.unwrap_dcn_auth(body, "s3")
+        kind, got, _ = p.parse_dcn(payload, 2, 4, 0)
+        assert kind == p.DCN_KIND_DEBT
+        np.testing.assert_array_equal(got, delta)
+
+
+# ----------------------------------------------------- span-tree oracles
+
+
+def _assert_span_tree(spans, tid, *, want_stages, n_slices=None):
+    """The completeness oracle: every wanted stage present under the
+    trace id, timestamps monotone (t_end >= t_start), same-stage spans
+    non-overlapping per thread, and per-slice spans (when present)
+    contained in the frame's device window."""
+    mine = [s for s in spans if s["trace_id"] == tid]
+    stages = {s["stage"] for s in mine}
+    missing = set(want_stages) - stages
+    assert not missing, f"stages missing from the trace: {missing}"
+    for s in mine:
+        assert s["t_end_ns"] >= s["t_start_ns"], s
+    # Same-stage spans must not overlap within one thread (each thread's
+    # pipeline processes one frame's stage at a time).
+    by = {}
+    for s in mine:
+        by.setdefault((s["thread"], s["stage"]), []).append(s)
+    for (_, stage), group in by.items():
+        group.sort(key=lambda s: s["t_start_ns"])
+        for a, b in zip(group, group[1:]):
+            assert a["t_end_ns"] <= b["t_start_ns"], (
+                f"overlapping {stage} spans in one thread")
+    if n_slices is not None:
+        slices = [s for s in mine if s["stage"] == "slice"]
+        assert len({s["shard"] for s in slices}) == n_slices
+        device = [s for s in mine if s["stage"] == "device"]
+        assert device, "no device span to parent the slices"
+        lo = min(d["t_start_ns"] for d in device)
+        hi = max(d["t_end_ns"] for d in device)
+        for s in slices:
+            assert lo <= s["t_start_ns"] and s["t_end_ns"] <= hi, (
+                "slice span escapes the frame's device window")
+
+
+class TestAsyncioDoorSpanTree:
+    def test_mixed_mesh_frame_traced_end_to_end(self, recorder):
+        """One mixed frame through the asyncio door on a 2-slice mesh:
+        client span -> io -> coalesce/queue/launch -> device -> barrier +
+        per-slice spans -> resolve -> encode, all under ONE wire-
+        propagated trace id."""
+        cfg = _sketch_cfg()
+        mesh = SlicedMeshLimiter(cfg, n_devices=2)
+
+        async def run():
+            srv = RateLimitServer(mesh, max_batch=4096, max_delay=200e-6)
+            await srv.start()
+            c = await AsyncClient.connect(srv.host, srv.port)
+            tid = tracing.new_trace_id()
+            # Raw ids chosen to fan out over BOTH slices (uniform ids
+            # split ~evenly under splitmix64 % 2).
+            ids = np.arange(1, 257, dtype=np.uint64)
+            t0 = tracing.now()
+            out = await c.allow_hashed(ids, trace_id=tid)
+            tracing.record("client", t0, tracing.now(), trace_id=tid,
+                           batch=len(out))
+            assert len(out) == 256 and out.allowed.all()
+            await c.close()
+            await srv.shutdown()
+            return tid
+
+        tid = asyncio.run(run())
+        spans = recorder.dump()
+        _assert_span_tree(
+            spans, tid,
+            want_stages=("client", "io", "coalesce", "queue", "launch",
+                         "device", "barrier", "slice", "resolve",
+                         "encode"),
+            n_slices=2)
+        # The client span must enclose the whole server-side pipeline.
+        mine = [s for s in spans if s["trace_id"] == tid]
+        client = next(s for s in mine if s["stage"] == "client")
+        for s in mine:
+            if s["stage"] != "client":
+                assert client["t_start_ns"] <= s["t_start_ns"]
+                assert s["t_end_ns"] <= client["t_end_ns"]
+        mesh.close()
+
+    def test_string_lane_traced(self, recorder):
+        lim = create_limiter(_sketch_cfg(), backend="sketch")
+
+        async def run():
+            srv = RateLimitServer(lim, max_batch=64, max_delay=200e-6)
+            await srv.start()
+            c = await AsyncClient.connect(srv.host, srv.port)
+            tid = tracing.new_trace_id()
+            res = await c.allow_n("user:1", 1, trace_id=tid)
+            assert res.allowed
+            await c.close()
+            await srv.shutdown()
+            return tid
+
+        tid = asyncio.run(run())
+        _assert_span_tree(recorder.dump(), tid,
+                          want_stages=("io", "coalesce", "launch",
+                                       "device", "resolve", "encode"))
+        lim.close()
+
+
+@pytest.mark.skipif(not native_server_available(),
+                    reason="needs g++ for the native server")
+class TestNativeDoorSpanTree:
+    def test_mixed_mesh_frame_traced_end_to_end(self, recorder):
+        """One mixed hashed frame through the NATIVE door with the mesh
+        slices mounted as dispatch shards (1 shard == 1 device,
+        ADR-012): the ABI 9 spans callback yields io -> dispatch ->
+        device -> complete per touched shard, under the wire trace id."""
+        from ratelimiter_tpu.parallel.limiter import build_slices
+
+        slices = build_slices(_sketch_cfg(), n_devices=2)
+        srv = NativeRateLimitServer(slices[0], "127.0.0.1", 0,
+                                    max_batch=4096, max_delay=200e-6,
+                                    shard_limiters=list(slices))
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                tid = tracing.new_trace_id()
+                t0 = tracing.now()
+                out = c.allow_hashed(np.arange(1, 257, dtype=np.uint64),
+                                     trace_id=tid)
+                tracing.record("client", t0, tracing.now(), trace_id=tid,
+                               batch=len(out))
+                assert len(out) == 256 and out.allowed.all()
+                # stats() surfaces the cumulative per-stage aggregates
+                # (ABI 9).
+                st = srv.stats()
+                assert st["stage_ns"]["batches"] > 0
+                assert st["stage_ns"]["device"] > 0
+        finally:
+            srv.shutdown()
+        spans = recorder.dump()
+        _assert_span_tree(spans, tid,
+                          want_stages=("client", "io", "dispatch",
+                                       "device", "complete"))
+        # Both shards (= devices) dispatched under this trace id.
+        mine = [s for s in spans if s["trace_id"] == tid]
+        assert {s["shard"] for s in mine
+                if s["stage"] == "device"} == {0, 1}
+        client = next(s for s in mine if s["stage"] == "client")
+        for s in mine:
+            if s["stage"] != "client":
+                assert client["t_start_ns"] <= s["t_start_ns"]
+                assert s["t_end_ns"] <= client["t_end_ns"]
+
+    def test_string_lane_traced(self, recorder):
+        lim = create_limiter(_sketch_cfg(), backend="sketch")
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0, max_batch=64,
+                                    max_delay=200e-6)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                tid = tracing.new_trace_id()
+                res = c.allow_n("user:1", 1, trace_id=tid)
+                assert res.allowed
+                res2 = c.allow_batch(["a", "b"], [1, 1], trace_id=tid)
+                assert all(r.allowed for r in res2)
+        finally:
+            srv.shutdown()
+        lim.close()
+        _assert_span_tree(recorder.dump(), tid,
+                          want_stages=("io", "dispatch", "device",
+                                       "complete"))
+
+
+# --------------------------------------------------- zero-overhead smoke
+
+
+class TestZeroOverhead:
+    def test_decisions_identical_recorder_on_vs_off(self):
+        """Tracing must never change behavior: same traffic, recorder on
+        vs off, byte-identical decision stream."""
+        def run(enable: bool):
+            tracing.disable()
+            if enable:
+                tracing.enable(1024)
+            try:
+                lim = create_limiter(
+                    _sketch_cfg(), backend="sketch",
+                    clock=ManualClock(T0))
+
+                async def drive():
+                    srv = RateLimitServer(lim, max_batch=32,
+                                          max_delay=100e-6)
+                    await srv.start()
+                    c = await AsyncClient.connect(srv.host, srv.port)
+                    out = []
+                    ids = np.arange(1, 65, dtype=np.uint64)
+                    for i in range(8):
+                        br = await c.allow_hashed(
+                            ids, trace_id=(i + 1) if enable else 0)
+                        out.append(br.allowed.copy())
+                        rs = await c.allow_batch(
+                            [f"u:{j}" for j in range(16)],
+                            trace_id=(i + 1) if enable else 0)
+                        out.append(np.array([r.allowed for r in rs]))
+                    await c.close()
+                    await srv.shutdown()
+                    return np.concatenate(out)
+
+                got = asyncio.run(drive())
+                lim.close()
+                return got
+            finally:
+                tracing.disable()
+
+        off = run(False)
+        on = run(True)
+        np.testing.assert_array_equal(off, on)
+
+    def test_recorder_on_throughput_smoke(self):
+        """Pinned throughput smoke for the acceptance bar (recorder ON
+        within 3% of OFF on the standard bench). The claim guarded here
+        is structural — spans are stamped per *dispatch*, never per
+        decision, at clock-read cost — so the CI margin is loose (1.5x)
+        to absorb shared-runner scheduler noise; the tight 3% A/B is a
+        bench measurement (``bench.py`` with/without ``--trace``,
+        recorded in ADR-014)."""
+        import time as _time
+
+        from ratelimiter_tpu.serving.batcher import MicroBatcher
+
+        def run(enable: bool) -> float:
+            tracing.disable()
+            if enable:
+                tracing.enable(4096)
+            try:
+                lim = create_limiter(_sketch_cfg(), backend="sketch")
+                ids = np.arange(1, 2049, dtype=np.uint64)
+                ns = np.ones(len(ids), dtype=np.int64)
+
+                async def drive() -> float:
+                    b = MicroBatcher(lim, max_batch=4096,
+                                     max_delay=50e-6,
+                                     registry=m.Registry())
+                    await b.submit_hashed_nowait(ids, ns)  # warm/compile
+                    t0 = _time.perf_counter()
+                    for i in range(20):
+                        await b.submit_hashed_nowait(
+                            ids, ns, trace_id=(i + 1) if enable else 0)
+                    dt = _time.perf_counter() - t0
+                    await b.drain()
+                    b.close()
+                    return dt
+
+                # Best of 3 rounds: the per-round minimum is the
+                # noise-robust estimator for "cost of the code path".
+                best = min(asyncio.run(drive()) for _ in range(3))
+                lim.close()
+                return best
+            finally:
+                tracing.disable()
+
+        off = run(False)
+        on = run(True)
+        assert on <= off * 1.5, (
+            f"recorder-on hot path regressed: {on:.4f}s vs {off:.4f}s "
+            "for 20 traced 2048-id dispatches")
+
+    def test_hot_path_defaults_off(self):
+        tracing.disable()
+        assert tracing.RECORDER is None
+        from ratelimiter_tpu.serving.batcher import MicroBatcher
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+
+        async def drive():
+            b = MicroBatcher(lim, max_batch=16, registry=m.Registry())
+            fut = b.submit_nowait("k", 1)
+            res = await fut
+            await b.drain()
+            b.close()
+            return res
+
+        res = asyncio.run(drive())
+        assert res.allowed
+        assert tracing.RECORDER is None
+        lim.close()
+
+
+# --------------------------------------------------- metrics satellites
+
+
+class TestMetricsSatellites:
+    def test_label_value_escaping(self):
+        reg = m.Registry()
+        c = reg.counter("t_total", "h")
+        evil = 'a"b\\c\nd'
+        c.inc(key=evil)
+        text = reg.render()
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith("t_total{"))
+        assert line == 't_total{key="a\\"b\\\\c\\nd"} 1'
+        # The exposition must stay one-sample-per-line: no raw newline
+        # leaked into the body.
+        assert 'a"b' not in text
+
+    def test_histogram_bisect_matches_linear_reference(self):
+        buckets = m.LATENCY_BUCKETS
+        h = m.Histogram("h_seconds", "h", buckets)
+        rng = np.random.default_rng(0)
+        values = list(rng.uniform(0, 3.0, size=500))
+        values += list(buckets)  # exact boundary values: `<=` semantics
+
+        def linear_bucket(v):
+            for i, ub in enumerate(buckets):
+                if v <= ub:
+                    return i
+            return len(buckets)
+
+        want = [0] * (len(buckets) + 1)
+        for v in values:
+            h.observe(v)
+            want[linear_bucket(v)] += 1
+        got = h._counts[()]
+        assert got[:-1] == want[:-1] and got[-1] == want[-1]
+        assert h.count() == len(values)
+        assert h.sum() == pytest.approx(sum(values))
+
+    def test_locked_reads_race_free(self):
+        c = m.Counter("race_total", "h")
+        g = m.Gauge("race_g", "h")
+        h = m.Histogram("race_seconds", "h")
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                c.inc(key=f"k{i % 50}")
+                g.inc(key=f"k{i % 50}")
+                h.observe(0.01)
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(2000):
+                c.value(key="k1")
+                g.value(key="k1")
+                h.count()
+                h.sum()
+        finally:
+            stop.set()
+            t.join()
+
+    def test_openmetrics_exemplars(self):
+        reg = m.Registry()
+        h = reg.histogram("lat_seconds", "h")
+        h.observe(0.003, exemplar="00000000000000ab", stage="device")
+        h.observe(0.004, stage="device")  # unsampled: no exemplar update
+        # Past every bucket bound -> the +Inf overflow bucket keeps its
+        # exemplar too (the slowest observations are the ones worth a
+        # trace id).
+        h.observe(99.0, exemplar="00000000000000cd", stage="device")
+        classic = reg.render()
+        assert "# {" not in classic       # classic text has no exemplars
+        om = reg.render_openmetrics()
+        assert '# {trace_id="00000000000000ab"} 0.003' in om
+        assert '# {trace_id="00000000000000cd"} 99' in om
+        inf_line = next(l for l in om.splitlines()
+                        if 'le="+Inf"' in l and "lat_seconds" in l)
+        assert "00000000000000cd" in inf_line
+        assert om.rstrip().endswith("# EOF")
+
+    def test_openmetrics_counter_family_name(self):
+        """OpenMetrics counter families must be named WITHOUT the
+        `_total` suffix in HELP/TYPE while the sample keeps it —
+        `# TYPE x_total counter` fails Prometheus's strict OM parser
+        and drops the whole scrape."""
+        reg = m.Registry()
+        c = reg.counter("req_total", "requests")
+        c.inc(door="binary")
+        classic = reg.render()
+        assert "# TYPE req_total counter" in classic
+        assert 'req_total{door="binary"} 1' in classic
+        om = reg.render_openmetrics()
+        assert "# TYPE req counter" in om
+        assert "# TYPE req_total" not in om
+        assert 'req_total{door="binary"} 1' in om
+
+    def test_stage_histograms_via_collect_hook(self):
+        reg = m.Registry()
+        tracing.disable()
+        rec = tracing.enable(256, registry=reg)
+        try:
+            t0 = tracing.now()
+            rec.record("device", t0, t0 + 2_000_000, trace_id=0xAB)
+            text = reg.render_openmetrics()
+            assert "rate_limiter_stage_seconds" in text
+            assert 'stage="device"' in text
+            assert f'trace_id="{0xAB:016x}"' in text
+            # Scrape again: the cursor advanced, counts must not double.
+            text2 = reg.render()
+            line = next(
+                ln for ln in text2.splitlines()
+                if ln.startswith("rate_limiter_stage_seconds_count"))
+            assert line.endswith(" 1")
+        finally:
+            tracing.disable()
+
+
+# ------------------------------------------------------- debug endpoints
+
+
+class TestDebugEndpoints:
+    def _get(self, port, path, token=None, timeout=10):
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_debug_trace_gating_and_dump(self, recorder):
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+        gw = HttpGateway(lambda key, n: lim.allow_n(key, n), lim.reset,
+                         enable_debug=True, debug_token="s3cr3t")
+        gw.start()
+        try:
+            t0 = tracing.now()
+            recorder.record("device", t0, t0 + 1000, trace_id=5)
+            code, _ = self._get(gw.port, "/debug/trace")
+            assert code == 403                       # bearer required
+            code, body = self._get(gw.port, "/debug/trace", token="s3cr3t")
+            assert code == 200 and body["enabled"]
+            assert any(ev["name"] == "device"
+                       for ev in body["traceEvents"])
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_debug_disabled_by_default(self):
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+        gw = HttpGateway(lambda key, n: lim.allow_n(key, n), lim.reset)
+        gw.start()
+        try:
+            code, _ = self._get(gw.port, "/debug/trace")
+            assert code == 403
+            code, _ = self._get(gw.port, "/debug/profile?seconds=0.1")
+            assert code == 403
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_debug_profile_capture(self, recorder):
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+        gw = HttpGateway(lambda key, n: lim.allow_n(key, n), lim.reset,
+                         enable_debug=True)
+        gw.start()
+        try:
+            # The process's FIRST capture pays several seconds of
+            # profiler-server init on top of the capture window.
+            code, body = self._get(gw.port, "/debug/profile?seconds=0.2",
+                                   timeout=90)
+            # 503 = profiler unavailable on this platform (reported, not
+            # crashed); 200 = capture artifacts on disk.
+            assert code in (200, 503)
+            if code == 200:
+                assert body["ok"] and body["files"]
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_traceparent_reaches_trace_aware_decide(self, recorder):
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+        seen = {}
+
+        def decide(key, n, trace_id=0):
+            seen["tid"] = trace_id
+            return lim.allow_n(key, n)
+
+        gw = HttpGateway(decide, lim.reset)
+        gw.start()
+        try:
+            tid = tracing.new_trace_id()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/allow?key=u1")
+            req.add_header("traceparent", tracing.format_traceparent(tid))
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 200
+                assert resp.headers["traceparent"]
+            assert seen["tid"] == tid
+            spans = recorder.dump()
+            assert any(s["stage"] == "http" and s["trace_id"] == tid
+                       for s in spans)
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_metrics_openmetrics_negotiation(self, recorder):
+        reg = m.Registry()
+        h = reg.histogram("neg_seconds", "h")
+        h.observe(0.001, exemplar="ff")
+        lim = create_limiter(_sketch_cfg(), backend="sketch",
+                             clock=ManualClock(T0))
+        gw = HttpGateway(lambda key, n: lim.allow_n(key, n), lim.reset,
+                         metrics_render=reg.render)
+        gw.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/metrics")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert "# EOF" not in resp.read().decode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/metrics")
+            req.add_header("Accept", "application/openmetrics-text")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                text = resp.read().decode()
+                assert "openmetrics-text" in resp.headers["Content-Type"]
+                assert text.rstrip().endswith("# EOF")
+                assert 'trace_id="ff"' in text
+        finally:
+            gw.shutdown()
+            lim.close()
+
+
+# ----------------------------------------------------- bench integration
+
+
+class TestBenchTrace:
+    def test_loadgen_trace_sampling(self):
+        """The e2e loadgen's trace_sample knob (`python -m benchmarks
+        --only e2e --trace-sample N`): sampled frames carry wire trace
+        ids and land client spans in the local recorder. The server is
+        IN-PROCESS here, so its spans share the loadgen's rings — size
+        the ring past the scalar-latency pass's span volume or the
+        early client spans wrap away (in the real subprocess loadgen
+        the client process records only its own spans)."""
+        from benchmarks.e2e import _drive
+
+        tracing.disable()
+        rec = tracing.enable(1 << 14)
+        lim = create_limiter(_sketch_cfg(), backend="sketch")
+        try:
+            async def run():
+                srv = RateLimitServer(lim, max_batch=256,
+                                      max_delay=200e-6)
+                await srv.start()
+                try:
+                    return await _drive(srv.port, seconds=0.3, conns=1,
+                                        window=64, n_keys=100,
+                                        warmup=0.0, trace_sample=1)
+                finally:
+                    await srv.shutdown()
+
+            out = asyncio.run(run())
+            assert out["completed"] > 0
+            clients = [s for s in rec.dump() if s["stage"] == "client"]
+            assert clients, "no sampled client spans recorded"
+            assert all(s["trace_id"] for s in clients)
+        finally:
+            tracing.disable()
+            lim.close()
+
+    def test_stage_breakdown_smoke(self):
+        """bench.py --trace block: tiny run, every expected stage key
+        present and the hot stages populated."""
+        import bench
+
+        tracing.disable()
+        out = bench.measure_stage_breakdown(seconds=0.3, batch=256,
+                                            width=1 << 11)
+        assert tracing.RECORDER is None      # restored the off default
+        for stage in ("io", "route", "queue", "coalesce", "launch",
+                      "device", "resolve", "encode"):
+            assert stage in out["stage_us"]
+        assert out["decisions"] > 0
+        assert out["stage_us"]["device"] > 0
+        assert out["stage_spans"]["io"] > 0
