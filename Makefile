@@ -6,8 +6,9 @@ PY ?= python
 REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
-.PHONY: help test test-all test-serving test-mesh test-tracing lint check \
-        native bench bench-quick bench-matrix serve verify clean
+.PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
+        lint check native bench bench-quick bench-chaos bench-matrix serve \
+        verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -27,6 +28,13 @@ test-mesh:       ## mesh contract + multichip + slice-parallel serving tests
 
 test-tracing:    ## flight-recorder span trees, both doors (ADR-014)
 	$(PY) -m pytest tests/test_tracing.py -q
+
+test-chaos:      ## failure-domain chaos suite + client resilience (ADR-015)
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m pytest tests/test_chaos.py tests/test_client_resilience.py -q
+
+bench-chaos:     ## degraded-serving numbers (retention/entry/recovery JSON)
+	$(PY) bench.py --chaos slow-slice
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -78,7 +78,7 @@ if _cache:
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
-from ratelimiter_tpu import Algorithm, Config, SketchParams
+from ratelimiter_tpu import Algorithm, Config, MeshSpec, SketchParams
 from ratelimiter_tpu.evaluation.loadgen import build_bench_chunk
 from ratelimiter_tpu.evaluation.oracle_device import (
     build_eval_chunk,
@@ -503,11 +503,113 @@ def measure_host_phases(B: int = INGEST_BATCH, reps: int = 30) -> dict:
             "hashed": hashed_phases, "host_cut_factor": round(cut, 1)}
 
 
+def run_chaos_bench(scenario: str, *, n_devices: int = 4,
+                    seconds: float = 2.0) -> dict:
+    """Degraded-serving measurement (``--chaos``, ADR-015): arm one
+    chaos scenario against a quarantine-enabled sliced mesh and measure
+    the robustness contract the chaos suite proves — as NUMBERS, so
+    robustness regressions become measurable like perf ones:
+
+    * ``throughput_retention``: healthy-slice decision rate during the
+      fault as a fraction of the no-fault baseline (same traffic);
+    * ``quarantine_entry_latency_s``: fault armed -> victim slice out of
+      routing (frames stop paying the per-slice deadline);
+    * ``recovery_s``: fault cleared -> probe + rejoin complete.
+    """
+    import jax  # noqa: F401 — backend init after XLA_FLAGS is set
+
+    from ratelimiter_tpu import chaos as chaos_pkg
+    from ratelimiter_tpu.parallel.limiter import SlicedMeshLimiter
+
+    deadline = 0.05
+    victim = 1
+    cfg = Config(
+        algorithm=Algorithm.SLIDING_WINDOW, limit=1_000_000, window=60.0,
+        fail_open=True,
+        sketch=SketchParams(depth=2, width=1 << 14, sub_windows=4),
+        mesh=MeshSpec(devices=n_devices, quarantine=True,
+                      slice_deadline=deadline, probe_interval=0.1),
+    )
+    lim = SlicedMeshLimiter(cfg)
+    ids = np.arange(4096, dtype=np.uint64)
+    owners = lim.owner_of_id(ids)
+    healthy_ids = np.ascontiguousarray(ids[owners != victim])
+    for _ in range(3):  # warm every slice (and the guards' warm gates)
+        lim.allow_ids(ids)
+
+    def rate(run_ids, secs: float) -> float:
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            lim.allow_ids(run_ids)
+            n += int(run_ids.shape[0])
+        return n / (time.perf_counter() - t0)
+
+    baseline = rate(healthy_ids, seconds)
+
+    inj = chaos_pkg.install(seed=42)
+    try:
+        # Arm the scenario against the victim slice. "slow-slice" delays
+        # past the per-slice deadline — the canonical gray failure.
+        if scenario == "slow-slice":
+            inj.delay_slice(victim, 4 * deadline)
+        else:
+            chaos_pkg.scenario(scenario, inj, slice_idx=victim,
+                               seconds=4 * deadline)
+        t_arm = time.perf_counter()
+        entry = float("nan")
+        while time.perf_counter() - t_arm < 10.0:
+            lim.allow_ids(ids)  # mixed traffic touches the victim
+            if lim.quarantine.state(victim) != "healthy":
+                entry = time.perf_counter() - t_arm
+                break
+        degraded = rate(healthy_ids, seconds)
+        degraded_mixed = rate(ids, max(0.5, seconds / 2))
+        inj.clear_slice(victim)
+        t_clear = time.perf_counter()
+        recovery = float("nan")
+        while time.perf_counter() - t_clear < 30.0:
+            lim.allow_ids(ids)  # traffic kicks the lazy half-open probe
+            if lim.quarantine.state(victim) == "healthy":
+                recovery = time.perf_counter() - t_clear
+                break
+            time.sleep(0.01)
+        status = lim.quarantine.status()
+    finally:
+        chaos_pkg.uninstall()
+        lim.close()
+    def _num(x, nd):
+        # null, never NaN: json.dumps renders bare NaN, which strict
+        # JSON parsers reject — exactly when the regression this block
+        # exists to catch (no quarantine entry / no recovery) happened.
+        return None if x != x else round(x, nd)
+
+    return {
+        "scenario": scenario,
+        "n_devices": n_devices,
+        "victim_slice": victim,
+        "slice_deadline_s": deadline,
+        "baseline_healthy_rate": round(baseline, 1),
+        "degraded_healthy_rate": round(degraded, 1),
+        "throughput_retention": round(degraded / max(baseline, 1e-9), 3),
+        "degraded_mixed_rate": round(degraded_mixed, 1),
+        "quarantine_entry_latency_s": _num(entry, 4),
+        "recovery_s": _num(recovery, 4),
+        "degraded_decisions": status["degraded_decisions"],
+        "transitions": status["transitions"],
+    }
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--chaos", default=None, metavar="SCENARIO",
+                    help="run ONLY the degraded-serving chaos bench "
+                         "(ADR-015) for this scenario (slow-slice, "
+                         "kill-slice, wedge-slice) and emit a "
+                         "degraded_serving JSON block")
     ap.add_argument("--snapshot-interval", type=float, default=None,
                     metavar="S",
                     help="also measure durability overhead (phase E): "
@@ -528,6 +630,30 @@ def main() -> None:
                          "serving rate per count). On CPU this forces N "
                          "virtual host devices")
     args = ap.parse_args()
+
+    if args.chaos:
+        # Before any jax.devices() call initializes the backend (same
+        # ordering rule as --mesh-devices below). A pre-set device-count
+        # flag wins: size the mesh to it instead of assuming 4.
+        import re as _re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = _re.search(r"xla_force_host_platform_device_count=(\d+)",
+                       flags)
+        if m is None:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+            n_dev = 4
+        else:
+            n_dev = max(2, int(m.group(1)))
+        print(json.dumps({
+            "metric": "degraded_serving",
+            "platform": jax.devices()[0].platform,
+            "degraded_serving": run_chaos_bench(args.chaos,
+                                                n_devices=n_dev),
+        }))
+        return
 
     if args.mesh_devices:
         # Must land before the first jax.devices() call initializes the

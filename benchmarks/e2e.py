@@ -46,7 +46,8 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
                   max_batch: int = 4096, max_delay_us: float = 500.0,
                   native: bool = False, shards: int = 1,
                   inflight: int = 8, mesh_devices: Optional[int] = None,
-                  extra_env: Optional[Dict[str, str]] = None):
+                  extra_env: Optional[Dict[str, str]] = None,
+                  extra_args: Optional[list] = None):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
@@ -67,7 +68,8 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
         + (["--native"] if native else [])
         + (["--shards", str(shards)] if shards > 1 else [])
         + (["--mesh-devices", str(mesh_devices)]
-           if mesh_devices is not None else []),
+           if mesh_devices is not None else [])
+        + (list(extra_args) if extra_args else []),
         env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     line = proc.stdout.readline()  # blocks until "serving ..." banner
     if "serving" not in line:
@@ -254,7 +256,10 @@ def _build_loadgen(td: str) -> str:
 def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
                      affine: bool = True, spread: Optional[int] = None,
                      loadgen: Optional[str] = None,
-                     platform: Optional[str] = None) -> Dict:
+                     platform: Optional[str] = None,
+                     chaos: Optional[str] = None,
+                     chaos_slice: int = 1,
+                     chaos_after: float = 1.0) -> Dict:
     """One measured point of the slice-parallel serving curve (ADR-012):
     a real ``--backend mesh --native`` server over ``n_devices`` pinned
     slices, driven by the C++ loadgen's zero-copy hashed lane.
@@ -284,9 +289,20 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
     spread = max(1, min(int(spread), n_devices))
     with tempfile.TemporaryDirectory() as td:
         binary = loadgen or _build_loadgen(td)
+        # Chaos-enabled runs (ADR-015): the server arms one scenario
+        # mid-traffic and quarantine contains it; the loadgen keeps
+        # driving through the fault — fail-open answers count as served
+        # (the row reports the degraded-but-serving rate).
+        chaos_args = []
+        if chaos:
+            chaos_args = ["--fail-open", "--quarantine",
+                          "--chaos-scenario", chaos,
+                          "--chaos-slice", str(chaos_slice),
+                          "--chaos-after", str(chaos_after)]
         proc, port = _spawn_server(
             "mesh", platform=platform, native=True, max_batch=16384,
-            max_delay_us=1000.0, inflight=1, mesh_devices=n_devices)
+            max_delay_us=1000.0, inflight=1, mesh_devices=n_devices,
+            extra_args=chaos_args)
         try:
             # 16 conns x 8 x 2048 ids = 262K in flight: enough offered
             # load to keep EIGHT devices' coalescers at max_batch depth
@@ -305,6 +321,9 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
             except subprocess.TimeoutExpired:
                 proc.kill()
     row["n_devices"] = n_devices
+    if chaos:
+        row["chaos"] = {"scenario": chaos, "victim_slice": chaos_slice,
+                        "armed_after_s": chaos_after}
     row["traffic"] = (
         "shard-affine (consistent-hash LB shape)" if spread == 1
         else ("mixed (uniform per-frame fan-out, scatter-gather "

@@ -46,6 +46,8 @@ from ratelimiter_tpu.core.errors import (
     StorageUnavailableError,
     ClosedError,
     CheckpointError,
+    DeadlineExceededError,
+    RequestTimeoutError,
 )
 from ratelimiter_tpu.core.clock import Clock, SystemClock, ManualClock
 from ratelimiter_tpu.algorithms.base import RateLimiter
@@ -70,6 +72,8 @@ __all__ = [
     "StorageUnavailableError",
     "ClosedError",
     "CheckpointError",
+    "DeadlineExceededError",
+    "RequestTimeoutError",
     "Clock",
     "SystemClock",
     "ManualClock",

@@ -322,6 +322,23 @@ class MeshSpec:
 
     #: Devices to span (None = every visible device; must be >= 1).
     devices: Optional[int] = None
+    #: Failure-domain isolation (ADR-015): wrap every slice in a
+    #: quarantine guard — per-slice dispatch deadline + failure
+    #: classifier, degraded per-range answers per ``fail_open``, and
+    #: half-open probe recovery with restore-before-rejoin. OFF by
+    #: default: the guard adds one executor hop per slice resolve, and
+    #: the no-quarantine hot path must stay byte-identical.
+    quarantine: bool = False
+    #: Per-slice sub-dispatch deadline, seconds: a slice that has not
+    #: resolved within this budget is classified failed and its key
+    #: range degrades (only that range — other slices stay exact).
+    slice_deadline: float = 0.25
+    #: Seconds a quarantined slice waits before each half-open probe.
+    probe_interval: float = 1.0
+    #: Consecutive classified failures before a slice quarantines
+    #: (1 = first fault quarantines; the failure already degraded that
+    #: frame's range either way).
+    failure_threshold: int = 1
 
     def validate(self) -> None:
         if self.devices is not None and (
@@ -329,6 +346,19 @@ class MeshSpec:
             raise InvalidConfigError(
                 f"mesh devices must be a positive integer or None, "
                 f"got {self.devices!r}")
+        if self.slice_deadline <= 0:
+            raise InvalidConfigError(
+                f"mesh slice_deadline must be positive, "
+                f"got {self.slice_deadline!r}")
+        if self.probe_interval <= 0:
+            raise InvalidConfigError(
+                f"mesh probe_interval must be positive, "
+                f"got {self.probe_interval!r}")
+        if not isinstance(self.failure_threshold, int) \
+                or self.failure_threshold < 1:
+            raise InvalidConfigError(
+                f"mesh failure_threshold must be an integer >= 1, "
+                f"got {self.failure_threshold!r}")
 
 
 @dataclass(frozen=True)

@@ -56,6 +56,33 @@ class ClosedError(RateLimiterError, RuntimeError):
     """
 
 
+class DeadlineExceededError(RateLimiterError, RuntimeError):
+    """Raised (fail-closed) when a request's propagated deadline expired
+    before its dispatch ran — the server sheds the work instead of
+    burning a dispatch slot on an answer nobody is waiting for
+    (ADR-015). Fail-open configs answer a fail-open allowance instead.
+
+    No reference analog: the reference's per-decision Redis round-trip
+    has no queueing stage where a deadline could be checked.
+    """
+
+
+class RequestTimeoutError(RateLimiterError, TimeoutError):
+    """Raised by the blocking Client when one call's read deadline
+    expires mid-stream. Names the pending request (``request_id`` /
+    ``request_type``) and marks the connection desynchronized: the next
+    call reconnects (or resyncs by draining the stale frame) — it can
+    NEVER return the timed-out frame's result as its own (ADR-015;
+    the pre-PR-8 behavior left the wire misaligned).
+    """
+
+    def __init__(self, msg: str, *, request_id: int = 0,
+                 request_type: int = 0):
+        super().__init__(msg)
+        self.request_id = int(request_id)
+        self.request_type = int(request_type)
+
+
 class CheckpointError(RateLimiterError, RuntimeError):
     """Raised when a state snapshot cannot be written or restored (missing
     file, wrong format, or a config fingerprint mismatch).

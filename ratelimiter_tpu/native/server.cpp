@@ -112,7 +112,7 @@ inline uint64_t splitmix64(uint64_t x) {
 }
 constexpr uint16_t E_INVALID_N = 1, E_INVALID_KEY = 2,
                    E_STORAGE_UNAVAILABLE = 3, E_INVALID_CONFIG = 5,
-                   E_INTERNAL = 7;
+                   E_INTERNAL = 7, E_DEADLINE = 8;
 constexpr uint32_t MAX_FRAME = 1u << 20;
 // T_DCN_PUSH frames carry whole slabs / debt deltas; the larger cap is
 // honored ONLY when the server was created with a dcn callback, so plain
@@ -126,6 +126,14 @@ constexpr uint32_t MAX_KEY_LEN = 4096;
 // the spans callback so the Python flight recorder can attribute every
 // pipeline stage of the dispatch that served the frame.
 constexpr uint8_t TRACE_FLAG = 0x40;
+// Deadline extension (ADR-015, serving/protocol.py DEADLINE_FLAG):
+// request frames with bit 5 set prefix their body with an f64 RELATIVE
+// deadline budget in seconds (after the trace id when both flags are
+// set). Anchored to frame arrival on the local monotonic clock; the
+// dispatcher SHEDS work whose deadline expired before its dispatch ran,
+// answering per the fail-open policy instead of burning a dispatch
+// slot.
+constexpr uint8_t DEADLINE_FLAG = 0x20;
 
 // Span clock: CLOCK_MONOTONIC ns — the SAME domain as Python's
 // time.monotonic_ns(), so C++ io/dispatch stamps and Python device-side
@@ -249,6 +257,10 @@ struct Pending {
   // the frame's wire-propagated trace id (0 = unsampled).
   uint64_t t_io = 0;
   uint64_t trace_id = 0;
+  // Wire-propagated absolute deadline, CLOCK_MONOTONIC ns (ABI 10,
+  // ADR-015; 0 = none): anchored at frame arrival from the frame's
+  // relative budget. Expired items are shed at the dispatch boundary.
+  uint64_t deadline_ns = 0;
 };
 
 inline size_t pending_count(const Pending& p) {
@@ -304,7 +316,16 @@ struct Server {
   // num_shards <= 64 cap). Routing-balance observability for the
   // slice-parallel serving tier (ADR-012).
   std::atomic<uint64_t> shard_decisions[64]{};
+  // Per-shard quarantine state (ABI 10, ADR-015): 0 healthy, 1 out of
+  // routing (quarantined/probing/restoring). Pushed from Python by the
+  // quarantine manager's on_state_change via set_shard_health;
+  // surfaced in stats()["shard_quarantined"] so operators see the
+  // degraded topology from the C++ door's own surface.
+  std::atomic<uint32_t> shard_quarantined[64]{};
   std::atomic<uint64_t> slo_breaches{0};
+  // Decisions shed because their propagated deadline expired before
+  // dispatch (ABI 10, ADR-015).
+  std::atomic<uint64_t> deadline_shed{0};
   // Cumulative per-stage wall time (ns) across batched dispatches
   // (ABI 9, ADR-014): io (enqueue -> drain), dispatch (drain -> launch
   // or blocking decide returned), device + complete (pipelined resolve
@@ -1181,6 +1202,51 @@ void dispatch_group(Server* s, uint32_t shard, std::vector<Pending>&& group,
   s->rcv.notify_one();
 }
 
+// Deadline shedding (ABI 10, ADR-015): answer the items of `group`
+// whose propagated deadline expired BEFORE their dispatch ran, per the
+// fail-open policy — fail-open rows stamped allowed|fail_open with the
+// LIVE limit/window, fail-closed a typed E_DEADLINE error — and remove
+// them from the group so the dispatch slot is never burned on them.
+// Join-split segments deposit through emit_reply's normal paths, so a
+// partially-shed multi-shard frame still answers as ONE frame.
+void shed_expired(Server* s, uint32_t shard, std::vector<Pending>& group,
+                  bool hashed) {
+  uint64_t now = mono_ns();
+  bool any = false;
+  for (const auto& p : group)
+    if (p.deadline_ns != 0 && now >= p.deadline_ns) { any = true; break; }
+  if (!any) return;
+  std::vector<Pending> live, dead;
+  live.reserve(group.size());
+  for (auto& p : group) {
+    if (p.deadline_ns != 0 && now >= p.deadline_ns)
+      dead.push_back(std::move(p));
+    else
+      live.push_back(std::move(p));
+  }
+  size_t total = 0;
+  for (const auto& p : dead) total += pending_count(p);
+  s->deadline_shed.fetch_add(total);
+  Server::Reply r;
+  r.hashed = hashed;
+  r.total = total;
+  if (s->fail_open) {
+    r.limit = s->limit.load();
+    double reset_at = now_s() + s->window_s.load();
+    r.flags.assign(total, 3);  // allowed | fail_open
+    r.remaining.assign(total, 0);
+    r.retry.assign(total, 0.0);
+    r.reset_at.assign(total, reset_at);
+    s->decisions.fetch_add(total);
+    s->shard_decisions[shard].fetch_add(total);
+  } else {
+    r.err_code = E_DEADLINE;
+    r.err_msg = "request deadline expired before dispatch";
+  }
+  emit_reply(s, dead, r);
+  group = std::move(live);
+}
+
 void handle_reset(Server* s, uint32_t shard, const Pending& p) {
   uint16_t err_code = 0;
   std::string err_msg;
@@ -1339,6 +1405,7 @@ void dispatcher_main(Server* s, uint32_t shard) {
           head.join = j;
           head.t_io = front.t_io;
           head.trace_id = front.trace_id;
+          head.deadline_ns = front.deadline_ns;
           if (front.hashed) {
             head.ids.assign(front.ids.begin(), front.ids.begin() + room);
             front.ids.erase(front.ids.begin(), front.ids.begin() + room);
@@ -1380,6 +1447,10 @@ void dispatcher_main(Server* s, uint32_t shard) {
         decisions.push_back(std::move(p));
       }
     }
+    // Deadline shedding BEFORE the dispatch fork (ABI 10, ADR-015):
+    // both the pipelined/throughput and SLO paths skip expired work.
+    if (!decisions.empty()) shed_expired(s, shard, decisions, false);
+    if (!hashed.empty()) shed_expired(s, shard, hashed, true);
     if (decisions.empty() && hashed.empty()) continue;
     if (s->slo_us == 0) {
       // Pipelined (ADR-010) or legacy throughput path, per group.
@@ -1469,12 +1540,14 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     uint8_t rawtype = (uint8_t)c->rbuf[off + 4];
     bool traced = (rawtype & TRACE_FLAG) != 0 && rawtype < 0x80;
     uint8_t type = traced ? (uint8_t)(rawtype & ~TRACE_FLAG) : rawtype;
+    bool deadlined = (type & DEADLINE_FLAG) != 0 && rawtype < 0x80;
+    if (deadlined) type = (uint8_t)(type & ~DEADLINE_FLAG);
     uint64_t req_id;
     memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     uint32_t cap =
         (s->dcn_enabled && type == T_DCN_PUSH) ? MAX_DCN_FRAME : MAX_FRAME;
     if (length > cap) return false;  // protocol error
-    size_t tskip = traced ? 8 : 0;
+    size_t tskip = (traced ? 8 : 0) + (deadlined ? 8 : 0);
     if (s->dcn_enabled && type == T_DCN_PUSH && !c->dcn_big &&
         (size_t)4 + length > c->rbuf.size() - off) {
       // Incomplete DCN frame that will need slab-sized buffering:
@@ -1516,6 +1589,21 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       body += 8;
       blen -= 8;
     }
+    uint64_t deadline_ns = 0;
+    if (deadlined) {
+      if (blen < 8) return false;  // short deadline extension
+      double budget;
+      memcpy(&budget, body, 8);
+      body += 8;
+      blen -= 8;
+      // Relative budget anchored at arrival (wall clocks need not
+      // agree across machines); non-positive budgets are already
+      // expired and shed at the next dispatch boundary.
+      if (budget > 0.0 && budget < 86400.0 * 365)
+        deadline_ns = mono_ns() + (uint64_t)(budget * 1e9);
+      else if (budget <= 0.0)
+        deadline_ns = 1;  // any past instant: expired on arrival
+    }
 
     auto enqueue = [&](Pending&& p, size_t nkeys, uint32_t shard) {
       Server::ShardQ& q = *s->shardqs[shard];
@@ -1550,6 +1638,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         Pending p{c, req_id, false, {std::move(key)}, {(int64_t)n}};
         p.t_io = mono_ns();
         p.trace_id = trace_id;
+        p.deadline_ns = deadline_ns;
         enqueue(std::move(p), 1, shard);
       }
     } else if (type == T_ALLOW_BATCH) {
@@ -1562,6 +1651,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       Pending p{c, req_id, true, {}, {}};
       p.t_io = mono_ns();
       p.trace_id = trace_id;
+      p.deadline_ns = deadline_ns;
       p.keys.reserve(count);
       p.ns.reserve(count);
       size_t pos = 4;
@@ -1630,6 +1720,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
             Pending part{c, req_id, true, {}, {}};
             part.t_io = p.t_io;
             part.trace_id = p.trace_id;
+            part.deadline_ns = p.deadline_ns;
             part.join = j;
             part.pos = std::move(per[sh]);
             part.keys.reserve(part.pos.size());
@@ -1666,6 +1757,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         Pending p{c, req_id, true, {}, {}};
         p.t_io = mono_ns();
         p.trace_id = trace_id;
+        p.deadline_ns = deadline_ns;
         p.hashed = true;
         p.ids.reserve(count);
         p.ns.reserve(count);
@@ -1710,6 +1802,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
               Pending part{c, req_id, true, {}, {}};
               part.t_io = p.t_io;
               part.trace_id = p.trace_id;
+              part.deadline_ns = p.deadline_ns;
               part.hashed = true;
               part.join = j;
               part.pos = std::move(per[sh]);
@@ -2016,6 +2109,22 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     }
     PyList_SET_ITEM(per_shard, i, v);
   }
+  // Per-shard quarantine state (ABI 10, ADR-015).
+  PyObject* per_quar = PyList_New(ps->s->num_shards);
+  if (per_quar == nullptr) {
+    Py_DECREF(per_shard);
+    return nullptr;
+  }
+  for (uint32_t i = 0; i < ps->s->num_shards; ++i) {
+    PyObject* v =
+        PyLong_FromLong((long)ps->s->shard_quarantined[i].load());
+    if (v == nullptr) {
+      Py_DECREF(per_shard);
+      Py_DECREF(per_quar);
+      return nullptr;
+    }
+    PyList_SET_ITEM(per_quar, i, v);
+  }
   // Cumulative per-stage wall time (ABI 9, ADR-014): ns each pipeline
   // stage has consumed across batched dispatches, plus the dispatch
   // count — enough to derive mean per-stage cost without any Python
@@ -2030,22 +2139,44 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
       "batches", (unsigned long long)ps->s->stage_batches.load());
   if (stage_ns == nullptr) {
     Py_DECREF(per_shard);
+    Py_DECREF(per_quar);
     return nullptr;
   }
   PyObject* out = Py_BuildValue(
-      "{s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O,s:O}", "decisions_total",
+      "{s:K,s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O,s:O,s:O}", "decisions_total",
       (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
-      (unsigned long long)ps->s->slo_breaches.load(), "uptime_s",
+      (unsigned long long)ps->s->slo_breaches.load(),
+      // Deadline shedding (ABI 10, ADR-015).
+      "deadline_shed_total",
+      (unsigned long long)ps->s->deadline_shed.load(), "uptime_s",
       now_s() - ps->s->started_at, "inflight_depth",
       (unsigned long long)depth, "inflight_window", ps->s->inflight_window,
       "pipelined", ps->s->pipelined ? Py_True : Py_False,
       // Shard routing observability (mesh mode: one shard == one
       // device, so this is the per-device decision balance, ADR-012).
       "num_shards", ps->s->num_shards, "shard_decisions", per_shard,
-      "stage_ns", stage_ns);
+      "shard_quarantined", per_quar, "stage_ns", stage_ns);
   Py_DECREF(per_shard);  // Py_BuildValue "O" took its own reference
+  Py_DECREF(per_quar);
   Py_DECREF(stage_ns);
   return out;
+}
+
+PyObject* server_set_shard_health(PyObject* self, PyObject* args) {
+  // Quarantine-state push (ABI 10, ADR-015): the Python quarantine
+  // manager's on_state_change mirrors each slice's health here so the
+  // C++ door's stats() reports the degraded topology (0 = healthy,
+  // 1 = out of routing).
+  PyServer* ps = (PyServer*)self;
+  unsigned int shard;
+  int quarantined;
+  if (!PyArg_ParseTuple(args, "Ip", &shard, &quarantined)) return nullptr;
+  if (shard >= ps->s->num_shards) {
+    PyErr_SetString(PyExc_ValueError, "shard out of range");
+    return nullptr;
+  }
+  ps->s->shard_quarantined[shard].store(quarantined ? 1u : 0u);
+  Py_RETURN_NONE;
 }
 
 PyObject* server_set_limits(PyObject* self, PyObject* args) {
@@ -2120,6 +2251,8 @@ PyMethodDef server_methods[] = {
      "{decisions_total, uptime_s, inflight_depth, ...}"},
     {"set_limits", server_set_limits, METH_VARARGS,
      "set_limits(limit, window_s): refresh the fail-open stamp fields"},
+    {"set_shard_health", server_set_shard_health, METH_VARARGS,
+     "set_shard_health(shard, quarantined): mirror quarantine state"},
     {nullptr, nullptr, 0, nullptr},
 };
 
@@ -2226,7 +2359,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 9; }
+int64_t rl_server_abi_version() { return 10; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

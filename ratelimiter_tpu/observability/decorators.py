@@ -493,6 +493,18 @@ class CircuitBreakerDecorator(LimiterDecorator):
         self._open_until = 0.0
         self._probe_inflight = False
         self._cb_lock = threading.Lock()
+        #: Per-sub-limiter scoping (ADR-015 satellite): around a
+        #: composite backend (the sliced mesh — sub_limiters() > 1), a
+        #: failure ATTRIBUTED to one slice (exception ``slice_index`` /
+        #: result ``fail_open_slices``) counts against that slice's own
+        #: breaker state and NEVER the whole-keyspace one — one bad
+        #: device must not short-circuit every other range. Unattributed
+        #: failures (the whole backend down) trip the global breaker as
+        #: before.
+        self._scoped = len(undecorated(inner).sub_limiters()) > 1
+        self._sub_consecutive: dict = {}
+        self._sub_last_failure: dict = {}
+        self._sub_open_until: dict = {}
         reg = registry if registry is not None else m.DEFAULT
         self._transitions = reg.counter(
             "rate_limiter_breaker_transitions_total",
@@ -504,6 +516,26 @@ class CircuitBreakerDecorator(LimiterDecorator):
     @property
     def state(self) -> str:
         return self._state
+
+    def sub_state(self, index: int, now: Optional[float] = None) -> str:
+        """Scoped breaker state of one sub-limiter: "open" while its
+        cooldown runs, else "closed" (slice-scoped failures never have
+        a half-open phase here — the quarantine manager owns per-slice
+        probing; this state is attribution bookkeeping)."""
+        t = self.inner.clock.now() if now is None else float(now)
+        with self._cb_lock:
+            return ("open"
+                    if self._sub_open_until.get(index, 0.0) > t
+                    else "closed")
+
+    def sub_states(self) -> dict:
+        with self._cb_lock:
+            return dict(self._sub_open_until)
+
+    @staticmethod
+    def _exc_slices(exc: Exception):
+        si = getattr(exc, "slice_index", None)
+        return [si] if si is not None else None
 
     def _trip(self, now: float) -> None:
         self._state = "open"
@@ -523,11 +555,37 @@ class CircuitBreakerDecorator(LimiterDecorator):
         with self._cb_lock:
             self._probe_inflight = False
 
-    def _note_result(self, failed: bool, now: float, probe: bool) -> None:
+    def _note_result(self, failed: bool, now: float, probe: bool,
+                     slices=None) -> None:
         with self._cb_lock:
             if probe:
                 self._probe_inflight = False
             if failed:
+                if slices and self._scoped:
+                    # Slice-attributed failure: count against the named
+                    # slices only. The whole-keyspace breaker must keep
+                    # admitting traffic for every other range — that is
+                    # the regression a single-slice fault storm used to
+                    # cause (it tripped the global breaker). "Consecutive"
+                    # is cooldown-windowed: a failure more than one
+                    # cooldown after the slice's previous one restarts
+                    # its count (a healthy frame can't clear it — frames
+                    # not touching the slice say nothing about it — so
+                    # isolated transients must not accumulate forever).
+                    for s in slices:
+                        last = self._sub_last_failure.get(s, 0.0)
+                        stale = now - last > self.cooldown
+                        self._sub_last_failure[s] = now
+                        c = (1 if stale
+                             else self._sub_consecutive.get(s, 0) + 1)
+                        self._sub_consecutive[s] = c
+                        if (c >= self.failure_threshold
+                                and self._sub_open_until.get(s, 0.0)
+                                <= now):
+                            self._sub_open_until[s] = now + self.cooldown
+                            self._transitions.inc(to="open",
+                                                  slice=str(s))
+                    return
                 self._consecutive += 1
                 if (self._state == "half-open"
                         or self._consecutive >= self.failure_threshold):
@@ -577,14 +635,15 @@ class CircuitBreakerDecorator(LimiterDecorator):
             return self._short_circuit(1, t)
         try:
             res = self.inner.allow_n(key, n, now=now)
-        except StorageUnavailableError:
-            self._note_result(True, t, probe)
+        except StorageUnavailableError as exc:
+            self._note_result(True, t, probe, self._exc_slices(exc))
             raise
         except BaseException:
             if probe:
                 self._clear_probe()
             raise
-        self._note_result(res.fail_open, t, probe)
+        self._note_result(res.fail_open, t, probe,
+                          getattr(res, "fail_open_slices", None))
         return res
 
     def allow_batch(self, keys: Sequence[str], ns=None, *,
@@ -595,14 +654,15 @@ class CircuitBreakerDecorator(LimiterDecorator):
             return self._short_circuit(len(keys), t)
         try:
             out = self.inner.allow_batch(keys, ns, now=now)
-        except StorageUnavailableError:
-            self._note_result(True, t, probe)
+        except StorageUnavailableError as exc:
+            self._note_result(True, t, probe, self._exc_slices(exc))
             raise
         except BaseException:
             if probe:
                 self._clear_probe()
             raise
-        self._note_result(out.fail_open, t, probe)
+        self._note_result(out.fail_open, t, probe,
+                          getattr(out, "fail_open_slices", None))
         return out
 
     # Pipelined path (ADR-010): the breaker admits (or short-circuits) at
@@ -620,8 +680,8 @@ class CircuitBreakerDecorator(LimiterDecorator):
             return DispatchTicket(result=self._short_circuit(len(keys), t))
         try:
             ticket = self.inner.launch_batch(keys, ns, now=now)
-        except StorageUnavailableError:
-            self._note_result(True, t, probe)
+        except StorageUnavailableError as exc:
+            self._note_result(True, t, probe, self._exc_slices(exc))
             raise
         except BaseException:
             if probe:
@@ -641,14 +701,15 @@ class CircuitBreakerDecorator(LimiterDecorator):
             return self._short_circuit(b, t)
         try:
             out = fn()
-        except StorageUnavailableError:
-            self._note_result(True, t, probe)
+        except StorageUnavailableError as exc:
+            self._note_result(True, t, probe, self._exc_slices(exc))
             raise
         except BaseException:
             if probe:
                 self._clear_probe()
             raise
-        self._note_result(out.fail_open, t, probe)
+        self._note_result(out.fail_open, t, probe,
+                          getattr(out, "fail_open_slices", None))
         return out
 
     def _guarded_launch(self, fn, b: int, now):
@@ -660,8 +721,8 @@ class CircuitBreakerDecorator(LimiterDecorator):
             return DispatchTicket(result=self._short_circuit(b, t))
         try:
             ticket = fn()
-        except StorageUnavailableError:
-            self._note_result(True, t, probe)
+        except StorageUnavailableError as exc:
+            self._note_result(True, t, probe, self._exc_slices(exc))
             raise
         except BaseException:
             if probe:
@@ -697,16 +758,18 @@ class CircuitBreakerDecorator(LimiterDecorator):
             ticket.meta = None
         try:
             out = self.inner.resolve(ticket)
-        except StorageUnavailableError:
+        except StorageUnavailableError as exc:
             if tag is not None:
-                self._note_result(True, tag[1], tag[2])
+                self._note_result(True, tag[1], tag[2],
+                                  self._exc_slices(exc))
             raise
         except BaseException:
             if tag is not None and tag[2]:
                 self._clear_probe()
             raise
         if tag is not None:
-            self._note_result(out.fail_open, tag[1], tag[2])
+            self._note_result(out.fail_open, tag[1], tag[2],
+                              getattr(out, "fail_open_slices", None))
         return out
 
 

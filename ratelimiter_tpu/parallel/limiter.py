@@ -300,6 +300,28 @@ class SlicedMeshLimiter(RateLimiter):
         self.n_slices = len(self.slices)
         self._CKPT_KIND = f"mesh:{self.slices[0]._CKPT_KIND}"
         self._seed = self.config.sketch.seed
+        #: Failure-domain isolation (ADR-015, opt-in via
+        #: ``MeshSpec.quarantine``): every slice is wrapped in a
+        #: SliceGuard enforcing a per-slice dispatch deadline and
+        #: degraded answers for quarantined ranges; ``self.quarantine``
+        #: is the shared state machine (None = subsystem off and the
+        #: hot path byte-identical to the unguarded build).
+        self.quarantine = None
+        if self.config.mesh.quarantine:
+            from ratelimiter_tpu.parallel.quarantine import (
+                QuarantineManager,
+                SliceGuard,
+            )
+
+            spec = self.config.mesh
+            self.quarantine = QuarantineManager(
+                self.n_slices, clock=self.clock,
+                probe_interval=spec.probe_interval,
+                failure_threshold=spec.failure_threshold)
+            self.slices = [
+                SliceGuard(s, i, self.quarantine,
+                           deadline=spec.slice_deadline)
+                for i, s in enumerate(self.slices)]
 
     # ------------------------------------------------------------ routing
 
@@ -403,7 +425,15 @@ class SlicedMeshLimiter(RateLimiter):
                 "foreign ticket reached SlicedMeshLimiter.resolve")
         if len(subs) == 1 and subs[0][1] is None:
             s, _, sub = subs[0]
-            res = self.slices[s].resolve(sub)
+            try:
+                res = self.slices[s].resolve(sub)
+            except Exception as exc:
+                if getattr(exc, "slice_index", None) is None:
+                    try:
+                        exc.slice_index = s
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                raise
             ticket.result = res
             return res
         # Single completion barrier: wait for EVERY slice's device work
@@ -414,6 +444,13 @@ class SlicedMeshLimiter(RateLimiter):
         trace = getattr(ticket, "trace_id", 0)
         outs = [sub.outs for _, _, sub in subs
                 if getattr(sub, "outs", None) is not None]
+        if self.quarantine is not None:
+            # Quarantine mode (ADR-015): NO global barrier — a wedged
+            # device would hang it indefinitely. Each slice's guard
+            # bounds its own resolve with the per-slice deadline
+            # instead; the frame finishes within one deadline budget of
+            # its slowest (possibly dead) slice.
+            outs = []
         if outs:
             t_b0 = tracing.now() if rec is not None else 0
             try:
@@ -436,6 +473,12 @@ class SlicedMeshLimiter(RateLimiter):
         reset_at = np.zeros(b, dtype=np.float64)
         limits = None
         fail_open = False
+        #: Per-slice fail-open attribution (ADR-015 / satellite 1): when
+        #: EVERY fail-open contribution names its slice, the frame's
+        #: result carries the union so the breaker decorator can scope
+        #: the failure instead of tripping the whole keyspace.
+        fo_slices: list = []
+        fo_unattributed = False
         err = None
         wire = bool(getattr(ticket, "wire", False))
         for s, pos, sub in subs:
@@ -443,6 +486,11 @@ class SlicedMeshLimiter(RateLimiter):
             try:
                 res = self.slices[s].resolve(sub)
             except Exception as exc:  # fail-closed slice: finish the rest
+                if getattr(exc, "slice_index", None) is None:
+                    try:
+                        exc.slice_index = s
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
                 if rec is not None:
                     rec.record("slice", t_s0, tracing.now(),
                                trace_id=trace, shard=s,
@@ -458,6 +506,12 @@ class SlicedMeshLimiter(RateLimiter):
             remaining[pos] = res.remaining
             retry[pos] = res.retry_after
             reset_at[pos] = res.reset_at
+            if res.fail_open:
+                attr = getattr(res, "fail_open_slices", None)
+                if attr:
+                    fo_slices.extend(attr)
+                else:
+                    fo_unattributed = True
             fail_open = fail_open or res.fail_open
             wire = wire and res.wire_packed is not None
             if res.limits is not None:
@@ -485,6 +539,8 @@ class SlicedMeshLimiter(RateLimiter):
                           remaining=remaining, retry_after=retry,
                           reset_at=reset_at, fail_open=fail_open,
                           limits=limits, wire_packed=wire_packed)
+        if fail_open and fo_slices and not fo_unattributed:
+            res.fail_open_slices = sorted(set(fo_slices))
         ticket.result = res
         return res
 
@@ -652,6 +708,35 @@ class SlicedMeshLimiter(RateLimiter):
             sub = {k[len(prefix):]: v for k, v in arrays.items()
                    if k.startswith(prefix)}
             s._restore_loaded(sub, extras[i], label=f"{path}[slice{i}]")
+
+    def restore_slice(self, path: str, index: int) -> None:
+        """Slice-scoped restore (ADR-015): replace ONE slice's state
+        with its sub-dictionary of the combined snapshot at ``path``,
+        leaving every other slice untouched. This is the recovery half
+        of quarantine — a slice rejoining routing restores from the
+        newest snapshot (plus the WAL suffix the persistence tier
+        replays, recover.recover_unit) before it serves again. Same
+        slice-count refusal as a full restore."""
+        from ratelimiter_tpu.checkpoint import load_state
+
+        self._check_open()
+        if not 0 <= index < self.n_slices:
+            raise CheckpointError(
+                f"restore_slice: slice {index} out of range "
+                f"[0, {self.n_slices})")
+        arrays, meta = load_state(path, self._CKPT_KIND, self.config)
+        saved = int(meta.get("n_slices", -1))
+        if saved != self.n_slices:
+            raise CheckpointError(
+                f"{path}: snapshot holds {saved} slice(s) but this mesh "
+                f"runs {self.n_slices} — per-slice counters are only "
+                f"meaningful under the routing that produced them")
+        extras = meta.get("slice_extras") or [{}] * self.n_slices
+        prefix = f"slice{index}:"
+        sub = {k[len(prefix):]: v for k, v in arrays.items()
+               if k.startswith(prefix)}
+        self.slices[index]._restore_loaded(
+            sub, extras[index], label=f"{path}[slice{index}]")
 
     # ------------------------------------------------- fault injection
 

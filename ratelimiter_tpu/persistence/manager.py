@@ -131,6 +131,22 @@ class PersistenceManager:
 
     # ------------------------------------------------------------ surface
 
+    def slice_restorer(self) -> Callable[[int], None]:
+        """The quarantine tier's restore-before-rejoin hook (ADR-015):
+        a callable restoring ONE dispatch unit from the newest readable
+        snapshot + WAL suffix (recover.recover_unit). Wire it as
+        ``QuarantineManager.restore_fn``. Mutation replay bypasses the
+        PersistentLimiter wrappers, so nothing is re-logged — safe to
+        run while the rest of the deployment keeps serving."""
+        from ratelimiter_tpu.persistence.recover import recover_unit
+
+        def restore(unit: int) -> None:
+            assert self._limiters, "attach() first"
+            recover_unit(self._limiters, self.dir, unit,
+                         shard_of=self._shard_of)
+
+        return restore
+
     def snapshot_now(self) -> dict:
         """Manual trigger (HTTP /v1/snapshot, binary T_SNAPSHOT)."""
         assert self.snapshotter is not None, "attach() first"

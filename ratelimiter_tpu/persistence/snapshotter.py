@@ -179,6 +179,14 @@ class Snapshotter:
                 raise
 
     def _snapshot_locked(self) -> dict:
+        from ratelimiter_tpu import chaos
+
+        if chaos.INJECTOR is not None:
+            # Chaos seam (ADR-015): the snapshot-stall scenario sleeps
+            # here — BEFORE the capture — so the suite can prove a
+            # stalled snapshot thread never blocks the decide path
+            # (capture_state is the only lock-holding phase).
+            chaos.INJECTOR.snapshot_capture()
         t0 = time.perf_counter()
         snap_id = self._next_id
         # Watermark BEFORE capture: see module docstring for why this

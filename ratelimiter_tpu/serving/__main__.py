@@ -43,6 +43,45 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--backend mesh: devices to span (default: all "
                          "visible; on CPU set XLA_FLAGS="
                          "--xla_force_host_platform_device_count=N)")
+    ap.add_argument("--quarantine", action="store_true",
+                    help="--backend mesh: per-slice failure domains "
+                         "(ADR-015) — slice dispatches get a deadline + "
+                         "failure classifier; a failing slice's key "
+                         "range degrades per --fail-open while every "
+                         "other slice keeps serving exactly, with "
+                         "half-open probe recovery and (with "
+                         "--snapshot-dir) restore-before-rejoin")
+    ap.add_argument("--slice-deadline-ms", type=float, default=250.0,
+                    help="per-slice sub-dispatch deadline (quarantine "
+                         "mode): a slice not resolving within this "
+                         "budget is classified failed")
+    ap.add_argument("--probe-interval", type=float, default=1.0,
+                    help="seconds between half-open probes of a "
+                         "quarantined slice")
+    ap.add_argument("--quarantine-threshold", type=int, default=1,
+                    help="consecutive classified failures before a "
+                         "slice quarantines")
+    # Chaos harness (ADR-015; TEST/BENCH ONLY — deterministic fault
+    # injection in the serving process so loadgen runs can measure
+    # degraded-mode serving end to end).
+    ap.add_argument("--chaos-scenario", default=None,
+                    metavar="NAME",
+                    help="arm one chaos scenario in-process (kill-slice, "
+                         "slow-slice, wedge-slice, dcn-partition, "
+                         "dcn-corrupt, snapshot-stall). Requires "
+                         "--quarantine for the slice scenarios. Test/"
+                         "bench lever — never set in production")
+    ap.add_argument("--chaos-slice", type=int, default=0,
+                    help="victim slice index for slice scenarios")
+    ap.add_argument("--chaos-after", type=float, default=0.0,
+                    help="arm the scenario this many seconds after "
+                         "serving starts (0 = immediately) — the "
+                         "kill-a-slice-MID-TRAFFIC shape")
+    ap.add_argument("--chaos-seconds", type=float, default=0.05,
+                    help="delay/stall magnitude for slow-slice / "
+                         "snapshot-stall")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="injector RNG seed (failures replay exactly)")
     ap.add_argument("--limit", type=int, default=100)
     ap.add_argument("--window", type=float, default=60.0,
                     help="window seconds")
@@ -279,10 +318,16 @@ def make_threadsafe_decide(batcher, loop):
     """Single-decision bridge from gateway/gRPC worker threads into the
     event loop's micro-batcher: every surface shares device dispatches.
     Trace-aware (ADR-014): a sampled HTTP/gRPC request's trace id rides
-    into the batcher so its coalesced dispatch records under it."""
-    def decide(key: str, n: int, trace_id: int = 0):
+    into the batcher so its coalesced dispatch records under it.
+    Deadline-aware (ADR-015): a caller's RELATIVE budget anchors to the
+    local monotonic clock and the batcher sheds the work per policy if
+    it expires in the coalescing queue."""
+    def decide(key: str, n: int, trace_id: int = 0, deadline=None):
+        abs_deadline = (time.monotonic() + float(deadline)
+                        if deadline is not None else 0.0)
         return asyncio.run_coroutine_threadsafe(
-            batcher.submit(key, n, trace_id=trace_id),
+            batcher.submit(key, n, trace_id=trace_id,
+                           deadline=abs_deadline),
             loop).result(timeout=30)
 
     return decide
@@ -397,10 +442,54 @@ async def amain(args) -> None:
             snapshot_after_mutations=args.snapshot_after_mutations,
             retain=args.snapshot_retain,
             wal_fsync=args.wal_fsync),
-        mesh=MeshSpec(devices=args.mesh_devices),
+        mesh=MeshSpec(devices=args.mesh_devices,
+                      quarantine=args.quarantine,
+                      slice_deadline=args.slice_deadline_ms * 1e-3,
+                      probe_interval=args.probe_interval,
+                      failure_threshold=args.quarantine_threshold),
     )
     if args.mesh_devices is not None and args.backend != "mesh":
         raise SystemExit("--mesh-devices needs --backend mesh")
+    if args.quarantine and args.backend != "mesh":
+        raise SystemExit("--quarantine needs --backend mesh (failure "
+                         "domains are per device slice)")
+    start_chaos = None
+    if args.chaos_scenario:
+        slice_scen = args.chaos_scenario in ("kill-slice", "slow-slice",
+                                             "wedge-slice")
+        if slice_scen and not args.quarantine:
+            raise SystemExit("--chaos-scenario slice faults need "
+                             "--quarantine (otherwise nothing contains "
+                             "them)")
+        from ratelimiter_tpu import chaos as chaos_pkg
+
+        _inj = chaos_pkg.install(seed=args.chaos_seed)
+
+        def _arm_chaos() -> None:
+            chaos_pkg.scenario(args.chaos_scenario, _inj,
+                               slice_idx=args.chaos_slice,
+                               seconds=args.chaos_seconds)
+            logging.getLogger("ratelimiter_tpu.serving").warning(
+                "chaos scenario %s armed (slice %d, seed %d)",
+                args.chaos_scenario, args.chaos_slice, args.chaos_seed)
+
+        def start_chaos() -> None:
+            # Called once SERVING starts (the banner), not at parse
+            # time: --chaos-after counts from when traffic can flow, so
+            # prewarm/compile time never eats the delay (the
+            # kill-a-slice-MID-TRAFFIC shape needs a clean pre-fault
+            # phase).
+            if args.chaos_after > 0:
+                import threading
+
+                t = threading.Timer(args.chaos_after, _arm_chaos)
+                # Daemon: a server stopped before the delay elapses must
+                # exit promptly, not join a timer waiting to arm chaos
+                # against a torn-down limiter.
+                t.daemon = True
+                t.start()
+            else:
+                _arm_chaos()
     if args.backend == "mesh" and args.shards > 1:
         raise SystemExit("--backend mesh routes one dispatch shard per "
                          "device; use --mesh-devices, not --shards")
@@ -425,13 +514,35 @@ async def amain(args) -> None:
     # owning devices.
     mesh_native = bool(args.backend == "mesh" and args.native)
     slices = None
+    qmgr = None
     if mesh_native:
         from ratelimiter_tpu.parallel.limiter import build_slices
 
         slices = build_slices(cfg)
+        if cfg.mesh.quarantine:
+            # Native door failure domains (ADR-015): one guard per
+            # mounted shard — the C++ shard router IS the slice router,
+            # so a guard around each shard limiter scopes faults to
+            # exactly one key range.
+            from ratelimiter_tpu.parallel.quarantine import (
+                QuarantineManager,
+                SliceGuard,
+            )
+
+            qmgr = QuarantineManager(
+                len(slices), clock=slices[0].clock,
+                probe_interval=cfg.mesh.probe_interval,
+                failure_threshold=cfg.mesh.failure_threshold)
+            slices = [SliceGuard(s, i, qmgr,
+                                 deadline=cfg.mesh.slice_deadline)
+                      for i, s in enumerate(slices)]
         limiter = decorate(slices[0])
     else:
         limiter = decorate(create_limiter(cfg, backend=args.backend))
+        if args.backend == "mesh":
+            from ratelimiter_tpu.observability.decorators import undecorated
+
+            qmgr = getattr(undecorated(limiter), "quarantine", None)
     if args.backend != "exact" and not args.no_prewarm:
         _prewarm(limiter, args.max_batch)
         if slices is not None:
@@ -485,6 +596,13 @@ async def amain(args) -> None:
             persist.recover()
             persist.start()
         server.start()
+        if qmgr is not None:
+            # Mirror quarantine transitions into the C++ door's stats
+            # and wire restore-before-rejoin to the durability tier.
+            qmgr.on_state_change = (
+                lambda i, st: server.set_shard_health(i, st != "healthy"))
+            if persist is not None:
+                qmgr.restore_fn = persist.slice_restorer()
         if dcn_peers:
             # One pusher PER SHARD limiter: keys are hash-routed across
             # shards, so exporting shard 0 alone would hide (N-1)/N of
@@ -516,6 +634,8 @@ async def amain(args) -> None:
                                     server.shard_limiters[0].override_count(),
                                 **_envelope_health(server.shard_limiters),
                                 **_debt_slab_health(server.shard_limiters),
+                                **({"quarantine": qmgr.status()}
+                                   if qmgr is not None else {}),
                                 **(persist.status() if persist else {})},
                 enable_reset=http_reset,
                 reset_token=args.http_reset_token,
@@ -553,6 +673,8 @@ async def amain(args) -> None:
               f"{args.host}:{server.port}"
               + (f" http:{gateway.port}" if gateway else "")
               + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
+        if start_chaos is not None:
+            start_chaos()
         await stop.wait()
         for pu in pushers:
             pu.stop()
@@ -593,6 +715,10 @@ async def amain(args) -> None:
         persist.attach([limiter])
         persist.recover()
         persist.start()
+        if qmgr is not None:
+            # Restore-before-rejoin (ADR-015): a recovering slice
+            # replays the newest snapshot + WAL suffix before routing.
+            qmgr.restore_fn = persist.slice_restorer()
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,
@@ -625,6 +751,8 @@ async def amain(args) -> None:
                             "policy_overrides": limiter.override_count(),
                             **_envelope_health([limiter]),
                             **_debt_slab_health([limiter]),
+                            **({"quarantine": qmgr.status()}
+                               if qmgr is not None else {}),
                             **(persist.status() if persist else {})},
             enable_reset=http_reset,
             reset_token=args.http_reset_token,
@@ -659,6 +787,8 @@ async def amain(args) -> None:
           f"{args.host}:{server.port}"
           + (f" http:{gateway.port}" if gateway else "")
           + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
+    if start_chaos is not None:
+        start_chaos()
     await stop.wait()
     for pu in pushers:
         pu.stop()

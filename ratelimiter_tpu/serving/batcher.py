@@ -45,6 +45,7 @@ import numpy as np
 
 from ratelimiter_tpu.algorithms.base import RateLimiter, check_key, check_n
 from ratelimiter_tpu.core.errors import (
+    DeadlineExceededError,
     InvalidConfigError,
     InvalidNError,
     StorageUnavailableError,
@@ -93,7 +94,7 @@ class MicroBatcher:
         self.dispatch_timeout = dispatch_timeout
         self.inflight = inflight
         self.adaptive_delay = adaptive_delay
-        self._pending: List[Tuple[str, int, asyncio.Future]] = []
+        self._pending: List[Tuple[str, int, asyncio.Future, float]] = []
         #: Queued ALLOW_HASHED frames awaiting the next coalescing window
         #: (scatter-gather scheduling, ADR-013): (ids, ns, future,
         #: trace_id) per frame; flushed alongside the string queue into
@@ -164,6 +165,10 @@ class MicroBatcher:
         self._slo_breaches = reg.counter(
             "rate_limiter_server_slo_breaches_total",
             "Dispatches that exceeded dispatch_timeout")
+        self._deadline_shed = reg.counter(
+            "rate_limiter_server_deadline_shed_total",
+            "Decisions shed because their propagated deadline expired "
+            "before dispatch (answered per fail-open/closed, ADR-015)")
         self._inflight_gauge = reg.gauge(
             "rate_limiter_pipeline_inflight",
             "Launched device dispatches not yet resolved (pipelined "
@@ -193,13 +198,45 @@ class MicroBatcher:
             self._q_trace = trace_id
 
     def _enqueue(self, loop: asyncio.AbstractEventLoop, key: str,
-                 n: int, trace_id: int = 0) -> asyncio.Future:
+                 n: int, trace_id: int = 0,
+                 deadline: float = 0.0) -> asyncio.Future:
         fut: asyncio.Future = loop.create_future()
-        self._pending.append((key, n, fut))
+        self._pending.append((key, n, fut, deadline))
         self._note_window(trace_id)
         if len(self._pending) >= self.max_batch:
             self._flush()
         return fut
+
+    # -------------------------------------------------- deadline shedding
+
+    def _shed_frame(self, fut: asyncio.Future, b: int) -> None:
+        """Answer one whole hashed frame (``b`` decisions) whose
+        propagated deadline expired before dispatch, per the
+        fail-open/closed policy (ADR-015) — nobody is waiting for the
+        real answer, so the dispatch slot is not burned."""
+        self._deadline_shed.inc(b)
+        cfg = self.limiter.config
+        if fut.done():
+            return
+        if cfg.fail_open:
+            reset_at = self.limiter.clock.now() + float(cfg.window)
+            fut.set_result(batch_fail_open(b, cfg.limit, reset_at))
+        else:
+            fut.set_exception(DeadlineExceededError(
+                "request deadline expired before dispatch"))
+
+    def _shed_scalar(self, fut: asyncio.Future) -> None:
+        """Scalar (string-lane) flavor of deadline shedding."""
+        self._deadline_shed.inc()
+        cfg = self.limiter.config
+        if fut.done():
+            return
+        if cfg.fail_open:
+            fut.set_result(fail_open_result(
+                cfg.limit, self.limiter.clock.now() + float(cfg.window)))
+        else:
+            fut.set_exception(DeadlineExceededError(
+                "request deadline expired before dispatch"))
 
     def _arm_timer(self, loop: asyncio.AbstractEventLoop) -> None:
         # Queue depth counts BOTH lanes in max_batch units: pending
@@ -234,8 +271,8 @@ class MicroBatcher:
             self._timer = loop.call_later(max(0.0, target - loop.time()),
                                           self._flush)
 
-    def submit_nowait(self, key: str, n: int = 1,
-                      trace_id: int = 0) -> asyncio.Future:
+    def submit_nowait(self, key: str, n: int = 1, trace_id: int = 0,
+                      deadline: float = 0.0) -> asyncio.Future:
         """Queue one decision and return its future WITHOUT awaiting —
         the zero-task fast path the server's reader loop uses (a done
         callback writes the response; no coroutine per request).
@@ -243,19 +280,26 @@ class MicroBatcher:
         fail fast and never poison a batch (reference pre-Redis guards,
         ``tokenbucket.go:91-93``). Must run on the event loop thread.
         ``trace_id`` (ADR-014) samples the window this decision joins
-        into the flight recorder."""
+        into the flight recorder. ``deadline`` (ADR-015, absolute
+        ``time.monotonic`` seconds; 0 = none): work whose deadline has
+        expired is SHED — answered per policy at enqueue or dispatch
+        time instead of burning a dispatch slot."""
         if self._draining:
             raise StorageUnavailableError("server is shutting down")
         check_key(key)
         check_n(n)
         loop = asyncio.get_running_loop()
         self._loop = loop
-        fut = self._enqueue(loop, key, n, trace_id)
+        if deadline and time.monotonic() >= deadline:
+            fut: asyncio.Future = loop.create_future()
+            self._shed_scalar(fut)
+            return fut
+        fut = self._enqueue(loop, key, n, trace_id, deadline)
         self._arm_timer(loop)
         return fut
 
-    def submit_many_nowait(self, pairs,
-                           trace_id: int = 0) -> List[asyncio.Future]:
+    def submit_many_nowait(self, pairs, trace_id: int = 0,
+                           deadline: float = 0.0) -> List[asyncio.Future]:
         """Queue a whole frame of (key, n) decisions atomically: every
         pair is validated BEFORE any is queued, so a bad pair mid-frame
         cannot leave earlier pairs consuming quota with nobody reading
@@ -268,19 +312,26 @@ class MicroBatcher:
             check_n(n)
         loop = asyncio.get_running_loop()
         self._loop = loop
-        futs = [self._enqueue(loop, key, n, trace_id) for key, n in pairs]
+        if deadline and time.monotonic() >= deadline:
+            futs = [loop.create_future() for _ in pairs]
+            for f in futs:
+                self._shed_scalar(f)
+            return futs
+        futs = [self._enqueue(loop, key, n, trace_id, deadline)
+                for key, n in pairs]
         self._arm_timer(loop)
         return futs
 
     async def submit(self, key: str, n: int = 1, *,
-                     trace_id: int = 0) -> Result:
+                     trace_id: int = 0, deadline: float = 0.0) -> Result:
         """Queue one decision; resolves when its batch's dispatch lands."""
-        return await self.submit_nowait(key, n, trace_id)
+        return await self.submit_nowait(key, n, trace_id, deadline)
 
     # ------------------------------------------------- hashed bulk lane
 
     def submit_hashed_nowait(self, ids: np.ndarray, ns: np.ndarray,
-                             trace_id: int = 0) -> asyncio.Future:
+                             trace_id: int = 0,
+                             deadline: float = 0.0) -> asyncio.Future:
         """Queue one whole ALLOW_HASHED frame into the current coalescing
         window (the zero-copy bulk lane, ADR-011 + the scatter-gather
         scheduler, ADR-013): every hashed frame queued within
@@ -305,6 +356,10 @@ class MicroBatcher:
         loop = asyncio.get_running_loop()
         self._loop = loop
         fut: asyncio.Future = loop.create_future()
+        if deadline and ids.shape[0] and time.monotonic() >= deadline:
+            # Already expired at parse: answer per policy NOW (ADR-015).
+            self._shed_frame(fut, int(ids.shape[0]))
+            return fut
         if not ids.shape[0]:
             # count == 0 frames are valid (empty RESULT_HASHED), no
             # dispatch needed.
@@ -357,7 +412,7 @@ class MicroBatcher:
             # window first; the oversized frame then dispatches alone
             # (arrival order across dispatches is preserved).
             self._flush()
-        self._pending_hashed.append((ids, ns, fut, trace_id))
+        self._pending_hashed.append((ids, ns, fut, trace_id, deadline))
         self._pending_hashed_ids += b
         self._note_window(trace_id)
         if self._pending_hashed_ids >= self.max_batch:
@@ -507,8 +562,20 @@ class MicroBatcher:
         frame from its contiguous row range of the window result
         (BatchResult.rows — numpy views + row-offset wire buffers, no
         re-packing)."""
+        # Deadline shedding at the dispatch boundary (ADR-015): frames
+        # whose propagated deadline expired while queued in the
+        # coalescing window are answered per policy and never join the
+        # dispatch.
+        now_mono = time.monotonic()
+        expired = [f for f in frames if f[4] and now_mono >= f[4]]
+        if expired:
+            for fids, _, fut, _, _ in expired:
+                self._shed_frame(fut, int(fids.shape[0]))
+            frames = [f for f in frames if not (f[4] and now_mono >= f[4])]
+            if not frames:
+                return
         if len(frames) == 1:
-            ids, ns, fut, tid = frames[0]
+            ids, ns, fut, tid, _ = frames[0]
             await self._dispatch_hashed(ids, ns, fut, tid)
             return
         rec = tracing.RECORDER
@@ -527,13 +594,13 @@ class MicroBatcher:
         await self._dispatch_hashed(ids, ns, win, tid)
         exc = win.exception()
         if exc is not None:
-            for _, _, fut, _ in frames:
+            for _, _, fut, _, _ in frames:
                 if not fut.done():
                     fut.set_exception(exc)
             return
         out = win.result()
         off = 0
-        for fids, _, fut, _ in frames:
+        for fids, _, fut, _, _ in frames:
             k = int(fids.shape[0])
             if not fut.done():
                 fut.set_result(out.rows(off, k))
@@ -629,8 +696,19 @@ class MicroBatcher:
             self._resolve_hist.observe(time.perf_counter() - t0)
 
     async def _dispatch(self, batch, trace_id: int = 0) -> None:
-        keys = [k for k, _, _ in batch]
-        ns = [n for _, n, _ in batch]
+        # Deadline shedding at the dispatch boundary (ADR-015): entries
+        # whose propagated deadline expired while coalescing are
+        # answered per policy here and excluded from the device batch.
+        now_mono = time.monotonic()
+        expired = [e for e in batch if e[3] and now_mono >= e[3]]
+        if expired:
+            for _, _, fut, _ in expired:
+                self._shed_scalar(fut)
+            batch = [e for e in batch if not (e[3] and now_mono >= e[3])]
+            if not batch:
+                return
+        keys = [k for k, _, _, _ in batch]
+        ns = [n for _, n, _, _ in batch]
         self._dispatch_batch.observe(float(len(batch)))
         loop = asyncio.get_running_loop()
         t_q = tracing.now() if tracing.RECORDER is not None else 0
@@ -643,7 +721,7 @@ class MicroBatcher:
                 ticket = await loop.run_in_executor(
                     self._pool, self._launch_work, keys, ns, trace_id, t_q)
             except Exception as exc:
-                for _, _, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
@@ -665,7 +743,7 @@ class MicroBatcher:
             # Fail-open dispatch failures never get here (the limiter maps
             # them to a fail-open BatchResult); this is fail-closed or a
             # validation race — every waiter gets the error.
-            for _, _, fut in batch:
+            for _, _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(exc)
             return
@@ -680,14 +758,14 @@ class MicroBatcher:
             cfg = self.limiter.config
             if cfg.fail_open:
                 reset_at = self.limiter.clock.now() + float(cfg.window)
-                for _, _, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_result(fail_open_result(cfg.limit, reset_at))
                 self.decisions_total += len(batch)
             else:
                 err = StorageUnavailableError(
                     f"dispatch exceeded SLO ({self.dispatch_timeout * 1e3:.1f} ms)")
-                for _, _, fut in batch:
+                for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(err)
             # Keep the eventual result from leaking an un-awaited error.
@@ -695,7 +773,7 @@ class MicroBatcher:
             return
 
         self.decisions_total += len(batch)
-        for i, (_, _, fut) in enumerate(batch):
+        for i, (_, _, fut, _) in enumerate(batch):
             if not fut.done():
                 fut.set_result(out.result(i))
 

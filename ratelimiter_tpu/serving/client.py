@@ -13,99 +13,277 @@ The reference plans a Go client library (``pkg/client/`` placeholder,
 Both re-raise server-side errors as the same exception types the library
 raises locally (core/errors.py), so "local limiter" and "remote limiter"
 are drop-in interchangeable.
+
+Resilience (ADR-015):
+
+* **Separate connect vs per-call read timeouts.** ``Client``'s connect
+  ``timeout`` used to become the permanent socket timeout; now
+  ``connect_timeout`` bounds connection establishment and
+  ``call_timeout`` bounds each call's reads.
+* **Typed mid-stream timeouts.** A read timing out mid-call raises
+  :class:`~ratelimiter_tpu.core.errors.RequestTimeoutError` naming the
+  pending request, and marks the connection DESYNCHRONIZED — the next
+  call reconnects instead of reading the stale frame as its own result.
+* **Bounded retries with exponential backoff + full jitter.** Connection
+  errors (refused/reset/closed) retry up to ``retries`` times with
+  ``sleep = random() * min(backoff_max, backoff * 2**attempt)`` and an
+  automatic reconnect. Mid-stream timeouts are NEVER auto-retried: the
+  server may have applied the decision, and a blind retry double-spends
+  quota — the typed error hands that call to the caller's policy.
+* **Per-call deadlines.** ``deadline=`` (seconds of budget) on the
+  decision calls bounds the whole call INCLUDING retries, and rides the
+  wire as the protocol's deadline extension so the server sheds the
+  work if the budget expires in its queue (answering per its
+  fail-open/fail-closed policy).
 """
 
 from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import socket
 import threading
+import time
 from typing import Dict, Optional, Sequence
 
+from ratelimiter_tpu.core.errors import (
+    DeadlineExceededError,
+    RequestTimeoutError,
+)
 from ratelimiter_tpu.core.types import Result
 from ratelimiter_tpu.serving import protocol as p
 
 
+def _jitter_delay(attempt: int, backoff: float, backoff_max: float) -> float:
+    """Full-jitter exponential backoff (AWS architecture blog shape):
+    uniform in [0, min(backoff_max, backoff * 2**attempt)] — decorrelates
+    a thundering herd of reconnecting clients."""
+    return random.random() * min(backoff_max, backoff * (2.0 ** attempt))
+
+
+def _stamp(frame: bytes, trace_id: int, budget_s: Optional[float]) -> bytes:
+    """Apply the frame extensions in canonical order: deadline first
+    (innermost), trace id last (outermost on the wire)."""
+    if budget_s is not None:
+        frame = p.with_deadline(frame, max(0.0, budget_s))
+    if trace_id:
+        frame = p.with_trace(frame, trace_id)
+    return frame
+
+
 class Client:
-    """Blocking client, thread-safe (a lock serializes request/response)."""
+    """Blocking client, thread-safe (a lock serializes request/response).
+
+    Args:
+        host/port: server address.
+        timeout: legacy single knob — default for BOTH connect_timeout
+            and call_timeout when they are not given.
+        connect_timeout: bound on connection establishment (connect +
+            reconnects), seconds.
+        call_timeout: bound on each call's socket reads, seconds. A
+            breach raises RequestTimeoutError (typed, names the pending
+            request) and desynchronizes the connection — the next call
+            reconnects.
+        retries: connection-error retries per call (0 disables).
+        backoff/backoff_max: exponential backoff base/cap, seconds;
+            actual sleeps are full-jitter uniform draws.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout: Optional[float] = 10.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                 timeout: Optional[float] = 10.0, *,
+                 connect_timeout: Optional[float] = None,
+                 call_timeout: Optional[float] = None,
+                 retries: int = 2, backoff: float = 0.05,
+                 backoff_max: float = 2.0):
+        self._host, self._port = host, port
+        self._connect_timeout = (connect_timeout if connect_timeout
+                                 is not None else timeout)
+        self._call_timeout = (call_timeout if call_timeout is not None
+                              else timeout)
+        self.retries = int(retries)
+        self._backoff = float(backoff)
+        self._backoff_max = float(backoff_max)
+        self._sock: Optional[socket.socket] = None
         self._buf = b""
+        self._desynced = False
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        self._connect_locked()
 
     # ------------------------------------------------------------ plumbing
 
-    def _recv_exact(self, n: int) -> bytes:
+    def _connect_locked(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Per-call READ timeout — deliberately not the connect timeout
+        # (the pre-PR-8 bug: one knob silently bounded both).
+        self._sock.settimeout(self._call_timeout)
+        self._buf = b""
+        self._desynced = False
+
+    def _reconnect_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self._connect_locked()
+
+    def _recv_exact(self, n: int, deadline_at: Optional[float],
+                    req_id: int, req_type: int) -> bytes:
         while len(self._buf) < n:
-            chunk = self._sock.recv(65536)
+            if deadline_at is not None:
+                rem = deadline_at - time.monotonic()
+                if rem <= 0:
+                    self._desynced = True
+                    raise RequestTimeoutError(
+                        f"deadline expired awaiting response to request "
+                        f"{req_id} (type {req_type}); connection will "
+                        f"reconnect", request_id=req_id,
+                        request_type=req_type)
+                if self._call_timeout is None or rem < self._call_timeout:
+                    self._sock.settimeout(rem)
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
+                # Mid-stream read timeout: the response may still arrive
+                # later — reading on would hand THIS request the NEXT
+                # frame. Mark desynced so the next call reconnects.
+                self._desynced = True
+                raise RequestTimeoutError(
+                    f"timed out awaiting response to request {req_id} "
+                    f"(type {req_type}); connection will reconnect",
+                    request_id=req_id, request_type=req_type) from None
+            finally:
+                if deadline_at is not None:
+                    self._sock.settimeout(self._call_timeout)
             if not chunk:
                 raise ConnectionError("server closed the connection")
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
-    def _roundtrip(self, frame: bytes, req_id: int):
+    def _roundtrip_once(self, frame: bytes, req_id: int, req_type: int,
+                        deadline_at: Optional[float]):
         with self._lock:
+            if self._desynced or self._sock is None:
+                self._reconnect_locked()
             self._sock.sendall(frame)
-            hdr = self._recv_exact(p.HEADER_SIZE)
+            hdr = self._recv_exact(p.HEADER_SIZE, deadline_at, req_id,
+                                   req_type)
             length, type_, rid = p.parse_header(hdr)
-            body = self._recv_exact(length - 9)
-        if rid != req_id:
-            raise p.ProtocolError(f"response id {rid} != request id {req_id}")
+            body = self._recv_exact(length - 9, deadline_at, req_id,
+                                    req_type)
+            if rid != req_id:
+                # A stale frame (e.g. the answer to a request a caller
+                # abandoned on timeout) must never be returned as this
+                # call's result; drop the connection state.
+                self._desynced = True
+                raise p.ProtocolError(
+                    f"response id {rid} != request id {req_id}")
         if type_ == p.T_ERROR:
             code, msg = p.parse_error(body)
             raise p.exception_for(code, msg)
         return type_, body
 
+    def _roundtrip(self, frame: bytes, req_id: int, *,
+                   trace_id: int = 0, deadline: Optional[float] = None):
+        """One request/response with bounded connection-error retries.
+        ``deadline`` (seconds of budget) bounds the WHOLE call including
+        retries and rides the wire so the server can shed expired work;
+        RequestTimeoutError is never auto-retried (the decision may have
+        been applied — retrying double-spends quota)."""
+        req_type = frame[4] if len(frame) > 4 else 0
+        deadline_at = (time.monotonic() + deadline
+                       if deadline is not None else None)
+        attempt = 0
+        while True:
+            budget = (None if deadline_at is None
+                      else deadline_at - time.monotonic())
+            if budget is not None and budget <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before request {req_id} was sent")
+            wire = _stamp(frame, trace_id,
+                          budget if deadline is not None else None)
+            try:
+                return self._roundtrip_once(wire, req_id, req_type,
+                                            deadline_at)
+            except RequestTimeoutError:
+                raise
+            except (ConnectionError, OSError) as exc:
+                attempt += 1
+                if attempt > self.retries:
+                    raise
+                delay = _jitter_delay(attempt - 1, self._backoff,
+                                      self._backoff_max)
+                if (deadline_at is not None
+                        and time.monotonic() + delay >= deadline_at):
+                    raise DeadlineExceededError(
+                        f"deadline expired during retry backoff "
+                        f"(attempt {attempt}): {exc}") from exc
+                time.sleep(delay)
+                with self._lock:
+                    try:
+                        self._reconnect_locked()
+                    except OSError:
+                        pass  # next loop iteration retries the connect
+
+    @property
+    def desynced(self) -> bool:
+        """True when the previous call left an unread response on the
+        wire (mid-stream timeout); the next call reconnects."""
+        return self._desynced
+
     # ------------------------------------------------------------- surface
 
-    def allow(self, key: str, *, trace_id: int = 0) -> Result:
-        return self.allow_n(key, 1, trace_id=trace_id)
+    def allow(self, key: str, *, trace_id: int = 0,
+              deadline: Optional[float] = None) -> Result:
+        return self.allow_n(key, 1, trace_id=trace_id, deadline=deadline)
 
-    def allow_n(self, key: str, n: int, *, trace_id: int = 0) -> Result:
+    def allow_n(self, key: str, n: int, *, trace_id: int = 0,
+                deadline: Optional[float] = None) -> Result:
         """``trace_id`` (nonzero) samples this request into the server's
         flight recorder via the wire trace extension (ADR-014); pair it
         with a client-side ``tracing.record("client", ...)`` span to get
-        the full client → door → device tree in one dump."""
+        the full client → door → device tree in one dump. ``deadline``
+        (seconds) bounds the call including retries and propagates to
+        the server (ADR-015)."""
         req_id = next(self._ids)
-        frame = p.encode_allow_n(req_id, key, n)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = self._roundtrip(frame, req_id)
+        type_, body = self._roundtrip(p.encode_allow_n(req_id, key, n),
+                                      req_id, trace_id=trace_id,
+                                      deadline=deadline)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result(body)
 
     def allow_batch(self, keys: Sequence[str],
                     ns: Optional[Sequence[int]] = None, *,
-                    trace_id: int = 0) -> list:
+                    trace_id: int = 0,
+                    deadline: Optional[float] = None) -> list:
         """One ALLOW_BATCH frame; results in request order."""
         if ns is None:
             ns = [1] * len(keys)
         req_id = next(self._ids)
-        frame = p.encode_allow_batch(req_id, keys, ns)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = self._roundtrip(frame, req_id)
+        type_, body = self._roundtrip(
+            p.encode_allow_batch(req_id, keys, ns), req_id,
+            trace_id=trace_id, deadline=deadline)
         if type_ != p.T_RESULT_BATCH:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
 
-    def allow_hashed(self, ids, ns=None, *, trace_id: int = 0):
+    def allow_hashed(self, ids, ns=None, *, trace_id: int = 0,
+                     deadline: Optional[float] = None):
         """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
         lane, ADR-011): columnar on the wire, hashed on device server-side;
         returns the frame's BatchResult (frombuffer-view columns). The id
         keyspace is disjoint from string keys; sketch-family servers only."""
         req_id = next(self._ids)
-        frame = p.encode_allow_hashed(req_id, ids, ns)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = self._roundtrip(frame, req_id)
+        type_, body = self._roundtrip(
+            p.encode_allow_hashed(req_id, ids, ns), req_id,
+            trace_id=trace_id, deadline=deadline)
         if type_ != p.T_RESULT_HASHED:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_hashed(body)
@@ -119,14 +297,16 @@ class Client:
     def health(self) -> tuple[bool, float, int]:
         """(serving, uptime_seconds, decisions_total)."""
         req_id = next(self._ids)
-        type_, body = self._roundtrip(p.encode_simple(p.T_HEALTH, req_id), req_id)
+        type_, body = self._roundtrip(
+            p.encode_simple(p.T_HEALTH, req_id), req_id)
         if type_ != p.T_HEALTH_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_health(body)
 
     def metrics(self) -> str:
         req_id = next(self._ids)
-        type_, body = self._roundtrip(p.encode_simple(p.T_METRICS, req_id), req_id)
+        type_, body = self._roundtrip(
+            p.encode_simple(p.T_METRICS, req_id), req_id)
         if type_ != p.T_METRICS_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)
@@ -176,7 +356,8 @@ class Client:
 
     def close(self) -> None:
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
 
@@ -189,23 +370,70 @@ class Client:
 
 class AsyncClient:
     """Pipelined asyncio client: unlimited in-flight requests, responses
-    matched by id. One reader task per connection."""
+    matched by id. One reader task per connection. Connection errors
+    auto-reconnect with bounded full-jitter retries (decision calls only
+    resend when the frame never completed its write cycle — after a
+    response-wait is interrupted by connection loss the call is retried
+    like the blocking client's connection-error class, not its
+    mid-stream-timeout class, because a dead connection can never hand
+    back a misaligned frame). Per-call ``deadline`` bounds the wait and
+    rides the wire (ADR-015)."""
 
     def __init__(self):
+        self._host: str = "127.0.0.1"
+        self._port: int = 0
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
         self._waiting: Dict[int, asyncio.Future] = {}
         self._reader_task: Optional[asyncio.Task] = None
+        self.retries = 2
+        self._backoff = 0.05
+        self._backoff_max = 2.0
+        self._conn_lock: Optional[asyncio.Lock] = None
 
     @classmethod
-    async def connect(cls, host: str = "127.0.0.1", port: int = 0) -> "AsyncClient":
+    async def connect(cls, host: str = "127.0.0.1", port: int = 0, *,
+                      retries: int = 2, backoff: float = 0.05,
+                      backoff_max: float = 2.0) -> "AsyncClient":
         self = cls()
-        self._reader, self._writer = await asyncio.open_connection(host, port)
+        self._host, self._port = host, port
+        self.retries = int(retries)
+        self._backoff = float(backoff)
+        self._backoff_max = float(backoff_max)
+        self._conn_lock = asyncio.Lock()
+        await self._open()
+        return self
+
+    async def _open(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self._host, self._port)
         self._writer.get_extra_info("socket").setsockopt(
             socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._reader_task = asyncio.ensure_future(self._read_loop())
-        return self
+
+    async def _ensure_open(self) -> None:
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            # A peer-closed connection may leave the writer LOOKING open
+            # (is_closing() flips only after a failed write); the reader
+            # task exiting is the reliable death signal — without this
+            # check a resent request would wait on a future nobody will
+            # ever complete.
+            dead = (self._writer is None or self._writer.is_closing()
+                    or self._reader_task is None
+                    or self._reader_task.done())
+            if dead:
+                if self._reader_task is not None:
+                    self._reader_task.cancel()
+                    try:
+                        await self._reader_task
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                if self._writer is not None:
+                    self._writer.close()
+                await self._open()
 
     async def _read_loop(self) -> None:
         try:
@@ -217,33 +445,81 @@ class AsyncClient:
                 if fut is not None and not fut.done():
                     fut.set_result((type_, body))
         except (asyncio.IncompleteReadError, ConnectionResetError,
-                asyncio.CancelledError) as exc:
+                asyncio.CancelledError, OSError) as exc:
             for fut in self._waiting.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError(f"connection lost: {exc!r}"))
             self._waiting.clear()
 
-    async def _request(self, frame: bytes, req_id: int):
+    async def _request_once(self, frame: bytes, req_id: int):
         fut = asyncio.get_running_loop().create_future()
         self._waiting[req_id] = fut
-        self._writer.write(frame)
-        await self._writer.drain()
-        type_, body = await fut
+        try:
+            self._writer.write(frame)
+            await self._writer.drain()
+            type_, body = await fut
+        finally:
+            self._waiting.pop(req_id, None)
         if type_ == p.T_ERROR:
             code, msg = p.parse_error(body)
             raise p.exception_for(code, msg)
         return type_, body
 
-    async def allow(self, key: str, *, trace_id: int = 0) -> Result:
-        return await self.allow_n(key, 1, trace_id=trace_id)
+    async def _request(self, frame: bytes, req_id: int, *,
+                       trace_id: int = 0,
+                       deadline: Optional[float] = None):
+        """Request/response with auto-reconnect + bounded full-jitter
+        retries on connection errors; ``deadline`` bounds the whole call
+        and propagates on the wire (a deadline breach while the
+        connection is HEALTHY raises DeadlineExceededError without
+        retrying — the server may still apply the decision)."""
+        loop = asyncio.get_running_loop()
+        deadline_at = (loop.time() + deadline
+                       if deadline is not None else None)
+        attempt = 0
+        while True:
+            budget = (None if deadline_at is None
+                      else deadline_at - loop.time())
+            if budget is not None and budget <= 0:
+                raise DeadlineExceededError(
+                    f"deadline expired before request {req_id} was sent")
+            wire = _stamp(frame, trace_id,
+                          budget if deadline is not None else None)
+            try:
+                await self._ensure_open()
+                if budget is not None:
+                    return await asyncio.wait_for(
+                        self._request_once(wire, req_id), budget)
+                return await self._request_once(wire, req_id)
+            except asyncio.TimeoutError:
+                raise DeadlineExceededError(
+                    f"deadline expired awaiting response to request "
+                    f"{req_id}") from None
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    OSError) as exc:
+                attempt += 1
+                if attempt > self.retries:
+                    raise
+                delay = _jitter_delay(attempt - 1, self._backoff,
+                                      self._backoff_max)
+                if (deadline_at is not None
+                        and loop.time() + delay >= deadline_at):
+                    raise DeadlineExceededError(
+                        f"deadline expired during retry backoff "
+                        f"(attempt {attempt}): {exc}") from exc
+                await asyncio.sleep(delay)
 
-    async def allow_n(self, key: str, n: int, *,
-                      trace_id: int = 0) -> Result:
+    async def allow(self, key: str, *, trace_id: int = 0,
+                    deadline: Optional[float] = None) -> Result:
+        return await self.allow_n(key, 1, trace_id=trace_id,
+                                  deadline=deadline)
+
+    async def allow_n(self, key: str, n: int, *, trace_id: int = 0,
+                      deadline: Optional[float] = None) -> Result:
         req_id = next(self._ids)
-        frame = p.encode_allow_n(req_id, key, n)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = await self._request(frame, req_id)
+        type_, body = await self._request(
+            p.encode_allow_n(req_id, key, n), req_id, trace_id=trace_id,
+            deadline=deadline)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result(body)
@@ -260,30 +536,30 @@ class AsyncClient:
 
     async def allow_batch(self, keys: Sequence[str],
                           ns: Optional[Sequence[int]] = None, *,
-                          trace_id: int = 0) -> list:
+                          trace_id: int = 0,
+                          deadline: Optional[float] = None) -> list:
         """One ALLOW_BATCH frame for the whole sequence (amortized framing;
         decisions still coalesce with other connections server-side).
         Returns results in request order."""
         if ns is None:
             ns = [1] * len(keys)
         req_id = next(self._ids)
-        frame = p.encode_allow_batch(req_id, keys, ns)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = await self._request(frame, req_id)
+        type_, body = await self._request(
+            p.encode_allow_batch(req_id, keys, ns), req_id,
+            trace_id=trace_id, deadline=deadline)
         if type_ != p.T_RESULT_BATCH:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_batch(body)
 
-    async def allow_hashed(self, ids, ns=None, *, trace_id: int = 0):
+    async def allow_hashed(self, ids, ns=None, *, trace_id: int = 0,
+                           deadline: Optional[float] = None):
         """One ALLOW_HASHED frame of raw u64 key ids (the zero-copy bulk
         lane, ADR-011); returns the frame's BatchResult. Pipelines with
         every other in-flight request on this connection."""
         req_id = next(self._ids)
-        frame = p.encode_allow_hashed(req_id, ids, ns)
-        if trace_id:
-            frame = p.with_trace(frame, trace_id)
-        type_, body = await self._request(frame, req_id)
+        type_, body = await self._request(
+            p.encode_allow_hashed(req_id, ids, ns), req_id,
+            trace_id=trace_id, deadline=deadline)
         if type_ != p.T_RESULT_HASHED:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_result_hashed(body)
@@ -296,14 +572,16 @@ class AsyncClient:
 
     async def health(self) -> tuple[bool, float, int]:
         req_id = next(self._ids)
-        type_, body = await self._request(p.encode_simple(p.T_HEALTH, req_id), req_id)
+        type_, body = await self._request(
+            p.encode_simple(p.T_HEALTH, req_id), req_id)
         if type_ != p.T_HEALTH_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_health(body)
 
     async def metrics(self) -> str:
         req_id = next(self._ids)
-        type_, body = await self._request(p.encode_simple(p.T_METRICS, req_id), req_id)
+        type_, body = await self._request(
+            p.encode_simple(p.T_METRICS, req_id), req_id)
         if type_ != p.T_METRICS_R:
             raise p.ProtocolError(f"unexpected response type {type_}")
         return p.parse_metrics(body)

@@ -109,6 +109,18 @@ class _PeerConn:
     def push(self, frame: bytes, req_id: int) -> None:
         """Send one frame, wait for T_OK; raises on error/disconnect
         (the caller decides whether the payload can be dropped)."""
+        from ratelimiter_tpu import chaos
+
+        if chaos.INJECTOR is not None:
+            # Chaos seam (ADR-015): the DCN-partition scenario drops the
+            # frame here (raising so the pusher's per-peer retry/loss
+            # accounting sees a real delivery failure); corruption
+            # mutates the frame so the receiver's HMAC/CRC paths fire.
+            mutated = chaos.INJECTOR.dcn_frame(frame)
+            if mutated is None:
+                raise ConnectionError("chaos: DCN frame dropped "
+                                      "(injected partition)")
+            frame = mutated
         try:
             sk = self._connect()
             sk.sendall(frame)

@@ -34,6 +34,7 @@ from typing import Callable, Optional
 
 from ratelimiter_tpu.core.errors import (
     ClosedError,
+    DeadlineExceededError,
     InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
@@ -142,9 +143,13 @@ class GrpcRateLimitServer:
         # Trace context (ADR-014): callers propagate W3C traceparent as
         # gRPC metadata; trace-aware decide callables (the in-repo
         # doors) receive the id, plain lambdas keep working.
-        from ratelimiter_tpu.serving.http_gateway import _accepts_trace
+        from ratelimiter_tpu.serving.http_gateway import (
+            _accepts_kw,
+            _accepts_trace,
+        )
 
         self._decide_trace = _accepts_trace(decide)
+        self._decide_deadline = _accepts_kw(decide, "deadline")
         self._trace_ctx = threading.local()
         self._default_limit = default_limit or (lambda: 0)
         self._decisions_total = decisions_total or (lambda: 0)
@@ -168,6 +173,14 @@ class GrpcRateLimitServer:
                         tid = 0
                 t0 = tracing.now() if rec is not None else 0
                 self._trace_ctx.tid = tid
+                # gRPC deadlines propagate natively: time_remaining()
+                # is the caller's residual budget (None = no deadline).
+                # Deadline-aware decide callables shed expired work per
+                # the server's fail-open/closed policy (ADR-015).
+                try:
+                    self._trace_ctx.budget = context.time_remaining()
+                except Exception:  # noqa: BLE001 — optional surface
+                    self._trace_ctx.budget = None
                 try:
                     out = fn(request)
                     if rec is not None:
@@ -176,6 +189,9 @@ class GrpcRateLimitServer:
                 except (InvalidKeyError, InvalidNError,
                         InvalidConfigError) as exc:
                     context.abort(grpc_mod.StatusCode.INVALID_ARGUMENT,
+                                  str(exc))
+                except DeadlineExceededError as exc:
+                    context.abort(grpc_mod.StatusCode.DEADLINE_EXCEEDED,
                                   str(exc))
                 except StorageUnavailableError as exc:
                     context.abort(grpc_mod.StatusCode.UNAVAILABLE, str(exc))
@@ -191,9 +207,13 @@ class GrpcRateLimitServer:
 
         def call_decide(key, n):
             tid = getattr(self._trace_ctx, "tid", 0)
+            budget = getattr(self._trace_ctx, "budget", None)
+            kwargs = {}
             if tid and self._decide_trace:
-                return self.decide(key, n, trace_id=tid)
-            return self.decide(key, n)
+                kwargs["trace_id"] = tid
+            if budget is not None and self._decide_deadline:
+                kwargs["deadline"] = budget
+            return self.decide(key, n, **kwargs)
 
         def allow(req):
             return _to_pb(pb2, call_decide(req.key, 1))

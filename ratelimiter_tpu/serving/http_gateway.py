@@ -62,6 +62,7 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from ratelimiter_tpu.core.errors import (
+    DeadlineExceededError,
     InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
@@ -300,12 +301,26 @@ class HttpGateway:
                         # doors are; plain lambdas keep working).
                         tid = tracing.parse_traceparent(
                             self.headers.get("traceparent"))
+                        # Request deadline (ADR-015): callers propagate a
+                        # RELATIVE millisecond budget; deadline-aware
+                        # decide callables (the in-repo doors) shed
+                        # expired work per policy, and a client-side
+                        # expired budget answers 504 below.
+                        budget = None
+                        dl_hdr = self.headers.get("X-RateLimit-Deadline-Ms")
+                        if dl_hdr is not None:
+                            try:
+                                budget = float(dl_hdr) / 1000.0
+                            except ValueError:
+                                budget = None
+                        kwargs = {}
+                        if tid and gateway._decide_trace:
+                            kwargs["trace_id"] = tid
+                        if budget is not None and gateway._decide_deadline:
+                            kwargs["deadline"] = budget
                         rec = tracing.RECORDER
                         t0 = tracing.now() if rec is not None else 0
-                        if tid and gateway._decide_trace:
-                            res = gateway.decide(key, n, trace_id=tid)
-                        else:
-                            res = gateway.decide(key, n)
+                        res = gateway.decide(key, n, **kwargs)
                         if rec is not None:
                             rec.record("http", t0, tracing.now(),
                                        trace_id=tid)
@@ -398,6 +413,10 @@ class HttpGateway:
                 except (InvalidKeyError, InvalidNError, InvalidConfigError,
                         ValueError) as exc:
                     self._send(400, {"error": str(exc)})
+                except DeadlineExceededError as exc:
+                    # The propagated deadline expired before dispatch
+                    # (fail-closed side of deadline shedding, ADR-015).
+                    self._send(504, {"error": str(exc)})
                 except StorageUnavailableError as exc:
                     # Reference example: backend down -> 503
                     # (docs/EXAMPLES.md:38-41).
@@ -430,6 +449,7 @@ class HttpGateway:
         self.debug_token = debug_token
         self._profile_lock = threading.Lock()
         self._decide_trace = _accepts_trace(decide)
+        self._decide_deadline = _accepts_kw(decide, "deadline")
         self.metrics_render = metrics_render if metrics_render else lambda: ""
         # OpenMetrics negotiation needs a renderer that takes the
         # openmetrics kwarg (Registry.render does; plain lambdas don't).

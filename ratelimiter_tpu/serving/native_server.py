@@ -40,7 +40,7 @@ from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 9
+_ABI = 10
 
 
 def _load_extension():
@@ -484,7 +484,8 @@ class NativeRateLimitServer:
 
         return int(splitmix64(np.asarray([raw_id], np.uint64))[0] % n_shards)
 
-    def decide_one(self, key: str, n: int = 1, *, trace_id: int = 0):
+    def decide_one(self, key: str, n: int = 1, *, trace_id: int = 0,
+                   deadline=None):
         """Single-key decision routed to the key's dispatch shard — the
         HTTP/gRPC gateways' decide callable when this server fronts
         traffic. Observability covers every shard when the server was
@@ -498,7 +499,23 @@ class NativeRateLimitServer:
 
         ``trace_id`` (ADR-014): a sampled gateway request (HTTP
         ``traceparent`` / gRPC metadata) records its synchronous device
-        dispatch into the flight recorder under the owning shard."""
+        dispatch into the flight recorder under the owning shard.
+
+        ``deadline`` (ADR-015, RELATIVE seconds of budget): an already-
+        expired budget is shed — answered per the limiter's
+        fail-open/fail-closed policy without a dispatch (this path is
+        synchronous, so arrival is the only shed point)."""
+        if deadline is not None and float(deadline) <= 0.0:
+            from ratelimiter_tpu.core.errors import DeadlineExceededError
+            from ratelimiter_tpu.core.types import fail_open_result
+
+            cfg = self.limiter.config
+            if cfg.fail_open:
+                return fail_open_result(
+                    cfg.limit,
+                    self.limiter.clock.now() + float(cfg.window))
+            raise DeadlineExceededError(
+                "request deadline expired before dispatch")
         shard = self.shard_of(key)
         rec = tracing.RECORDER
         t0 = tracing.now() if rec is not None else 0
@@ -534,6 +551,13 @@ class NativeRateLimitServer:
         return results
 
     # ------------------------------------------------- dynamic config
+
+    def set_shard_health(self, shard: int, quarantined: bool) -> None:
+        """Mirror one shard's quarantine state into the C++ door (ABI
+        10, ADR-015) — ``stats()["shard_quarantined"]`` then reports the
+        degraded topology. Wire the quarantine manager's
+        ``on_state_change`` to this."""
+        self._server.set_shard_health(int(shard), bool(quarantined))
 
     def refresh_fail_open_params(self) -> None:
         """Push the live default limit/window into the C++ door's atomic

@@ -88,6 +88,7 @@ from typing import Tuple
 
 from ratelimiter_tpu.core.errors import (
     ClosedError,
+    DeadlineExceededError,
     InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
@@ -144,10 +145,42 @@ T_ERROR = 255
 TRACE_FLAG = 0x40
 _TRACE_ID = struct.Struct("<Q")
 
+# ------------------------------------------- deadline context (ADR-015)
+#
+# Request deadline propagation, the same frame-extension mechanism as
+# the trace id: bit 5 (0x20) on a REQUEST type byte means the body is
+# prefixed with an f64 RELATIVE deadline budget in seconds (relative,
+# not absolute — client and server wall clocks need not agree; the
+# receiver anchors the budget to frame arrival). Servers SHED work
+# whose budget has expired before its dispatch runs, answering per the
+# fail-open/fail-closed policy instead of burning a dispatch slot
+# (core/errors.DeadlineExceededError on the fail-closed side). When
+# both extensions are present the trace id comes FIRST on the wire:
+# apply ``with_deadline`` before ``with_trace``. For T_DCN_PUSH the
+# prefix rides OUTSIDE the HMAC envelope, exactly like the trace id.
+DEADLINE_FLAG = 0x20
+_DEADLINE = struct.Struct("<d")
+_REQ_FLAGS = TRACE_FLAG | DEADLINE_FLAG
+
+
+def with_deadline(frame: bytes, budget_s: float) -> bytes:
+    """Re-frame a request with the deadline extension (flag bit on the
+    type byte + f64 relative budget prefixed to the body). Must be
+    applied BEFORE ``with_trace`` — the trace id is the outermost
+    prefix on the wire."""
+    length, type_, req_id = _HDR.unpack_from(frame)
+    if type_ & _REQ_FLAGS or type_ >= 128:
+        raise ProtocolError(f"type {type_} cannot carry a deadline")
+    body = _DEADLINE.pack(float(budget_s)) + frame[HEADER_SIZE:]
+    return _HDR.pack(1 + 8 + len(body), type_ | DEADLINE_FLAG,
+                     req_id) + body
+
 
 def with_trace(frame: bytes, trace_id: int) -> bytes:
     """Re-frame a request with the trace-id extension (flag bit on the
-    type byte + u64 id prefixed to the body)."""
+    type byte + u64 id prefixed to the body). Composes with the
+    deadline extension (apply ``with_deadline`` first; the trace id
+    ends up outermost)."""
     length, type_, req_id = _HDR.unpack_from(frame)
     if type_ & TRACE_FLAG or type_ >= 128:
         raise ProtocolError(f"type {type_} cannot carry a trace id")
@@ -159,13 +192,31 @@ def with_trace(frame: bytes, trace_id: int) -> bytes:
 def split_trace(type_: int, body: bytes):
     """(base_type, trace_id, body) from a possibly-flagged request frame
     — servers call this once per frame; unflagged frames pass through
-    with trace_id 0 and zero copies."""
+    with trace_id 0 and zero copies. The deadline flag (if any) stays
+    on the returned type for ``split_request`` callers."""
     if not (type_ & TRACE_FLAG) or type_ >= 128:
         return type_, 0, body
     if len(body) < _TRACE_ID.size:
         raise ProtocolError("short trace-id extension")
     (trace_id,) = _TRACE_ID.unpack_from(body)
     return type_ & ~TRACE_FLAG, trace_id, body[_TRACE_ID.size:]
+
+
+def split_request(type_: int, body: bytes):
+    """(base_type, trace_id, deadline_budget_s, body) — strips BOTH
+    frame extensions in canonical order (trace id, then deadline).
+    Unflagged frames pass through with (0, None) and zero copies.
+    ``deadline_budget_s`` is the sender's RELATIVE budget (None = no
+    deadline; <= 0 = already expired on arrival); anchor it to frame
+    arrival on the receiving side."""
+    type_, trace_id, body = split_trace(type_, body)
+    if not (type_ & DEADLINE_FLAG) or type_ >= 128:
+        return type_, trace_id, None, body
+    if len(body) < _DEADLINE.size:
+        raise ProtocolError("short deadline extension")
+    (budget,) = _DEADLINE.unpack_from(body)
+    return (type_ & ~DEADLINE_FLAG, trace_id, budget,
+            body[_DEADLINE.size:])
 
 
 # Error codes <-> exceptions (reference errors.go:5-20 analogs)
@@ -176,6 +227,9 @@ E_CLOSED = 4
 E_INVALID_CONFIG = 5
 E_SHUTTING_DOWN = 6
 E_INTERNAL = 7
+#: The request's propagated deadline expired before its dispatch ran
+#: (fail-closed side of deadline shedding, ADR-015).
+E_DEADLINE = 8
 
 _CODE_TO_EXC = {
     E_INVALID_N: InvalidNError,
@@ -185,10 +239,13 @@ _CODE_TO_EXC = {
     E_INVALID_CONFIG: InvalidConfigError,
     E_SHUTTING_DOWN: StorageUnavailableError,
     E_INTERNAL: RateLimiterError,
+    E_DEADLINE: DeadlineExceededError,
 }
 
 
 def code_for(exc: Exception) -> int:
+    if isinstance(exc, DeadlineExceededError):
+        return E_DEADLINE
     if isinstance(exc, InvalidNError):
         return E_INVALID_N
     if isinstance(exc, (InvalidKeyError, UnicodeDecodeError)):
@@ -553,9 +610,10 @@ def parse_header(buf: bytes, *, allow_dcn: bool = False) -> Tuple[int, int, int]
     any client could force MAX_DCN_FRAME-sized buffering per connection
     just by labeling frames (memory DoS on plain deployments)."""
     length, type_, req_id = _HDR.unpack_from(buf)
-    # The size cap keys on the BASE type: a traced DCN push (TRACE_FLAG,
-    # ADR-014) still deserves the slab-sized cap on a DCN-enabled server.
-    base = type_ & ~TRACE_FLAG if type_ < 128 else type_
+    # The size cap keys on the BASE type: a traced and/or deadline-
+    # stamped DCN push (TRACE_FLAG/DEADLINE_FLAG) still deserves the
+    # slab-sized cap on a DCN-enabled server.
+    base = type_ & ~_REQ_FLAGS if type_ < 128 else type_
     cap = MAX_DCN_FRAME if (allow_dcn and base == T_DCN_PUSH) else MAX_FRAME
     if length < 9 or length > cap:
         raise ProtocolError(f"bad frame length {length}")

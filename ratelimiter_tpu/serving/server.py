@@ -184,13 +184,20 @@ class RateLimitServer:
                     length, type_, req_id = p.parse_header(
                         hdr, allow_dcn=self.dcn)
                     body = await reader.readexactly(length - 9)
-                    # Trace-context extension (ADR-014): flagged request
-                    # frames prefix a u64 trace id; unflagged frames pass
-                    # through untouched (trace_id 0 = unsampled).
-                    type_, trace_id, body = p.split_trace(type_, body)
+                    # Frame extensions: trace context (ADR-014) and the
+                    # request deadline (ADR-015). The deadline budget is
+                    # RELATIVE; anchor it to arrival on the local
+                    # monotonic clock — decision stages downstream shed
+                    # work whose deadline has already passed.
+                    type_, trace_id, budget, body = p.split_request(
+                        type_, body)
                 except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
                     log.warning("protocol error, dropping connection: %s", exc)
                     break
+                # None = no deadline; a <= 0 budget anchors in the past
+                # (expired on arrival — shed at the first check).
+                deadline = (time.monotonic() + budget
+                            if budget is not None else 0.0)
                 rec = tracing.RECORDER
                 t_io = tracing.now() if rec is not None else 0
                 if type_ == p.T_ALLOW_N:
@@ -198,7 +205,8 @@ class RateLimitServer:
                     # write the response from the future's done callback.
                     try:
                         key, n = p.parse_allow_n(body)
-                        fut = self.batcher.submit_nowait(key, n, trace_id)
+                        fut = self.batcher.submit_nowait(key, n, trace_id,
+                                                         deadline)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
@@ -216,8 +224,8 @@ class RateLimitServer:
                     # per-request Python objects between socket and step.
                     try:
                         ids, ns = p.parse_allow_hashed(body)
-                        fut = self.batcher.submit_hashed_nowait(ids, ns,
-                                                                trace_id)
+                        fut = self.batcher.submit_hashed_nowait(
+                            ids, ns, trace_id, deadline)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))
@@ -233,7 +241,7 @@ class RateLimitServer:
                     try:
                         keys, ns = p.parse_allow_batch(body)
                         futs = self.batcher.submit_many_nowait(
-                            zip(keys, ns), trace_id)
+                            zip(keys, ns), trace_id, deadline)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))

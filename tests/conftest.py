@@ -24,3 +24,9 @@ jax.config.update("jax_platforms", "cpu")
 # longer flip the global at import time (ops.ensure_x64 gates instead) —
 # the test env opts in here, once, before any backend initializes.
 jax.config.update("jax_enable_x64", True)
+# NOTE: deliberately NO persistent compile cache here (bench.py and the
+# serving binary do enable one). Measured on this image, concurrent
+# compilation from the stress suite's thread storms intermittently
+# deadlocks inside the cache's write path (~1 in 3 full runs wedge in
+# test_stress_concurrency with every thread parked on the limiter
+# lock); cold compiles are slower but deterministic.

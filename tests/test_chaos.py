@@ -1,0 +1,818 @@
+"""Tier-1 chaos suite (ADR-015): failure domains proven by fault
+injection.
+
+Acceptance contract (ISSUE 8): with one slice killed mid-traffic at
+n=8, healthy slices' decisions are BIT-IDENTICAL to a no-fault oracle;
+the dead slice's range answers per the configured fail-open/fail-closed
+policy within one deadline budget; after probe recovery + snapshot
+restore the slice serves exact overrides and counters within one
+snapshot interval; and with the injection seam disabled the hot path is
+byte-identical. Every scenario is seeded-deterministic so failures
+replay.
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ratelimiter_tpu import Algorithm, Config, MeshSpec, SketchParams, chaos
+from ratelimiter_tpu.chaos.injector import ChaosInjector, SliceFault
+from ratelimiter_tpu.core.errors import (
+    DeadlineExceededError,
+    InvalidKeyError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.parallel.limiter import SlicedMeshLimiter, build_slices
+from ratelimiter_tpu.parallel.quarantine import (
+    QuarantineManager,
+    SliceGuard,
+    classify_failure,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+
+N = 8
+T0 = 1_700_000_000.0
+
+
+def _cfg(devices: int = N, **kw):
+    # Acceptance scenarios (kill-mid-traffic oracle + both doors) run at
+    # the full n=8; unit-scoped scenarios run at n=4 — the mechanics are
+    # identical and each composite costs 8 limiter builds worth of
+    # compile otherwise (tier-1 wall-clock budget).
+    base = dict(
+        algorithm=Algorithm.SLIDING_WINDOW,
+        limit=10,
+        window=60.0,
+        fail_open=True,
+        sketch=SketchParams(depth=2, width=1 << 10, sub_windows=6),
+        mesh=MeshSpec(devices=devices, quarantine=True, slice_deadline=5.0,
+                      probe_interval=0.05),
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.uninstall()
+
+
+def _warm(lim, ids):
+    lim.allow_ids(ids, now=T0 - 1.0)
+
+
+# ------------------------------------------------------------ classifier
+
+
+class TestClassifier:
+    def test_backend_faults_quarantine(self):
+        assert classify_failure(StorageUnavailableError("x"))
+        assert classify_failure(SliceFault("x"))
+        assert classify_failure(DeadlineExceededError("x"))
+        assert classify_failure(TimeoutError())
+        assert classify_failure(OSError())
+        assert classify_failure(RuntimeError("xla fell over"))
+
+    def test_caller_errors_do_not(self):
+        from ratelimiter_tpu.core.errors import (
+            CheckpointError,
+            ClosedError,
+            InvalidConfigError,
+            InvalidNError,
+        )
+
+        for exc in (InvalidKeyError("k"), InvalidNError("n"),
+                    InvalidConfigError("c"), ClosedError("z"),
+                    CheckpointError("cp"), NotImplementedError(),
+                    TypeError()):
+            assert not classify_failure(exc), exc
+
+
+# ------------------------------------------------- kill-a-slice (direct)
+
+
+class TestKillSlice:
+    def test_healthy_ranges_bit_identical_to_no_fault_oracle(self):
+        """The acceptance oracle: same id traffic through a faulted
+        quarantine-mesh and a fault-free QUARANTINE-OFF mesh — rows
+        owned by healthy slices must match bit for bit (incl. frames
+        decided mid-fault), and the guard layer itself must be
+        decision-transparent."""
+        cfg = _cfg()
+        lim = SlicedMeshLimiter(cfg)
+        oracle = SlicedMeshLimiter(_cfg(mesh=MeshSpec(devices=N)))
+        victim = 2
+        rng = np.random.default_rng(7)
+        frames = [rng.integers(1, 1 << 40, size=256, dtype=np.uint64)
+                  for _ in range(6)]
+        _warm(lim, frames[0])
+        _warm(oracle, frames[0])
+        inj = chaos.install(seed=1)
+        try:
+            got, want = [], []
+            for i, ids in enumerate(frames):
+                if i == 3:  # mid-traffic kill
+                    inj.fail_slice(victim)
+                now = T0 + i * 0.25
+                got.append(lim.allow_ids(ids, now=now))
+                want.append(oracle.allow_ids(ids, now=now))
+            owners = lim.owner_of_id(np.concatenate(frames))
+            got_allowed = np.concatenate([g.allowed for g in got])
+            want_allowed = np.concatenate([w.allowed for w in want])
+            got_rem = np.concatenate([g.remaining for g in got])
+            want_rem = np.concatenate([w.remaining for w in want])
+            healthy = owners != victim
+            np.testing.assert_array_equal(got_allowed[healthy],
+                                          want_allowed[healthy])
+            np.testing.assert_array_equal(got_rem[healthy],
+                                          want_rem[healthy])
+            # Post-kill victim rows: fail-open allowances, flagged.
+            post = np.concatenate(
+                [np.full(256, i >= 3) for i in range(6)])
+            vict_rows = got_allowed[(owners == victim) & post]
+            assert vict_rows.size and vict_rows.all()
+            assert any(g.fail_open for g in got[3:])
+            assert not any(g.fail_open for g in got[:3])
+            assert lim.quarantine.state(victim) != "healthy"
+        finally:
+            lim.close()
+            oracle.close()
+
+    def test_fail_closed_range_errors_with_slice_attribution(self):
+        cfg = _cfg(devices=4, fail_open=False)
+        lim = SlicedMeshLimiter(cfg)
+        ids = np.arange(1, 257, dtype=np.uint64)
+        _warm(lim, ids)
+        inj = chaos.install(seed=2)
+        inj.fail_slice(1)
+        try:
+            with pytest.raises(StorageUnavailableError) as ei:
+                lim.allow_ids(ids, now=T0)
+            assert getattr(ei.value, "slice_index", None) == 1
+        finally:
+            lim.close()
+
+    def test_caller_errors_pass_through_without_quarantine(self):
+        lim = SlicedMeshLimiter(_cfg(devices=4))
+        try:
+            with pytest.raises(InvalidKeyError):
+                lim.allow_n("", 1)
+            assert lim.quarantine.quarantined() == []
+        finally:
+            lim.close()
+
+    def test_scalar_path_degrades_too(self):
+        lim = SlicedMeshLimiter(_cfg(devices=4))
+        # Find a key owned by slice 2, then kill slice 2.
+        key = next(f"k{i}" for i in range(200)
+                   if lim.owner_of_key(f"k{i}") == 2)
+        lim.allow_n(key, 1, now=T0)
+        inj = chaos.install(seed=3)
+        inj.fail_slice(2)
+        try:
+            res = lim.allow_n(key, 1, now=T0 + 0.1)
+            assert res.allowed and res.fail_open
+            assert res.limit == lim.config.limit
+        finally:
+            lim.close()
+
+
+# ------------------------------------------- slow/wedged slice deadlines
+
+
+class TestSliceDeadline:
+    def test_wedged_slice_answers_within_one_deadline_budget(self):
+        deadline = 0.3
+        cfg = _cfg(mesh=MeshSpec(devices=4, quarantine=True,
+                                 slice_deadline=deadline,
+                                 probe_interval=30.0))
+        lim = SlicedMeshLimiter(cfg)
+        ids = np.arange(1, 513, dtype=np.uint64)
+        _warm(lim, ids)
+        victim = int(lim.owner_of_id(ids[:1])[0])
+        inj = chaos.install(seed=4)
+        inj.wedge_slice(victim)
+        try:
+            t0 = time.perf_counter()
+            out = lim.allow_ids(ids, now=T0)
+            elapsed = time.perf_counter() - t0
+            # One deadline budget + bookkeeping slack — never the
+            # multi-second hang the pre-ADR-015 barrier would take.
+            assert elapsed < deadline * 2 + 1.0, elapsed
+            assert out.fail_open
+            assert lim.quarantine.state(victim) != "healthy"
+            # Subsequent frames skip the wedged slice entirely (fast).
+            t1 = time.perf_counter()
+            out2 = lim.allow_ids(ids, now=T0 + 0.1)
+            assert time.perf_counter() - t1 < deadline
+            assert out2.fail_open
+        finally:
+            inj.clear_slice(victim)
+            lim.close()
+
+    def test_slow_slice_quarantines_then_recovers(self):
+        deadline = 0.15
+        cfg = _cfg(mesh=MeshSpec(devices=4, quarantine=True,
+                                 slice_deadline=deadline,
+                                 probe_interval=0.05))
+        lim = SlicedMeshLimiter(cfg)
+        ids = np.arange(1, 257, dtype=np.uint64)
+        _warm(lim, ids)
+        victim = int(lim.owner_of_id(ids[:1])[0])
+        inj = chaos.install(seed=5)
+        inj.delay_slice(victim, 4 * deadline)
+        try:
+            out = lim.allow_ids(ids, now=T0)
+            assert out.fail_open
+            assert lim.quarantine.state(victim) != "healthy"
+            inj.clear_slice(victim)
+            deadline_at = time.time() + 30.0
+            while (lim.quarantine.state(victim) != "healthy"
+                   and time.time() < deadline_at):
+                lim.quarantine.probe_now(victim)
+                time.sleep(0.02)
+            assert lim.quarantine.state(victim) == "healthy"
+            out3 = lim.allow_ids(ids, now=T0 + 1.0)
+            assert not out3.fail_open
+        finally:
+            lim.close()
+
+
+# ------------------------------------- probe recovery + snapshot restore
+
+
+class TestRecoveryRestore:
+    def test_recovery_restores_snapshot_plus_wal_suffix(self, tmp_path):
+        """Restore-before-rejoin: after a kill + heal, the victim slice
+        serves EXACT overrides (snapshot + WAL replay) and counters
+        within one snapshot interval."""
+        from ratelimiter_tpu import PersistenceSpec
+        from ratelimiter_tpu.observability.metrics import Registry
+        from ratelimiter_tpu.persistence import PersistenceManager
+
+        cfg = _cfg(devices=4,
+                   persistence=PersistenceSpec(dir=str(tmp_path),
+                                               snapshot_interval=3600.0))
+        lim = SlicedMeshLimiter(cfg)
+        mgr = PersistenceManager(cfg.persistence, registry=Registry())
+        top = mgr.wrap(lim)
+        mgr.attach([top])
+        lim.quarantine.restore_fn = mgr.slice_restorer()
+        victim = 3
+        vkey = next(f"u{i}" for i in range(300)
+                    if lim.owner_of_key(f"u{i}") == victim)
+        try:
+            top.set_override(vkey, 77)           # pre-snapshot override
+            for i in range(8):                   # consume quota
+                top.allow_n(vkey, 1, now=T0 + i * 0.01)
+            mgr.snapshot_now()
+            top.set_override(f"{vkey}:wal", 55)  # WAL-suffix override
+            inj = chaos.install(seed=6)
+            inj.fail_slice(victim)
+            out = top.allow_n(vkey, 1, now=T0 + 1.0)
+            assert out.fail_open
+            assert lim.quarantine.state(victim) != "healthy"
+            # More WAL mutations while degraded (write-all still lands).
+            top.set_override(f"{vkey}:during", 33)
+            inj.clear_slice(victim)
+            assert lim.quarantine.probe_now(victim)
+            assert lim.quarantine.state(victim) == "healthy"
+            # Overrides exact after restore + WAL suffix.
+            assert lim.get_override(vkey).limit == 77
+            assert lim.get_override(f"{vkey}:wal").limit == 55
+            assert lim.get_override(f"{vkey}:during").limit == 33
+            # Counters within one snapshot interval: the 8 pre-snapshot
+            # units are restored, so the next 2 exhaust the 77-override
+            # far from fresh — remaining must reflect restored usage.
+            res = top.allow_n(vkey, 1, now=T0 + 2.0)
+            assert res.allowed and not res.fail_open
+            assert res.remaining <= 77 - 9
+        finally:
+            mgr.stop(final_snapshot=False)
+            top.close()
+
+    def test_probe_failure_reopens_and_restore_failure_blocks_rejoin(self):
+        lim = SlicedMeshLimiter(_cfg(mesh=MeshSpec(
+            devices=4, quarantine=True, slice_deadline=1.0,
+            probe_interval=0.01)))
+        ids = np.arange(1, 65, dtype=np.uint64)
+        _warm(lim, ids)
+        victim = int(lim.owner_of_id(ids[:1])[0])
+        inj = chaos.install(seed=7)
+        inj.fail_slice(victim)
+        try:
+            lim.allow_ids(ids, now=T0)
+            # Probe while the fault is still armed: must re-open.
+            assert not lim.quarantine.probe_now(victim)
+            assert lim.quarantine.state(victim) == "quarantined"
+            # Heal the device but make restore fail: stays quarantined
+            # (restore-before-rejoin is an invariant, not best-effort).
+            inj.clear_slice(victim)
+            calls = []
+
+            def bad_restore(idx):
+                calls.append(idx)
+                raise RuntimeError("restore target unavailable")
+
+            lim.quarantine.restore_fn = bad_restore
+            assert not lim.quarantine.probe_now(victim)
+            assert calls == [victim]
+            assert lim.quarantine.state(victim) == "quarantined"
+            lim.quarantine.restore_fn = None
+            assert lim.quarantine.probe_now(victim)
+        finally:
+            lim.close()
+
+
+# --------------------------------------------- breaker scoping satellite
+
+
+class TestBreakerScoping:
+    def test_single_slice_fault_storm_leaves_other_ranges_admitting(self):
+        from ratelimiter_tpu.observability import CircuitBreakerDecorator
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        lim = SlicedMeshLimiter(_cfg(devices=4))
+        breaker = CircuitBreakerDecorator(lim, failure_threshold=3,
+                                          cooldown=60.0,
+                                          registry=Registry())
+        ids = np.arange(1, 513, dtype=np.uint64)
+        _warm(lim, ids)
+        victim = 2
+        inj = chaos.install(seed=8)
+        inj.fail_slice(victim)
+        try:
+            for i in range(10):  # a storm: 10 consecutive failed frames
+                out = breaker.allow_ids(ids, now=T0 + i * 0.01)
+                assert out.fail_open
+            # The whole-keyspace breaker must NOT have tripped...
+            assert breaker.state == "closed"
+            # ...while the victim's scoped state did.
+            assert breaker.sub_state(victim, now=T0 + 1.0) == "open"
+            # Other ranges still reach the backend and decide exactly —
+            # a frame not touching the victim is NOT fail-open.
+            owners = lim.owner_of_id(ids)
+            healthy_ids = np.ascontiguousarray(ids[owners != victim])
+            res = breaker.allow_ids(healthy_ids, now=T0 + 2.0)
+            assert not res.fail_open
+        finally:
+            lim.close()
+
+    def test_unattributed_failures_still_trip_globally(self):
+        from ratelimiter_tpu.observability import CircuitBreakerDecorator
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        lim = SlicedMeshLimiter(_cfg(devices=4,
+                                     mesh=MeshSpec(devices=4)))
+        breaker = CircuitBreakerDecorator(lim, failure_threshold=2,
+                                          cooldown=60.0,
+                                          registry=Registry())
+        try:
+            for s in lim.slices:
+                s.inject_failure(StorageUnavailableError("backend down"))
+            out1 = breaker.allow_batch(["a", "b"], now=T0)
+            out2 = breaker.allow_batch(["c", "d"], now=T0 + 0.01)
+            assert out1.fail_open and out2.fail_open
+            assert breaker.state == "open"
+        finally:
+            lim.close()
+
+
+# --------------------------------------------------- e2e through the doors
+
+
+#: Shared door-test traffic (both doors drive IDENTICAL frames, so ONE
+#: no-fault oracle trace serves both — an 8-slice composite's compiles
+#: are the suite's dominant cost).
+_DOOR_VICTIM = 2
+_DOOR_FRAMES = [np.random.default_rng(11).integers(
+    1, 1 << 40, size=(6, 512), dtype=np.uint64)[i] for i in range(6)]
+_DOOR_ORACLE: dict = {}
+
+
+def _door_oracle():
+    """(owners over all frames, per-frame no-fault BatchResults,
+    owners of frames[0]) — computed once, replayed for both doors."""
+    if not _DOOR_ORACLE:
+        oracle = SlicedMeshLimiter(_cfg(limit=1000,
+                                        mesh=MeshSpec(devices=N)))
+        try:
+            _warm(oracle, _DOOR_FRAMES[0])
+            want = [oracle.allow_ids(ids) for ids in _DOOR_FRAMES]
+            _DOOR_ORACLE.update(
+                owners=oracle.owner_of_id(np.concatenate(_DOOR_FRAMES)),
+                want_allowed=np.concatenate([w.allowed for w in want]),
+                frame0_owners=oracle.owner_of_id(_DOOR_FRAMES[0]))
+        finally:
+            oracle.close()
+    return _DOOR_ORACLE
+
+
+class TestChaosAsyncioDoor:
+    def test_kill_slice_mid_traffic_end_to_end(self):
+        from ratelimiter_tpu.serving.client import AsyncClient
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        cfg = _cfg(limit=1000)
+        orc = _door_oracle()
+        victim = _DOOR_VICTIM
+        frames = _DOOR_FRAMES
+
+        async def main():
+            lim = SlicedMeshLimiter(cfg)
+            _warm(lim, frames[0])
+            srv = RateLimitServer(lim, max_delay=1e-4)
+            await srv.start()
+            c = await AsyncClient.connect(port=srv.port)
+            inj = chaos.install(seed=12)
+            got = []
+            t_frame = []
+            for i, ids in enumerate(frames):
+                if i == 3:
+                    inj.fail_slice(victim)
+                t0 = time.perf_counter()
+                got.append(await c.allow_hashed(ids, deadline=30.0))
+                t_frame.append(time.perf_counter() - t0)
+            # Healthy-owned rows bit-identical to the no-fault oracle,
+            # through the real wire (coalesced T_RESULT_HASHED frames).
+            owners = orc["owners"]
+            got_allowed = np.concatenate([g.allowed for g in got])
+            healthy = owners != victim
+            np.testing.assert_array_equal(got_allowed[healthy],
+                                          orc["want_allowed"][healthy])
+            # Satellite 3: a quarantined slice's rows in the coalesced
+            # hashed frame carry the batch fail_open flag with LIVE
+            # limit/window values.
+            assert all(g.fail_open for g in got[3:])
+            assert not any(g.fail_open for g in got[:3])
+            lim.update_limit(777)
+            post = await c.allow_hashed(frames[0])
+            assert post.fail_open
+            assert post.limit == 777
+            vmask = orc["frame0_owners"] == victim
+            now = time.time()
+            resets = np.asarray(post.reset_at)[vmask]
+            assert np.all(resets > now - 5.0)
+            assert np.all(resets < now + float(cfg.window) + 5.0)
+            # No multi-second p99: every frame within a deadline-ish
+            # budget (kill faults fail fast; bound generously for CI).
+            assert max(t_frame[3:]) < 5.0, t_frame
+            await c.close()
+            await srv.shutdown()
+            lim.close()
+
+        asyncio.run(main())
+
+
+class TestChaosNativeDoor:
+    def test_kill_slice_mid_traffic_end_to_end(self):
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+            native_server_available,
+        )
+        if not native_server_available():
+            pytest.skip("no compiler for the native front door")
+        from ratelimiter_tpu.serving.client import Client
+
+        cfg = _cfg(limit=1000, mesh=MeshSpec(devices=N))
+        slices = build_slices(cfg)
+        qmgr = QuarantineManager(len(slices), clock=slices[0].clock,
+                                 probe_interval=30.0)
+        guards = [SliceGuard(s, i, qmgr, deadline=5.0)
+                  for i, s in enumerate(slices)]
+        srv = NativeRateLimitServer(guards[0], shard_limiters=guards,
+                                    max_delay=1e-4)
+        srv.start()
+        qmgr.on_state_change = (
+            lambda i, st: srv.set_shard_health(i, st != "healthy"))
+        orc = _door_oracle()
+        victim = _DOOR_VICTIM
+        frames = _DOOR_FRAMES
+        inj = chaos.install(seed=14)
+        try:
+            with Client(port=srv.port, timeout=120.0) as c:
+                got = []
+                for i, ids in enumerate(frames):
+                    if i == 3:
+                        inj.fail_slice(victim)
+                    got.append(c.allow_hashed(ids, deadline=60.0))
+                owners = orc["owners"]
+                got_allowed = np.concatenate([g.allowed for g in got])
+                healthy = owners != victim
+                np.testing.assert_array_equal(got_allowed[healthy],
+                                              orc["want_allowed"][healthy])
+                assert all(g.fail_open for g in got[3:])
+                assert not any(g.fail_open for g in got[:3])
+                # Live limit/window in degraded rows after an update
+                # through the server (satellite 3, native half).
+                srv.update_limit(888)
+                post = c.allow_hashed(frames[0])
+                assert post.fail_open and post.limit == 888
+                st = srv.stats()
+                assert st["shard_quarantined"][victim] == 1
+                assert sum(st["shard_quarantined"]) == 1
+        finally:
+            chaos.uninstall()
+            srv.shutdown(close_limiters=False)
+            for g in guards:
+                g.close()
+
+
+# ----------------------------------------------------------- DCN chaos
+
+
+class TestDcnChaos:
+    def _pusher_pair(self, secret=None):
+        from ratelimiter_tpu import ManualClock, create_limiter
+        from ratelimiter_tpu.serving.dcn_peer import DcnPusher
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0,
+                     sketch=SketchParams(depth=2, width=1 << 10,
+                                         sub_windows=4))
+        # Virtual time on the SENDER: the pusher's export cadence reads
+        # the limiter's clock, so a wall clock would roll the whole ring
+        # past the test's traffic before the first export.
+        sender = create_limiter(cfg, backend="sketch",
+                                clock=ManualClock(T0))
+        receiver = create_limiter(cfg, backend="sketch")
+        return cfg, sender, receiver, DcnPusher, RateLimitServer
+
+    def test_partition_drops_frames_and_clears(self):
+        cfg, sender, receiver, DcnPusher, RateLimitServer = \
+            self._pusher_pair()
+
+        async def main():
+            srv = RateLimitServer(receiver, dcn=True)
+            await srv.start()
+            push = DcnPusher(sender, [("127.0.0.1", srv.port)],
+                             interval=3600.0)
+            loop = asyncio.get_running_loop()
+            try:
+                sender.allow_batch([f"k{i}" for i in range(64)], now=T0)
+                # Roll the window forward so a completed sub-window slab
+                # exists to export (the pusher syncs to the sender's
+                # manual clock).
+                sender.clock.set(T0 + 31.0)
+                sender.allow_batch(["roll"], now=T0 + 31.0)
+                inj = chaos.install(seed=21)
+                inj.partition_dcn(1.0)
+                delivered = await loop.run_in_executor(
+                    None, push.sync_once)
+                assert delivered == 0
+                assert inj.dcn_dropped >= 1
+                assert push.pushes_failed >= 1
+                # Partition heals: the next cycle retries the slabs
+                # (per-peer watermarks) and delivers.
+                inj.clear()
+                delivered2 = await loop.run_in_executor(
+                    None, push.sync_once)
+                assert delivered2 >= 1
+            finally:
+                push.stop()
+                await srv.shutdown()
+
+        asyncio.run(main())
+        sender.close()
+        receiver.close()
+
+    def test_corruption_rejected_by_hmac_no_mass_merged(self):
+        cfg, sender, receiver, DcnPusher, RateLimitServer = \
+            self._pusher_pair(secret="s3cret")
+
+        async def main():
+            srv = RateLimitServer(receiver, dcn=True, dcn_secret="s3cret")
+            await srv.start()
+            push = DcnPusher(sender, [("127.0.0.1", srv.port)],
+                             interval=3600.0, secret="s3cret")
+            loop = asyncio.get_running_loop()
+            try:
+                sender.allow_batch([f"c{i}" for i in range(64)], now=T0)
+                sender.clock.set(T0 + 31.0)
+                sender.allow_batch(["roll"], now=T0 + 31.0)
+                before = int(receiver.in_window_admitted_mass())
+                inj = chaos.install(seed=22)
+                inj.corrupt_dcn(1.0)
+                delivered = await loop.run_in_executor(
+                    None, push.sync_once)
+                assert delivered == 0
+                assert inj.dcn_corrupted >= 1
+                # The corrupted push must merge NOTHING (HMAC covers the
+                # body, and the flip landed inside it).
+                assert int(receiver.in_window_admitted_mass()) == before
+            finally:
+                push.stop()
+                await srv.shutdown()
+
+        asyncio.run(main())
+        sender.close()
+        receiver.close()
+
+
+# ------------------------------------------------- snapshot-stall chaos
+
+
+class TestSnapshotStall:
+    def test_stalled_snapshot_thread_never_blocks_decisions(self, tmp_path):
+        from ratelimiter_tpu import PersistenceSpec, create_limiter
+        from ratelimiter_tpu.observability.metrics import Registry
+        from ratelimiter_tpu.persistence import PersistenceManager
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=100,
+                     window=60.0,
+                     sketch=SketchParams(depth=2, width=1 << 10,
+                                         sub_windows=4),
+                     persistence=PersistenceSpec(dir=str(tmp_path),
+                                                 snapshot_interval=3600.0))
+        lim = create_limiter(cfg, backend="sketch")
+        mgr = PersistenceManager(cfg.persistence, registry=Registry())
+        top = mgr.wrap(lim)
+        mgr.attach([top])
+        ids = np.arange(1, 257, dtype=np.uint64)
+        lim.allow_hashed(ids, now=T0)
+        inj = chaos.install(seed=31)
+        inj.stall_snapshot(1.0)
+        import threading
+
+        t = threading.Thread(target=mgr.snapshot_now, daemon=True)
+        t.start()
+        time.sleep(0.1)  # snapshot thread is now inside the stall
+        t0 = time.perf_counter()
+        lim.allow_hashed(ids, now=T0 + 0.5)
+        decide_s = time.perf_counter() - t0
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert inj.snapshot_stalls == 1
+        # The stall happened BEFORE capture takes the lock: decisions
+        # during it must not pay the stall.
+        assert decide_s < 0.5, decide_s
+        mgr.stop(final_snapshot=False)
+        top.close()
+
+
+# ---------------------------------------------- deadline shedding (doors)
+
+
+class TestDeadlineShedding:
+    def test_asyncio_door_sheds_expired_work_per_policy(self):
+        from ratelimiter_tpu import create_limiter
+        from ratelimiter_tpu.serving import protocol as p
+        from ratelimiter_tpu.serving.client import AsyncClient
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0, fail_open=True,
+                     sketch=SketchParams(depth=2, width=1 << 10,
+                                         sub_windows=4))
+
+        async def main():
+            lim = create_limiter(cfg, backend="sketch")
+            srv = RateLimitServer(lim)
+            await srv.start()
+            c = await AsyncClient.connect(port=srv.port)
+            # Expired-on-arrival frame (raw, the client refuses to send
+            # one): fail-open policy answers an allowance stamped
+            # fail_open — no dispatch slot burned.
+            raw = p.with_deadline(p.encode_allow_n(50, "k", 1), -1.0)
+            _, body = await c._request_once(raw, 50)
+            res = p.parse_result(body)
+            assert res.allowed and res.fail_open
+            # Hashed frame, same contract.
+            ids = np.arange(1, 65, dtype=np.uint64)
+            raw = p.with_deadline(p.encode_allow_hashed(51, ids), 0.0)
+            t, body = await c._request_once(raw, 51)
+            assert t == p.T_RESULT_HASHED
+            br = p.parse_result_hashed(body)
+            assert br.fail_open and bool(np.all(br.allowed))
+            # A generous deadline passes through untouched.
+            live = await c.allow_n("k2", 1, deadline=30.0)
+            assert not live.fail_open
+            await c.close()
+            await srv.shutdown()
+            lim.close()
+
+        asyncio.run(main())
+
+    def test_asyncio_door_fail_closed_sheds_with_typed_error(self):
+        from ratelimiter_tpu import create_limiter
+        from ratelimiter_tpu.serving import protocol as p
+        from ratelimiter_tpu.serving.client import AsyncClient
+        from ratelimiter_tpu.serving.server import RateLimitServer
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0, fail_open=False,
+                     sketch=SketchParams(depth=2, width=1 << 10,
+                                         sub_windows=4))
+
+        async def main():
+            lim = create_limiter(cfg, backend="sketch")
+            srv = RateLimitServer(lim)
+            await srv.start()
+            c = await AsyncClient.connect(port=srv.port)
+            raw = p.with_deadline(p.encode_allow_n(60, "k", 1), -1.0)
+            with pytest.raises(DeadlineExceededError):
+                await c._request_once(raw, 60)
+            await c.close()
+            await srv.shutdown()
+            lim.close()
+
+        asyncio.run(main())
+
+    def test_native_door_sheds_and_counts(self):
+        from ratelimiter_tpu import create_limiter
+        from ratelimiter_tpu.serving import protocol as p
+        from ratelimiter_tpu.serving.client import Client
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+            native_server_available,
+        )
+        if not native_server_available():
+            pytest.skip("no compiler for the native front door")
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0, fail_open=True,
+                     sketch=SketchParams(depth=2, width=1 << 10,
+                                         sub_windows=4))
+        lim = create_limiter(cfg, backend="sketch")
+        srv = NativeRateLimitServer(lim)
+        srv.start()
+        try:
+            import socket as sk_mod
+
+            raw = p.with_deadline(p.encode_allow_n(70, "k", 1), -1.0)
+            sk = sk_mod.create_connection(("127.0.0.1", srv.port))
+            sk.sendall(raw)
+            buf = b""
+            while len(buf) < 13:
+                buf += sk.recv(65536)
+            length, type_, rid = p.parse_header(buf[:13])
+            while len(buf) < 4 + length:
+                buf += sk.recv(65536)
+            assert rid == 70
+            res = p.parse_result(buf[13:])
+            assert res.allowed and res.fail_open
+            sk.close()
+            assert srv.stats()["deadline_shed_total"] == 1
+            # Live frames unaffected (and the shed counter stays put).
+            with Client(port=srv.port, timeout=60.0) as c:
+                out = c.allow("k2", deadline=30.0)
+                assert not out.fail_open
+            assert srv.stats()["deadline_shed_total"] == 1
+        finally:
+            srv.shutdown(close_limiters=False)
+            lim.close()
+
+
+# ----------------------------------------------- determinism + zero-cost
+
+
+class TestHarnessProperties:
+    def test_seeded_determinism_replays_exactly(self):
+        a = ChaosInjector(seed=99)
+        b = ChaosInjector(seed=99)
+        a.partition_dcn(0.5)
+        a.corrupt_dcn(0.5)
+        b.partition_dcn(0.5)
+        b.corrupt_dcn(0.5)
+        frame = b"x" * 64
+        seq_a = [a.dcn_frame(frame) for _ in range(64)]
+        seq_b = [b.dcn_frame(frame) for _ in range(64)]
+        assert seq_a == seq_b
+        c = ChaosInjector(seed=100)
+        c.partition_dcn(0.5)
+        c.corrupt_dcn(0.5)
+        assert [c.dcn_frame(frame) for _ in range(64)] != seq_a
+
+    def test_chaos_off_decisions_byte_identical(self):
+        """Seam disabled (no injector installed): the quarantine-guarded
+        mesh decides byte-identically to the unguarded one."""
+        assert chaos.INJECTOR is None
+        guarded = SlicedMeshLimiter(_cfg(devices=4))
+        plain = SlicedMeshLimiter(_cfg(devices=4,
+                                       mesh=MeshSpec(devices=4)))
+        rng = np.random.default_rng(17)
+        try:
+            for i in range(4):
+                ids = rng.integers(1, 1 << 40, size=512, dtype=np.uint64)
+                now = T0 + i * 0.2
+                g = guarded.allow_ids(ids, now=now)
+                p = plain.allow_ids(ids, now=now)
+                np.testing.assert_array_equal(g.allowed, p.allowed)
+                np.testing.assert_array_equal(g.remaining, p.remaining)
+                np.testing.assert_array_equal(g.retry_after, p.retry_after)
+                np.testing.assert_array_equal(g.reset_at, p.reset_at)
+                assert g.fail_open == p.fail_open
+        finally:
+            guarded.close()
+            plain.close()

@@ -1,0 +1,380 @@
+"""Client resilience + deadline propagation (ADR-015).
+
+Covers the PR 8 client contract: separate connect vs per-call read
+timeouts (the old single ``timeout`` knob silently bounded both), typed
+mid-stream timeouts that name the pending request and NEVER let the
+next call read the stale frame as its own result, bounded full-jitter
+retries with automatic reconnect, per-call deadlines that bound the
+retry loop AND ride the wire, and the protocol's deadline extension
+itself (composition with the trace extension, shedding at both doors).
+"""
+
+import asyncio
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ratelimiter_tpu.core.errors import (
+    DeadlineExceededError,
+    RequestTimeoutError,
+)
+from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving.client import AsyncClient, Client, _jitter_delay
+
+T0 = 1_700_000_000.0
+
+
+def _result_frame(req_id: int, allowed=True) -> bytes:
+    return p.encode_result(req_id, Result(
+        allowed=allowed, limit=10, remaining=5, retry_after=0.0,
+        reset_at=T0, fail_open=False))
+
+
+class _ScriptedServer:
+    """Minimal frame server driven by a per-request handler — the
+    misbehavior harness (slow responses, dropped connections) the real
+    doors would never exhibit on purpose."""
+
+    def __init__(self, handler):
+        self.handler = handler
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.connections = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        buf = b""
+        try:
+            while True:
+                while len(buf) < p.HEADER_SIZE:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                length, type_, rid = p.parse_header(buf[:p.HEADER_SIZE],
+                                                    allow_dcn=True)
+                while len(buf) < 4 + length:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                body = buf[p.HEADER_SIZE:4 + length]
+                buf = buf[4 + length:]
+                out = self.handler(type_, rid, body, conn)
+                if out is not None:
+                    conn.sendall(out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------- satellite 2: timeouts
+
+
+class TestSeparateTimeouts:
+    def test_connect_timeout_is_not_the_read_timeout(self):
+        srv = _ScriptedServer(lambda t, rid, b, c: _result_frame(rid))
+        try:
+            c = Client(port=srv.port, connect_timeout=5.0,
+                       call_timeout=0.75, retries=0)
+            assert c._sock.gettimeout() == pytest.approx(0.75)
+            assert c.allow("k").allowed
+            c.close()
+        finally:
+            srv.close()
+
+    def test_midstream_timeout_is_typed_and_names_the_request(self):
+        answered = []
+
+        def handler(type_, rid, body, conn):
+            if not answered:
+                answered.append(rid)
+                return None  # swallow the first request forever
+            return _result_frame(rid)
+
+        srv = _ScriptedServer(handler)
+        try:
+            c = Client(port=srv.port, call_timeout=0.3, retries=0)
+            with pytest.raises(RequestTimeoutError) as ei:
+                c.allow("k")
+            assert ei.value.request_id == 1
+            assert ei.value.request_type == p.T_ALLOW_N
+            assert c.desynced
+            c.close()
+        finally:
+            srv.close()
+
+    def test_next_call_after_timeout_never_returns_wrong_frames_result(self):
+        """The pre-PR-8 failure mode: request 1 times out, its response
+        arrives late, request 2 reads it as its own. The client must
+        reconnect (or resync) instead."""
+        lock = threading.Lock()
+        state = {"first": None}
+
+        def handler(type_, rid, body, conn):
+            with lock:
+                if state["first"] is None:
+                    state["first"] = (rid, conn)
+
+                    def late():
+                        time.sleep(0.6)
+                        try:
+                            # The STALE answer: allowed=False so reading
+                            # it as request 2's result is detectable.
+                            conn.sendall(_result_frame(rid, allowed=False))
+                        except OSError:
+                            pass
+
+                    threading.Thread(target=late, daemon=True).start()
+                    return None
+            return _result_frame(rid, allowed=True)
+
+        srv = _ScriptedServer(handler)
+        try:
+            c = Client(port=srv.port, call_timeout=0.25, retries=0)
+            with pytest.raises(RequestTimeoutError):
+                c.allow("k")
+            # Second call: must come back with ITS OWN (allowed=True)
+            # result, never the stale allowed=False frame.
+            res = c.allow("k2")
+            assert res.allowed is True
+            assert srv.connections == 2, "client must have reconnected"
+            c.close()
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------- retries + backoff
+
+
+class TestRetries:
+    def test_connection_error_retries_with_reconnect(self):
+        calls = []
+
+        def handler(type_, rid, body, conn):
+            calls.append(rid)
+            if len(calls) == 1:
+                conn.close()  # first request: connection dies mid-call
+                return None
+            return _result_frame(rid)
+
+        srv = _ScriptedServer(handler)
+        try:
+            c = Client(port=srv.port, retries=2, backoff=0.01,
+                       call_timeout=5.0)
+            assert c.allow("k").allowed
+            assert srv.connections >= 2
+            c.close()
+        finally:
+            srv.close()
+
+    def test_retries_exhaust_to_the_underlying_error(self):
+        srv = _ScriptedServer(lambda t, rid, b, conn: conn.close())
+        try:
+            c = Client(port=srv.port, retries=1, backoff=0.01,
+                       call_timeout=5.0)
+            with pytest.raises((ConnectionError, OSError)):
+                c.allow("k")
+            c.close()
+        finally:
+            srv.close()
+
+    def test_midstream_timeout_is_never_auto_retried(self):
+        seen = []
+        srv = _ScriptedServer(
+            lambda t, rid, b, conn: seen.append(rid))  # answer nothing
+        try:
+            c = Client(port=srv.port, call_timeout=0.2, retries=5)
+            with pytest.raises(RequestTimeoutError):
+                c.allow("k")
+            time.sleep(0.1)
+            # Exactly ONE send: a retried decision could double-spend
+            # quota server-side.
+            assert len(seen) == 1
+            c.close()
+        finally:
+            srv.close()
+
+    def test_full_jitter_backoff_is_bounded(self):
+        for attempt in range(8):
+            for _ in range(50):
+                d = _jitter_delay(attempt, 0.05, 2.0)
+                assert 0.0 <= d <= min(2.0, 0.05 * 2 ** attempt)
+
+
+# ------------------------------------------------------------ deadlines
+
+
+class TestClientDeadlines:
+    def test_deadline_bounds_the_whole_call(self):
+        srv = _ScriptedServer(lambda t, rid, b, conn: None)  # black hole
+        try:
+            c = Client(port=srv.port, call_timeout=30.0, retries=0)
+            t0 = time.perf_counter()
+            with pytest.raises((RequestTimeoutError,
+                                DeadlineExceededError)):
+                c.allow("k", deadline=0.4)
+            assert time.perf_counter() - t0 < 2.0
+            c.close()
+        finally:
+            srv.close()
+
+    def test_deadline_rides_the_wire(self):
+        got = {}
+
+        def handler(type_, rid, body, conn):
+            base, tid, budget, rest = p.split_request(type_, body)
+            got.update(type=base, trace=tid, budget=budget)
+            return _result_frame(rid)
+
+        srv = _ScriptedServer(handler)
+        try:
+            c = Client(port=srv.port, retries=0)
+            c.allow("k", deadline=1.5, trace_id=42)
+            assert got["type"] == p.T_ALLOW_N
+            assert got["trace"] == 42
+            assert 0.0 < got["budget"] <= 1.5
+            c.close()
+        finally:
+            srv.close()
+
+    def test_expired_deadline_fails_before_send(self):
+        srv = _ScriptedServer(lambda t, rid, b, c_: _result_frame(rid))
+        try:
+            c = Client(port=srv.port, retries=0)
+            with pytest.raises(DeadlineExceededError):
+                c.allow("k", deadline=-0.1)
+            c.close()
+        finally:
+            srv.close()
+
+
+class TestAsyncClientResilience:
+    def test_reconnect_after_connection_loss(self):
+        calls = []
+
+        def handler(type_, rid, body, conn):
+            calls.append(rid)
+            if len(calls) == 1:
+                conn.close()
+                return None
+            return _result_frame(rid)
+
+        srv = _ScriptedServer(handler)
+
+        async def main():
+            c = await AsyncClient.connect(port=srv.port, retries=2,
+                                          backoff=0.01)
+            res = await c.allow("k")
+            assert res.allowed
+            await c.close()
+
+        try:
+            asyncio.run(main())
+            assert srv.connections >= 2
+        finally:
+            srv.close()
+
+    def test_deadline_bounds_wait_and_rides_wire(self):
+        got = {}
+
+        def handler(type_, rid, body, conn):
+            base, tid, budget, rest = p.split_request(type_, body)
+            got["budget"] = budget
+            return None  # never answer
+
+        srv = _ScriptedServer(handler)
+
+        async def main():
+            c = await AsyncClient.connect(port=srv.port, retries=0)
+            t0 = time.perf_counter()
+            with pytest.raises(DeadlineExceededError):
+                await c.allow("k", deadline=0.3)
+            assert time.perf_counter() - t0 < 2.0
+            await c.close()
+
+        try:
+            asyncio.run(main())
+            assert 0.0 < got["budget"] <= 0.3
+        finally:
+            srv.close()
+
+
+# ------------------------------------------------- protocol extension
+
+
+class TestDeadlineExtension:
+    def test_roundtrip_and_composition_with_trace(self):
+        frame = p.encode_allow_n(7, "key", 3)
+        stamped = p.with_trace(p.with_deadline(frame, 2.5), 99)
+        length, type_, rid = p.parse_header(stamped[:p.HEADER_SIZE])
+        assert rid == 7
+        assert type_ & p.TRACE_FLAG and type_ & p.DEADLINE_FLAG
+        base, tid, budget, body = p.split_request(
+            type_, stamped[p.HEADER_SIZE:])
+        assert base == p.T_ALLOW_N
+        assert tid == 99
+        assert budget == pytest.approx(2.5)
+        assert p.parse_allow_n(body) == ("key", 3)
+
+    def test_deadline_alone(self):
+        frame = p.with_deadline(p.encode_allow_n(1, "k", 1), 0.25)
+        length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        base, tid, budget, body = p.split_request(
+            type_, frame[p.HEADER_SIZE:])
+        assert (base, tid) == (p.T_ALLOW_N, 0)
+        assert budget == pytest.approx(0.25)
+
+    def test_unflagged_frames_report_no_deadline(self):
+        frame = p.encode_allow_n(1, "k", 1)
+        _, type_, _ = p.parse_header(frame[:p.HEADER_SIZE])
+        base, tid, budget, body = p.split_request(
+            type_, frame[p.HEADER_SIZE:])
+        assert budget is None
+
+    def test_responses_cannot_carry_extensions(self):
+        res = _result_frame(1)
+        with pytest.raises(p.ProtocolError):
+            p.with_deadline(res, 1.0)
+        with pytest.raises(p.ProtocolError):
+            p.with_trace(res, 1)
+
+    def test_deadline_must_precede_trace(self):
+        frame = p.with_trace(p.encode_allow_n(1, "k", 1), 5)
+        with pytest.raises(p.ProtocolError):
+            p.with_deadline(frame, 1.0)
+
+    def test_error_code_maps_to_typed_exception(self):
+        assert p.code_for(DeadlineExceededError("x")) == p.E_DEADLINE
+        exc = p.exception_for(p.E_DEADLINE, "expired")
+        assert isinstance(exc, DeadlineExceededError)
