@@ -7,8 +7,8 @@ REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
-        lint check native bench bench-quick bench-chaos bench-matrix serve \
-        verify clean
+        test-audit lint check native bench bench-quick bench-audit \
+        bench-chaos bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -32,6 +32,13 @@ test-tracing:    ## flight-recorder span trees, both doors (ADR-014)
 test-chaos:      ## failure-domain chaos suite + client resilience (ADR-015)
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -m pytest tests/test_chaos.py tests/test_client_resilience.py -q
+
+test-audit:      ## live accuracy observatory (ADR-016): engine, taps, /debug/audit
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m pytest tests/test_audit.py -q
+
+bench-audit:     ## live-vs-offline accuracy agreement + audit overhead A/B JSON
+	$(PY) bench.py --audit
 
 bench-chaos:     ## degraded-serving numbers (retention/entry/recovery JSON)
 	$(PY) bench.py --chaos slow-slice

@@ -1,0 +1,128 @@
+"""The live accuracy observatory: shadow-oracle auditing (ADR-016).
+
+The sketch backend is approximate by design; this example shows the
+observatory measuring HOW approximate, live. A deliberately undersized
+sketch serves Zipf traffic through the real asyncio door while the
+auditor mirrors a hash-coherent sample of decisions into an exact
+shadow oracle off the hot path — then prints the live false-deny rate
+with its Wilson confidence interval, the per-slice attribution, the
+top-K consumer analytics off the heavy-hitter side table, and the
+admission-SLO burn-rate block. Run on any host:
+
+    JAX_PLATFORMS=cpu python examples/14_accuracy_observatory.py
+
+The served form (everything below is also one curl against a real
+server — gate it like every debug surface, docs/OPERATIONS.md §6):
+
+    python -m ratelimiter_tpu.serving --backend mesh --audit \
+        --audit-sample 64 --audit-token s3cret --hh-slots 256 \
+        --http-port 8433
+    curl -H 'Authorization: Bearer s3cret' \
+        http://localhost:8433/debug/audit | jq
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import asyncio
+import json
+
+import numpy as np
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+from ratelimiter_tpu.evaluation import zipf_key_ids
+from ratelimiter_tpu.observability import MetricsDecorator, Registry, audit
+from ratelimiter_tpu.observability.slo import SloBurnTracker
+from ratelimiter_tpu.serving import AsyncClient, RateLimitServer
+
+T0 = 1_700_000_000.0
+
+# A geometry small enough that collisions actually bite (width 256 for
+# ~6K active keys), so the observatory has something to see. The hh
+# side table tracks hot keys exactly — that is what the top-K consumer
+# analytics export.
+cfg = Config(
+    algorithm=Algorithm.SLIDING_WINDOW, limit=50, window=60.0,
+    max_batch_admission_iters=1, key_prefix="",
+    sketch=SketchParams(depth=2, width=256, sub_windows=60,
+                        conservative_update=True, hh_slots=64,
+                        hh_promote_fraction=0.2))
+
+reg = Registry()
+
+
+async def main() -> None:
+    clock = ManualClock(T0)
+    lim = MetricsDecorator(
+        create_limiter(cfg, backend="sketch", clock=clock), reg)
+    server = RateLimitServer(lim, max_batch=2048, max_delay=100e-6,
+                             registry=reg)
+    await server.start()
+
+    # The observatory: OFF by default (the doors' tap is one None
+    # check — byte-identical hot path). enable() installs the
+    # process-wide auditor; sample=8 audits 1/8 of the keyspace so this
+    # short run collects a meaningful sample (production default: 64).
+    auditor = audit.enable(cfg, sample=8, registry=reg)
+    slo = SloBurnTracker(reg, objective=0.999, latency_target=0.025)
+    slo.attach()
+
+    client = await AsyncClient.connect(server.host, server.port)
+    ids = zipf_key_ids(n_keys=3000, n_requests=12_000, alpha=1.1, seed=0)
+    for start in range(0, 12_000, 2048):
+        end = min(start + 2048, 12_000)
+        clock.set(T0 + start / 20_000.0)   # 20K req/s of virtual time
+        await client.allow_hashed(ids[start:end].astype(np.uint64))
+    await client.close()
+    await server.shutdown()
+
+    assert auditor.flush(timeout=30), "audit queue did not drain"
+    st = auditor.status()
+    lo, hi = st["false_deny_wilson95"]
+    print("== live accuracy (shadow oracle, hash-coherent 1/8 sample) ==")
+    print(f"  audited decisions : {st['samples']}"
+          f"  (dropped: {st['dropped_decisions']})")
+    print(f"  false-deny rate   : {st['false_deny_rate']:.5f}"
+          f"  95% Wilson [{lo:.5f}, {hi:.5f}]")
+    print(f"  false-allow rate  : {st['false_allow_rate']:.2e}")
+    print(f"  fail-open samples : {st['fail_open_samples']}")
+
+    print("== top consumers (hh side table — hash tokens, never keys) ==")
+    base = lim.inner  # the undecorated sketch
+    for row in base.consumer_stats(k=5)["top"]:
+        print(f"  {row['consumer']}  in_window={row['in_window']}"
+              f"  share={row['share']:.3f}")
+
+    print("== admission SLO burn rate ==")
+    print(json.dumps(slo.status()["windows"], indent=2))
+
+    print("== the same families on /metrics ==")
+    for line in reg.render().splitlines():
+        if line.startswith(("rate_limiter_audit_false_deny_rate",
+                            "rate_limiter_audit_samples",
+                            "rate_limiter_top_consumer_mass",
+                            "rate_limiter_slo_burn_rate")):
+            print(" ", line)
+
+    slo.detach()
+    audit.disable()
+    lim.close()
+    print("OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

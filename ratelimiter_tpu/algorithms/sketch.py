@@ -36,7 +36,7 @@ from ratelimiter_tpu.core.types import (
     Result,
     batch_fail_open,
 )
-from ratelimiter_tpu.ops.hashing import hash_strings_u64, split_hash
+from ratelimiter_tpu.ops.hashing import split_hash
 
 _MIN_PAD = 8
 
@@ -161,12 +161,12 @@ class SketchLimiter(RateLimiter):
     # ------------------------------------------------------------- hashing
 
     def _hash(self, keys: List[str]) -> np.ndarray:
-        # The prefix namespaces the sketch exactly as it namespaces Redis
-        # keys in the reference (``config.go:81-87``).
-        prefix = self.config.prefix
-        if prefix:
-            keys = [f"{prefix}:{k}" for k in keys]
-        return hash_strings_u64(keys)
+        # Shared rule (ops/hashing.hash_prefixed_u64): prefix-namespace
+        # then bulk-hash — the audit tap's string lane applies the SAME
+        # function, so audited keys always match their serving hashes.
+        from ratelimiter_tpu.ops.hashing import hash_prefixed_u64
+
+        return hash_prefixed_u64(keys, self.config.prefix)
 
     # ------------------------------------------------------------ dispatch
     #
@@ -809,6 +809,51 @@ class SketchLimiter(RateLimiter):
         """Device memory held by the sketch — constant in key cardinality."""
         return sum(int(np.prod(v.shape)) * v.dtype.itemsize
                    for v in self._state.values() if hasattr(v, "shape"))
+
+    @property
+    def has_hh(self) -> bool:
+        """Whether the heavy-hitter side table is configured
+        (SketchParams.hh_slots > 0)."""
+        return "hh_owner" in self._state
+
+    def consumer_stats(self, k: int = 10) -> dict:
+        """Top-K consumer analytics off the heavy-hitter side table
+        (ADR-016 §5): the hh slots already track promoted hot keys'
+        EXACT in-window counts for admission — this read-only view
+        exports them as analytics. Cost: the lock is held for three
+        reference reads (jax arrays are immutable — same discipline as
+        debt_slab_stats), then K-slot host fetches; scrape/healthz
+        cadence only, never the decide path.
+
+        Consumers are identified by their (h1, h2) hash pair rendered as
+        one 64-bit hex token — irreversible (no raw keys leave the
+        process, the PII boundary of OPERATIONS §6) yet stable across
+        scrapes and slices, so dashboards can track a hot consumer over
+        time. ``{"slots": 0}`` when the side table is off
+        (SketchParams.hh_slots=0)."""
+        if "hh_owner" not in self._state:
+            return {"slots": 0, "occupied": 0, "top": []}
+        with self._lock:
+            owner_ref = self._state["hh_owner"]
+            owner2_ref = self._state["hh_owner2"]
+            totals_ref = self._state["hh_totals"]
+        owner = np.asarray(owner_ref)
+        owner2 = np.asarray(owner2_ref)
+        totals = np.asarray(totals_ref)
+        live = (owner != 0) & (totals > 0)
+        idx = np.nonzero(live)[0]
+        order = idx[np.argsort(totals[idx], kind="stable")[::-1]][:max(0, k)]
+        total_mass = int(totals[live].sum())
+        return {
+            "slots": int(owner.shape[0]),
+            "occupied": int((owner != 0).sum()),
+            "tracked_mass": total_mass,
+            "top": [{
+                "consumer": f"{(int(owner[i]) << 32) | int(owner2[i]):016x}",
+                "in_window": int(totals[i]),
+                "share": round(int(totals[i]) / max(1, total_mass), 6),
+            } for i in order],
+        }
 
 
 class SketchTokenBucketLimiter(SketchLimiter):

@@ -184,7 +184,8 @@ class DispatchTicket:
     """
 
     __slots__ = ("outs", "b", "limit", "limits", "ns", "now_us", "t_sec",
-                 "slot", "padded", "result", "meta", "wire", "trace_id")
+                 "slot", "padded", "result", "meta", "wire", "trace_id",
+                 "audit")
 
     def __init__(self, result: "BatchResult | None" = None):
         self.outs = None        # device-side (allowed, remaining, retry, reset)
@@ -204,6 +205,11 @@ class DispatchTicket:
         #                         0 = unsampled. Set by the serving doors
         #                         at launch so resolve-side spans (incl.
         #                         mesh per-slice spans) link to the frame.
+        self.audit = None       # (h64, ns) pinned by the native door's
+        #                         launch callbacks ONLY while the live
+        #                         auditor is on (ADR-016), so resolve can
+        #                         mirror the frame into the shadow-oracle
+        #                         tap; None when auditing is off.
 
     @property
     def resolved(self) -> bool:

@@ -1,5 +1,19 @@
-"""Accuracy evaluation: sketch vs exact oracle (BASELINE.json metric)."""
+"""Accuracy evaluation: sketch vs exact oracle (BASELINE.json metric).
+
+The three-way comparison core (``compare.py``) is shared with the live
+accuracy observatory (``observability/audit.py``, ADR-016)."""
 
 from ratelimiter_tpu.evaluation.accuracy import evaluate_accuracy, zipf_key_ids
+from ratelimiter_tpu.evaluation.compare import (
+    ShadowComparator,
+    ThreeWayTally,
+    wilson_interval,
+)
 
-__all__ = ["evaluate_accuracy", "zipf_key_ids"]
+__all__ = [
+    "ShadowComparator",
+    "ThreeWayTally",
+    "evaluate_accuracy",
+    "wilson_interval",
+    "zipf_key_ids",
+]

@@ -14,28 +14,29 @@ CU write expire — a small, *measured* false-allow risk (BENCH_r02:
 ``false_allow_rate_vs_oracle ~= 2e-8``), traded for a large false-deny
 reduction. Allow-where-oracle-denied events therefore combine that CU
 effect with the *semantic* difference between sub-window-ring sliding and
-the reference's two-window weighting; the three-way comparison below
-separates the CMS-error component from the semantic component.
+the reference's two-window weighting; the three-way comparison separates
+the CMS-error component from the semantic component.
 
-Three-way comparison (each isolates one error source):
-* sketch (CMS, d x w)        — the system under test;
-* twin   (CMS, huge width)   — same sub-window semantics, no collisions:
-                               sketch-vs-twin disagreement == pure CMS error;
-* oracle (dense, exact)      — reference two-window sliding semantics:
-                               twin-vs-oracle disagreement == pure semantic
-                               resolution difference.
+The comparison core itself (sketch vs collision-free twin vs exact
+oracle, tally arithmetic, Wilson intervals) lives in
+``evaluation/compare.py`` — the SAME engine the live accuracy observatory
+(``observability/audit.py``, ADR-016) runs against a hash-sampled tap of
+serving traffic, so the offline bench and the online auditor can never
+disagree about what a false deny is. This module is the offline driver:
+a synthetic Zipf trace under virtual time.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
 from ratelimiter_tpu.core.clock import ManualClock
-from ratelimiter_tpu.core.config import Config, DenseParams, SketchParams
+from ratelimiter_tpu.core.config import Config, SketchParams
 from ratelimiter_tpu.core.types import Algorithm
+from ratelimiter_tpu.evaluation.compare import ShadowComparator
 
 
 def zipf_key_ids(n_keys: int, n_requests: int, alpha: float = 1.1,
@@ -60,9 +61,15 @@ class AccuracyReport:
     cms_false_denies_vs_twin: int    # sketch denied, twin allowed (pure CMS)
     cms_false_deny_rate: float
     semantic_disagreements: int      # twin vs oracle (resolution difference)
+    #: 95% Wilson interval on false_deny_rate (compare.wilson_interval) —
+    #: the same bound the live auditor reports, so bench JSONs and
+    #: /debug/audit quote comparable uncertainty.
+    false_deny_wilson95: Tuple[float, float] = (0.0, 1.0)
 
     def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["false_deny_wilson95"] = list(self.false_deny_wilson95)
+        return d
 
 
 def evaluate_accuracy(
@@ -79,7 +86,6 @@ def evaluate_accuracy(
 ) -> AccuracyReport:
     """Run the same batched trace through sketch / twin / exact-dense oracle
     under identical virtual time (requests arrive uniformly at request_rate)."""
-    from ratelimiter_tpu.algorithms.dense import DenseLimiter
     from ratelimiter_tpu.algorithms.sketch import SketchLimiter
     from ratelimiter_tpu.ops.hashing import splitmix64
 
@@ -87,60 +93,40 @@ def evaluate_accuracy(
     ids = zipf_key_ids(n_keys, n_requests, alpha, seed)
     hashes = splitmix64(ids)
 
-    base = dict(limit=limit, window=window, key_prefix="")
-    cfg_sketch = Config(algorithm=Algorithm.TPU_SKETCH, sketch=sketch, **base)
-    # Twin: identical sub-window semantics, collision-free width.
-    twin_width = max(sketch.width * 64, 1 << 22)
-    cfg_twin = Config(algorithm=Algorithm.TPU_SKETCH,
-                      sketch=dataclasses.replace(sketch, depth=1, width=twin_width),
-                      **base)
+    cfg_sketch = Config(algorithm=Algorithm.TPU_SKETCH, sketch=sketch,
+                        limit=limit, window=window, key_prefix="")
     # The oracle only needs a slot per *distinct* key that can appear in the
     # trace (slots are assigned on demand), not per key in the keyspace.
     oracle_cap = min(n_keys, n_requests) + 1
-    cfg_oracle = Config(algorithm=Algorithm.SLIDING_WINDOW,
-                        dense=DenseParams(capacity=oracle_cap), **base)
 
     t0 = 1_700_000_000.0
     lim_sketch = SketchLimiter(cfg_sketch, ManualClock(t0))
-    lim_twin = SketchLimiter(cfg_twin, ManualClock(t0)) if include_twin else None
-    lim_oracle = DenseLimiter(cfg_oracle, ManualClock(t0), capacity=oracle_cap)
+    # Twin: identical sub-window semantics, collision-free width; oracle:
+    # exact two-window sliding semantics (compare.ShadowComparator).
+    comparator = ShadowComparator(
+        cfg_sketch, include_twin=include_twin,
+        twin_width=max(sketch.width * 64, 1 << 22),
+        oracle_capacity=oracle_cap)
 
-    allows_sketch = np.empty(n_requests, dtype=bool)
-    allows_twin = np.empty(n_requests, dtype=bool)
-    allows_oracle = np.empty(n_requests, dtype=bool)
-
-    # The dense oracle's key->slot map is fed integer-formatted keys once.
     for start in range(0, n_requests, batch):
         end = min(start + batch, n_requests)
         now = t0 + start / request_rate
         h = hashes[start:end]
-        allows_sketch[start:end] = lim_sketch.allow_hashed(h, now=now).allowed
-        if lim_twin is not None:
-            allows_twin[start:end] = lim_twin.allow_hashed(h, now=now).allowed
-        keys = [f"k{i}" for i in ids[start:end]]
-        allows_oracle[start:end] = lim_oracle.allow_batch(keys, now=now).allowed
+        live = lim_sketch.allow_hashed(h, now=now).allowed
+        comparator.observe(h, None, now, live)
 
     lim_sketch.close()
-    if lim_twin is not None:
-        lim_twin.close()
-    lim_oracle.close()
+    comparator.close()
 
-    oracle_allows = int(allows_oracle.sum())
-    fd = int((allows_oracle & ~allows_sketch).sum())
-    fa = int((~allows_oracle & allows_sketch).sum())
-    if include_twin:
-        cms_fd = int((allows_twin & ~allows_sketch).sum())
-        twin_allows = int(allows_twin.sum())
-        sem = int((allows_twin != allows_oracle).sum())
-    else:
-        cms_fd, twin_allows, sem = 0, 0, 0
+    t = comparator.tally
     return AccuracyReport(
-        requests=n_requests,
-        oracle_allows=oracle_allows,
-        false_denies_vs_oracle=fd,
-        false_allows_vs_oracle=fa,
-        false_deny_rate=fd / max(1, oracle_allows),
-        cms_false_denies_vs_twin=cms_fd,
-        cms_false_deny_rate=cms_fd / max(1, twin_allows),
-        semantic_disagreements=sem,
+        requests=t.requests,
+        oracle_allows=t.oracle_allows,
+        false_denies_vs_oracle=t.false_denies_vs_oracle,
+        false_allows_vs_oracle=t.false_allows_vs_oracle,
+        false_deny_rate=t.false_deny_rate,
+        cms_false_denies_vs_twin=t.cms_false_denies_vs_twin,
+        cms_false_deny_rate=t.cms_false_deny_rate,
+        semantic_disagreements=t.semantic_disagreements,
+        false_deny_wilson95=t.false_deny_wilson(),
     )

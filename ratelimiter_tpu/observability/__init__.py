@@ -1,8 +1,9 @@
 """Observability: metrics registry + decorator wrappers (reference L4,
 ``docs/ADR/003-decorator-pattern-for-observability.md``) + the
-flight-recorder tracing subsystem (ADR-014, ``tracing.py``)."""
+flight-recorder tracing subsystem (ADR-014, ``tracing.py``) + the live
+accuracy observatory (ADR-016, ``audit.py``/``slo.py``)."""
 
-from ratelimiter_tpu.observability import tracing
+from ratelimiter_tpu.observability import audit, slo, tracing
 from ratelimiter_tpu.observability.metrics import (
     BATCH_BUCKETS,
     Counter,
@@ -35,5 +36,7 @@ __all__ = [
     "MetricsDecorator",
     "Registry",
     "TracingDecorator",
+    "audit",
+    "slo",
     "tracing",
 ]

@@ -319,6 +319,27 @@ class MetricsDecorator(LimiterDecorator):
         # device fetch under the backend lock, so the gauges refresh via
         # a scrape-time collect hook, never per decision. A sliced mesh
         # expands to its per-device slices, one series each.
+        # Top-K consumer surface (heavy-hitter side table, ADR-016 §5):
+        # promoted hot keys' exact in-window counts exported as ranked
+        # gauges — refreshed by the same scrape-time collect-hook seam
+        # as the debt slab (a K-slot device fetch per unit per scrape,
+        # never the decide path). Consumer identity goes to /healthz
+        # and /debug/audit as hash tokens; the gauge keys by RANK so
+        # label cardinality stays bounded.
+        self._hh_units = [
+            (i, sl) for i, sl in enumerate(base.sub_limiters())
+            if getattr(sl, "has_hh", False)]
+        if self._hh_units:
+            self._hh_top_g = reg.gauge(
+                "rate_limiter_top_consumer_mass",
+                "In-window admitted mass of the rank-N hottest tracked "
+                "consumer (heavy-hitter side table; identities on "
+                "/debug/audit)")
+            self._hh_occ_g = reg.gauge(
+                "rate_limiter_hh_tracked_consumers",
+                "Occupied heavy-hitter slots (promoted hot keys "
+                "currently tracked exactly)")
+            reg.add_collect_hook(self._collect_consumers)
         self._debt_slabs = [
             (i, sl) for i, sl in enumerate(base.sub_limiters())
             if hasattr(sl, "debt_slab_stats")]
@@ -343,12 +364,31 @@ class MetricsDecorator(LimiterDecorator):
             self._debt_coll_g.set(st["collision_p"],
                                   shard=self._shard, slice=str(i))
 
+    def _collect_consumers(self) -> None:
+        for i, sl in self._hh_units:
+            st = sl.consumer_stats(k=5)
+            self._hh_occ_g.set(float(st["occupied"]),
+                               shard=self._shard, slice=str(i))
+            top = st["top"]
+            # Every rank 1..5 is written each scrape: when the list
+            # SHRINKS (a hot key's window rolled off), the vacated
+            # ranks must drop to 0 — a gauge only overwrites label
+            # sets it is told to, so skipping them would leave phantom
+            # heavy hitters frozen at their last mass forever.
+            for rank in range(1, 6):
+                mass = (float(top[rank - 1]["in_window"])
+                        if rank <= len(top) else 0.0)
+                self._hh_top_g.set(mass, shard=self._shard,
+                                   slice=str(i), rank=str(rank))
+
     def close(self) -> None:
         # Unhook BEFORE closing: on the process-default registry a
         # leftover collect hook would pin this decorator (and the closed
         # backend's device arrays) forever and poke it on every scrape.
         if self._debt_slabs:
             self.registry.remove_collect_hook(self._collect_debt_slab)
+        if self._hh_units:
+            self.registry.remove_collect_hook(self._collect_consumers)
         super().close()
 
     def _observe_envelope(self) -> None:
@@ -775,31 +815,90 @@ class CircuitBreakerDecorator(LimiterDecorator):
 
 class LoggingDecorator(LimiterDecorator):
     """Structured logging wrapper (``docs/ADR/003:68-91``): decisions at
-    DEBUG, fail-open allowances at WARNING, errors at ERROR. Keys are
-    logged as given (the caller owns PII policy, as in the reference)."""
+    DEBUG, fail-open allowances at WARNING, errors at ERROR.
+
+    Keys on the scalar path are logged at the caller's discretion:
+    by default as given (the caller owns PII policy, as in the
+    reference), or — with ``redact_keys=True`` — as the splitmix64 hash
+    of the key's finalized u64 hash (``key#<16 hex>``), an irreversible
+    but stable token that still correlates log lines per key without
+    writing raw identifiers (user ids, API tokens, emails) into log
+    storage. The PII trust boundary is documented in
+    docs/OPERATIONS.md §6.
+
+    Fail-open WARNINGs carry ``fail_open_slices`` when the result
+    attributes the degradation (a quarantined mesh range, ADR-015), so
+    a degraded-range line is actionable — it names WHICH slice's key
+    range is answering fabricated allowances, not just that some frame
+    somewhere failed open.
+    """
 
     def __init__(self, inner: RateLimiter,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None, *,
+                 redact_keys: bool = False):
         super().__init__(inner)
         self.logger = logger if logger is not None else logging.getLogger(
             "ratelimiter_tpu")
         self._algo = str(inner.config.algorithm)
+        self.redact_keys = bool(redact_keys)
 
-    def _observe_result(self, op: str, res: Result, n: int, dt: float) -> None:
+    def _fmt_key(self, key: str) -> str:
+        if not self.redact_keys:
+            return key
+        from ratelimiter_tpu.ops.hashing import hash_strings_u64, splitmix64
+
+        # Hash-of-hash: hash_strings_u64 feeds decisions and wire
+        # routing, so its raw value is quasi-public; the extra splitmix
+        # keeps log tokens uncorrelatable with routing hashes.
+        return f"key#{int(splitmix64(hash_strings_u64([key]))[0]):016x}"
+
+    @staticmethod
+    def _fo_slices(res) -> str:
+        attr = getattr(res, "fail_open_slices", None)
+        return f" fail_open_slices={sorted(attr)}" if attr else ""
+
+    # Scalar path: overridden (not just hooked) so the KEY is in scope
+    # for the log line — the base hooks deliberately do not carry it.
+
+    def allow_n(self, key: str, n: int, *,
+                now: Optional[float] = None) -> Result:
+        t0 = time.perf_counter()
+        try:
+            res = self.inner.allow_n(key, n, now=now)
+        except Exception as exc:
+            self._observe_error("allow_n", exc, time.perf_counter() - t0)
+            raise
+        dt = time.perf_counter() - t0
         if res.fail_open:
             self.logger.warning(
-                "fail-open allowance algorithm=%s n=%d latency=%.6f",
-                self._algo, n, dt)
+                "fail-open allowance algorithm=%s key=%s n=%d "
+                "latency=%.6f%s",
+                self._algo, self._fmt_key(key), n, dt, self._fo_slices(res))
         elif self.logger.isEnabledFor(logging.DEBUG):
             self.logger.debug(
-                "decision algorithm=%s allowed=%s n=%d remaining=%d latency=%.6f",
-                self._algo, res.allowed, n, res.remaining, dt)
+                "decision algorithm=%s key=%s allowed=%s n=%d remaining=%d "
+                "latency=%.6f",
+                self._algo, self._fmt_key(key), res.allowed, n,
+                res.remaining, dt)
+        return res
+
+    def reset(self, key: str) -> None:
+        # Quota-erase is audit-worthy: always logged, same redaction.
+        t0 = time.perf_counter()
+        try:
+            self.inner.reset(key)
+        except Exception as exc:
+            self._observe_error("reset", exc, time.perf_counter() - t0)
+            raise
+        self.logger.info("reset algorithm=%s key=%s latency=%.6f",
+                         self._algo, self._fmt_key(key),
+                         time.perf_counter() - t0)
 
     def _observe_batch(self, op: str, out: BatchResult, ns, dt: float) -> None:
         if out.fail_open:
             self.logger.warning(
-                "fail-open batch algorithm=%s size=%d latency=%.6f",
-                self._algo, len(out), dt)
+                "fail-open batch algorithm=%s size=%d latency=%.6f%s",
+                self._algo, len(out), dt, self._fo_slices(out))
         elif self.logger.isEnabledFor(logging.DEBUG):
             self.logger.debug(
                 "batch algorithm=%s size=%d allowed=%d latency=%.6f",

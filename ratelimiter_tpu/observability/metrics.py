@@ -83,6 +83,26 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def total(self, **labels_filter: str) -> float:
+        """Sum over every label set matching the (partial) filter —
+        the SLO burn-rate tracker's family-wide read (observability/
+        slo.py): e.g. a shed counter labeled per door sums to one
+        bad-event count."""
+        with self._lock:
+            out = 0.0
+            for key, v in self._values.items():
+                kd = dict(key)
+                if all(kd.get(k) == v2 for k, v2 in labels_filter.items()):
+                    out += v
+            return out
+
+    def labeled_values(self) -> list[tuple[tuple, float]]:
+        """Locked snapshot of (label_key, value) pairs — for consumers
+        that must inspect label VALUES (the burn tracker matches
+        ``result=error:*`` prefixes)."""
+        with self._lock:
+            return list(self._values.items())
+
     def render(self, om: bool = False) -> list[str]:
         # OpenMetrics requires the counter FAMILY name without the
         # `_total` suffix (HELP/TYPE lines) while the sample keeps it —
@@ -173,6 +193,31 @@ class Histogram(_Metric):
     def sum(self, **labels: str) -> float:
         with self._lock:
             return self._sums.get(_label_key(labels), 0.0)
+
+    def counts_over(self, threshold: float,
+                    **labels_filter: str) -> tuple[int, int, float]:
+        """(total, over, effective_threshold) across every label set
+        matching the (partial) filter: how many observations landed
+        STRICTLY above the largest bucket bound <= ``threshold``.
+        Cumulative buckets only resolve at bucket bounds, so the
+        threshold snaps DOWN to one (returned as effective_threshold;
+        pessimistic — borderline observations count as slow). The SLO
+        burn-rate tracker derives its latency axis from this
+        (observability/slo.py)."""
+        from bisect import bisect_right
+
+        idx = bisect_right(self.buckets, threshold)
+        eff = self.buckets[idx - 1] if idx > 0 else 0.0
+        total = over = 0
+        with self._lock:
+            for key, counts in self._counts.items():
+                kd = dict(key)
+                if not all(kd.get(k) == v for k, v in labels_filter.items()):
+                    continue
+                s = sum(counts)
+                total += s
+                over += s - sum(counts[:idx])
+        return total, over, eff
 
     def render(self, om: bool = False) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",

@@ -30,6 +30,19 @@ def hash_strings_u64(keys: Sequence[str]) -> np.ndarray:
     return bulk_hash_u64(keys)
 
 
+def hash_prefixed_u64(keys: Sequence[str], prefix: str = "") -> np.ndarray:
+    """THE key→hash rule: namespace prefix (exactly as it namespaces
+    Redis keys in the reference, ``config.go:81-87``) then the bulk
+    hash. One definition shared by the sketch backends
+    (SketchLimiter._hash) and the audit tap's string lane
+    (observability/audit.py) — if the formatting rule ever changes,
+    both move together, or string-lane audit hashes would silently
+    diverge from serving hashes."""
+    if prefix:
+        keys = [f"{prefix}:{k}" for k in keys]
+    return bulk_hash_u64(keys)
+
+
 def splitmix64(x: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64 finalizer: uniform 64-bit mixing of integer ids."""
     x = np.asarray(x, dtype=np.uint64).copy()

@@ -370,6 +370,10 @@ class SlicedMeshLimiter(RateLimiter):
                                                arrays, ns))]
             t.b = b
             t.limit = self.config.limit
+            # Launch-time decision timestamp (the audit tap mirrors the
+            # frame with the now it was DECIDED at, not resolve time —
+            # ADR-016).
+            t.t_sec = now
             return t
         # One argsort partitions the whole frame; per-slice position
         # arrays come out contiguous (stable sort keeps frame order
@@ -391,6 +395,7 @@ class SlicedMeshLimiter(RateLimiter):
                                               arrays[pos], ns[pos])))
         t.b = b
         t.limit = self.config.limit
+        t.t_sec = now
         # Wire frames reassemble device-packed buffers at resolve (the
         # scatter-back path) — only meaningful on the raw-id lane, the
         # one surface whose sub-launches pack on device.

@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--sketch-depth", type=int, default=4)
     ap.add_argument("--sketch-width", type=int, default=65536)
     ap.add_argument("--sub-windows", type=int, default=60)
+    ap.add_argument("--hh-slots", type=int, default=0,
+                    help="heavy-hitter side table slots (0 = off; power "
+                         "of two >= 16): promoted hot keys get exact "
+                         "private counters, and the observatory exports "
+                         "them as top-K consumer analytics "
+                         "(/healthz consumers, /debug/audit, "
+                         "rate_limiter_top_consumer_mass)")
     ap.add_argument("--kernels", default="auto",
                     choices=("auto", "pallas", "jnp"),
                     help="sketch hot-loop kernels (ADR-011): fused Pallas "
@@ -157,6 +164,40 @@ def build_parser() -> argparse.ArgumentParser:
                          "only, like every other token")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the MetricsDecorator (on by default)")
+    # Live accuracy observatory (ADR-016).
+    ap.add_argument("--audit", action="store_true",
+                    help="turn on the live accuracy observatory "
+                         "(ADR-016): a deterministic hash-sampled "
+                         "fraction of live decisions is mirrored into "
+                         "an exact shadow oracle off the hot path; live "
+                         "false-deny/false-allow rates with Wilson "
+                         "bounds land on /metrics, /healthz, and "
+                         "GET /debug/audit, plus the admission-SLO "
+                         "burn-rate block. Needs a sketch-family "
+                         "backend. Off by default = byte-identical hot "
+                         "path")
+    ap.add_argument("--audit-sample", type=int, default=64,
+                    help="audit 1 in N of the keyspace (hash-coherent: "
+                         "a key is always or never audited, so its "
+                         "windows stay whole; 1 audits everything)")
+    ap.add_argument("--audit-token", default=None,
+                    help="bearer token required by GET /debug/audit "
+                         "(Authorization header only, like every other "
+                         "token; without it the endpoint is open "
+                         "whenever --audit is set)")
+    ap.add_argument("--audit-twin", action="store_true",
+                    help="also run the collision-free CMS twin online, "
+                         "separating pure-CMS collision error from "
+                         "semantic error in the live stream. COSTS a "
+                         "jitted shadow dispatch per audited frame "
+                         "(measured ~15-20%% of a CPU box's serving "
+                         "throughput — ADR-016 §3), so it is off by "
+                         "default; the offline bench always runs the "
+                         "split (accuracy_three_way)")
+    ap.add_argument("--log-redact-keys", action="store_true",
+                    help="with --log-decisions: log splitmix64 hashes "
+                         "instead of raw keys (the PII trust boundary, "
+                         "docs/OPERATIONS.md §6)")
     # Cross-pod DCN exchange (parallel/dcn.py over serving/dcn_peer.py).
     ap.add_argument("--dcn-peer", action="append", default=[],
                     metavar="HOST:PORT",
@@ -259,7 +300,8 @@ def build_limiter_stack(limiter, args, shard: int = 0):
     if not args.no_metrics:
         limiter = MetricsDecorator(limiter, shard=str(shard))
     if args.log_decisions:
-        limiter = LoggingDecorator(limiter)
+        limiter = LoggingDecorator(
+            limiter, redact_keys=getattr(args, "log_redact_keys", False))
     return limiter
 
 
@@ -312,6 +354,63 @@ def _debt_slab_health(limiters) -> dict:
         "nonzero_cells": sum(s["nonzero_cells"] for s in stats),
         "cells": sum(s["cells"] for s in stats),
         "units": len(stats)}}
+
+
+def _consumers_health(limiters, k: int = 10) -> dict:
+    """Top-K consumer block for /healthz (heavy-hitter side table,
+    ADR-016 §5): per-unit consumer_stats merged across dispatch shards /
+    mesh slices — a consumer lives on exactly one slice (keys
+    hash-route), so the merged ranking is a straight sort over the
+    union. Consumer identities are hash tokens, never raw keys
+    (OPERATIONS §6). Empty when no unit runs an hh table."""
+    from ratelimiter_tpu.observability.decorators import undecorated
+
+    lims = [undecorated(lim) for lim in limiters]
+    lims = [sl for lim in lims for sl in lim.sub_limiters()]
+    units = [(i, lim) for i, lim in enumerate(lims)
+             if getattr(lim, "has_hh", False)]
+    if not units:
+        return {}
+    rows = []
+    occupied = slots = mass = 0
+    for i, lim in units:
+        st = lim.consumer_stats(k=k)
+        slots += st["slots"]
+        occupied += st["occupied"]
+        mass += st.get("tracked_mass", 0)
+        for row in st["top"]:
+            rows.append({**row, "slice": i})
+    rows.sort(key=lambda r: -r["in_window"])
+    return {"consumers": {
+        "slots": slots,
+        "occupied": occupied,
+        "tracked_mass": mass,
+        "top": rows[:k]}}
+
+
+def _audit_health() -> dict:
+    """Audit envelope for /healthz: the observatory's headline numbers
+    (rates + confidence + drop counters); the full per-slice breakdown
+    lives on GET /debug/audit."""
+    from ratelimiter_tpu.observability import audit
+
+    aud = audit.AUDITOR
+    if aud is None:
+        return {}
+    st = aud.status()
+    return {"audit": {
+        "sample": st["sample"],
+        "samples": st["samples"],
+        "false_deny_rate": st["false_deny_rate"],
+        "false_deny_wilson95": st["false_deny_wilson95"],
+        "false_allow_rate": st["false_allow_rate"],
+        "fail_open_samples": st["fail_open_samples"],
+        "dropped_decisions": st["dropped_decisions"],
+        "oracle_errors": st["oracle_errors"]}}
+
+
+def _slo_health(slo) -> dict:
+    return {"slo": slo.status()} if slo is not None else {}
 
 
 def make_threadsafe_decide(batcher, loop):
@@ -435,6 +534,7 @@ async def amain(args) -> None:
         fail_open=args.fail_open,
         sketch=SketchParams(depth=args.sketch_depth, width=args.sketch_width,
                             sub_windows=args.sub_windows,
+                            hh_slots=args.hh_slots,
                             kernels=args.kernels),
         persistence=PersistenceSpec(
             dir=args.snapshot_dir,
@@ -548,6 +648,47 @@ async def amain(args) -> None:
         if slices is not None:
             for i, s in enumerate(slices[1:], start=1):
                 _prewarm(s, args.max_batch)
+    # Live accuracy observatory (ADR-016): shadow-oracle auditor + SLO
+    # burn tracker, installed BEFORE serving starts so the first
+    # decision can already be mirrored. Audit off = the doors' taps are
+    # one None check (byte-identical hot path).
+    auditor = None
+    slo_tracker = None
+    if args.audit:
+        if args.backend not in ("sketch", "mesh"):
+            raise SystemExit("--audit needs a sketch-family backend "
+                             "(exact/dense decisions are already exact — "
+                             "there is nothing to audit)")
+        from ratelimiter_tpu.observability import audit as audit_mod
+        from ratelimiter_tpu.observability.decorators import (
+            undecorated as _undec,
+        )
+        from ratelimiter_tpu.observability.slo import SloBurnTracker
+
+        n_sl = (len(slices) if slices is not None
+                else len(_undec(limiter).sub_limiters()))
+        auditor = audit_mod.enable(cfg, sample=args.audit_sample,
+                                   n_slices=n_sl,
+                                   include_twin=args.audit_twin,
+                                   registry=obs_metrics.DEFAULT,
+                                   # Follow runtime update_limit/window
+                                   # (the decorator's config property
+                                   # reflects the backend live).
+                                   live_config=lambda: limiter.config)
+        slo_tracker = SloBurnTracker(obs_metrics.DEFAULT)
+        slo_tracker.attach()
+
+    def make_audit_status(lims):
+        """GET /debug/audit payload: rates + confidence + attribution,
+        top-K consumers, SLO burn block — one JSON for the operator."""
+        def _status() -> dict:
+            out = auditor.status() if auditor is not None else {}
+            out.update(_consumers_health(lims))
+            out.update(_slo_health(slo_tracker))
+            return out
+
+        return _status
+
     dcn_secret = (args.dcn_secret
                   or os.environ.get("RATELIMITER_TPU_DCN_SECRET") or None)
     http_reset = bool(args.http_reset or args.http_reset_token)
@@ -634,6 +775,9 @@ async def amain(args) -> None:
                                     server.shard_limiters[0].override_count(),
                                 **_envelope_health(server.shard_limiters),
                                 **_debt_slab_health(server.shard_limiters),
+                                **_consumers_health(server.shard_limiters),
+                                **_audit_health(),
+                                **_slo_health(slo_tracker),
                                 **({"quarantine": qmgr.status()}
                                    if qmgr is not None else {}),
                                 **(persist.status() if persist else {})},
@@ -648,7 +792,10 @@ async def amain(args) -> None:
                 snapshot=(persist.snapshot_now if persist else None),
                 snapshot_token=args.http_snapshot_token,
                 enable_debug=http_debug,
-                debug_token=args.debug_token)
+                debug_token=args.debug_token,
+                audit_status=(make_audit_status(server.shard_limiters)
+                              if args.audit else None),
+                audit_token=args.audit_token)
             gateway.start()
         grpc_srv = None
         if args.grpc_port is not None:
@@ -692,6 +839,13 @@ async def amain(args) -> None:
             server.close_shards()
         else:
             server.shutdown()
+        if auditor is not None:
+            from ratelimiter_tpu.observability import audit as audit_mod
+
+            auditor.flush(timeout=2.0)
+            audit_mod.disable()
+        if slo_tracker is not None:
+            slo_tracker.detach()
         limiter.close()
         return
     if args.shards > 1:
@@ -751,6 +905,9 @@ async def amain(args) -> None:
                             "policy_overrides": limiter.override_count(),
                             **_envelope_health([limiter]),
                             **_debt_slab_health([limiter]),
+                            **_consumers_health([limiter]),
+                            **_audit_health(),
+                            **_slo_health(slo_tracker),
                             **({"quarantine": qmgr.status()}
                                if qmgr is not None else {}),
                             **(persist.status() if persist else {})},
@@ -764,7 +921,10 @@ async def amain(args) -> None:
             snapshot=(persist.snapshot_now if persist else None),
             snapshot_token=args.http_snapshot_token,
             enable_debug=http_debug,
-            debug_token=args.debug_token)
+            debug_token=args.debug_token,
+            audit_status=(make_audit_status([limiter])
+                          if args.audit else None),
+            audit_token=args.audit_token)
         gateway.start()
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
@@ -801,6 +961,13 @@ async def amain(args) -> None:
         # After drain, before close: the final snapshot captures every
         # answered decision — a graceful shutdown loses nothing.
         persist.stop()
+    if auditor is not None:
+        from ratelimiter_tpu.observability import audit as audit_mod
+
+        auditor.flush(timeout=2.0)
+        audit_mod.disable()
+    if slo_tracker is not None:
+        slo_tracker.detach()
     limiter.close()
 
 

@@ -56,8 +56,8 @@ from ratelimiter_tpu.core.types import (
     batch_fail_open,
     fail_open_result,
 )
+from ratelimiter_tpu.observability import audit, tracing
 from ratelimiter_tpu.observability import metrics as m
-from ratelimiter_tpu.observability import tracing
 
 
 class MicroBatcher:
@@ -165,6 +165,13 @@ class MicroBatcher:
         self._slo_breaches = reg.counter(
             "rate_limiter_server_slo_breaches_total",
             "Dispatches that exceeded dispatch_timeout")
+        self._slo_breach_decisions = reg.counter(
+            "rate_limiter_server_slo_breach_decisions_total",
+            "Decisions answered by SLO-breach policy (fail-open/closed) "
+            "instead of a device result — the DECISION-unit form of "
+            "slo_breaches_total (one breached frame is up to max_batch "
+            "of these; the burn tracker's availability axis consumes "
+            "this one, ADR-016)")
         self._deadline_shed = reg.counter(
             "rate_limiter_server_deadline_shed_total",
             "Decisions shed because their propagated deadline expired "
@@ -467,6 +474,11 @@ class MicroBatcher:
         self._dispatch_batch.observe(float(b))
         loop = asyncio.get_running_loop()
         t_q = tracing.now() if tracing.RECORDER is not None else 0
+        # Audit timestamp fallback, captured at dispatch entry (the
+        # pipelined ticket's launch-time t_sec is preferred below).
+        t_tap = (self.limiter.clock.now() if audit.AUDITOR is not None
+                 else 0.0)
+        ticket = None
         t0 = time.perf_counter()
         if self._pipelined and self._hashed_lane:
             try:
@@ -503,6 +515,7 @@ class MicroBatcher:
             # Same SLO-breach policy as the string path (ADR-002 at the
             # dispatch layer): answer NOW per fail-open/closed.
             self._slo_breaches.inc()
+            self._slo_breach_decisions.inc(b)
             cfg = self.limiter.config
             if cfg.fail_open:
                 reset_at = self.limiter.clock.now() + float(cfg.window)
@@ -515,10 +528,35 @@ class MicroBatcher:
                     f"({self.dispatch_timeout * 1e3:.1f} ms)")
                 if not fut.done():
                     fut.set_exception(err)
-            work.add_done_callback(lambda f: f.exception())
+            # The shielded device call still lands and CONSUMES the
+            # frame's sketch mass — mirror its eventual result into the
+            # audit tap (ADR-016) so audited keys' shadow timelines
+            # don't develop holes that read as false denies later; the
+            # callback also keeps the un-awaited error from leaking.
+            t_dec = getattr(ticket, "t_sec", 0.0) or t_tap
+
+            def _late_tap(f, _ids=ids, _ns=ns, _t=t_dec):
+                if f.exception() is not None:
+                    return
+                aud = audit.AUDITOR
+                if aud is not None:
+                    aud.offer_ids(_ids, _ns, _t, f.result())
+
+            work.add_done_callback(_late_tap)
             return
 
         self.decisions_total += b
+        # Live accuracy tap (ADR-016): mirror the resolved frame into
+        # the shadow-oracle queue — one None check when auditing is off
+        # (byte-identical hot path, same seam as tracing.RECORDER), one
+        # bounded-queue append of existing references when on. Sampling
+        # and hashing happen on the audit worker, never here. The
+        # timestamp is the LAUNCH-time now the sketch decided with
+        # (ticket.t_sec), not resolve time.
+        aud = audit.AUDITOR
+        if aud is not None:
+            aud.offer_ids(ids, ns,
+                          getattr(ticket, "t_sec", 0.0) or t_tap, out)
         if not fut.done():
             fut.set_result(out)
 
@@ -712,6 +750,9 @@ class MicroBatcher:
         self._dispatch_batch.observe(float(len(batch)))
         loop = asyncio.get_running_loop()
         t_q = tracing.now() if tracing.RECORDER is not None else 0
+        t_tap = (self.limiter.clock.now() if audit.AUDITOR is not None
+                 else 0.0)
+        ticket = None
         t0 = time.perf_counter()
         if self._pipelined:
             # Launch/resolve split (ADR-010): the launch executor stages
@@ -755,6 +796,7 @@ class MicroBatcher:
             # device call keeps running so state converges; waiters are
             # answered NOW by policy.
             self._slo_breaches.inc()
+            self._slo_breach_decisions.inc(len(batch))
             cfg = self.limiter.config
             if cfg.fail_open:
                 reset_at = self.limiter.clock.now() + float(cfg.window)
@@ -768,11 +810,31 @@ class MicroBatcher:
                 for _, _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(err)
-            # Keep the eventual result from leaking an un-awaited error.
-            work.add_done_callback(lambda f: f.exception())
+            # The shielded call still consumes the frame's sketch mass:
+            # mirror its eventual result into the audit tap so shadow
+            # timelines stay whole (ADR-016); also keeps the un-awaited
+            # error from leaking.
+            t_dec = getattr(ticket, "t_sec", 0.0) or t_tap
+
+            def _late_tap(f, _keys=keys, _ns=ns, _t=t_dec):
+                if f.exception() is not None:
+                    return
+                aud = audit.AUDITOR
+                if aud is not None:
+                    aud.offer_keys(_keys, _ns, _t, f.result())
+
+            work.add_done_callback(_late_tap)
             return
 
         self.decisions_total += len(batch)
+        # Live accuracy tap (ADR-016): string-lane frames mirror BEFORE
+        # the per-request split (the worker hashes with the limiter's
+        # prefix rule), stamped with the launch-time now; audit-off is
+        # one None check.
+        aud = audit.AUDITOR
+        if aud is not None:
+            aud.offer_keys(keys, ns,
+                           getattr(ticket, "t_sec", 0.0) or t_tap, out)
         for i, (_, _, fut, _) in enumerate(batch):
             if not fut.done():
                 fut.set_result(out.result(i))

@@ -28,6 +28,14 @@ anything without the binary protocol) get drop-in rate limiting:
                                         /v1/policy, off by default)
     GET/POST /debug/profile?seconds=N -> on-demand jax.profiler capture
                                         (same gate; one at a time)
+    GET      /debug/audit            -> live accuracy observatory JSON
+                                        (ADR-016): false-deny/allow
+                                        rates with Wilson bounds, top-K
+                                        consumers, SLO burn rate,
+                                        dropped-sample counts. Wired
+                                        only when auditing is on
+                                        (--audit); bearer-gated via
+                                        --audit-token
 
 Reset is a quota-erase lever and the policy endpoint is a quota-GRANT
 lever, so on a broad plain-HTTP surface both are bypass risks: the
@@ -116,7 +124,9 @@ class HttpGateway:
                  snapshot: Optional[Callable[[], dict]] = None,
                  snapshot_token: Optional[str] = None,
                  enable_debug: bool = False,
-                 debug_token: Optional[str] = None):
+                 debug_token: Optional[str] = None,
+                 audit_status: Optional[Callable[[], dict]] = None,
+                 audit_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -269,6 +279,31 @@ class HttpGateway:
                 self._send(200, {"ok": True, "dir": out_dir,
                                  "seconds": seconds, "files": files})
 
+            def _handle_debug_audit(self) -> None:
+                """Live accuracy observatory snapshot (ADR-016): the
+                auditor's rates + confidence + attribution, top-K
+                consumer analytics, and the SLO burn-rate block. Top-K
+                rows expose consumer HASH tokens (never raw keys), but
+                traffic shape is still reconnaissance-grade — so the
+                endpoint exists only when auditing is on and honors its
+                own bearer token (header only, like every other
+                token)."""
+                if gateway.audit_status is None:
+                    self._send(403, {"error": "the accuracy observatory "
+                                     "is not enabled on this server "
+                                     "(--audit)"})
+                    return
+                if not self._bearer_ok(gateway.audit_token):
+                    self._send(403, {"error": "bad audit token"})
+                    return
+                try:
+                    self._send(200, gateway.audit_status())
+                except Exception as exc:  # noqa: BLE001 — a flaky shadow
+                    # leg must degrade the debug surface, never the conn.
+                    log.exception("debug audit status failed")
+                    self._send(503, {"error": f"audit status unavailable: "
+                                     f"{exc}"})
+
             def _handle(self):
                 # Drain any request body first: HTTP/1.1 keep-alive means
                 # unread body bytes would be parsed as the next request
@@ -385,6 +420,8 @@ class HttpGateway:
                         self._handle_debug_trace()
                     elif url.path == "/debug/profile":
                         self._handle_debug_profile(q)
+                    elif url.path == "/debug/audit":
+                        self._handle_debug_audit()
                     elif url.path == "/healthz":
                         self._send(200, gateway.health())
                     elif url.path == "/metrics":
@@ -447,6 +484,9 @@ class HttpGateway:
         # like /v1/policy (explicit opt-in + header-only bearer).
         self.enable_debug = bool(enable_debug)
         self.debug_token = debug_token
+        # Accuracy observatory (ADR-016): wired iff auditing is on.
+        self.audit_status = audit_status
+        self.audit_token = audit_token
         self._profile_lock = threading.Lock()
         self._decide_trace = _accepts_trace(decide)
         self._decide_deadline = _accepts_kw(decide, "deadline")

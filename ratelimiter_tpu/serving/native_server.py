@@ -35,8 +35,8 @@ from ratelimiter_tpu.core.errors import (
     InvalidKeyError,
     InvalidNError,
 )
+from ratelimiter_tpu.observability import audit, tracing
 from ratelimiter_tpu.observability import metrics as m
-from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
 
 
@@ -336,12 +336,24 @@ class NativeRateLimitServer:
                 lengths_b: bytes, ns_b: bytes, trace_id: int = 0):
         b = len(offsets_b) // 8
         lim = self._shard_limiters[shard]
+        aud = audit.AUDITOR
+        # Decision timestamp captured BEFORE the decide (the backend
+        # reads its clock at launch; a post-decide read would lag by the
+        # dispatch) — audit-off skips even this.
+        t_dec = lim.clock.now() if aud is not None else 0.0
         try:
             if self._fast:
                 h64, ns = self._hash_buffers(blob, offsets_b, lengths_b,
                                              ns_b)
                 with self._locks[shard]:
                     out = lim.allow_hashed(h64, ns)
+                # Live accuracy tap (ADR-016): h64 is the finalized
+                # string hash (prefix already applied by the C++ blob
+                # builder), so the hashed offer is exact; off = one
+                # None check.
+                if aud is not None:
+                    aud.offer_hashed(h64, ns, t_dec, out,
+                                     slice_idx=shard)
             else:
                 offsets = np.frombuffer(offsets_b, dtype=np.int64)
                 lengths = np.frombuffer(lengths_b, dtype=np.int64)
@@ -350,6 +362,9 @@ class NativeRateLimitServer:
                         for o, l in zip(offsets.tolist(), lengths.tolist())]
                 with self._locks[shard]:
                     out = lim.allow_batch(keys, ns.tolist())
+                if aud is not None:
+                    aud.offer_keys(keys, ns, t_dec, out,
+                                   slice_idx=shard)
         except (InvalidNError, InvalidKeyError) as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         except Exception as exc:
@@ -364,6 +379,8 @@ class NativeRateLimitServer:
         allow_hashed's staging memcpy; zero host hash math."""
         b = len(ids_b) // 8
         lim = self._shard_limiters[shard]
+        aud = audit.AUDITOR
+        t_dec = lim.clock.now() if aud is not None else 0.0
         try:
             h64 = np.frombuffer(ids_b, dtype=np.uint64)
             ns = np.frombuffer(ns_b, dtype=np.int64)
@@ -371,6 +388,11 @@ class NativeRateLimitServer:
                 out = lim.allow_hashed(h64, ns)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
+        # Tap (ADR-016): the C++ io thread already ran splitmix64, so
+        # these ARE finalized hashes (offer_hashed, not offer_ids). The
+        # frombuffer view pins the bytes object — no copy.
+        if aud is not None:
+            aud.offer_hashed(h64, ns, t_dec, out, slice_idx=shard)
         self._batch_hist.observe(float(b))
         return self._pack_result(out)
 
@@ -388,6 +410,10 @@ class NativeRateLimitServer:
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         ticket.trace_id = trace_id
+        if audit.AUDITOR is not None:
+            # Pin the frame's hashes to the ticket so _resolve can tap
+            # (ADR-016); the frombuffer views keep the bytes alive.
+            ticket.audit = (h64, ns)
         with self._depth_lock:
             self._depth += 1
             self._inflight_gauge.set(float(self._depth))
@@ -409,6 +435,8 @@ class NativeRateLimitServer:
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         ticket.trace_id = trace_id
+        if audit.AUDITOR is not None:
+            ticket.audit = (h64, ns)
         with self._depth_lock:
             self._depth += 1
             self._inflight_gauge.set(float(self._depth))
@@ -429,6 +457,20 @@ class NativeRateLimitServer:
             with self._depth_lock:
                 self._depth -= 1
                 self._inflight_gauge.set(float(self._depth))
+        aud = audit.AUDITOR
+        if aud is not None and ticket.audit is not None:
+            # Tap on the completer thread (ADR-016): shard-resolve order
+            # is launch order, so the shadow oracle sees each shard's
+            # (and thus each key's) timeline in decision order. The
+            # timestamp is the ticket's LAUNCH-time now — the one the
+            # sketch decided with — not resolve time: under a deep
+            # in-flight window the skew would otherwise span sub-window
+            # boundaries and read as tap-induced false denies.
+            h64, ns = ticket.audit
+            aud.offer_hashed(h64, ns,
+                             getattr(ticket, "t_sec", 0.0)
+                             or lim.clock.now(),
+                             out, slice_idx=shard)
         self._resolve_hist.observe(time.perf_counter() - t0)
         self._batch_hist.observe(float(len(out)))
         return self._pack_result(out)
@@ -518,12 +560,19 @@ class NativeRateLimitServer:
                 "request deadline expired before dispatch")
         shard = self.shard_of(key)
         rec = tracing.RECORDER
+        aud = audit.AUDITOR
+        t_dec = (self._shard_limiters[shard].clock.now()
+                 if aud is not None else 0.0)
         t0 = tracing.now() if rec is not None else 0
         with self._locks[shard]:
             res = self._shard_limiters[shard].allow_n(key, n)
         if rec is not None:
             rec.record("device", t0, tracing.now(), trace_id=trace_id,
                        shard=shard)
+        if aud is not None:
+            # HTTP/gRPC side-door decisions join the audit stream too
+            # (ADR-016) — the worker normalizes the scalar Result.
+            aud.offer_keys([key], [n], t_dec, res, slice_idx=shard)
         return res
 
     def reset_one(self, key: str) -> None:
