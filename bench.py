@@ -907,7 +907,28 @@ def main() -> None:
                          "multichip_scaling curve (device step rate + e2e "
                          "serving rate per count). On CPU this forces N "
                          "virtual host devices")
+    ap.add_argument("--fleet-hosts", type=int, default=None, metavar="N",
+                    help="run ONLY the fleet scale-out bench (ADR-017) "
+                         "over N real server processes and emit the "
+                         "fleet_scaling JSON block: single-host "
+                         "baseline, N-host affine, N-host mixed with "
+                         "the measured forwarded fraction, and the "
+                         "kill -9 failover row (the multi-HOST sibling "
+                         "of --mesh-devices' multichip_scaling)")
     args = ap.parse_args()
+
+    if args.fleet_hosts:
+        from benchmarks.fleet import run_fleet_scaling
+
+        print(json.dumps({
+            "metric": "fleet_scaling",
+            "platform": jax.devices()[0].platform,
+            "fleet_scaling": run_fleet_scaling(
+                max(2, args.fleet_hosts),
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                log=lambda *a: print(*a, file=sys.stderr)),
+        }))
+        return
 
     if args.audit:
         platform = jax.devices()[0].platform

@@ -1,0 +1,405 @@
+"""Fleet scale-out benchmark (ADR-017): the ``fleet_scaling`` block.
+
+Topology per row: N real ``python -m ratelimiter_tpu.serving`` fleet
+members (asyncio door, sketch backend) + one LOADGEN PROCESS per member
+(multiprocessing — the Python client must scale with the fleet or the
+measurement caps at one interpreter's throughput). Each loadgen process
+drives its HOME host with pipelined raw-id frames (the zero-copy hashed
+lane) over several connections.
+
+The ``spread`` knob is the fleet mirror of the ADR-013 slice-spread
+knob: each connection's ids are drawn from the bucket ranges of
+``spread`` hosts starting at its home host. spread=1 is pure host-affine
+traffic (what a consistent-hash LB or FleetClient produces — zero
+forwarding); spread=N is uniform mixed traffic, so roughly (N-1)/N of
+every frame is mis-routed and exercises the server-side forwarder. The
+measured forwarded fraction is read back from the members'
+``rate_limiter_fleet_forwarded_decisions_total`` counters, not assumed.
+
+Rows: single-host baseline, N-host affine, N-host mixed (with forwarded
+fraction), plus a kill -9 failover row (recovery window + override
+exactness + bounded counter loss). Published as FLEET_r01.json via
+``bench.py --fleet-hosts N``.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _fleet_config_dict(ports: List[int], buckets: int,
+                       snap_dirs: Optional[List[str]] = None) -> dict:
+    n = len(ports)
+    per = buckets // n
+    hosts = []
+    for i, port in enumerate(ports):
+        lo = i * per
+        hi = buckets if i == n - 1 else (i + 1) * per
+        h = {"id": f"h{i}", "host": "127.0.0.1", "port": port,
+             "ranges": [[lo, hi]],
+             "successor": f"h{(i + 1) % n}" if n > 1 else None}
+        if h["successor"] is None:
+            del h["successor"]
+        if snap_dirs:
+            h["snapshot_dir"] = snap_dirs[i]
+        hosts.append(h)
+    return {"buckets": buckets, "epoch": 1, "hosts": hosts}
+
+
+def _spawn_member(port: int, cfgpath: str, self_id: str, *,
+                  snap: Optional[str] = None,
+                  max_batch: int = 8192) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    argv = [sys.executable, "-m", "ratelimiter_tpu.serving",
+            "--backend", "sketch", "--limit", "100", "--window", "60",
+            "--max-batch", str(max_batch), "--max-delay-us", "500",
+            "--inflight", "4", "--port", str(port),
+            "--fleet-config", cfgpath, "--fleet-self", self_id,
+            "--fleet-forward-deadline", "60",
+            "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5"]
+    if snap:
+        argv += ["--snapshot-dir", snap, "--snapshot-interval", "500"]
+    return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+
+
+def _wait_members(members: List[subprocess.Popen],
+                  timeout: float = 300.0) -> None:
+    """Block until EVERY member printed its serving banner. Members are
+    spawned first, awaited second, so they prewarm CONCURRENTLY — the
+    membership boot grace assumes roughly simultaneous starts."""
+    deadline = time.time() + timeout
+    for proc in members:
+        while True:
+            if time.time() > deadline:
+                raise RuntimeError("fleet member start timed out")
+            line = proc.stdout.readline()
+            if not line:
+                raise RuntimeError("fleet member died at start")
+            if line.startswith("serving"):
+                break
+
+
+def _id_pools(fleet: dict, per_host: int = 1 << 16,
+              seed: int = 0) -> List[np.ndarray]:
+    """Raw-u64 id pools, one per host, each id owned by that host under
+    the fleet routing rule (bucket(splitmix64(id)) -> owner)."""
+    from ratelimiter_tpu.fleet.config import FleetMap
+    from ratelimiter_tpu.ops.hashing import splitmix64
+
+    m = FleetMap.from_dict(fleet)
+    rng = np.random.default_rng(seed)
+    pools: List[List[np.ndarray]] = [[] for _ in m.hosts]
+    need = [per_host] * len(m.hosts)
+    while any(n > 0 for n in need):
+        ids = rng.integers(0, 1 << 62, size=1 << 18, dtype=np.uint64)
+        owners = m.owner_of_hash(splitmix64(ids))
+        for i in range(len(m.hosts)):
+            if need[i] > 0:
+                take = ids[owners == i][:need[i]]
+                pools[i].append(take)
+                need[i] -= take.shape[0]
+    return [np.concatenate(ps)[:per_host] for ps in pools]
+
+
+def _loadgen_entry(home: int, port: int, pool_bytes: bytes,
+                   seconds: float, warmup: float, conns: int,
+                   frame: int, depth: int, out_q) -> None:
+    """One loadgen process: per-connection home-host affinity — every
+    frame goes to ``port`` with ids from ``pool`` (which the parent
+    built for the connection's spread window). Counts decisions after
+    warmup; samples per-frame RTTs."""
+    import asyncio
+
+    pool = np.frombuffer(pool_bytes, dtype=np.uint64)
+
+    async def run():
+        from ratelimiter_tpu.serving.client import AsyncClient
+
+        clients = [await AsyncClient.connect(port=port)
+                   for _ in range(conns)]
+        counted = 0
+        lats: List[float] = []
+        t_measure = time.perf_counter() + warmup
+        stop_at = t_measure + seconds
+
+        async def worker(ci: int, c) -> None:
+            nonlocal counted
+            rng = np.random.default_rng(home * 131 + ci)
+            offs = rng.integers(0, pool.shape[0] - frame,
+                                size=4096).tolist()
+            k = 0
+
+            async def one():
+                nonlocal counted, k
+                off = offs[k % 4096]
+                k += 1
+                t0 = time.perf_counter()
+                await c.allow_hashed(pool[off:off + frame])
+                t1 = time.perf_counter()
+                if t1 >= t_measure:
+                    counted += frame
+                    lats.append(t1 - t0)
+
+            pending = {asyncio.ensure_future(one())
+                       for _ in range(depth)}
+            while time.perf_counter() < stop_at:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for d in done:
+                    d.result()
+                    if time.perf_counter() < stop_at:
+                        pending.add(asyncio.ensure_future(one()))
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+
+        await asyncio.gather(*(worker(i, c)
+                               for i, c in enumerate(clients)))
+        end = time.perf_counter()
+        for c in clients:
+            await c.close()
+        return counted, max(end - t_measure, 1e-9), lats
+
+    counted, span, lats = asyncio.run(run())
+    out_q.put((home, counted, span, lats))
+
+
+def _scrape_forwarded(ports: List[int]) -> int:
+    """Sum of rate_limiter_fleet_forwarded_decisions_total across the
+    members (senders count what they proxied out)."""
+    from ratelimiter_tpu.serving.client import Client
+
+    total = 0
+    for port in ports:
+        try:
+            with Client(port=port, timeout=10) as c:
+                for line in c.metrics().splitlines():
+                    if line.startswith(
+                            "rate_limiter_fleet_forwarded_decisions_total"):
+                        total += int(float(line.rsplit(" ", 1)[1]))
+        except Exception:  # noqa: BLE001 — a dead member scrapes as 0
+            pass
+    return total
+
+
+def _run_traffic(fleet: dict, ports: List[int], *, spread: int,
+                 seconds: float, warmup: float, conns: int, frame: int,
+                 depth: int, log=print) -> Dict:
+    pools = _id_pools(fleet, seed=1)
+    n = len(ports)
+    fwd_before = _scrape_forwarded(ports)
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = []
+    for home in range(n):
+        window = np.concatenate([pools[(home + j) % n]
+                                 for j in range(spread)])
+        np.random.default_rng(home).shuffle(window)
+        procs.append(ctx.Process(
+            target=_loadgen_entry,
+            args=(home, ports[home], window.tobytes(), seconds, warmup,
+                  conns, frame, depth, out_q)))
+    for pr in procs:
+        pr.start()
+    results = [out_q.get(timeout=seconds + 300) for _ in procs]
+    for pr in procs:
+        pr.join(timeout=60)
+    counted = sum(r[1] for r in results)
+    span = max(r[2] for r in results)
+    lats = np.array(sorted(x for r in results for x in r[3]))
+    fwd = _scrape_forwarded(ports) - fwd_before
+    row = {
+        "n_hosts": n,
+        "spread": spread,
+        "decisions_per_sec": round(counted / span, 1),
+        "completed": counted,
+        "frame_p50_ms": (round(float(np.percentile(lats, 50)) * 1e3, 2)
+                         if lats.size else None),
+        "frame_p99_ms": (round(float(np.percentile(lats, 99)) * 1e3, 2)
+                         if lats.size else None),
+        "connections_per_host": conns,
+        "ids_per_frame": frame,
+        "frames_in_flight_per_conn": depth,
+        # Numerator scraped from the members' forwarded-decisions
+        # counters over the WHOLE run (warmup included); denominator is
+        # post-warmup client decisions — so the mixed row reads high
+        # (an upper bound), and the affine row's 0.0 is exact.
+        "forwarded_fraction_measured": (round(fwd / counted, 4)
+                                        if counted else None),
+        "forwarded_fraction_expected": round((spread - 1) / spread, 4),
+        "traffic": ("host-affine (consistent-hash LB / FleetClient "
+                    "shape)" if spread == 1
+                    else ("uniform mixed (every frame fans out; "
+                          "server-side forwarding)" if spread >= n
+                          else f"partially mixed (spread {spread}/{n})")),
+    }
+    log(f"fleet n={n} spread={spread}: "
+        f"{row['decisions_per_sec']:.0f}/s "
+        f"fwd={row['forwarded_fraction_measured']}")
+    return row
+
+
+def _run_failover(tmp: str, *, log=print) -> Dict:
+    """Kill -9 one of two members mid-traffic; measure the window until
+    the successor serves the dead host's range, and verify the failover
+    contract (override exact, counters within one snapshot interval)."""
+    from ratelimiter_tpu.serving.client import Client, FleetClient
+
+    ports = [_free_port(), _free_port()]
+    snaps = [os.path.join(tmp, f"snap-{i}") for i in range(2)]
+    fleet = _fleet_config_dict(ports, 32, snap_dirs=snaps)
+    cfgpath = os.path.join(tmp, "fleet-failover.json")
+    with open(cfgpath, "w", encoding="utf-8") as f:
+        json.dump(fleet, f)
+    members = [_spawn_member(ports[i], cfgpath, f"h{i}", snap=snaps[i])
+               for i in range(2)]
+    try:
+        _wait_members(members)
+        fc = FleetClient(fleet)
+        owner_of = (lambda k: int(
+            fc.map.owner_of_hash(fc._hash([k]))[0]))
+        k0 = next(f"k:{i}" for i in range(99) if owner_of(f"k:{i}") == 0)
+        c0 = Client(port=ports[0], timeout=120)
+        assert c0.allow_n(k0, 30).allowed
+        c0.set_override("vip", 42)
+        c0.snapshot()
+        for _ in range(5):
+            c0.allow_n(k0, 2)   # post-snapshot: the bounded loss
+        t_kill = time.time()
+        members[0].send_signal(signal.SIGKILL)
+        members[0].wait(timeout=30)
+        recovered_at = None
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                fc.allow_n(k0, 1)
+                recovered_at = time.time()
+                break
+            except Exception:  # noqa: BLE001 — still failing over
+                time.sleep(0.1)
+        window = (recovered_at - t_kill) if recovered_at else None
+        with Client(port=ports[1], timeout=120) as c1:
+            override_exact = c1.get_override("vip") == (42, 1.0)
+        # Snapshot held 30 consumed; true total 41 (30+10+probe).
+        # Bounded under-count: 59 more fits, 50 after that must not.
+        counters_bounded = (fc.allow_n(k0, 59).allowed
+                            and not fc.allow_n(k0, 50).allowed)
+        fc.close()
+        c0.close()
+        row = {
+            "recovery_window_s": round(window, 2) if window else None,
+            "epoch_after": fc.map.epoch,
+            "override_exact": bool(override_exact),
+            "counters_within_one_snapshot_interval": bool(
+                counters_bounded),
+            "contract": ("kill -9 one member; successor restores the "
+                         "range from the dead member's newest snapshot "
+                         "+ WAL suffix, bumps the ownership epoch, and "
+                         "serves; the client self-heals off the "
+                         "refreshed map"),
+        }
+        log(f"fleet failover: window={row['recovery_window_s']}s "
+            f"override_exact={row['override_exact']}")
+        return row
+    finally:
+        for pr in members:
+            if pr.poll() is None:
+                pr.terminate()
+        for pr in members:
+            try:
+                pr.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+
+
+def run_fleet_scaling(n_hosts: int = 2, *, seconds: float = 4.0,
+                      warmup: float = 2.0, conns: int = 4,
+                      frame: int = 2048, depth: int = 4,
+                      log=print) -> Dict:
+    """The whole fleet_scaling block: single-host baseline, N-host
+    affine, N-host mixed (forwarded), and the failover row."""
+    import tempfile
+
+    out: Dict = {
+        "harness": ("N asyncio-door sketch members + one loadgen "
+                    "process per member (pipelined raw-id frames, "
+                    "per-connection home-host affinity, spread knob "
+                    "dials the mis-routed fraction)"),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        # -------- single-host baseline (a fleet of one)
+        port = _free_port()
+        fleet1 = _fleet_config_dict([port], 16)
+        cfg1 = os.path.join(tmp, "fleet1.json")
+        with open(cfg1, "w", encoding="utf-8") as f:
+            json.dump(fleet1, f)
+        m0 = _spawn_member(port, cfg1, "h0")
+        try:
+            _wait_members([m0])
+            out["single_host"] = _run_traffic(
+                fleet1, [port], spread=1, seconds=seconds,
+                warmup=warmup, conns=conns, frame=frame, depth=depth,
+                log=log)
+        finally:
+            m0.terminate()
+            m0.wait(timeout=30)
+        # -------- N hosts: affine then mixed
+        ports = [_free_port() for _ in range(n_hosts)]
+        fleetN = _fleet_config_dict(ports, 16 * n_hosts)
+        cfgN = os.path.join(tmp, "fleetN.json")
+        with open(cfgN, "w", encoding="utf-8") as f:
+            json.dump(fleetN, f)
+        members = [_spawn_member(ports[i], cfgN, f"h{i}")
+                   for i in range(n_hosts)]
+        try:
+            _wait_members(members)
+            out["affine"] = _run_traffic(
+                fleetN, ports, spread=1, seconds=seconds, warmup=warmup,
+                conns=conns, frame=frame, depth=depth, log=log)
+            out["mixed"] = _run_traffic(
+                fleetN, ports, spread=n_hosts, seconds=seconds,
+                warmup=warmup, conns=conns, frame=frame, depth=depth,
+                log=log)
+        finally:
+            for pr in members:
+                pr.terminate()
+            for pr in members:
+                try:
+                    pr.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+        single = out["single_host"]["decisions_per_sec"]
+        out["affine_scaling_vs_single_host"] = (
+            round(out["affine"]["decisions_per_sec"] / single, 2)
+            if single else None)
+        out["mixed_vs_affine"] = (
+            round(out["mixed"]["decisions_per_sec"]
+                  / out["affine"]["decisions_per_sec"], 2)
+            if out["affine"]["decisions_per_sec"] else None)
+        # -------- failover
+        out["failover"] = _run_failover(tmp, log=log)
+    return out

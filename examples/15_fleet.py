@@ -1,0 +1,147 @@
+"""The fleet tier: many hosts, one limiter (ADR-017).
+
+Spins up a TWO-member fleet as real server subprocesses, then shows the
+three behaviors that make N processes one limiter:
+
+1. affine routing — FleetClient partitions every frame by keyspace
+   owner and fans out (zero forwarding, the fast path);
+2. mis-routed traffic — a "dumb LB" sends everything to one member,
+   whose forwarder proxies foreign rows to their owner (answers stay
+   bit-identical, one key's quota counts once fleet-wide);
+3. per-range failover — kill -9 one member and its successor adopts
+   the range (restored from the dead member's snapshot + WAL suffix),
+   bumping the ownership epoch; the client self-heals off the new map.
+
+    JAX_PLATFORMS=cpu python examples/15_fleet.py
+
+Production shape: docs/OPERATIONS.md §9 and deployments/fleet-compose.yml.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn(port, cfgpath, self_id, snap):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ratelimiter_tpu.serving",
+         "--backend", "sketch", "--limit", "100", "--window", "600",
+         "--sketch-width", "8192", "--sub-windows", "6",
+         "--port", str(port), "--no-prewarm",
+         "--snapshot-dir", snap, "--snapshot-interval", "500",
+         "--fleet-config", cfgpath, "--fleet-self", self_id,
+         "--fleet-forward-deadline", "60",
+         "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def wait_banner(proc):
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("fleet member died at start")
+        if line.startswith("serving"):
+            return
+
+
+def main() -> None:
+    from ratelimiter_tpu.serving.client import Client, FleetClient
+
+    tmp = tempfile.mkdtemp(prefix="rl-fleet-demo-")
+    pa, pb = free_port(), free_port()
+    fleet = {"buckets": 32, "epoch": 1, "hosts": [
+        {"id": "a", "host": "127.0.0.1", "port": pa,
+         "ranges": [[0, 16]], "successor": "b",
+         "snapshot_dir": os.path.join(tmp, "a")},
+        {"id": "b", "host": "127.0.0.1", "port": pb,
+         "ranges": [[16, 32]], "successor": "a",
+         "snapshot_dir": os.path.join(tmp, "b")}]}
+    cfgpath = os.path.join(tmp, "fleet.json")
+    with open(cfgpath, "w", encoding="utf-8") as f:
+        json.dump(fleet, f, indent=1)
+    a = spawn(pa, cfgpath, "a", os.path.join(tmp, "a"))
+    b = spawn(pb, cfgpath, "b", os.path.join(tmp, "b"))
+    try:
+        wait_banner(a)
+        wait_banner(b)
+        print(f"fleet up: a:{pa} owns buckets [0,16), "
+              f"b:{pb} owns [16,32)")
+
+        # ---- 1. affine routing: the fleet client partitions by owner.
+        fc = FleetClient(fleet)
+        res = fc.allow_batch([f"user:{i}" for i in range(100)])
+        print(f"affine: {sum(r.allowed for r in res)}/100 allowed "
+              f"across both members")
+
+        # ---- 2. dumb LB: everything lands on a; foreign rows forward.
+        with Client(port=pa, timeout=120) as ca:
+            res = ca.allow_batch([f"user:{i}" for i in range(100)])
+            print(f"mis-routed via a: {sum(r.allowed for r in res)}/100 "
+                  f"(b's rows proxied, same answers)")
+            # One key, both entry points, ONE quota.
+            owner = int(fc.map.owner_of_hash(fc._hash(["hot"]))[0])
+            used = sum(ca.allow_n("hot", 10).allowed for _ in range(12))
+            print(f"'hot' (owner {fleet['hosts'][owner]['id']}): "
+                  f"{used}x10 allowed of limit 100 through the "
+                  f"non-owner door too")
+
+        # ---- 3. failover: consume + snapshot on a, then kill -9.
+        ka = next(f"k:{i}" for i in range(99)
+                  if int(fc.map.owner_of_hash(fc._hash([f"k:{i}"]))[0])
+                  == 0)
+        with Client(port=pa, timeout=120) as ca:
+            ca.allow_n(ka, 30)
+            ca.set_override("vip", 42)
+            ca.snapshot()
+        a.send_signal(signal.SIGKILL)
+        a.wait(timeout=30)
+        t0 = time.time()
+        while time.time() - t0 < 90:
+            try:
+                fc.allow_n(ka, 1)
+                break
+            except Exception:
+                time.sleep(0.2)
+        print(f"failover: b adopted a's range in "
+              f"{time.time() - t0:.1f}s (epoch {fc.map.epoch})")
+        with Client(port=pb, timeout=120) as cb:
+            print(f"override survived: vip -> {cb.get_override('vip')}")
+        denied = not fc.allow_n(ka, 75).allowed
+        print(f"counters survived: {ka} already ~31/100 consumed, "
+              f"75 more denied={denied}")
+        fc.close()
+        print("OK")
+    finally:
+        for proc in (a, b):
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in (a, b):
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    main()

@@ -91,3 +91,19 @@ class CheckpointError(RateLimiterError, RuntimeError):
     (``docs/ADR/001:51-52``); HBM-resident state makes snapshotting an
     explicit subsystem here (SURVEY.md §5.4, ratelimiter_tpu/checkpoint.py).
     """
+
+
+class NotOwnerError(RateLimiterError, RuntimeError):
+    """Typed fleet redirect (ADR-017): the server answering this frame
+    does not own the keys' hash buckets under its (newer) ownership
+    epoch, and forwarding is off (``--fleet-no-forward``) or impossible.
+    The message is machine-parseable (``protocol.parse_not_owner``) and
+    names the owner's address plus the answering server's epoch, so a
+    stale router refreshes its map and re-routes instead of retrying the
+    wrong host forever. ``owner``/``epoch`` are populated when the error
+    was parsed off the wire or raised by a fleet router."""
+
+    def __init__(self, msg: str, *, owner: str = "", epoch: int = 0):
+        super().__init__(msg)
+        self.owner = owner
+        self.epoch = int(epoch)

@@ -1,0 +1,32 @@
+"""Fleet tier: multi-host scale-out with consistent-hash routing,
+cross-host forwarding, and per-range failover (ADR-017).
+
+One ratelimiter_tpu server owns a contiguous set of keyspace hash
+buckets; a fleet of them is ONE limiter:
+
+* :class:`~ratelimiter_tpu.fleet.config.FleetMap` — the ownership map
+  (bucket ranges per host, epoch-versioned);
+* :class:`~ratelimiter_tpu.fleet.forwarder.FleetCore` /
+  :class:`~ratelimiter_tpu.fleet.forwarder.FleetForwarder` — per-process
+  routing + the bounded server-side forwarder for mis-routed rows;
+* :class:`~ratelimiter_tpu.fleet.membership.FleetMembership` —
+  announce/heartbeat gossip over the authenticated DCN channel plus
+  per-range failover onto the configured successor (restored from the
+  dead host's newest snapshot + WAL suffix).
+
+Client-side consistent-hash routing lives in
+``serving/client.py`` (``FleetClient`` / ``AsyncFleetClient``).
+"""
+
+from ratelimiter_tpu.fleet.config import FleetHost, FleetMap, affine_map
+from ratelimiter_tpu.fleet.forwarder import FleetCore, FleetForwarder
+from ratelimiter_tpu.fleet.membership import FleetMembership
+
+__all__ = [
+    "FleetHost",
+    "FleetMap",
+    "affine_map",
+    "FleetCore",
+    "FleetForwarder",
+    "FleetMembership",
+]

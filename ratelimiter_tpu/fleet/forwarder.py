@@ -1,0 +1,779 @@
+"""Fleet routing core + the server-side bounded forwarder (ADR-017).
+
+``FleetCore`` is one process's view of the fleet: the live ownership map
+(swapped atomically on epoch bumps), this host's identity, per-peer
+forward channels, the adopted-range standby unit installed by failover,
+and the shared metrics. Both front doors route through one core:
+
+* the asyncio door wraps its serving limiter in :class:`FleetForwarder`
+  (a LimiterDecorator — the micro-batcher's launch_batch / launch_ids
+  calls partition per frame);
+* the native (C++) door calls the core directly from its bridge
+  callbacks (serving/native_server.py), where the key blob is still in
+  hand — foreign STRING rows forward as strings so a multi-shard
+  receiver's FNV router lands them on the same shard as that key's
+  direct traffic.
+
+Forwarding rides the PLAIN decision lanes (T_ALLOW_BATCH for string
+rows, T_ALLOW_HASHED for raw-id rows — already-finalized hashes recover
+their raw ids via ``splitmix64_inv``), so every server parses forwarded
+traffic natively and the receiver's decisions are bit-identical to the
+same rows arriving directly. Per-peer channels are single-worker FIFO
+queues over ONE pooled connection: same-key frames forwarded to a peer
+arrive (and decide) in send order — the cross-host half of the in-batch
+sequencing contract, pinned by tests/test_fleet.py.
+
+Bounded-ness: each peer channel has a finite queue and every forwarded
+call carries the fleet forward deadline (the ADR-015 wire extension —
+the peer sheds expired work). Overflow / peer failure degrades the rows
+per the configured fail-open/fail-closed policy, exactly the quarantine
+contract (ADR-015), and feeds the membership failure classifier.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import queue as queue_mod
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ratelimiter_tpu.core.errors import (
+    NotOwnerError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.core.types import (
+    BatchResult,
+    DispatchTicket,
+    batch_fail_open,
+    fail_open_result,
+)
+from ratelimiter_tpu.fleet.config import FleetMap
+from ratelimiter_tpu.observability import metrics as m
+from ratelimiter_tpu.observability.decorators import LimiterDecorator
+from ratelimiter_tpu.ops.hashing import (
+    hash_prefixed_u64,
+    splitmix64,
+    splitmix64_inv,
+)
+
+log = logging.getLogger("ratelimiter_tpu.fleet")
+
+
+class _PeerChannel:
+    """FIFO forward channel to ONE peer: a single daemon worker drains a
+    bounded queue over one blocking Client connection. One worker per
+    peer = frames to a peer decide in send order (same-key sequencing
+    across the forwarding hop); the queue bound is the forwarder's
+    backpressure (overflow answers degraded, never buffers unbounded)."""
+
+    def __init__(self, host: str, port: int, *, deadline: float,
+                 queue_cap: int, label: str):
+        self.host, self.port = host, port
+        self.deadline = float(deadline)
+        self.label = label
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=queue_cap)
+        self._client = None
+        self._thread = threading.Thread(
+            target=self._run, name=f"rl-fleet-fwd-{label}", daemon=True)
+        self._thread.start()
+
+    def _get_client(self):
+        if self._client is None:
+            from ratelimiter_tpu.serving.client import Client
+
+            self._client = Client(
+                self.host, self.port,
+                connect_timeout=min(self.deadline, 5.0),
+                call_timeout=self.deadline + 1.0,
+                retries=1, backoff=0.02, backoff_max=0.2)
+        return self._client
+
+    def _drop_client(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            self._client = None
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._drop_client()
+                return
+            fut, kind, payload = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                c = self._get_client()
+                if kind == "batch":
+                    keys, ns = payload
+                    out = c.allow_batch(keys, ns, deadline=self.deadline)
+                elif kind == "ids":
+                    ids, ns = payload
+                    out = c.allow_hashed(ids, ns, deadline=self.deadline)
+                elif kind == "allow_n":
+                    key, n = payload
+                    out = c.allow_n(key, n, deadline=self.deadline)
+                elif kind == "reset":
+                    c.reset(payload)
+                    out = None
+                elif kind == "map":
+                    out = c.fleet_map()
+                else:  # pragma: no cover - programming error
+                    raise ValueError(f"unknown forward kind {kind}")
+                fut.set_result(out)
+            except BaseException as exc:  # noqa: BLE001 — future carries it
+                # A failed call may leave the connection desynced/dead;
+                # rebuild it next job rather than risk misaligned frames.
+                self._drop_client()
+                fut.set_exception(exc)
+
+    def submit(self, kind: str, payload) -> "concurrent.futures.Future":
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            self._q.put_nowait((fut, kind, payload))
+        except queue_mod.Full:
+            raise StorageUnavailableError(
+                f"fleet forward queue to {self.host}:{self.port} is full "
+                f"({self._q.maxsize} frames) — peer slow or dead") from None
+        return fut
+
+    def close(self) -> None:
+        try:
+            self._q.put_nowait(None)
+        except queue_mod.Full:
+            pass
+
+
+class FleetCore:
+    """One process's fleet state: live map + identity + peer channels +
+    adopted-range unit + metrics. Thread-safe: the map reference swaps
+    atomically; routing reads never lock."""
+
+    def __init__(self, fleet_map: FleetMap, self_id: str, *,
+                 prefix: str = "", forward: bool = True,
+                 forward_deadline: float = 1.0,
+                 forward_queue: int = 128,
+                 registry: Optional[m.Registry] = None):
+        fleet_map.validate()
+        self.self_id = self_id
+        self.prefix = prefix
+        self.forward_enabled = bool(forward)
+        self.forward_deadline = float(forward_deadline)
+        self.forward_queue = int(forward_queue)
+        self._lock = threading.Lock()
+        self._channels: Dict[int, _PeerChannel] = {}
+        #: Adopted-range standby unit (failover): decisions for adopted
+        #: buckets run on this limiter, restored from the dead peer's
+        #: snapshot + WAL suffix before it serves (restore-before-rejoin).
+        self._adopted_unit = None
+        self._adopted_lock = threading.Lock()
+        self._adopted_exec: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        #: Failure sink (wired to FleetMembership.note_peer_failure):
+        #: classified forward failures count toward peer-death detection.
+        self.on_peer_failure = None
+        reg = registry if registry is not None else m.DEFAULT
+        self._g_epoch = reg.gauge(
+            "rate_limiter_fleet_epoch",
+            "Current fleet ownership-map epoch (bumps on failover)")
+        self._g_owned = reg.gauge(
+            "rate_limiter_fleet_owned_buckets",
+            "Hash buckets this host owns under the current map")
+        self._g_adopted = reg.gauge(
+            "rate_limiter_fleet_adopted_buckets",
+            "Owned buckets served by the adopted-range standby unit "
+            "(nonzero only after a failover adoption)")
+        self._c_forwarded = reg.counter(
+            "rate_limiter_fleet_forwarded_decisions_total",
+            "Decisions proxied to their owning host because they "
+            "arrived mis-routed (ADR-017 server-side forwarding)")
+        self._c_forward_errors = reg.counter(
+            "rate_limiter_fleet_forward_errors_total",
+            "Forward calls that failed (peer dead/slow/queue-full); "
+            "their rows answered per fail-open/closed policy")
+        self._c_redirects = reg.counter(
+            "rate_limiter_fleet_redirects_total",
+            "Frames answered with the E_NOT_OWNER typed redirect "
+            "instead of forwarding")
+        self._c_degraded = reg.counter(
+            "rate_limiter_fleet_degraded_decisions_total",
+            "Decisions answered per fail-open/closed policy because "
+            "their owner was unreachable")
+        # Buckets whose ownership maps to a dead host mid-failover are
+        # recorded here by the membership so routing can degrade fast
+        # instead of timing out per frame.
+        self._dead_ordinals: frozenset = frozenset()
+        self._install(fleet_map, adopted_buckets=None)
+
+    # ------------------------------------------------------------- state
+
+    def _install(self, fleet_map: FleetMap,
+                 adopted_buckets: Optional[np.ndarray]) -> None:
+        """Swap in a new map (and adopted-bucket mask) atomically."""
+        self_ord = fleet_map.ordinal(self.self_id)
+        adopted = (adopted_buckets if adopted_buckets is not None
+                   else np.zeros(fleet_map.buckets, dtype=bool))
+        with self._lock:
+            self.map = fleet_map
+            self.self_ordinal = self_ord
+            self._adopted_buckets = adopted
+        self._g_epoch.set(float(fleet_map.epoch))
+        self._g_owned.set(float(fleet_map.owned_buckets(self.self_id)))
+        self._g_adopted.set(float(int(adopted.sum())))
+
+    def swap_map(self, new_map: FleetMap,
+                 adopted_buckets: Optional[np.ndarray] = None) -> None:
+        if adopted_buckets is None:
+            # Preserve the existing mask where sizes agree (a map update
+            # that doesn't change adoption).
+            adopted_buckets = self._adopted_buckets
+            if adopted_buckets.shape[0] != new_map.buckets:
+                adopted_buckets = None
+        self._install(new_map, adopted_buckets)
+
+    def install_adopted(self, unit, ranges: Sequence) -> None:
+        """Mount the failover standby unit for ``ranges`` (list of
+        (lo, hi) bucket ranges). The unit must already be restored
+        (restore-before-rejoin); routing flips to it atomically."""
+        mask = self._adopted_buckets.copy()
+        if mask.shape[0] != self.map.buckets:
+            mask = np.zeros(self.map.buckets, dtype=bool)
+        for lo, hi in ranges:
+            mask[lo:hi] = True
+        with self._adopted_lock:
+            self._adopted_unit = unit
+            if self._adopted_exec is None:
+                # Single worker: adopted-range decides stay FIFO (per-key
+                # order), mirroring every other dispatch unit.
+                self._adopted_exec = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="rl-fleet-adopted")
+        with self._lock:
+            self._adopted_buckets = mask
+        self._g_adopted.set(float(int(mask.sum())))
+
+    def set_dead(self, ordinals: Sequence[int]) -> None:
+        """Membership marks unreachable hosts so routing degrades their
+        rows immediately instead of paying a connect timeout per frame."""
+        self._dead_ordinals = frozenset(int(o) for o in ordinals)
+
+    # ----------------------------------------------------------- routing
+
+    def hash_keys(self, keys: Sequence[str]) -> np.ndarray:
+        return hash_prefixed_u64(list(keys), self.prefix)
+
+    def owners_of_hash(self, h64: np.ndarray) -> np.ndarray:
+        return self.map.owner_of_hash(h64)
+
+    def owners_of_ids(self, ids: np.ndarray) -> np.ndarray:
+        return self.owners_of_hash(splitmix64(np.asarray(ids, np.uint64)))
+
+    def all_local(self, owners: np.ndarray) -> bool:
+        return bool((owners == self.self_ordinal).all()
+                    and not self._adopted_buckets.any())
+
+    def split(self, h64: np.ndarray, owners: np.ndarray):
+        """Partition one frame: (local_pos, adopted_pos,
+        {foreign_ordinal: pos}) — one stable argsort, contiguous
+        position slices, frame order preserved within every group."""
+        mine = owners == self.self_ordinal
+        if self._adopted_buckets.any():
+            adopted_rows = mine & self._adopted_buckets[
+                self.map.bucket_of_hash(h64)]
+            local_rows = mine & ~adopted_rows
+        else:
+            adopted_rows = np.zeros(0, dtype=bool)
+            local_rows = mine
+        local_pos = np.nonzero(local_rows)[0]
+        adopted_pos = (np.nonzero(adopted_rows)[0]
+                       if adopted_rows.shape[0] else
+                       np.zeros(0, dtype=np.int64))
+        foreign: Dict[int, np.ndarray] = {}
+        if local_pos.shape[0] + adopted_pos.shape[0] < owners.shape[0]:
+            fpos = np.nonzero(~mine)[0]
+            foreign = {o: fpos[sub] for o, sub in
+                       self.map.partition(owners[fpos]).items()}
+        return local_pos, adopted_pos, foreign
+
+    def channel(self, ordinal: int) -> _PeerChannel:
+        ch = self._channels.get(ordinal)
+        host = self.map.hosts[ordinal]
+        if ch is None or (ch.host, ch.port) != (host.host, host.port):
+            with self._lock:
+                ch = self._channels.get(ordinal)
+                if ch is None or (ch.host, ch.port) != (host.host,
+                                                        host.port):
+                    if ch is not None:
+                        ch.close()
+                    ch = _PeerChannel(
+                        host.host, host.port,
+                        deadline=self.forward_deadline,
+                        queue_cap=self.forward_queue, label=host.id)
+                    self._channels[ordinal] = ch
+        return ch
+
+    # ------------------------------------------------------- redirecting
+
+    def redirect_error(self, h64_row: int, owner_ordinal: int
+                       ) -> NotOwnerError:
+        from ratelimiter_tpu.serving import protocol as p
+
+        host = self.map.hosts[owner_ordinal]
+        bucket = int(np.uint64(h64_row) % np.uint64(self.map.buckets))
+        self._c_redirects.inc()
+        return NotOwnerError(
+            p.format_not_owner(bucket, f"{host.id}@{host.addr}",
+                               self.map.epoch, self.map.buckets),
+            owner=host.addr, epoch=self.map.epoch)
+
+    def check_frame_owned(self, h64: np.ndarray) -> None:
+        """Redirect-only mode's door check: raises the typed redirect
+        when any row is foreign (the whole frame errors, the batch
+        error contract)."""
+        owners = self.owners_of_hash(h64)
+        foreign = owners != self.self_ordinal
+        if foreign.any():
+            i = int(np.argmax(foreign))
+            raise self.redirect_error(int(h64[i]), int(owners[i]))
+
+    # ------------------------------------------------------- forwarding
+
+    def forward_keys(self, ordinal: int, keys: List[str],
+                     ns: np.ndarray) -> "concurrent.futures.Future":
+        self._c_forwarded.inc(len(keys), peer=self.map.hosts[ordinal].id)
+        return self.channel(ordinal).submit(
+            "batch", (keys, [int(x) for x in ns]))
+
+    def forward_allow_n(self, ordinal: int, key: str,
+                        n: int) -> "concurrent.futures.Future":
+        self._c_forwarded.inc(peer=self.map.hosts[ordinal].id)
+        return self.channel(ordinal).submit("allow_n", (key, int(n)))
+
+    def forward_ids(self, ordinal: int, raw_ids: np.ndarray,
+                    ns: np.ndarray) -> "concurrent.futures.Future":
+        self._c_forwarded.inc(int(raw_ids.shape[0]),
+                              peer=self.map.hosts[ordinal].id)
+        return self.channel(ordinal).submit(
+            "ids", (np.ascontiguousarray(raw_ids, dtype=np.uint64),
+                    np.ascontiguousarray(ns, dtype=np.uint32)))
+
+    def forward_hashes(self, ordinal: int, h64: np.ndarray,
+                       ns: np.ndarray) -> "concurrent.futures.Future":
+        """Forward FINALIZED hashes: recover the raw ids (splitmix64 is
+        a bijection) and ride the plain hashed lane — the receiver
+        re-finalizes to bit-identical hashes."""
+        return self.forward_ids(ordinal, splitmix64_inv(h64), ns)
+
+    def note_forward_failure(self, ordinal: int, exc: BaseException,
+                             count: int) -> None:
+        host = self.map.hosts[ordinal]
+        self._c_forward_errors.inc(peer=host.id)
+        self._c_degraded.inc(count)
+        cb = self.on_peer_failure
+        if cb is not None:
+            try:
+                cb(host.id, exc)
+            except Exception:  # noqa: BLE001 — observability only
+                log.exception("fleet on_peer_failure callback failed")
+
+    # ---------------------------------------------------- adopted ranges
+
+    @property
+    def adopted_unit(self):
+        return self._adopted_unit
+
+    def adopted_submit(self, fn) -> "concurrent.futures.Future":
+        with self._adopted_lock:
+            ex = self._adopted_exec
+        assert ex is not None, "no adopted unit installed"
+        return ex.submit(fn)
+
+    def decide_adopted_hashed(self, h64: np.ndarray, ns: np.ndarray
+                              ) -> "concurrent.futures.Future":
+        unit = self._adopted_unit
+        return self.adopted_submit(
+            lambda: unit.allow_hashed(h64, ns))
+
+    def decide_adopted_keys(self, keys: List[str], ns
+                            ) -> "concurrent.futures.Future":
+        unit = self._adopted_unit
+        return self.adopted_submit(
+            lambda: unit.allow_batch(keys, list(ns)))
+
+    # ----------------------------------------------------------- surface
+
+    def status(self) -> dict:
+        """/healthz fleet block (membership adds liveness)."""
+        mp = self.map
+        me = mp.host(self.self_id)
+        return {
+            "self": self.self_id,
+            "epoch": mp.epoch,
+            "buckets": mp.buckets,
+            "owned_ranges": [list(r) for r in me.ranges],
+            "adopted_buckets": int(self._adopted_buckets.sum()),
+            "forwarding": self.forward_enabled,
+            "forwarded_total": int(self._c_forwarded.total()),
+            "forward_errors_total": int(self._c_forward_errors.total()),
+            "redirects_total": int(self._c_redirects.total()),
+        }
+
+    def map_payload(self) -> dict:
+        return self.map.to_dict()
+
+    def close(self) -> None:
+        with self._lock:
+            chans = list(self._channels.values())
+            self._channels.clear()
+        for ch in chans:
+            ch.close()
+        with self._adopted_lock:
+            if self._adopted_exec is not None:
+                self._adopted_exec.shutdown(wait=False)
+                self._adopted_exec = None
+            unit = self._adopted_unit
+            self._adopted_unit = None
+        if unit is not None:
+            try:
+                unit.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+
+def collect_jobs(core: FleetCore, jobs, cfg, now: float):
+    """Wait out a fleet ticket's forward/adopted futures: returns
+    ``(parts, err)`` where ``parts`` is ``[(positions, result)]`` ready
+    for :func:`scatter_merge`. A failed forward degrades its rows per
+    the fail-open/closed policy (fail-closed keeps the FIRST error to
+    raise after every job is drained — the ADR-013 non-transactional
+    frame contract: other hosts' quota stands)."""
+    parts = []
+    err = None
+    budget = core.forward_deadline + 2.0
+    for pos, fut, ordinal in jobs:
+        k = int(pos.shape[0])
+        try:
+            out = fut.result(timeout=budget)
+        except Exception as exc:
+            if ordinal is not None:
+                core.note_forward_failure(ordinal, exc, k)
+            if not cfg.fail_open:
+                err = err if err is not None else StorageUnavailableError(
+                    f"fleet forward failed ({exc}); rows fail closed "
+                    f"per config")
+                continue
+            out = batch_fail_open(k, cfg.limit, now + float(cfg.window))
+        parts.append((pos, out))
+    return parts, err
+
+
+def scatter_merge(b: int, limit: int, parts) -> BatchResult:
+    """Scatter per-group results back to frame order: ``parts`` is
+    ``[(positions | None, BatchResult | list[Result])]`` (None =
+    positions are the whole frame). ``fail_open`` ORs over groups (the
+    multi-shard contract, ADR-013); per-row ``limits`` materialize when
+    any group carried overrides."""
+    allowed = np.zeros(b, dtype=bool)
+    remaining = np.zeros(b, dtype=np.int64)
+    retry = np.zeros(b, dtype=np.float64)
+    reset_at = np.zeros(b, dtype=np.float64)
+    limits = None
+    fail_open = False
+    for pos, out in parts:
+        sel = slice(None) if pos is None else pos
+        if isinstance(out, list):  # forwarded string rows: Result objects
+            allowed[sel] = [r.allowed for r in out]
+            remaining[sel] = [r.remaining for r in out]
+            retry[sel] = [r.retry_after for r in out]
+            reset_at[sel] = [r.reset_at for r in out]
+            fail_open = fail_open or any(r.fail_open for r in out)
+            if any(r.limit != limit for r in out):
+                # Keep whatever limit fidelity the leg carried. NOTE:
+                # the RESULT_BATCH wire stamps every row with the
+                # DEFAULT limit (overridden keys' true limits ride the
+                # scalar path only — protocol.py), so forwarded batch
+                # rows inherit that documented wire bound; this branch
+                # matters for in-process legs and future wire upgrades.
+                if limits is None:
+                    limits = np.full(b, limit, dtype=np.int64)
+                limits[sel] = [r.limit for r in out]
+        else:
+            allowed[sel] = out.allowed
+            remaining[sel] = out.remaining
+            retry[sel] = out.retry_after
+            reset_at[sel] = out.reset_at
+            fail_open = fail_open or out.fail_open
+            if getattr(out, "limits", None) is not None:
+                if limits is None:
+                    limits = np.full(b, limit, dtype=np.int64)
+                limits[sel] = out.limits
+    return BatchResult(allowed=allowed, limit=limit, remaining=remaining,
+                       retry_after=retry, reset_at=reset_at,
+                       fail_open=fail_open, limits=limits)
+
+
+class FleetTicket(DispatchTicket):
+    """Composite ticket for one frame split across the fleet: the local
+    sub-ticket plus in-flight forward / adopted futures, scattered back
+    to frame order at resolve (the cross-HOST sibling of
+    MeshDispatchTicket's cross-slice form)."""
+
+    __slots__ = ("local", "local_pos", "jobs")
+
+    def __init__(self, result=None):
+        super().__init__(result)
+        self.local = None        # (positions | None, inner ticket)
+        self.local_pos = None
+        self.jobs = ()           # [(positions, future, ordinal|None)]
+
+
+class FleetForwarder(LimiterDecorator):
+    """Asyncio-door fleet decorator: partitions every decision frame by
+    keyspace owner — local rows dispatch on the inner limiter, adopted
+    rows on the failover standby unit, foreign rows forward to their
+    owner — and reassembles per-frame answers in frame order. Wraps the
+    TOP of the serving stack (outside persistence: forwarded rows must
+    not consume local quota, and decisions are never WAL-logged
+    anyway)."""
+
+    def __init__(self, inner, core: FleetCore):
+        super().__init__(inner)
+        self.core = core
+
+    @property
+    def pipelined(self) -> bool:
+        return bool(getattr(self.inner, "pipelined", False))
+
+    # ------------------------------------------------------------ helpers
+
+    def _launch_fleet(self, h64: np.ndarray, ns: np.ndarray, now: float,
+                      *, keys: Optional[List[str]] = None,
+                      raw_ids: Optional[np.ndarray] = None,
+                      wire: bool = False) -> FleetTicket:
+        core = self.core
+        owners = core.owners_of_hash(h64)
+        if core.all_local(owners):
+            # Fast path: the whole frame is ours — one owner check, no
+            # split, the inner ticket passes through (wire buffers
+            # preserved).
+            if raw_ids is not None:
+                return self.inner.launch_ids(raw_ids, ns, now=now,
+                                             wire=wire)
+            return self.inner.launch_hashed(h64, ns, now=now)
+        local_pos, adopted_pos, foreign = core.split(h64, owners)
+        if foreign and not core.forward_enabled:
+            o = next(iter(foreign))
+            raise core.redirect_error(int(h64[foreign[o][0]]), o)
+        t = FleetTicket()
+        t.b = int(h64.shape[0])
+        t.limit = self.inner.config.limit
+        t.t_sec = now
+        jobs = []
+        if local_pos.shape[0]:
+            if local_pos.shape[0] == t.b:
+                sub_h, sub_n = h64, ns
+                t.local_pos = None
+            else:
+                sub_h, sub_n = h64[local_pos], ns[local_pos]
+                t.local_pos = local_pos
+            if raw_ids is not None:
+                ids_sub = (raw_ids if t.local_pos is None
+                           else raw_ids[local_pos])
+                t.local = self.inner.launch_ids(ids_sub, sub_n, now=now)
+            else:
+                t.local = self.inner.launch_hashed(sub_h, sub_n, now=now)
+        if adopted_pos.shape[0]:
+            jobs.append((adopted_pos,
+                         core.decide_adopted_hashed(h64[adopted_pos],
+                                                    ns[adopted_pos]),
+                         None))
+        for o, pos in foreign.items():
+            if o in core._dead_ordinals:
+                # Known-dead owner mid-failover: degrade now rather than
+                # pay a connect timeout per frame.
+                fut: concurrent.futures.Future = concurrent.futures.Future()
+                fut.set_exception(StorageUnavailableError(
+                    f"fleet owner {core.map.hosts[o].id} is down "
+                    f"(failover pending)"))
+                jobs.append((pos, fut, o))
+                continue
+            try:
+                if keys is not None:
+                    fut = core.forward_keys(o, [keys[i] for i in pos],
+                                            ns[pos])
+                elif raw_ids is not None:
+                    fut = core.forward_ids(o, raw_ids[pos], ns[pos])
+                else:
+                    fut = core.forward_hashes(o, h64[pos], ns[pos])
+            except StorageUnavailableError as exc:  # queue full
+                fut = concurrent.futures.Future()
+                fut.set_exception(exc)
+            jobs.append((pos, fut, o))
+        t.jobs = tuple(jobs)
+        return t
+
+    # ----------------------------------------------------------- launch
+
+    def launch_batch(self, keys, ns=None, *, now=None):
+        from ratelimiter_tpu.algorithms.base import check_key, check_n
+
+        keys = list(keys)
+        for k in keys:
+            check_key(k)
+        if ns is None:
+            ns_arr = np.ones(len(keys), dtype=np.int64)
+        else:
+            for n in ns:
+                check_n(int(n))
+            ns_arr = np.asarray(ns, dtype=np.int64)
+        t = self.clock.now() if now is None else float(now)
+        h64 = self.core.hash_keys(keys)
+        owners = self.core.owners_of_hash(h64)
+        if self.core.all_local(owners):
+            return self.inner.launch_batch(keys, ns, now=now)
+        return self._launch_fleet(h64, ns_arr, t, keys=keys)
+
+    def launch_ids(self, ids, ns=None, *, now=None, wire: bool = False):
+        ids = np.asarray(ids, dtype=np.uint64)
+        ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
+                  else np.asarray(ns, dtype=np.int64))
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_fleet(splitmix64(ids), ns_arr, t,
+                                  raw_ids=ids, wire=wire)
+
+    def launch_hashed(self, h64, ns=None, *, now=None):
+        h64 = np.asarray(h64, dtype=np.uint64)
+        ns_arr = (np.ones(h64.shape[0], dtype=np.int64) if ns is None
+                  else np.asarray(ns, dtype=np.int64))
+        t = self.clock.now() if now is None else float(now)
+        return self._launch_fleet(h64, ns_arr, t)
+
+    # ---------------------------------------------------------- resolve
+
+    def resolve(self, ticket):
+        if not isinstance(ticket, FleetTicket):
+            return self.inner.resolve(ticket)
+        if ticket.result is not None:
+            return ticket.result
+        parts = []
+        err = None
+        if ticket.local is not None:
+            try:
+                parts.append((ticket.local_pos,
+                              self.inner.resolve(ticket.local)))
+            except Exception as exc:  # finish the forwards regardless
+                err = exc
+        fparts, ferr = collect_jobs(self.core, ticket.jobs,
+                                    self.inner.config, ticket.t_sec)
+        parts.extend(fparts)
+        err = err if err is not None else ferr
+        if err is not None:
+            raise err
+        res = scatter_merge(ticket.b, ticket.limit, parts)
+        ticket.result = res
+        return res
+
+    # ------------------------------------------------------ sync surface
+
+    def allow_batch(self, keys, ns=None, *, now=None):
+        return self.resolve(self.launch_batch(keys, ns, now=now))
+
+    def allow_ids(self, ids, ns=None, *, now=None):
+        return self.resolve(self.launch_ids(ids, ns, now=now))
+
+    def allow_hashed(self, h64, ns=None, *, now=None):
+        return self.resolve(self.launch_hashed(h64, ns, now=now))
+
+    def allow_n(self, key, n, *, now=None):
+        core = self.core
+        h64 = core.hash_keys([key])
+        owner = int(core.owners_of_hash(h64)[0])
+        if owner == core.self_ordinal:
+            if core._adopted_buckets.any() and bool(
+                    core._adopted_buckets[
+                        int(core.map.bucket_of_hash(h64)[0])]):
+                return core.adopted_submit(
+                    lambda: core.adopted_unit.allow_n(
+                        key, n, now=now)).result()
+            return self.inner.allow_n(key, n, now=now)
+        if not core.forward_enabled:
+            raise core.redirect_error(int(h64[0]), owner)
+        t = self.clock.now() if now is None else float(now)
+        try:
+            fut = core.forward_allow_n(owner, key, n)
+            return fut.result(timeout=core.forward_deadline + 2.0)
+        except Exception as exc:
+            core.note_forward_failure(owner, exc, 1)
+            cfg = self.inner.config
+            if not cfg.fail_open:
+                raise StorageUnavailableError(
+                    f"fleet forward failed ({exc}); fails closed per "
+                    f"config") from exc
+            return fail_open_result(cfg.limit, t + float(cfg.window))
+
+    def reset(self, key: str) -> None:
+        """Reset applies locally AND at the owner (a mis-routed reset on
+        a non-owner would otherwise be a silent no-op — the same rule as
+        shard-routed resets, stretched across hosts)."""
+        core = self.core
+        h64 = core.hash_keys([key])
+        owner = int(core.owners_of_hash(h64)[0])
+        if owner == core.self_ordinal:
+            if core._adopted_buckets.any() and bool(
+                    core._adopted_buckets[
+                        int(core.map.bucket_of_hash(h64)[0])]):
+                core.adopted_submit(
+                    lambda: core.adopted_unit.reset(key)).result()
+                return
+            self.inner.reset(key)
+            return
+        if not core.forward_enabled:
+            raise core.redirect_error(int(h64[0]), owner)
+        core.channel(owner).submit("reset", key).result(
+            timeout=core.forward_deadline + 2.0)
+
+    # Policy overrides apply on the LOCAL stack only: fleet-wide
+    # distribution is the client's job (FleetClient.set_override hits
+    # every member, exactly as set_override_all hits every shard) — a
+    # server cannot know whether its peers already heard the same call.
+    # The adopted unit mirrors local writes so adopted keys honor
+    # overrides set after failover.
+
+    def set_override(self, key, limit=None, *, window_scale=1.0):
+        ov = self.inner.set_override(key, limit, window_scale=window_scale)
+        unit = self.core.adopted_unit
+        if unit is not None:
+            self.core.adopted_submit(
+                lambda: unit.set_override(
+                    key, limit, window_scale=window_scale)).result()
+        return ov
+
+    def delete_override(self, key) -> bool:
+        existed = self.inner.delete_override(key)
+        unit = self.core.adopted_unit
+        if unit is not None:
+            existed = self.core.adopted_submit(
+                lambda: unit.delete_override(key)).result() or existed
+        return existed
+
+    def get_override(self, key):
+        core = self.core
+        unit = core.adopted_unit
+        if unit is not None:
+            h64 = core.hash_keys([key])
+            if bool(core._adopted_buckets[
+                    int(core.map.bucket_of_hash(h64)[0])]):
+                # Overrides restored from the dead host's WAL live only
+                # in the standby unit.
+                return core.adopted_submit(
+                    lambda: unit.get_override(key)).result()
+        return self.inner.get_override(key)
+
+    def close(self) -> None:
+        super().close()
+        self.core.close()

@@ -1,0 +1,329 @@
+"""Fleet membership: announce/heartbeat gossip + per-range failover
+(ADR-017).
+
+Every fleet member runs one ``FleetMembership``: a background thread
+announces this host's view of the ownership map to every peer each
+``heartbeat`` seconds (T_DCN_PUSH kind=DCN_KIND_FLEET over the existing
+authenticated DCN channel — RLA2 HMAC + replay guard when a secret is
+held, ADR-007), and the same thread watches peer liveness:
+
+* an announce from a peer refreshes its ``last_seen`` and, when it
+  carries a HIGHER epoch, installs that map (highest epoch wins — the
+  fleet's only convergence rule, sufficient because every ownership
+  change bumps the epoch exactly once at the host that made it);
+* a peer silent past ``dead_after`` (or accumulating
+  ``failure_threshold`` quarantine-classified forward failures, the
+  ADR-015 classifier) is declared dead;
+* if this host is the configured SUCCESSOR for a dead peer's ranges, it
+  fails them over: build a standby unit restored from the dead peer's
+  newest snapshot + WAL suffix (``adopt_fn`` — restore-before-rejoin,
+  the same contract as slice quarantine), mount it for the adopted
+  buckets, install the reassigned map at ``epoch + 1``, and announce it
+  immediately so routers and peers converge.
+
+Announce reception is PASSIVE for followers: a member that is not the
+successor simply learns the new map from the successor's announce (or
+keeps forwarding — mis-routed rows stay correct either way, they just
+pay a hop).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ratelimiter_tpu.fleet.config import FleetHost, FleetMap
+from ratelimiter_tpu.fleet.forwarder import FleetCore
+from ratelimiter_tpu.observability import metrics as m
+
+log = logging.getLogger("ratelimiter_tpu.fleet")
+
+
+class FleetMembership:
+    """Announce/heartbeat + liveness + failover for one fleet member.
+
+    Args:
+        core: the process's FleetCore (map swaps and adopted-unit
+            mounting go through it).
+        heartbeat: seconds between announce pushes.
+        dead_after: declare a previously-seen peer dead after this many
+            seconds of silence.
+        boot_grace: never-seen peers can only be declared dead after
+            this many seconds from OUR start (default
+            ``max(3 * dead_after, 15)``): a fleet starts in arbitrary
+            order and a member still prewarming its jit shapes is not
+            dead — failing it over at boot would fork its ranges the
+            moment it finally serves (rejoin is never automatic).
+        failure_threshold: quarantine-classified forward failures
+            (FleetCore.on_peer_failure) before a peer is treated as
+            dead without waiting out ``dead_after``.
+        adopt_fn: ``adopt_fn(dead: FleetHost) -> limiter`` — build the
+            standby unit for the dead host's ranges, restored from its
+            ``snapshot_dir`` when reachable (wired by the server binary
+            to the persistence tier). None disables adoption (ranges
+            degrade per policy until an operator acts).
+        secret: DCN shared secret; announces ride the RLA2 envelope.
+    """
+
+    def __init__(self, core: FleetCore, *, heartbeat: float = 0.5,
+                 dead_after: float = 2.0, failure_threshold: int = 3,
+                 boot_grace: Optional[float] = None,
+                 adopt_fn: Optional[Callable[[FleetHost], object]] = None,
+                 secret: Optional[str] = None,
+                 registry: Optional[m.Registry] = None):
+        import secrets as _secrets
+
+        self.core = core
+        self.heartbeat = float(heartbeat)
+        self.dead_after = float(dead_after)
+        self.boot_grace = (float(boot_grace) if boot_grace is not None
+                           else max(3.0 * self.dead_after, 15.0))
+        self.failure_threshold = int(failure_threshold)
+        self.adopt_fn = adopt_fn
+        self.secret = secret
+        self._sender = _secrets.randbits(64)
+        self._last_seq = 0
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._last_seen: Dict[str, float] = {}
+        self._peer_epoch: Dict[str, int] = {}
+        self._failures: Dict[str, int] = {}
+        self._dead: set = set()
+        self._started_at = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conns: Dict[str, object] = {}
+        self.failovers = 0
+        reg = registry if registry is not None else m.DEFAULT
+        self._g_alive = reg.gauge(
+            "rate_limiter_fleet_peer_alive",
+            "1 while this fleet peer is considered live (announce heard "
+            "within dead_after), 0 once declared dead")
+        self._c_failovers = reg.counter(
+            "rate_limiter_fleet_failovers_total",
+            "Per-range failovers this host performed as successor")
+        self._c_announces = reg.counter(
+            "rate_limiter_fleet_announces_total",
+            "Fleet announce frames sent (ok) / failed, by outcome")
+        core.on_peer_failure = self.note_peer_failure
+
+    # ---------------------------------------------------------- announce
+
+    def _next_seq(self) -> int:
+        # Wall-clock-tracking monotonic sequence, same contract as
+        # DcnPusher._next_seq (the replay guard reads seq as a coarse
+        # timestamp for first-contact freshness).
+        self._last_seq = max(self._last_seq + 1, int(time.time() * 1e6))
+        return self._last_seq
+
+    def announce_payload(self) -> dict:
+        return {"kind": "announce", "from": self.core.self_id,
+                "map": self.core.map_payload(),
+                "sent_at": time.time()}
+
+    def announce_once(self) -> int:
+        """Push one announce to every peer; returns deliveries. Never
+        raises — a dead peer's connection failure is exactly the signal
+        the OTHER side's monitor consumes."""
+        from ratelimiter_tpu.serving import protocol as p
+        from ratelimiter_tpu.serving.dcn_peer import _PeerConn
+
+        payload = self.announce_payload()
+        delivered = 0
+        for host in self.core.map.hosts:
+            if host.id == self.core.self_id:
+                continue
+            with self._lock:
+                if host.id in self._dead:
+                    continue
+            req_id = next(self._ids)
+            frame = p.encode_dcn_fleet(
+                req_id, payload, secret=self.secret, sender=self._sender,
+                seq=(self._next_seq() if self.secret is not None
+                     else None))
+            conn = self._conns.get(host.id)
+            if conn is None or (conn.host, conn.port) != (host.host,
+                                                          host.port):
+                conn = _PeerConn(host.host, host.port, timeout=2.0)
+                self._conns[host.id] = conn
+            try:
+                conn.push(frame, req_id)
+                delivered += 1
+                self._c_announces.inc(outcome="ok")
+            except Exception as exc:  # noqa: BLE001 — liveness signal
+                self._c_announces.inc(outcome="error")
+                log.debug("fleet announce to %s (%s) failed: %s",
+                          host.id, host.addr, exc)
+        return delivered
+
+    def handle_announce(self, payload: dict) -> None:
+        """Receive path (both doors funnel DCN_KIND_FLEET here via
+        dcn_peer.merge_push_payload's on_fleet hook)."""
+        peer = str(payload.get("from", ""))
+        if not peer or peer == self.core.self_id:
+            return
+        map_d = payload.get("map") or {}
+        epoch = int(map_d.get("epoch", 0))
+        with self._lock:
+            self._last_seen[peer] = time.monotonic()
+            self._peer_epoch[peer] = epoch
+            self._failures[peer] = 0
+            was_dead = peer in self._dead
+            if was_dead:
+                # A declared-dead peer announcing again is back AS A
+                # MEMBER (liveness), but its ranges stay wherever the
+                # epoch says they are — rejoining ownership is an
+                # operator/resharding action (ROADMAP item 2), never
+                # automatic (two hosts serving one range would split
+                # counters).
+                self._dead.discard(peer)
+        self._g_alive.set(1.0, peer=peer)
+        if was_dead:
+            self.core.set_dead([self.core.map.ordinal(p_id)
+                                for p_id in self._dead
+                                if self._in_map(p_id)])
+        if epoch > self.core.map.epoch:
+            try:
+                new_map = FleetMap.from_dict(map_d)
+            except Exception as exc:  # noqa: BLE001 — bad gossip
+                log.warning("fleet announce from %s carried an invalid "
+                            "map (%s); ignoring", peer, exc)
+                return
+            log.info("fleet: adopting map epoch %d from %s (was %d)",
+                     epoch, peer, self.core.map.epoch)
+            self.core.swap_map(new_map)
+
+    def _in_map(self, host_id: str) -> bool:
+        return any(h.id == host_id for h in self.core.map.hosts)
+
+    # ---------------------------------------------------------- liveness
+
+    def note_peer_failure(self, host_id: str, exc: BaseException) -> None:
+        """Forward-path failure sink (FleetCore.on_peer_failure): only
+        quarantine-classified backend faults count toward death — a
+        caller error must never fail a healthy peer over (ADR-015)."""
+        from ratelimiter_tpu.parallel.quarantine import classify_failure
+
+        if not classify_failure(exc):
+            return
+        with self._lock:
+            self._failures[host_id] = self._failures.get(host_id, 0) + 1
+
+    def _check_dead(self) -> None:
+        now = time.monotonic()
+        grace_until = self._started_at + self.boot_grace
+        newly_dead = []
+        with self._lock:
+            for host in self.core.map.hosts:
+                hid = host.id
+                if hid == self.core.self_id or hid in self._dead:
+                    continue
+                seen = self._last_seen.get(hid)
+                silent = (now - seen > self.dead_after if seen is not None
+                          else now > grace_until)
+                failed = self._failures.get(hid, 0) >= self.failure_threshold
+                if silent or failed:
+                    self._dead.add(hid)
+                    newly_dead.append((host, "silence" if silent
+                                       else "forward failures"))
+        for host, why in newly_dead:
+            self._g_alive.set(0.0, peer=host.id)
+            log.warning("fleet peer %s (%s) declared dead (%s)",
+                        host.id, host.addr, why)
+            self.core.set_dead([self.core.map.ordinal(p_id)
+                                for p_id in self._dead
+                                if self._in_map(p_id)])
+            self._maybe_failover(host)
+
+    # ---------------------------------------------------------- failover
+
+    def _maybe_failover(self, dead: FleetHost) -> None:
+        cur = self.core.map.host(dead.id)
+        if not cur.ranges:
+            return  # already failed over (or never owned anything)
+        if cur.successor != self.core.self_id:
+            return  # somebody else's job; we learn the map via announce
+        log.warning("fleet: failing over %s's ranges %s to %s "
+                    "(epoch %d -> %d)", dead.id,
+                    [list(r) for r in cur.ranges], self.core.self_id,
+                    self.core.map.epoch, self.core.map.epoch + 1)
+        unit = None
+        if self.adopt_fn is not None:
+            try:
+                unit = self.adopt_fn(cur)
+            except Exception:  # noqa: BLE001 — adopt empty instead
+                log.exception("fleet: standby restore for %s failed; "
+                              "adopting the range with FRESH state "
+                              "(under-counts, fail-toward-allowing)",
+                              dead.id)
+        new_map = self.core.map.reassign(dead.id, self.core.self_id)
+        if unit is not None:
+            # Mount BEFORE the map swap: the instant the swap makes the
+            # buckets local, routing finds the restored unit
+            # (restore-before-rejoin; a gap would decide adopted keys
+            # on empty state).
+            self.core.install_adopted(unit, cur.ranges)
+            self.core.swap_map(new_map)
+        else:
+            self.core.swap_map(new_map)
+        self.failovers += 1
+        self._c_failovers.inc()
+        # Converge fast: don't wait a heartbeat to tell the fleet.
+        self.announce_once()
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.heartbeat):
+                try:
+                    self.announce_once()
+                    self._check_dead()
+                except Exception:  # noqa: BLE001 — keep the heart beating
+                    log.exception("fleet membership cycle failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="rl-fleet-membership")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self._conns.clear()
+
+    # ----------------------------------------------------------- surface
+
+    def status(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            peers = {}
+            for host in self.core.map.hosts:
+                if host.id == self.core.self_id:
+                    continue
+                seen = self._last_seen.get(host.id)
+                peers[host.id] = {
+                    "addr": host.addr,
+                    "alive": host.id not in self._dead,
+                    "last_seen_age_s": (round(now - seen, 3)
+                                        if seen is not None else None),
+                    "epoch": self._peer_epoch.get(host.id),
+                    "ranges": [list(r) for r in
+                               self.core.map.host(host.id).ranges],
+                }
+        return {"peers": peers, "failovers": self.failovers,
+                "heartbeat_s": self.heartbeat,
+                "dead_after_s": self.dead_after}

@@ -97,3 +97,40 @@ def split_hash_dev(h64, seed: int = 0):
     h1 = (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
     h2 = (h >> jnp.uint64(32)).astype(jnp.uint32) | jnp.uint32(1)
     return h1, h2
+
+
+# ----------------------------------------------------- splitmix64 inverse
+#
+# splitmix64 is a bijection on u64 (an odd-constant add, then three
+# invertible xorshift-multiply rounds), so a FINALIZED hash can be taken
+# back to the raw id that produced it. The fleet tier (ADR-017) uses this
+# to forward already-finalized hashes over the plain T_ALLOW_HASHED wire
+# lane — the receiver re-finalizes the recovered raw ids and lands on
+# bit-identical hashes, so cross-host forwarding needs no new decision
+# frame type. Fuzz-pinned round-trip in tests/test_fleet.py.
+
+#: Modular inverses of the two splitmix64 multipliers mod 2^64.
+_INV_C1 = np.uint64(pow(0xBF58476D1CE4E5B9, -1, 1 << 64))
+_INV_C2 = np.uint64(pow(0x94D049BB133111EB, -1, 1 << 64))
+
+
+def _unshift_right(x: np.ndarray, s: int) -> np.ndarray:
+    """Invert ``y = x ^ (x >> s)`` (iterate to the fixpoint: each round
+    recovers ``s`` more high-order-correct bits)."""
+    y = x.copy()
+    for _ in range(-(-64 // s) - 1):
+        y = x ^ (y >> np.uint64(s))
+    return y
+
+
+def splitmix64_inv(x: np.ndarray) -> np.ndarray:
+    """Exact inverse of :func:`splitmix64` (vectorized)."""
+    x = np.asarray(x, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = _unshift_right(x, 31)
+        x = x * _INV_C2
+        x = _unshift_right(x, 27)
+        x = x * _INV_C1
+        x = _unshift_right(x, 30)
+        x = x - np.uint64(0x9E3779B97F4A7C15)
+    return x

@@ -6,12 +6,19 @@ coalesce into shared batched device dispatches (serving/batcher.py).
 """
 
 from ratelimiter_tpu.serving.batcher import MicroBatcher
-from ratelimiter_tpu.serving.client import AsyncClient, Client
+from ratelimiter_tpu.serving.client import (
+    AsyncClient,
+    AsyncFleetClient,
+    Client,
+    FleetClient,
+)
 from ratelimiter_tpu.serving.server import RateLimitServer, run_server
 
 __all__ = [
     "AsyncClient",
+    "AsyncFleetClient",
     "Client",
+    "FleetClient",
     "MicroBatcher",
     "RateLimitServer",
     "run_server",

@@ -215,6 +215,41 @@ def build_parser() -> argparse.ArgumentParser:
                          "DCN-slab-sized receive buffer concurrently "
                          "(size to your peer count; refused peers get a "
                          "typed error and re-push next cycle)")
+    # Fleet tier (ADR-017): multi-host scale-out — this server owns a
+    # set of keyspace hash buckets; mis-routed rows forward to their
+    # owner; peers heartbeat over the DCN channel; a dead peer's ranges
+    # fail over to its configured successor.
+    ap.add_argument("--fleet-config", default=None, metavar="PATH",
+                    help="join a fleet: JSON ownership map (buckets, "
+                         "epoch, hosts with id/host/port/ranges/"
+                         "successor/snapshot_dir). Implies accepting "
+                         "DCN pushes (fleet announces ride that "
+                         "channel); needs a sketch-family backend and "
+                         "--fleet-self")
+    ap.add_argument("--fleet-self", default=None, metavar="ID",
+                    help="this server's host id inside --fleet-config")
+    ap.add_argument("--fleet-no-forward", action="store_true",
+                    help="answer mis-routed frames with the typed "
+                         "E_NOT_OWNER redirect instead of proxying them "
+                         "to the owner (routing becomes entirely the "
+                         "client's job; dumb LBs will see errors)")
+    ap.add_argument("--fleet-heartbeat", type=float, default=0.5,
+                    help="seconds between fleet announce pushes")
+    ap.add_argument("--fleet-dead-after", type=float, default=2.0,
+                    help="declare a peer dead after this many seconds "
+                         "of announce silence (failover trigger)")
+    ap.add_argument("--fleet-boot-grace", type=float, default=None,
+                    help="seconds from start before a NEVER-seen peer "
+                         "can be declared dead (default max(3 x "
+                         "dead-after, 15): members prewarming at boot "
+                         "are not dead)")
+    ap.add_argument("--fleet-forward-deadline", type=float, default=1.0,
+                    help="per-call deadline (seconds) on forwarded "
+                         "frames; rides the wire so the owner sheds "
+                         "expired work (ADR-015)")
+    ap.add_argument("--fleet-forward-queue", type=int, default=128,
+                    help="bounded per-peer forward queue (frames); "
+                         "overflow answers per fail-open/closed policy")
     ap.add_argument("--dcn-secret", default=None,
                     help="shared secret HMAC-gating T_DCN_PUSH frames "
                          "(both sides must set it; prefer the "
@@ -691,6 +726,84 @@ async def amain(args) -> None:
 
     dcn_secret = (args.dcn_secret
                   or os.environ.get("RATELIMITER_TPU_DCN_SECRET") or None)
+
+    # Fleet tier (ADR-017): routing core + membership. Built before
+    # either door so the doors' constructors take the core; the
+    # membership announcer starts once serving does.
+    fleet_core = None
+    fleet_membership = None
+    if args.fleet_config:
+        if args.backend not in ("sketch", "mesh"):
+            raise SystemExit("--fleet-config needs a sketch-family "
+                             "backend (fleet routing hashes keys)")
+        if not args.fleet_self:
+            raise SystemExit("--fleet-config needs --fleet-self "
+                             "(this server's host id in the map)")
+        from ratelimiter_tpu.fleet import (
+            FleetCore,
+            FleetMap,
+            FleetMembership,
+        )
+
+        fleet_map = FleetMap.load(args.fleet_config)
+        fleet_core = FleetCore(
+            fleet_map, args.fleet_self, prefix=cfg.prefix,
+            forward=not args.fleet_no_forward,
+            forward_deadline=args.fleet_forward_deadline,
+            forward_queue=args.fleet_forward_queue,
+            registry=obs_metrics.DEFAULT)
+
+        def _fleet_adopt(dead):
+            """Failover standby unit: a fresh single-device sketch
+            limiter restored from the dead host's newest snapshot + WAL
+            suffix (restore-before-rejoin, the slice-quarantine
+            contract). Restore failure (unreachable dir, a mesh peer's
+            multi-file snapshot, drift) adopts FRESH state instead —
+            under-counts only, the fail-toward-allowing direction;
+            overrides are then absent until re-applied fleet-wide."""
+            unit = create_limiter(cfg, backend="sketch")
+            if dead.snapshot_dir:
+                from ratelimiter_tpu.persistence.recover import (
+                    recover as _precover,
+                )
+
+                try:
+                    report = _precover([unit], dead.snapshot_dir)
+                    logging.getLogger("ratelimiter_tpu.fleet").warning(
+                        "fleet: adopted %s's ranges from %s (%s)",
+                        dead.id, dead.snapshot_dir, report.summary())
+                except Exception:
+                    logging.getLogger(
+                        "ratelimiter_tpu.fleet").exception(
+                        "fleet: restore of %s's snapshot dir %s failed; "
+                        "adopting with fresh state", dead.id,
+                        dead.snapshot_dir)
+                    unit.close()
+                    unit = create_limiter(cfg, backend="sketch")
+            return unit
+
+        fleet_membership = FleetMembership(
+            fleet_core, heartbeat=args.fleet_heartbeat,
+            dead_after=args.fleet_dead_after,
+            boot_grace=args.fleet_boot_grace, adopt_fn=_fleet_adopt,
+            secret=dcn_secret, registry=obs_metrics.DEFAULT)
+        if not args.native and args.inflight < 2:
+            # The fleet-merge side pool (the symmetric-forwarding
+            # deadlock fix) only exists on the pipelined path; the
+            # synchronous one-executor path can wedge two members on
+            # each other under saturated mixed traffic until the
+            # forward deadline degrades the rows.
+            logging.getLogger("ratelimiter_tpu.fleet").warning(
+                "fleet on the asyncio door with --inflight 1: forwarded "
+                "frames block the single dispatch executor; use "
+                "--inflight >= 2 for mixed/mis-routed traffic")
+
+    def _fleet_health() -> dict:
+        if fleet_core is None:
+            return {}
+        return {"fleet": {**fleet_core.status(),
+                          **fleet_membership.status()}}
+
     http_reset = bool(args.http_reset or args.http_reset_token)
     http_policy = bool(args.http_policy or args.http_policy_token)
     dcn_peers = []
@@ -715,9 +828,14 @@ async def amain(args) -> None:
                               if args.dispatch_timeout_ms else None),
             inflight=args.inflight,
             shards=(len(slices) if mesh_native else args.shards),
-            dcn=bool(args.dcn_listen or args.dcn_peer),
+            # Fleet membership gossips over the DCN channel, so a fleet
+            # member always listens for pushes.
+            dcn=bool(args.dcn_listen or args.dcn_peer or fleet_core),
             dcn_secret=dcn_secret,
             max_dcn_conns=args.dcn_max_transfers,
+            fleet=fleet_core,
+            fleet_announce=(fleet_membership.handle_announce
+                            if fleet_membership is not None else None),
             # Mesh: the pre-built per-device slices ARE the shards, each
             # wearing the same decorator stack (+ persistence wrapper)
             # under its own shard label.
@@ -778,6 +896,7 @@ async def amain(args) -> None:
                                 **_consumers_health(server.shard_limiters),
                                 **_audit_health(),
                                 **_slo_health(slo_tracker),
+                                **_fleet_health(),
                                 **({"quarantine": qmgr.status()}
                                    if qmgr is not None else {}),
                                 **(persist.status() if persist else {})},
@@ -820,9 +939,13 @@ async def amain(args) -> None:
               f"{args.host}:{server.port}"
               + (f" http:{gateway.port}" if gateway else "")
               + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
+        if fleet_membership is not None:
+            fleet_membership.start()
         if start_chaos is not None:
             start_chaos()
         await stop.wait()
+        if fleet_membership is not None:
+            fleet_membership.stop()
         for pu in pushers:
             pu.stop()
         if gateway is not None:
@@ -839,6 +962,10 @@ async def amain(args) -> None:
             server.close_shards()
         else:
             server.shutdown()
+        if fleet_core is not None:
+            # After the door drains: in-flight frames may still hold
+            # forward futures.
+            fleet_core.close()
         if auditor is not None:
             from ratelimiter_tpu.observability import audit as audit_mod
 
@@ -873,6 +1000,15 @@ async def amain(args) -> None:
             # Restore-before-rejoin (ADR-015): a recovering slice
             # replays the newest snapshot + WAL suffix before routing.
             qmgr.restore_fn = persist.slice_restorer()
+    if fleet_core is not None:
+        # Wrap AFTER recovery: WAL replay must apply locally, never
+        # forward (a replayed reset for a now-foreign key belongs to
+        # history, not to a peer). Outermost of the whole stack — the
+        # batcher's frames partition by owner before anything local
+        # runs.
+        from ratelimiter_tpu.fleet import FleetForwarder
+
+        limiter = FleetForwarder(limiter, fleet_core)
     server = RateLimitServer(
         limiter, args.host, args.port,
         max_batch=args.max_batch,
@@ -880,9 +1016,12 @@ async def amain(args) -> None:
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                           if args.dispatch_timeout_ms else None),
         inflight=args.inflight,
-        dcn=bool(args.dcn_listen or args.dcn_peer),
+        dcn=bool(args.dcn_listen or args.dcn_peer or fleet_core),
         dcn_secret=dcn_secret,
-        snapshot=(persist.snapshot_now if persist else None))
+        snapshot=(persist.snapshot_now if persist else None),
+        fleet=fleet_core,
+        fleet_announce=(fleet_membership.handle_announce
+                        if fleet_membership is not None else None))
     await server.start()
 
     gateway = None
@@ -908,6 +1047,7 @@ async def amain(args) -> None:
                             **_consumers_health([limiter]),
                             **_audit_health(),
                             **_slo_health(slo_tracker),
+                            **_fleet_health(),
                             **({"quarantine": qmgr.status()}
                                if qmgr is not None else {}),
                             **(persist.status() if persist else {})},
@@ -947,9 +1087,13 @@ async def amain(args) -> None:
           f"{args.host}:{server.port}"
           + (f" http:{gateway.port}" if gateway else "")
           + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
+    if fleet_membership is not None:
+        fleet_membership.start()
     if start_chaos is not None:
         start_chaos()
     await stop.wait()
+    if fleet_membership is not None:
+        fleet_membership.stop()
     for pu in pushers:
         pu.stop()
     if gateway is not None:

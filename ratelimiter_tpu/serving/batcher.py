@@ -146,6 +146,18 @@ class MicroBatcher:
         else:
             self._resolve_pool = None
             self._window = None
+        #: Side pool for FLEET tickets (ADR-017): a frame whose resolve
+        #: must wait on a peer's answer (forwarded rows) may NOT occupy
+        #: the FIFO resolve executor — inbound forwarded frames from
+        #: that same peer resolve there, and two members blocking their
+        #: pipelines on each other is a distributed deadlock (observed
+        #: under symmetric mixed load). Remote-merge frames also give
+        #: their in-flight window slot back before the wait: the window
+        #: bounds DEVICE dispatches, and a network wait holding a slot
+        #: recreates the same cycle one layer down. Lazily built — zero
+        #: cost for non-fleet deployments.
+        self._fleet_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
         self._depth = 0
         self._depth_lock = threading.Lock()
         self._inflight: set = set()
@@ -489,8 +501,7 @@ class MicroBatcher:
                 if not fut.done():
                     fut.set_exception(exc)
                 return
-            work = loop.run_in_executor(self._resolve_pool,
-                                        self._resolve_work, ticket)
+            work = self._resolve_target(loop, ticket)
         else:
             work = loop.run_in_executor(
                 self._pool,
@@ -702,7 +713,24 @@ class MicroBatcher:
         self._depth_add(1)
         return ticket
 
-    def _resolve_work(self, ticket):
+    def _resolve_target(self, loop, ticket):
+        """Schedule one ticket's resolve on the right executor: plain
+        tickets keep the FIFO resolve thread; fleet tickets (remote
+        forward legs pending — ``ticket.jobs``) move to the side pool
+        and release their window slot NOW (see _fleet_pool above)."""
+        if getattr(ticket, "jobs", None):
+            if self._fleet_pool is None:
+                self._fleet_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="rl-fleet-merge")
+            self._window.release()
+            self._depth_add(-1)
+            return loop.run_in_executor(
+                self._fleet_pool,
+                lambda: self._resolve_work(ticket, release=False))
+        return loop.run_in_executor(self._resolve_pool,
+                                    self._resolve_work, ticket)
+
+    def _resolve_work(self, ticket, release: bool = True):
         rec = tracing.RECORDER
         tn0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
@@ -729,8 +757,9 @@ class MicroBatcher:
                            outcome=tracing.ERROR)
             raise
         finally:
-            self._window.release()
-            self._depth_add(-1)
+            if release:
+                self._window.release()
+                self._depth_add(-1)
             self._resolve_hist.observe(time.perf_counter() - t0)
 
     async def _dispatch(self, batch, trace_id: int = 0) -> None:
@@ -766,8 +795,7 @@ class MicroBatcher:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
-            work = loop.run_in_executor(self._resolve_pool,
-                                        self._resolve_work, ticket)
+            work = self._resolve_target(loop, ticket)
         else:
             work = loop.run_in_executor(
                 self._pool, lambda: self._allow_work(keys, ns, trace_id))
@@ -859,3 +887,5 @@ class MicroBatcher:
         self._pool.shutdown(wait=True)
         if self._resolve_pool is not None:
             self._resolve_pool.shutdown(wait=True)
+        if self._fleet_pool is not None:
+            self._fleet_pool.shutdown(wait=True)

@@ -48,7 +48,8 @@ log = logging.getLogger("ratelimiter_tpu.serving.dcn")
 
 def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
                        secret: Optional[str] = None,
-                       guard: Optional[p.DcnReplayGuard] = None) -> None:
+                       guard: Optional[p.DcnReplayGuard] = None,
+                       on_fleet=None) -> None:
     """Parse one T_DCN_PUSH body and merge it into every given limiter —
     the single receive path shared by the asyncio server (its one
     limiter) and the native front door (every shard limiter).
@@ -56,6 +57,15 @@ def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
     ``guard`` (per-server DcnReplayGuard) rejects stale/duplicate
     sequenced envelopes BEFORE any mass merges — a replayed push is a
     counter-mass injection, i.e. targeted false denies (ADR-007).
+
+    ``on_fleet`` (ADR-017): fleet announce frames (DCN_KIND_FLEET) ride
+    the same channel — and the same auth/replay envelope, which is the
+    point: an announce can MOVE KEYSPACE OWNERSHIP, so it deserves
+    exactly the protection counter-mass injection gets. After the
+    envelope verifies, the parsed JSON payload is handed to this
+    callback (the fleet membership) instead of the merge path. Without
+    a callback, fleet frames answer E_INVALID_CONFIG — a non-fleet
+    server must not silently swallow ownership gossip.
 
     With dispatch shards, the full foreign payload merges into EVERY
     shard: a key is only ever read on its owner shard, where the foreign
@@ -68,6 +78,15 @@ def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
     from ratelimiter_tpu.parallel.dcn import merge_completed, merge_debt
 
     body = p.unwrap_dcn_auth(body, secret, guard)
+    if body[:1] and body[0] == p.DCN_KIND_FLEET:
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+
+        if on_fleet is None:
+            raise InvalidConfigError(
+                "fleet announce received but this server is not a fleet "
+                "member (--fleet-config)")
+        on_fleet(p.parse_dcn_fleet(body[1:]))
+        return
     lims = [undecorated(lim) for lim in limiters]
     lim0 = lims[0]
     if not isinstance(lim0, SketchLimiter):

@@ -151,7 +151,8 @@ class NativeRateLimitServer:
                  dcn_secret: Optional[str] = None,
                  max_dcn_conns: int = 4,
                  shard_decorate=None,
-                 shard_limiters: Optional[list] = None):
+                 shard_limiters: Optional[list] = None,
+                 fleet=None, fleet_announce=None):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -238,6 +239,16 @@ class NativeRateLimitServer:
                 self._shard_limiters.append(
                     shard_decorate(clone, i) if shard_decorate else clone)
         self._locks = [threading.Lock() for _ in range(shards)]
+
+        # Fleet tier (ADR-017): the bridge partitions every decision
+        # frame by keyspace owner BEFORE the shard limiter sees it —
+        # the blob is still in hand here, so foreign STRING rows
+        # forward as strings (a multi-shard receiver's FNV router then
+        # lands them on the same shard as that key's direct traffic;
+        # h64-routed forwarding would split a key's quota across two
+        # shards). None = byte-identical hot path.
+        self._fleet = fleet
+        self._fleet_announce = fleet_announce
 
         # Fast path: C++ prepends the prefix while building the blob, so
         # the decide callback hashes ready-made bytes (the numpy re-pack
@@ -332,6 +343,128 @@ class NativeRateLimitServer:
         rec.record("complete", t_v1, tracing.now(), trace_id=trace_id,
                    shard=shard, batch=count)
 
+    # ------------------------------------------------- fleet split (ADR-017)
+
+    def _keys_from_blob(self, blob: bytes, offsets: np.ndarray,
+                        lengths: np.ndarray, pos: np.ndarray):
+        """Recover the RAW key strings for the given rows (prefix
+        stripped — the receiving server re-applies its identical
+        prefix, so the forwarded key hashes bit-identically)."""
+        pl = len(self._prefix_bytes)
+        return [blob[int(offsets[i]) + pl:
+                     int(offsets[i]) + int(lengths[i])].decode("utf-8")
+                for i in pos.tolist()]
+
+    def _fleet_split(self, h64: np.ndarray, ns: np.ndarray, *,
+                     blob=None, offsets=None, lengths=None,
+                     raw_ids=None):
+        """Partition one frame by fleet owner and fire the forwards.
+        Returns ``(local_pos, jobs)``; ``(None, ())`` = whole frame
+        local (caller keeps the untouched fast path). Raises the typed
+        redirect in redirect-only mode."""
+        import concurrent.futures as cf
+
+        from ratelimiter_tpu.core.errors import StorageUnavailableError
+
+        core = self._fleet
+        owners = core.owners_of_hash(h64)
+        if core.all_local(owners):
+            return None, ()
+        if not core.forward_enabled:
+            # Typed redirect — but only for frames that actually carry
+            # FOREIGN rows: with adopted ranges mounted, all_local() is
+            # False for every frame (the adopted mask must be checked
+            # row-wise), and a wholly-self-owned frame must fall
+            # through to the split below, not bounce off itself. Raised
+            # as the library error; every bridge caller wraps it into a
+            # _BridgeError with the right wire code (code_for knows
+            # E_NOT_OWNER).
+            foreign = owners != core.self_ordinal
+            if foreign.any():
+                i = int(np.argmax(foreign))
+                raise core.redirect_error(int(h64[i]), int(owners[i]))
+        local_pos, adopted_pos, foreign = core.split(h64, owners)
+        jobs = []
+        if adopted_pos.shape[0]:
+            jobs.append((adopted_pos,
+                         core.decide_adopted_hashed(h64[adopted_pos],
+                                                    ns[adopted_pos]),
+                         None))
+        for o, pos in foreign.items():
+            try:
+                if o in core._dead_ordinals:
+                    raise StorageUnavailableError(
+                        f"fleet owner {core.map.hosts[o].id} is down "
+                        f"(failover pending)")
+                if raw_ids is not None:
+                    fut = core.forward_ids(o, raw_ids[pos], ns[pos])
+                elif blob is not None:
+                    fut = core.forward_keys(
+                        o, self._keys_from_blob(blob, offsets, lengths,
+                                                pos), ns[pos])
+                else:
+                    fut = core.forward_hashes(o, h64[pos], ns[pos])
+            except StorageUnavailableError as exc:
+                fut = cf.Future()
+                fut.set_exception(exc)
+            jobs.append((pos, fut, o))
+        return local_pos, jobs
+
+    def _fleet_decide(self, shard: int, h64: np.ndarray, ns: np.ndarray,
+                      local_pos: np.ndarray, jobs):
+        """Blocking fleet decide: local rows dispatch on the shard
+        limiter WHILE the forwards (already in flight) overlap their
+        network RTT with the device step; merge in frame order."""
+        from ratelimiter_tpu.fleet.forwarder import (
+            collect_jobs,
+            scatter_merge,
+        )
+
+        lim = self._shard_limiters[shard]
+        now = lim.clock.now()
+        parts = []
+        err = None
+        if local_pos.shape[0]:
+            try:
+                with self._locks[shard]:
+                    parts.append((local_pos,
+                                  lim.allow_hashed(h64[local_pos],
+                                                   ns[local_pos])))
+            except Exception as exc:  # noqa: BLE001 — drain forwards first
+                err = exc
+        fparts, ferr = collect_jobs(self._fleet, jobs, lim.config, now)
+        parts.extend(fparts)
+        err = err if err is not None else ferr
+        if err is not None:
+            raise err
+        return scatter_merge(int(h64.shape[0]), lim.config.limit, parts)
+
+    def _fleet_launch(self, shard: int, h64: np.ndarray, ns: np.ndarray,
+                      *, blob=None, offsets=None, lengths=None):
+        """Pipelined fleet launch: local rows launch on the shard
+        limiter (non-blocking), forwards fly concurrently; returns a
+        FleetTicket for _resolve's merge — or None when the whole frame
+        is local (caller keeps the untouched path)."""
+        from ratelimiter_tpu.fleet.forwarder import FleetTicket
+
+        local_pos, jobs = self._fleet_split(h64, ns, blob=blob,
+                                            offsets=offsets,
+                                            lengths=lengths)
+        if local_pos is None and not jobs:
+            return None
+        lim = self._shard_limiters[shard]
+        t = FleetTicket()
+        t.b = int(h64.shape[0])
+        t.limit = lim.config.limit
+        t.t_sec = lim.clock.now()
+        if local_pos is not None and local_pos.shape[0]:
+            with self._locks[shard]:
+                t.local = lim.launch_hashed(h64[local_pos], ns[local_pos])
+            t.local_pos = local_pos
+            t.t_sec = getattr(t.local, "t_sec", 0.0) or t.t_sec
+        t.jobs = tuple(jobs)
+        return t
+
     def _decide(self, shard: int, blob: bytes, offsets_b: bytes,
                 lengths_b: bytes, ns_b: bytes, trace_id: int = 0):
         b = len(offsets_b) // 8
@@ -345,6 +478,19 @@ class NativeRateLimitServer:
             if self._fast:
                 h64, ns = self._hash_buffers(blob, offsets_b, lengths_b,
                                              ns_b)
+                if self._fleet is not None:
+                    local_pos, jobs = self._fleet_split(
+                        h64, ns, blob=blob,
+                        offsets=np.frombuffer(offsets_b, dtype=np.int64),
+                        lengths=np.frombuffer(lengths_b, dtype=np.int64))
+                    if local_pos is not None or jobs:
+                        out = self._fleet_decide(shard, h64, ns,
+                                                 local_pos, jobs)
+                        if aud is not None:
+                            aud.offer_hashed(h64, ns, t_dec, out,
+                                             slice_idx=shard)
+                        self._batch_hist.observe(float(b))
+                        return self._pack_result(out)
                 with self._locks[shard]:
                     out = lim.allow_hashed(h64, ns)
                 # Live accuracy tap (ADR-016): h64 is the finalized
@@ -384,6 +530,19 @@ class NativeRateLimitServer:
         try:
             h64 = np.frombuffer(ids_b, dtype=np.uint64)
             ns = np.frombuffer(ns_b, dtype=np.int64)
+            if self._fleet is not None:
+                # Hashed-lane ids arrive FINALIZED (C++ splitmix64);
+                # foreign rows forward via the inverse (bit-identical
+                # at the owner — forwarder.forward_hashes).
+                local_pos, jobs = self._fleet_split(h64, ns)
+                if local_pos is not None or jobs:
+                    out = self._fleet_decide(shard, h64, ns, local_pos,
+                                             jobs)
+                    if aud is not None:
+                        aud.offer_hashed(h64, ns, t_dec, out,
+                                         slice_idx=shard)
+                    self._batch_hist.observe(float(b))
+                    return self._pack_result(out)
             with self._locks[shard]:
                 out = lim.allow_hashed(h64, ns)
         except Exception as exc:
@@ -405,6 +564,17 @@ class NativeRateLimitServer:
         try:
             h64 = np.frombuffer(ids_b, dtype=np.uint64)
             ns = np.frombuffer(ns_b, dtype=np.int64)
+            if self._fleet is not None:
+                ticket = self._fleet_launch(shard, h64, ns)
+                if ticket is not None:
+                    ticket.trace_id = trace_id
+                    if audit.AUDITOR is not None:
+                        ticket.audit = (h64, ns)
+                    with self._depth_lock:
+                        self._depth += 1
+                        self._inflight_gauge.set(float(self._depth))
+                    self._launch_hist.observe(time.perf_counter() - t0)
+                    return ticket
             with self._locks[shard]:
                 ticket = lim.launch_hashed(h64, ns)
         except Exception as exc:
@@ -430,6 +600,20 @@ class NativeRateLimitServer:
         lim = self._shard_limiters[shard]
         try:
             h64, ns = self._hash_buffers(blob, offsets_b, lengths_b, ns_b)
+            if self._fleet is not None:
+                ticket = self._fleet_launch(
+                    shard, h64, ns, blob=blob,
+                    offsets=np.frombuffer(offsets_b, dtype=np.int64),
+                    lengths=np.frombuffer(lengths_b, dtype=np.int64))
+                if ticket is not None:
+                    ticket.trace_id = trace_id
+                    if audit.AUDITOR is not None:
+                        ticket.audit = (h64, ns)
+                    with self._depth_lock:
+                        self._depth += 1
+                        self._inflight_gauge.set(float(self._depth))
+                    self._launch_hist.observe(time.perf_counter() - t0)
+                    return ticket
             with self._locks[shard]:
                 ticket = lim.launch_hashed(h64, ns)
         except Exception as exc:
@@ -443,6 +627,34 @@ class NativeRateLimitServer:
         self._launch_hist.observe(time.perf_counter() - t0)
         return ticket
 
+    def _fleet_resolve(self, shard: int, ticket):
+        """Resolve one ticket, merging fleet tickets (local sub-resolve
+        + in-flight forwards scattered back to frame order); plain
+        tickets pass straight through to the shard limiter."""
+        from ratelimiter_tpu.fleet.forwarder import (
+            FleetTicket,
+            collect_jobs,
+            scatter_merge,
+        )
+
+        lim = self._shard_limiters[shard]
+        if not isinstance(ticket, FleetTicket):
+            return lim.resolve(ticket)
+        parts = []
+        err = None
+        if ticket.local is not None:
+            try:
+                parts.append((ticket.local_pos, lim.resolve(ticket.local)))
+            except Exception as exc:  # noqa: BLE001 — drain forwards first
+                err = exc
+        fparts, ferr = collect_jobs(self._fleet, ticket.jobs, lim.config,
+                                    ticket.t_sec or lim.clock.now())
+        parts.extend(fparts)
+        err = err if err is not None else ferr
+        if err is not None:
+            raise err
+        return scatter_merge(ticket.b, ticket.limit, parts)
+
     def _resolve(self, shard: int, ticket):
         """Resolve phase: block on the oldest in-flight dispatch (GIL
         released while the device drains) and hand the flat result
@@ -450,7 +662,7 @@ class NativeRateLimitServer:
         t0 = time.perf_counter()
         lim = self._shard_limiters[shard]
         try:
-            out = lim.resolve(ticket)
+            out = self._fleet_resolve(shard, ticket)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
         finally:
@@ -493,7 +705,8 @@ class NativeRateLimitServer:
 
         try:
             merge_push_payload(self._shard_limiters, payload,
-                               self.dcn_secret, self._dcn_guard)
+                               self.dcn_secret, self._dcn_guard,
+                               self._fleet_announce)
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
 
@@ -558,6 +771,10 @@ class NativeRateLimitServer:
                     self.limiter.clock.now() + float(cfg.window))
             raise DeadlineExceededError(
                 "request deadline expired before dispatch")
+        if self._fleet is not None:
+            res = self._fleet_decide_one(key, n)
+            if res is not None:
+                return res
         shard = self.shard_of(key)
         rec = tracing.RECORDER
         aud = audit.AUDITOR
@@ -575,9 +792,58 @@ class NativeRateLimitServer:
             aud.offer_keys([key], [n], t_dec, res, slice_idx=shard)
         return res
 
+    def _fleet_decide_one(self, key: str, n: int):
+        """Scalar fleet routing for the gateway side doors: None =
+        locally owned on live state (fall through to the shard path)."""
+        from ratelimiter_tpu.core.errors import StorageUnavailableError
+        from ratelimiter_tpu.core.types import fail_open_result
+
+        core = self._fleet
+        h64 = core.hash_keys([key])
+        owner = int(core.owners_of_hash(h64)[0])
+        if owner == core.self_ordinal:
+            if core._adopted_buckets.any() and bool(
+                    core._adopted_buckets[
+                        int(core.map.bucket_of_hash(h64)[0])]):
+                return core.adopted_submit(
+                    lambda: core.adopted_unit.allow_n(key, n)).result()
+            return None
+        if not core.forward_enabled:
+            raise core.redirect_error(int(h64[0]), owner)
+        try:
+            return core.forward_allow_n(owner, key, n).result(
+                timeout=core.forward_deadline + 2.0)
+        except Exception as exc:  # noqa: BLE001 — degrade per policy
+            core.note_forward_failure(owner, exc, 1)
+            cfg = self.limiter.config
+            if not cfg.fail_open:
+                raise StorageUnavailableError(
+                    f"fleet forward failed ({exc}); fails closed per "
+                    f"config") from exc
+            return fail_open_result(
+                cfg.limit, self.limiter.clock.now() + float(cfg.window))
+
     def reset_one(self, key: str) -> None:
         """Reset routed to the key's dispatch shard (resetting shard 0's
-        limiter for a key owned by shard 2 would be a silent no-op)."""
+        limiter for a key owned by shard 2 would be a silent no-op) —
+        or, under fleet, to the key's OWNING HOST (same rule one layer
+        up: a local reset of a foreign key resets nothing)."""
+        if self._fleet is not None:
+            core = self._fleet
+            h64 = core.hash_keys([key])
+            owner = int(core.owners_of_hash(h64)[0])
+            if owner != core.self_ordinal:
+                if not core.forward_enabled:
+                    raise core.redirect_error(int(h64[0]), owner)
+                core.channel(owner).submit("reset", key).result(
+                    timeout=core.forward_deadline + 2.0)
+                return
+            if core._adopted_buckets.any() and bool(
+                    core._adopted_buckets[
+                        int(core.map.bucket_of_hash(h64)[0])]):
+                core.adopted_submit(
+                    lambda: core.adopted_unit.reset(key)).result()
+                return
         shard = self.shard_of(key)
         with self._locks[shard]:
             self._shard_limiters[shard].reset(key)
@@ -586,7 +852,16 @@ class NativeRateLimitServer:
         """Bulk decide for the gRPC AllowBatch surface: group by owning
         shard, ONE allow_batch per touched shard (in-batch same-key
         sequencing preserved — a key's requests all land on its shard in
-        frame order), results reassembled in request order."""
+        frame order), results reassembled in request order. Under fleet,
+        rows owned elsewhere route per key first (gRPC is an interop
+        side door; bulk fleet traffic belongs on the binary lanes)."""
+        pairs = list(pairs)
+        if self._fleet is not None:
+            core = self._fleet
+            h64 = core.hash_keys([k for k, _ in pairs])
+            owners = core.owners_of_hash(h64)
+            if not core.all_local(owners):
+                return [self.decide_one(k, n) for k, n in pairs]
         by_shard: dict = {}
         for i, (key, n) in enumerate(pairs):
             by_shard.setdefault(self.shard_of(key), []).append((i, key, n))
@@ -648,9 +923,26 @@ class NativeRateLimitServer:
         for shard, lim in enumerate(self._shard_limiters):
             with self._locks[shard]:
                 ov = lim.set_override(key, limit, window_scale=window_scale)
+        unit = self._fleet.adopted_unit if self._fleet is not None else None
+        if unit is not None:
+            # Adopted-range keys decide on the standby unit — mirror the
+            # write there too (write-all, one more unit).
+            ov = self._fleet.adopted_submit(
+                lambda: unit.set_override(
+                    key, limit, window_scale=window_scale)).result()
         return ov
 
     def get_override_one(self, key: str):
+        if self._fleet is not None and self._fleet.adopted_unit is not None:
+            core = self._fleet
+            h64 = core.hash_keys([key])
+            if bool(core._adopted_buckets[
+                    int(core.map.bucket_of_hash(h64)[0])]):
+                # Overrides restored from the dead host's WAL live only
+                # in the standby unit.
+                unit = core.adopted_unit
+                return core.adopted_submit(
+                    lambda: unit.get_override(key)).result()
         shard = self.shard_of(key)
         with self._locks[shard]:
             return self._shard_limiters[shard].get_override(key)
@@ -660,6 +952,10 @@ class NativeRateLimitServer:
         for shard, lim in enumerate(self._shard_limiters):
             with self._locks[shard]:
                 existed = lim.delete_override(key) or existed
+        unit = self._fleet.adopted_unit if self._fleet is not None else None
+        if unit is not None:
+            existed = self._fleet.adopted_submit(
+                lambda: unit.delete_override(key)).result() or existed
         return existed
 
     @property

