@@ -7,8 +7,9 @@ REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
-        test-audit test-fleet lint check native bench bench-quick \
-        bench-audit bench-chaos bench-fleet bench-matrix serve verify clean
+        test-audit test-fleet test-reshard lint check native bench \
+        bench-quick bench-audit bench-chaos bench-fleet bench-reshard \
+        bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -40,8 +41,15 @@ test-audit:      ## live accuracy observatory (ADR-016): engine, taps, /debug/au
 test-fleet:      ## fleet tier (ADR-017): map/routing/forwarding/failover, 2+ real server processes
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q
 
+test-reshard:    ## elastic lifecycle (ADR-018): re-bucketing oracle, migration/rejoin/departure, handoff chaos
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m pytest tests/test_reshard.py tests/test_elastic.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs N-host affine/mixed + failover JSON)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 2
+
+bench-reshard:   ## elastic lifecycle numbers (migration window / rolling-restart retention / rejoin JSON)
+	JAX_PLATFORMS=cpu $(PY) bench.py --reshard
 
 bench-audit:     ## live-vs-offline accuracy agreement + audit overhead A/B JSON
 	$(PY) bench.py --audit

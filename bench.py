@@ -915,7 +915,35 @@ def main() -> None:
                          "the measured forwarded fraction, and the "
                          "kill -9 failover row (the multi-HOST sibling "
                          "of --mesh-devices' multichip_scaling)")
+    ap.add_argument("--reshard", action="store_true",
+                    help="run ONLY the elastic lifecycle bench "
+                         "(ADR-018) over a 2-host fleet and emit the "
+                         "reshard JSON block: migration window on a "
+                         "SIGTERM departure handoff, e2e retention + "
+                         "client errors through a full rolling restart "
+                         "of one member, automatic rejoin convergence "
+                         "time, and offline tools/rebucket.py resize "
+                         "timings (published as RESHARD_r01.json)")
     args = ap.parse_args()
+
+    if args.reshard:
+        # Before the first jax.devices() call initializes the backend:
+        # the offline rebucket row builds a 4-slice mesh in-process.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        from benchmarks.reshard import run_reshard
+
+        print(json.dumps({
+            "metric": "reshard",
+            "platform": jax.devices()[0].platform,
+            "reshard": run_reshard(
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                log=lambda *a: print(*a, file=sys.stderr)),
+        }))
+        return
 
     if args.fleet_hosts:
         from benchmarks.fleet import run_fleet_scaling

@@ -52,11 +52,17 @@ class ChaosInjector:
         self._dcn_drop_p = 0.0
         self._dcn_corrupt_p = 0.0
         self._snapshot_stall_s = 0.0
+        #: Elastic-lifecycle faults (ADR-018): stall/abort the fleet
+        #: handoff path at a named phase (capture -> restore -> flip).
+        self._handoff_stall: dict = {}      # phase -> seconds
+        self._handoff_abort: dict = {}      # phase -> remaining | None
         # Observability for assertions: what actually fired.
         self.slice_faults = 0
         self.dcn_dropped = 0
         self.dcn_corrupted = 0
         self.snapshot_stalls = 0
+        self.handoff_stalls = 0
+        self.handoff_aborts = 0
 
     # ------------------------------------------------------- scenarios
 
@@ -98,6 +104,23 @@ class ChaosInjector:
         with self._lock:
             self._snapshot_stall_s = float(seconds)
 
+    def stall_handoff(self, seconds: float, phase: str = "restore") -> None:
+        """Fleet handoff (migration/rejoin/departure) sleeps ``seconds``
+        at ``phase`` — the migration-stall scenario: the OLD owner keeps
+        serving at the old epoch for the whole stall (single owner per
+        epoch, just a longer window)."""
+        with self._lock:
+            self._handoff_stall[str(phase)] = float(seconds)
+
+    def abort_handoff(self, phase: str = "flip",
+                      count: Optional[int] = None) -> None:
+        """Fleet handoff raises at ``phase`` (the next ``count`` times,
+        or until cleared) — the in-process form of kill -9 mid-handoff:
+        the transition dies BEFORE the epoch bump is published, so the
+        old owner must remain the only owner."""
+        with self._lock:
+            self._handoff_abort[str(phase)] = count
+
     def clear(self) -> None:
         """Clear every scenario (wedged resolves are released)."""
         with self._lock:
@@ -106,6 +129,8 @@ class ChaosInjector:
             self._dcn_drop_p = 0.0
             self._dcn_corrupt_p = 0.0
             self._snapshot_stall_s = 0.0
+            self._handoff_stall.clear()
+            self._handoff_abort.clear()
         for mode in modes:
             if mode[0] == "wedge":
                 mode[1].set()
@@ -171,6 +196,29 @@ class ChaosInjector:
                 return bytes(buf)
         return frame
 
+    def handoff_phase(self, phase: str) -> None:
+        """Hook inside the fleet handoff path (fleet/membership.py), at
+        the named phase: ``capture`` (source, before the handoff
+        snapshot), ``restore`` (receiver, before the standby restore),
+        ``flip`` (receiver, before the epoch bump is published)."""
+        with self._lock:
+            stall = self._handoff_stall.get(phase, 0.0)
+            abort = phase in self._handoff_abort
+            if abort:
+                cur = self._handoff_abort[phase]
+                if cur is not None:
+                    if cur <= 1:
+                        self._handoff_abort.pop(phase, None)
+                    else:
+                        self._handoff_abort[phase] = cur - 1
+                self.handoff_aborts += 1
+            elif stall > 0.0:
+                self.handoff_stalls += 1
+        if abort:
+            raise SliceFault(f"injected handoff abort at {phase!r}")
+        if stall > 0.0:
+            time.sleep(stall)
+
     def snapshot_capture(self) -> None:
         """Hook at snapshot capture entry (snapshotter thread)."""
         with self._lock:
@@ -216,7 +264,14 @@ def scenario(name: str, injector: ChaosInjector, *, slice_idx: int = 0,
     * ``wedge-slice``    — slice resolves block until cleared;
     * ``dcn-partition``  — every DCN push frame dropped;
     * ``dcn-corrupt``    — every DCN push frame bit-flipped;
-    * ``snapshot-stall`` — snapshot captures sleep ``seconds``.
+    * ``snapshot-stall`` — snapshot captures sleep ``seconds``;
+    * ``migration-stall``     — fleet handoffs stall ``seconds`` at the
+      receiver's restore phase (the old owner keeps serving, ADR-018);
+    * ``kill-during-handoff`` — fleet handoffs die at the flip phase,
+      BEFORE the epoch bump publishes (exactly one owner must remain);
+    * ``rejoin-storm``        — announce frames drop with p=0.6: peers
+      flap dead/alive, driving repeated failover + rejoin give-backs
+      (seeded, so a storm replays exactly).
     """
     if name == "kill-slice":
         injector.fail_slice(slice_idx)
@@ -230,8 +285,15 @@ def scenario(name: str, injector: ChaosInjector, *, slice_idx: int = 0,
         injector.corrupt_dcn(1.0)
     elif name == "snapshot-stall":
         injector.stall_snapshot(seconds)
+    elif name == "migration-stall":
+        injector.stall_handoff(seconds, phase="restore")
+    elif name == "kill-during-handoff":
+        injector.abort_handoff(phase="flip")
+    elif name == "rejoin-storm":
+        injector.partition_dcn(0.6)
     else:
         raise ValueError(
             f"unknown chaos scenario {name!r} (known: kill-slice, "
             f"slow-slice, wedge-slice, dcn-partition, dcn-corrupt, "
-            f"snapshot-stall)")
+            f"snapshot-stall, migration-stall, kill-during-handoff, "
+            f"rejoin-storm)")

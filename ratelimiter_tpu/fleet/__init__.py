@@ -12,7 +12,12 @@ buckets; a fleet of them is ONE limiter:
 * :class:`~ratelimiter_tpu.fleet.membership.FleetMembership` —
   announce/heartbeat gossip over the authenticated DCN channel plus
   per-range failover onto the configured successor (restored from the
-  dead host's newest snapshot + WAL suffix).
+  dead host's newest snapshot + WAL suffix), live range migration /
+  graceful departure / automatic rejoin give-back via the handoff
+  protocol (ADR-018);
+* ``fleet/handoff.py`` — the handoff artifact: standby units restored
+  from a peer's snapshot dir (own unit + aux folds, or one origin's
+  adopted unit) before ownership flips.
 
 Client-side consistent-hash routing lives in
 ``serving/client.py`` (``FleetClient`` / ``AsyncFleetClient``).
@@ -20,6 +25,7 @@ Client-side consistent-hash routing lives in
 
 from ratelimiter_tpu.fleet.config import FleetHost, FleetMap, affine_map
 from ratelimiter_tpu.fleet.forwarder import FleetCore, FleetForwarder
+from ratelimiter_tpu.fleet.handoff import build_standby
 from ratelimiter_tpu.fleet.membership import FleetMembership
 
 __all__ = [
@@ -29,4 +35,5 @@ __all__ = [
     "FleetCore",
     "FleetForwarder",
     "FleetMembership",
+    "build_standby",
 ]

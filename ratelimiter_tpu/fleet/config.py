@@ -183,6 +183,32 @@ class FleetMap:
                 groups[o] = order[lo:hi]
         return groups
 
+    def assigns(self, ranges: Sequence[Tuple[int, int]],
+                host_id: str) -> bool:
+        """True when EVERY bucket of ``ranges`` is owned by ``host_id``
+        under this map — the ownership-level flip confirmation
+        (ADR-018): an epoch comparison alone would be satisfied by any
+        concurrent bump (e.g. an unrelated failover), falsely
+        confirming a move that never landed."""
+        try:
+            o = self.ordinal(host_id)
+        except InvalidConfigError:
+            return False
+        t = self.owner_table
+        return all((t[int(lo):int(hi)] == o).all() for lo, hi in ranges)
+
+    def canonical_key(self) -> str:
+        """Deterministic content key used to tie-break two DIFFERENT
+        maps published at the SAME epoch (two uncoordinated movers can
+        mint ``epoch + 1`` concurrently): every member prefers the
+        smaller key, so the fleet converges on one winner; the losing
+        move's sender sees its flip unconfirmed (``assigns``) and
+        retries at a higher epoch."""
+        import hashlib
+
+        return hashlib.sha256(json.dumps(
+            self.to_dict(), sort_keys=True).encode()).hexdigest()
+
     def ordinal(self, host_id: str) -> int:
         for i, h in enumerate(self.hosts):
             if h.id == host_id:
@@ -216,6 +242,39 @@ class FleetMap:
                 # Keep ranges sorted by lo so the map stays readable.
                 merged = tuple(sorted(h.ranges + dead.ranges))
                 hosts.append(replace(h, ranges=merged))
+            else:
+                hosts.append(h)
+        m = FleetMap(buckets=self.buckets, hosts=tuple(hosts),
+                     epoch=self.epoch + 1)
+        m.validate()
+        return m
+
+    def move_ranges(self, ranges: Sequence[Tuple[int, int]], from_id: str,
+                    to_id: str) -> "FleetMap":
+        """New map with the given ``[lo, hi)`` ranges moved from
+        ``from_id`` to ``to_id`` and the epoch bumped — the live
+        migration / rejoin / departure transition (ADR-018). Ranges move
+        as whole units and must be ranges ``from_id`` currently owns;
+        everything else (successors, snapshot dirs) is unchanged."""
+        src = self.host(from_id)
+        self.host(to_id)  # validates existence
+        moving = {(int(lo), int(hi)) for lo, hi in ranges}
+        owned = set(src.ranges)
+        if not moving:
+            return self
+        if not moving <= owned:
+            raise InvalidConfigError(
+                f"fleet host {from_id!r} does not own ranges "
+                f"{sorted(moving - owned)} (owns {sorted(owned)}); "
+                f"ranges move as whole units")
+        hosts: List[FleetHost] = []
+        for h in self.hosts:
+            if h.id == from_id:
+                hosts.append(replace(h, ranges=tuple(
+                    sorted(owned - moving))))
+            elif h.id == to_id:
+                hosts.append(replace(h, ranges=tuple(
+                    sorted(set(h.ranges) | moving))))
             else:
                 hosts.append(h)
         m = FleetMap(buckets=self.buckets, hosts=tuple(hosts),

@@ -172,7 +172,17 @@ class FleetCore:
         #: buckets run on this limiter, restored from the dead peer's
         #: snapshot + WAL suffix before it serves (restore-before-rejoin).
         self._adopted_unit = None
+        #: origin host id -> tuple of (lo, hi) ranges this host serves on
+        #: the standby unit FOR that origin (ADR-018: a rejoining origin
+        #: takes exactly these back; the aux snapshot cycle labels its
+        #: files with them).
+        self._adopted_origins: Dict[str, tuple] = {}
         self._adopted_lock = threading.Lock()
+        #: Serializes whole install_adopted calls: failover (membership
+        #: thread) and a handoff (its own thread) can race — unguarded,
+        #: both read no-unit-mounted and the second assignment silently
+        #: dropped the first restored unit and its mask bits.
+        self._install_lock = threading.Lock()
         self._adopted_exec: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
         #: Failure sink (wired to FleetMembership.note_peer_failure):
@@ -237,25 +247,114 @@ class FleetCore:
                 adopted_buckets = None
         self._install(new_map, adopted_buckets)
 
-    def install_adopted(self, unit, ranges: Sequence) -> None:
+    def install_adopted(self, unit, ranges: Sequence,
+                        origin: Optional[str] = None) -> None:
         """Mount the failover standby unit for ``ranges`` (list of
         (lo, hi) bucket ranges). The unit must already be restored
-        (restore-before-rejoin); routing flips to it atomically."""
-        mask = self._adopted_buckets.copy()
-        if mask.shape[0] != self.map.buckets:
-            mask = np.zeros(self.map.buckets, dtype=bool)
-        for lo, hi in ranges:
-            mask[lo:hi] = True
+        (restore-before-rejoin); routing flips to it atomically.
+
+        A SECOND adoption while a unit is already mounted (a migration
+        or a second failover landing on the same successor) folds the
+        new unit's state into the mounted one by conservative union
+        (parallel/reshard.py, ADR-018): the two populations are
+        disjoint key ranges, so estimates stay >= each origin's own —
+        never an over-admit. The fold runs on the adopted executor so
+        it serializes with in-flight adopted decides; whole installs
+        serialize on ``_install_lock`` (failover and handoff threads
+        can race), and the mask update ORs into the CURRENT mask under
+        the map lock so concurrent moves never lose each other's
+        buckets. Prefer :meth:`install_and_swap` when a map swap
+        follows — it holds the install lock across BOTH, so a racing
+        reconcile can never strip the just-mounted bits in the gap."""
+        with self._install_lock:
+            self._install_adopted_locked(unit, ranges, origin)
+
+    def install_and_swap(self, unit, ranges: Sequence,
+                         new_map: FleetMap,
+                         origin: Optional[str] = None) -> None:
+        """Mount the restored unit and install the new map as ONE
+        atomic step w.r.t. mask reconciliation: between a bare
+        install_adopted and the swap, the buckets still belong to the
+        giver under the CURRENT map, so an unrelated higher-epoch
+        announce running sync_adopted_with_map would strip the
+        pre-mounted bits (and release the origin) before the flip."""
+        with self._install_lock:
+            if unit is not None:
+                self._install_adopted_locked(unit, ranges, origin)
+            self.swap_map(new_map)
+
+    def _install_adopted_locked(self, unit, ranges: Sequence,
+                                origin: Optional[str]) -> None:
+        """Body of install_adopted; ``_install_lock`` must be held."""
         with self._adopted_lock:
-            self._adopted_unit = unit
             if self._adopted_exec is None:
-                # Single worker: adopted-range decides stay FIFO (per-key
-                # order), mirroring every other dispatch unit.
-                self._adopted_exec = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="rl-fleet-adopted")
+                # Single worker: adopted-range decides stay FIFO
+                # (per-key order), mirroring every other dispatch unit.
+                self._adopted_exec = (
+                    concurrent.futures.ThreadPoolExecutor(
+                        max_workers=1,
+                        thread_name_prefix="rl-fleet-adopted"))
+            existing = self._adopted_unit
+        if existing is None or existing is unit:
+            with self._adopted_lock:
+                self._adopted_unit = unit
+        else:
+            from ratelimiter_tpu.parallel import reshard
+
+            def fold() -> None:
+                _, arrays, extra = unit.capture_state()
+                reshard.merge_into_limiter(existing, arrays, extra)
+                unit.close()
+
+            self.adopted_submit(fold).result()
+        if origin is not None:
+            self._adopted_origins[origin] = tuple(
+                (int(lo), int(hi)) for lo, hi in ranges)
         with self._lock:
+            mask = self._adopted_buckets
+            if mask.shape[0] != self.map.buckets:
+                mask = np.zeros(self.map.buckets, dtype=bool)
+            else:
+                mask = mask.copy()
+            for lo, hi in ranges:
+                mask[lo:hi] = True
             self._adopted_buckets = mask
         self._g_adopted.set(float(int(mask.sum())))
+
+    def adopted_origin_ranges(self, origin: str) -> tuple:
+        """Ranges this host serves on the standby unit for ``origin``
+        (empty tuple when none)."""
+        return self._adopted_origins.get(origin, ())
+
+    def sync_adopted_with_map(self) -> List[str]:
+        """Reconcile the adopted mask with the CURRENT map: buckets the
+        map no longer assigns to this host leave the mask (their new
+        owner published a higher epoch — e.g. a rejoined origin took its
+        ranges back), and origins whose handed ranges all left are
+        released. Returns the released origin ids. Called after every
+        map swap; the single-owner-per-epoch invariant makes this pure
+        bookkeeping — the epoch bump already moved ownership. Takes
+        ``_install_lock`` so it can never interleave with a mid-flight
+        install_and_swap (whose mounted bits only become map-owned at
+        its swap)."""
+        with self._install_lock:
+            return self._sync_adopted_locked()
+
+    def _sync_adopted_locked(self) -> List[str]:
+        with self._lock:
+            mask = self._adopted_buckets
+            if not mask.any():
+                return []
+            mine = self.map.owner_table == self.self_ordinal
+            new_mask = mask & mine
+            self._adopted_buckets = new_mask
+        released = []
+        for origin, ranges in list(self._adopted_origins.items()):
+            if not any(new_mask[lo:hi].any() for lo, hi in ranges):
+                del self._adopted_origins[origin]
+                released.append(origin)
+        self._g_adopted.set(float(int(new_mask.sum())))
+        return released
 
     def set_dead(self, ordinals: Sequence[int]) -> None:
         """Membership marks unreachable hosts so routing degrades their
@@ -417,6 +516,8 @@ class FleetCore:
             "buckets": mp.buckets,
             "owned_ranges": [list(r) for r in me.ranges],
             "adopted_buckets": int(self._adopted_buckets.sum()),
+            "adopted_origins": {o: [list(r) for r in rs] for o, rs in
+                                self._adopted_origins.items()},
             "forwarding": self.forward_enabled,
             "forwarded_total": int(self._c_forwarded.total()),
             "forward_errors_total": int(self._c_forward_errors.total()),

@@ -695,18 +695,30 @@ class SlicedMeshLimiter(RateLimiter):
         }
 
     def restore(self, path: str) -> None:
+        """Restore a combined snapshot. A snapshot taken under a
+        DIFFERENT slice count is re-bucketed onto this mesh's geometry
+        (parallel/reshard.py, ADR-018): clean splits copy state
+        verbatim per new slice, merges take the conservative union
+        (elementwise max after period alignment) — per-key override
+        tables re-route exactly, estimates only rise, so the resharded
+        mesh never over-admits relative to the source. The same math is
+        available offline as ``tools/rebucket.py`` for cold resizes."""
         from ratelimiter_tpu.checkpoint import load_state
 
         self._check_open()
         arrays, meta = load_state(path, self._CKPT_KIND, self.config)
         saved = int(meta.get("n_slices", -1))
         if saved != self.n_slices:
-            raise CheckpointError(
-                f"{path}: snapshot holds {saved} slice(s) of key-routed "
-                f"state but this mesh runs {self.n_slices} device(s) — "
-                f"per-slice counters are only meaningful under the "
-                f"routing that produced them; restart with --mesh-devices "
-                f"{saved} (or accept the loss and start fresh)")
+            from ratelimiter_tpu.parallel import reshard
+
+            logging.getLogger(__name__).warning(
+                "%s: snapshot holds %d slice(s) but this mesh runs %d "
+                "device(s) — re-bucketing key-routed state onto the new "
+                "geometry (conservative union: overrides exact, "
+                "estimates only rise; ADR-018)", path, saved,
+                self.n_slices)
+            arrays, meta = reshard.rebucket_combined(
+                arrays, meta, self.n_slices, self.config)
         extras = meta.get("slice_extras") or [{}] * self.n_slices
         for i, s in enumerate(self.slices):
             prefix = f"slice{i}:"
@@ -734,8 +746,12 @@ class SlicedMeshLimiter(RateLimiter):
         if saved != self.n_slices:
             raise CheckpointError(
                 f"{path}: snapshot holds {saved} slice(s) but this mesh "
-                f"runs {self.n_slices} — per-slice counters are only "
-                f"meaningful under the routing that produced them")
+                f"runs {self.n_slices} — a SINGLE slice cannot be "
+                f"re-bucketed in place (its peers' state would stay on "
+                f"the old routing); use a full restore(), which "
+                f"re-buckets the whole snapshot onto the new geometry "
+                f"(parallel/reshard.py, ADR-018), or resize the snapshot "
+                f"offline with tools/rebucket.py")
         extras = meta.get("slice_extras") or [{}] * self.n_slices
         prefix = f"slice{index}:"
         sub = {k[len(prefix):]: v for k, v in arrays.items()

@@ -152,6 +152,20 @@ class PersistenceManager:
         assert self.snapshotter is not None, "attach() first"
         return self.snapshotter.snapshot_now()
 
+    def add_aux_unit(self, origin: str, limiter, ranges=()) -> None:
+        """Fold an adopted-range standby unit into this host's own
+        snapshot cycle (ADR-018, closing ADR-017's declared leftover):
+        every later snapshot captures it to an ``aux-*`` file recorded
+        in the manifest, so a SECOND failure after adoption no longer
+        loses the adopted counters/overrides — this host's successor
+        restores them from here (fleet/handoff.build_standby)."""
+        assert self.snapshotter is not None, "attach() first"
+        self.snapshotter.add_aux(origin, limiter, ranges)
+
+    def remove_aux_unit(self, origin: str) -> None:
+        assert self.snapshotter is not None, "attach() first"
+        self.snapshotter.remove_aux(origin)
+
     def status(self) -> dict:
         out = self.snapshotter.status() if self.snapshotter else {
             "persistence": True, "wal_seq": self.wal.last_seq}

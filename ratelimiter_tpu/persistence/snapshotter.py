@@ -46,6 +46,10 @@ def _snap_name(snap_id: int, shard: int) -> str:
     return f"snap-{snap_id:08d}-{shard:03d}.npz"
 
 
+def _aux_name(snap_id: int, origin: str) -> str:
+    return f"aux-{snap_id:08d}-{origin}.npz"
+
+
 def read_manifest(dir_: str) -> Optional[dict]:
     """The snapshot manifest, or None when the directory has none yet.
     Unparseable content raises CheckpointError: the manifest is written
@@ -113,6 +117,15 @@ class Snapshotter:
             "rate_limiter_wal_seq",
             "Sequence number of the last durable WAL record")
         self._lock = threading.Lock()         # serializes snapshots
+        #: Auxiliary units riding this host's snapshot cycle (ADR-018:
+        #: fleet adopted-range standby units — ADR-017's declared
+        #: leftover was exactly that a second failure after adoption
+        #: lost the adopted counters because the standby unit was never
+        #: re-snapshotted under the successor's own dir). Keyed by
+        #: origin host id; each cycle writes one extra file per entry,
+        #: recorded in the manifest under ``aux`` so recovery of THIS
+        #: host's successor can restore them too.
+        self._aux: dict = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -152,6 +165,18 @@ class Snapshotter:
             except Exception:
                 log.exception("background snapshot failed; will retry "
                               "next interval")
+
+    def add_aux(self, origin: str, limiter, ranges=()) -> None:
+        """Register an auxiliary unit (adopted-range standby) so every
+        later snapshot cycle captures it alongside the main shards."""
+        with self._lock:
+            self._aux[str(origin)] = {
+                "limiter": limiter,
+                "ranges": [list(r) for r in ranges]}
+
+    def remove_aux(self, origin: str) -> None:
+        with self._lock:
+            self._aux.pop(str(origin), None)
 
     def notify_mutation(self) -> None:
         """Called per WAL append; trips the mutation-count trigger."""
@@ -197,6 +222,20 @@ class Snapshotter:
         captures = []
         for lim in self.limiters:
             captures.append((lim.capture_state(), lim.config))
+        # Several origins can share ONE merged standby unit (second
+        # adoption folds into the mounted unit): capture and write it
+        # once, with each origin's manifest entry referencing the
+        # shared file — per-origin copies would pay a full capture +
+        # .npz write of identical content per adopted origin.
+        aux_captures = []
+        unit_caps: dict = {}    # id(limiter) -> (capture, config, origin)
+        for origin, entry in self._aux.items():
+            lim = entry["limiter"]
+            key = id(lim)
+            if key not in unit_caps:
+                unit_caps[key] = (lim.capture_state(), lim.config,
+                                  origin)
+            aux_captures.append((origin, entry["ranges"], key))
         capture_s = time.perf_counter() - t0
         # Off-lock from here: serialization + fsync happen while decisions
         # keep flowing.
@@ -207,6 +246,17 @@ class Snapshotter:
             save_state(os.path.join(self.dir, name), kind, config,
                        arrays, extra)
             files.append(name)
+        aux_files: dict = {}
+        for key, ((kind, arrays, extra), config,
+                  first_origin) in unit_caps.items():
+            name = _aux_name(snap_id, first_origin)
+            extra = {**extra, "wal_seq": wal_seq, "origin": first_origin}
+            save_state(os.path.join(self.dir, name), kind, config,
+                       arrays, extra)
+            aux_files[key] = name
+        aux_entries = [{"origin": origin, "file": aux_files[key],
+                        "ranges": ranges}
+                       for origin, ranges, key in aux_captures]
         from ratelimiter_tpu.checkpoint import config_fingerprint
 
         cfg = self.limiters[0].config
@@ -223,6 +273,8 @@ class Snapshotter:
             "config": {"algorithm": str(cfg.algorithm.value),
                        "limit": cfg.limit, "window": cfg.window},
         }
+        if aux_entries:
+            entry["aux"] = aux_entries
         manifest = read_manifest(self.dir) or {
             "format_version": MANIFEST_VERSION, "snapshots": []}
         manifest["snapshots"].append(entry)
@@ -247,9 +299,12 @@ class Snapshotter:
         """Drop snapshot files not referenced by the manifest and WAL
         segments wholly below the oldest retained watermark."""
         keep = {name for e in manifest["snapshots"] for name in e["files"]}
+        keep |= {a["file"] for e in manifest["snapshots"]
+                 for a in e.get("aux", [])}
         try:
             for name in os.listdir(self.dir):
-                if (name.startswith("snap-") and name.endswith(".npz")
+                if (name.startswith(("snap-", "aux-"))
+                        and name.endswith(".npz")
                         and name not in keep):
                     try:
                         os.unlink(os.path.join(self.dir, name))

@@ -68,9 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="NAME",
                     help="arm one chaos scenario in-process (kill-slice, "
                          "slow-slice, wedge-slice, dcn-partition, "
-                         "dcn-corrupt, snapshot-stall). Requires "
-                         "--quarantine for the slice scenarios. Test/"
-                         "bench lever — never set in production")
+                         "dcn-corrupt, snapshot-stall, migration-stall, "
+                         "kill-during-handoff, rejoin-storm). Requires "
+                         "--quarantine for the slice scenarios; the "
+                         "handoff/rejoin scenarios need --fleet-config. "
+                         "Test/bench lever — never set in production")
     ap.add_argument("--chaos-slice", type=int, default=0,
                     help="victim slice index for slice scenarios")
     ap.add_argument("--chaos-after", type=float, default=0.0,
@@ -233,6 +235,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "E_NOT_OWNER redirect instead of proxying them "
                          "to the owner (routing becomes entirely the "
                          "client's job; dumb LBs will see errors)")
+    ap.add_argument("--fleet-rejoin", default="auto",
+                    choices=["auto", "manual"],
+                    help="when a previously-dead peer announces again, "
+                         "hand its adopted ranges back automatically "
+                         "via the handoff protocol (snapshot -> restore "
+                         "on the returning host -> epoch bump; "
+                         "ADR-018). 'manual' preserves the ADR-017 "
+                         "operator-driven posture")
     ap.add_argument("--fleet-heartbeat", type=float, default=0.5,
                     help="seconds between fleet announce pushes")
     ap.add_argument("--fleet-dead-after", type=float, default=2.0,
@@ -756,36 +766,88 @@ async def amain(args) -> None:
         def _fleet_adopt(dead):
             """Failover standby unit: a fresh single-device sketch
             limiter restored from the dead host's newest snapshot + WAL
-            suffix (restore-before-rejoin, the slice-quarantine
-            contract). Restore failure (unreachable dir, a mesh peer's
-            multi-file snapshot, drift) adopts FRESH state instead —
-            under-counts only, the fail-toward-allowing direction;
-            overrides are then absent until re-applied fleet-wide."""
-            unit = create_limiter(cfg, backend="sketch")
-            if dead.snapshot_dir:
-                from ratelimiter_tpu.persistence.recover import (
-                    recover as _precover,
-                )
+            suffix, PLUS any adopted-range aux units its manifest
+            records — so a second failure after adoption keeps the
+            adopted counters too (restore-before-rejoin, ADR-018).
+            Restore failure (unreachable dir, a mesh peer's multi-file
+            snapshot, drift) adopts FRESH state instead — under-counts
+            only, the fail-toward-allowing direction; overrides are
+            then absent until re-applied fleet-wide."""
+            from ratelimiter_tpu.fleet.handoff import build_standby
 
+            if dead.snapshot_dir:
                 try:
-                    report = _precover([unit], dead.snapshot_dir)
+                    unit = build_standby(cfg, dead.snapshot_dir)
                     logging.getLogger("ratelimiter_tpu.fleet").warning(
-                        "fleet: adopted %s's ranges from %s (%s)",
-                        dead.id, dead.snapshot_dir, report.summary())
+                        "fleet: adopted %s's ranges from %s",
+                        dead.id, dead.snapshot_dir)
+                    return unit
                 except Exception:
                     logging.getLogger(
                         "ratelimiter_tpu.fleet").exception(
                         "fleet: restore of %s's snapshot dir %s failed; "
                         "adopting with fresh state", dead.id,
                         dead.snapshot_dir)
-                    unit.close()
-                    unit = create_limiter(cfg, backend="sketch")
-            return unit
+            return create_limiter(cfg, backend="sketch")
+
+        def _handoff_restore(payload):
+            """Incoming handoff (migration / departure / rejoin,
+            ADR-018): restore the moved ranges' state from the sender's
+            snapshot dir — its own unit (+ aux folds) for a migration
+            or departure, or exactly OUR aux unit for a rejoin
+            give-back. Reset replay applies only where the moved
+            ranges own the key."""
+            from ratelimiter_tpu.fleet.handoff import build_standby
+
+            dir_ = payload.get("snapshot_dir")
+            if not dir_:
+                return None
+            origin = payload.get("origin")
+            owns = None
+            if origin:
+                ranges = [tuple(r) for r in payload.get("ranges", [])]
+                buckets = fleet_core.map.buckets
+
+                def owns(key: str) -> bool:
+                    b = int(fleet_core.hash_keys([key])[0] % buckets)
+                    return any(lo <= b < hi for lo, hi in ranges)
+
+            return build_standby(cfg, dir_, origin=origin, owns=owns)
+
+        def _absorb(unit):
+            """Rejoin give-back: fold the returned ranges' state into
+            the main serving limiter (conservative union) so they run
+            the full pipelined path and ride the normal snapshot
+            files. Only for the single-unit sketch backend — a sliced
+            mesh or multi-shard door keeps the adopted-standby mount
+            (folding one unit into every slice would inflate them
+            all)."""
+            if args.backend != "sketch" or (args.native
+                                            and args.shards > 1):
+                return False
+            from ratelimiter_tpu.observability.decorators import (
+                undecorated as _undec,
+            )
+            from ratelimiter_tpu.parallel import reshard
+
+            _, arrays, extra = unit.capture_state()
+            reshard.merge_into_limiter(_undec(limiter), arrays, extra)
+            return True
 
         fleet_membership = FleetMembership(
             fleet_core, heartbeat=args.fleet_heartbeat,
             dead_after=args.fleet_dead_after,
             boot_grace=args.fleet_boot_grace, adopt_fn=_fleet_adopt,
+            snapshot_fn=(persist.snapshot_now if persist is not None
+                         else None),
+            handoff_restore_fn=_handoff_restore,
+            on_adopt=((lambda origin, unit, ranges:
+                       persist.add_aux_unit(origin, unit, ranges))
+                      if persist is not None else None),
+            on_release=(persist.remove_aux_unit
+                        if persist is not None else None),
+            absorb_fn=_absorb,
+            auto_rejoin=(args.fleet_rejoin == "auto"),
             secret=dcn_secret, registry=obs_metrics.DEFAULT)
         if not args.native and args.inflight < 2:
             # The fleet-merge side pool (the symmetric-forwarding
@@ -945,6 +1007,16 @@ async def amain(args) -> None:
             start_chaos()
         await stop.wait()
         if fleet_membership is not None:
+            # Departure announce BEFORE the doors close (ADR-018): hand
+            # our ranges to the successor (final-ish snapshot + restore
+            # on its side + epoch bump), so a rolling restart never
+            # leaves an ownership hole — in-flight rows ride the
+            # forward/redirect window while we drain below. Runs in a
+            # thread so the event loop keeps receiving the flip
+            # announce the wait depends on.
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: fleet_membership.depart(
+                    wait=max(2.0, 4 * args.fleet_heartbeat)))
             fleet_membership.stop()
         for pu in pushers:
             pu.stop()
@@ -1093,6 +1165,12 @@ async def amain(args) -> None:
         start_chaos()
     await stop.wait()
     if fleet_membership is not None:
+        # Departure announce BEFORE the door drains (ADR-018) — see the
+        # native path above; off-loop so the server keeps receiving the
+        # flip announce.
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: fleet_membership.depart(
+                wait=max(2.0, 4 * args.fleet_heartbeat)))
         fleet_membership.stop()
     for pu in pushers:
         pu.stop()

@@ -699,6 +699,13 @@ class FleetClient:
             the library default.
         deadline: default per-call deadline (seconds) riding the wire
             on every fan-out leg; None disables.
+        map_max_age: refresh the ownership map from any live member
+            once it is older than this many seconds (default 3.0;
+            None disables). Errors and E_NOT_OWNER redirects already
+            self-heal the map, but a REBALANCE is silent — the old
+            owner keeps answering via server-side forwarding — so a
+            long-lived client would otherwise pay the forwarding hop
+            forever after an elastic resharding (ADR-018).
         Remaining kwargs configure each underlying Client (retries,
         backoff, timeouts).
 
@@ -711,6 +718,7 @@ class FleetClient:
     def __init__(self, fleet_map=None, *, seed: Optional[tuple] = None,
                  prefix: Optional[str] = None,
                  deadline: Optional[float] = None,
+                 map_max_age: Optional[float] = 3.0,
                  retries: int = 2, **client_kw):
         from ratelimiter_tpu.core.config import DEFAULT_PREFIX
 
@@ -723,6 +731,8 @@ class FleetClient:
         self.map = _fleet_map_of(fleet_map)
         self.prefix = DEFAULT_PREFIX if prefix is None else prefix
         self.deadline = deadline
+        self.map_max_age = map_max_age
+        self._map_fetched_at = time.monotonic()
         self._retries = retries
         self._client_kw = client_kw
         self._clients: Dict[int, Client] = {}
@@ -761,12 +771,13 @@ class FleetClient:
     def refresh_map(self) -> bool:
         """Re-fetch the ownership map from the first live member; True
         iff a newer epoch was installed. Called automatically on typed
-        redirects and connection failures."""
+        redirects, connection failures, and (``map_max_age``) staleness."""
         for ordinal in range(len(self.map.hosts)):
             try:
                 d = self._client(ordinal).fleet_map()
             except Exception:  # noqa: BLE001 — try the next member
                 continue
+            self._map_fetched_at = time.monotonic()
             m = _fleet_map_of(d)
             if m.epoch > self.map.epoch:
                 with self._lock:
@@ -774,6 +785,16 @@ class FleetClient:
                 return True
             return False
         return False
+
+    def _maybe_refresh(self) -> None:
+        """Age-based refresh (see ``map_max_age``): rebalances are
+        silent to a routing-only client, so poll the epoch at a bounded
+        cadence instead of paying the forwarding hop indefinitely."""
+        if (self.map_max_age is not None
+                and time.monotonic() - self._map_fetched_at
+                > self.map_max_age):
+            self._map_fetched_at = time.monotonic()  # backoff on failure
+            self.refresh_map()
 
     def _refresh_from_error(self, exc: Exception) -> bool:
         from ratelimiter_tpu.core.errors import NotOwnerError
@@ -791,6 +812,7 @@ class FleetClient:
 
     def allow_n(self, key: str, n: int = 1, *,
                 deadline: Optional[float] = None) -> Result:
+        self._maybe_refresh()
         dl = deadline if deadline is not None else self.deadline
         owner = int(self.map.owner_of_hash(self._hash([key]))[0])
         try:
@@ -844,6 +866,7 @@ class FleetClient:
         """One logical frame routed across the fleet: results in
         request order (list of Result, like Client.allow_batch)."""
         keys = list(keys)
+        self._maybe_refresh()
         ns = [1] * len(keys) if ns is None else list(ns)
         dl = deadline if deadline is not None else self.deadline
         h64 = self._hash(keys)
@@ -872,6 +895,7 @@ class FleetClient:
         from ratelimiter_tpu.fleet.forwarder import scatter_merge
         from ratelimiter_tpu.ops.hashing import splitmix64
 
+        self._maybe_refresh()
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
                   else np.asarray(ns, dtype=np.int64))
@@ -947,6 +971,8 @@ class AsyncFleetClient:
         self.map = None
         self.prefix = ""
         self.deadline: Optional[float] = None
+        self.map_max_age: Optional[float] = 3.0
+        self._map_fetched_at = time.monotonic()
         self._clients: Dict[int, AsyncClient] = {}
         self._client_kw: dict = {}
 
@@ -955,6 +981,7 @@ class AsyncFleetClient:
                       seed: Optional[tuple] = None,
                       prefix: Optional[str] = None,
                       deadline: Optional[float] = None,
+                      map_max_age: Optional[float] = 3.0,
                       **client_kw) -> "AsyncFleetClient":
         from ratelimiter_tpu.core.config import DEFAULT_PREFIX
 
@@ -970,6 +997,8 @@ class AsyncFleetClient:
         self.map = _fleet_map_of(fleet_map)
         self.prefix = DEFAULT_PREFIX if prefix is None else prefix
         self.deadline = deadline
+        self.map_max_age = map_max_age
+        self._map_fetched_at = time.monotonic()
         self._client_kw = client_kw
         return self
 
@@ -996,12 +1025,23 @@ class AsyncFleetClient:
                 d = await c.fleet_map()
             except Exception:  # noqa: BLE001 — try the next member
                 continue
+            self._map_fetched_at = time.monotonic()
             m = _fleet_map_of(d)
             if m.epoch > self.map.epoch:
                 self.map = m
                 return True
             return False
         return False
+
+    async def _maybe_refresh(self) -> None:
+        """Age-based refresh — the FleetClient twin: a rebalance is
+        silent behind server-side forwarding, so poll the epoch at a
+        bounded cadence (``map_max_age``; None disables)."""
+        if (self.map_max_age is not None
+                and time.monotonic() - self._map_fetched_at
+                > self.map_max_age):
+            self._map_fetched_at = time.monotonic()  # backoff on failure
+            await self.refresh_map()
 
     async def _refresh_from_error(self, exc: Exception) -> bool:
         from ratelimiter_tpu.core.errors import NotOwnerError
@@ -1018,6 +1058,7 @@ class AsyncFleetClient:
 
     async def allow_n(self, key: str, n: int = 1, *,
                       deadline: Optional[float] = None) -> Result:
+        await self._maybe_refresh()
         dl = deadline if deadline is not None else self.deadline
         owner = int(self.map.owner_of_hash(self._hash([key]))[0])
         try:
@@ -1067,6 +1108,7 @@ class AsyncFleetClient:
 
     async def allow_batch(self, keys, ns=None, *,
                           deadline: Optional[float] = None) -> list:
+        await self._maybe_refresh()
         keys = list(keys)
         ns = [1] * len(keys) if ns is None else list(ns)
         dl = deadline if deadline is not None else self.deadline
@@ -1095,6 +1137,7 @@ class AsyncFleetClient:
         from ratelimiter_tpu.fleet.forwarder import scatter_merge
         from ratelimiter_tpu.ops.hashing import splitmix64
 
+        await self._maybe_refresh()
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
                   else np.asarray(ns, dtype=np.int64))
@@ -1116,554 +1159,6 @@ class AsyncFleetClient:
         if len(parts) == 1:
             return parts[0][1]
         return scatter_merge(int(ids.shape[0]), parts[0][1].limit, parts)
-
-    async def reset(self, key: str) -> None:
-        req_id = next(self._ids)
-        type_, _ = await self._request(p.encode_reset(req_id, key), req_id)
-        if type_ != p.T_OK:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-
-    async def health(self) -> tuple[bool, float, int]:
-        req_id = next(self._ids)
-        type_, body = await self._request(
-            p.encode_simple(p.T_HEALTH, req_id), req_id)
-        if type_ != p.T_HEALTH_R:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-        return p.parse_health(body)
-
-    async def metrics(self) -> str:
-        req_id = next(self._ids)
-        type_, body = await self._request(
-            p.encode_simple(p.T_METRICS, req_id), req_id)
-        if type_ != p.T_METRICS_R:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-        return p.parse_metrics(body)
-
-    async def snapshot(self) -> tuple[int, int, float]:
-        """Trigger a durability snapshot now; returns
-        (snapshot_id, wal_seq, duration_s)."""
-        req_id = next(self._ids)
-        type_, body = await self._request(
-            p.encode_simple(p.T_SNAPSHOT, req_id), req_id)
-        if type_ != p.T_SNAPSHOT_R:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-        return p.parse_snapshot_r(body)
-
-    async def fleet_map(self) -> dict:
-        """Fetch the server's fleet ownership map (ADR-017)."""
-        req_id = next(self._ids)
-        type_, body = await self._request(p.encode_fleet_map(req_id),
-                                          req_id)
-        if type_ != p.T_FLEET_MAP_R:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-        return p.parse_fleet_map_r(body)
-
-    # ------------------------------------------- policy overrides (tiers)
-
-    async def _policy_request(self, frame: bytes, req_id: int):
-        type_, body = await self._request(frame, req_id)
-        if type_ != p.T_POLICY_R:
-            raise p.ProtocolError(f"unexpected response type {type_}")
-        return p.parse_policy_r(body)
-
-    async def set_override(self, key: str, limit=None,
-                           window_scale: float = 1.0) -> tuple[int, float]:
-        req_id = next(self._ids)
-        _, limit, scale = await self._policy_request(
-            p.encode_policy_set(req_id, key, limit, window_scale), req_id)
-        return limit, scale
-
-    async def get_override(self, key: str):
-        req_id = next(self._ids)
-        found, limit, scale = await self._policy_request(
-            p.encode_policy_key(p.T_POLICY_GET, req_id, key), req_id)
-        return (limit, scale) if found else None
-
-    async def delete_override(self, key: str) -> bool:
-        req_id = next(self._ids)
-        found, _, _ = await self._policy_request(
-            p.encode_policy_key(p.T_POLICY_DEL, req_id, key), req_id)
-        return found
-
-    async def close(self) -> None:
-        if self._reader_task is not None:
-            self._reader_task.cancel()
-            try:
-                await self._reader_task
-            except asyncio.CancelledError:
-                pass
-        if self._writer is not None:
-            self._writer.close()
-            try:
-                await self._writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
-
-
-# ====================================================================
-#                      fleet clients (ADR-017)
-# ====================================================================
-#
-# Client-side consistent-hash routing: the shard-affine loadgen mode
-# (benchmarks/e2e.py spread knob, ADR-013) promoted to first-class
-# client behavior. Every allow_batch / allow_hashed frame partitions by
-# keyspace owner (the SAME splitmix64 / h64 % buckets rule the servers
-# and mesh slices apply), fans out over per-host pooled connections
-# with the PR 8 retry/deadline machinery, and reassembles per-frame
-# answers in request order. Affine routing means a frame's rows arrive
-# at servers that own them — the zero-forwarding fast path; a stale map
-# self-heals off the typed E_NOT_OWNER redirect or a connection error
-# (refresh from any live member, retry once).
-
-
-def _fleet_map_of(obj):
-    from ratelimiter_tpu.fleet.config import FleetMap
-
-    if isinstance(obj, FleetMap):
-        return obj
-    if isinstance(obj, dict):
-        return FleetMap.from_dict(obj)
-    if isinstance(obj, str):
-        return FleetMap.load(obj)
-    raise TypeError(f"fleet map must be FleetMap/dict/path, got {obj!r}")
-
-
-class FleetClient:
-    """Blocking fleet client: one pooled :class:`Client` per member,
-    frames partitioned by owner and fanned out concurrently.
-
-    Args:
-        fleet_map: FleetMap | dict | path to the ``--fleet-config``
-            JSON. Optional when ``seed`` is given (the map bootstraps
-            via T_FLEET_MAP from the seed server).
-        seed: (host, port) of any asyncio-door fleet member, used to
-            bootstrap and refresh the map.
-        prefix: the servers' key prefix (Config.prefix) — the client
-            must hash strings exactly as the servers do. ``None`` uses
-            the library default.
-        deadline: default per-call deadline (seconds) riding the wire
-            on every fan-out leg; None disables.
-        Remaining kwargs configure each underlying Client (retries,
-        backoff, timeouts).
-
-    Same-key ordering: one connection per host (the default pool) and
-    sequential use per thread means a key's frames reach its owner in
-    issue order — the property tests/test_fleet.py pins across a
-    forwarding hop as well.
-    """
-
-    def __init__(self, fleet_map=None, *, seed: Optional[tuple] = None,
-                 prefix: Optional[str] = None,
-                 deadline: Optional[float] = None,
-                 retries: int = 2, **client_kw):
-        from ratelimiter_tpu.core.config import DEFAULT_PREFIX
-
-        if fleet_map is None:
-            if seed is None:
-                raise ValueError("FleetClient needs fleet_map or seed")
-            with Client(seed[0], seed[1], retries=retries,
-                        **client_kw) as c:
-                fleet_map = c.fleet_map()
-        self.map = _fleet_map_of(fleet_map)
-        self.prefix = DEFAULT_PREFIX if prefix is None else prefix
-        self.deadline = deadline
-        self._retries = retries
-        self._client_kw = client_kw
-        self._clients: Dict[int, Client] = {}
-        self._lock = threading.Lock()
-        self._pool = None
-
-    # ------------------------------------------------------------ plumbing
-
-    def _executor(self):
-        import concurrent.futures
-
-        with self._lock:
-            if self._pool is None:
-                self._pool = concurrent.futures.ThreadPoolExecutor(
-                    max_workers=max(2, len(self.map.hosts)),
-                    thread_name_prefix="rl-fleet-client")
-            return self._pool
-
-    def _client(self, ordinal: int) -> Client:
-        with self._lock:
-            c = self._clients.get(ordinal)
-            host = self.map.hosts[ordinal]
-            if c is None or (c._host, c._port) != (host.host, host.port):
-                if c is not None:
-                    c.close()
-                c = Client(host.host, host.port, retries=self._retries,
-                           **self._client_kw)
-                self._clients[ordinal] = c
-        return c
-
-    def _hash(self, keys: Sequence[str]):
-        from ratelimiter_tpu.ops.hashing import hash_prefixed_u64
-
-        return hash_prefixed_u64(list(keys), self.prefix)
-
-    def refresh_map(self) -> bool:
-        """Re-fetch the ownership map from the first live member; True
-        iff a newer epoch was installed. Called automatically on typed
-        redirects and connection failures."""
-        for ordinal in range(len(self.map.hosts)):
-            try:
-                d = self._client(ordinal).fleet_map()
-            except Exception:  # noqa: BLE001 — try the next member
-                continue
-            m = _fleet_map_of(d)
-            if m.epoch > self.map.epoch:
-                with self._lock:
-                    self.map = m
-                return True
-            return False
-        return False
-
-    def _refresh_from_error(self, exc: Exception) -> bool:
-        from ratelimiter_tpu.core.errors import NotOwnerError
-
-        if isinstance(exc, NotOwnerError):
-            return self.refresh_map() or True  # owner named: retry anyway
-        if isinstance(exc, (ConnectionError, OSError)):
-            return self.refresh_map()
-        return False
-
-    # ------------------------------------------------------------- scalar
-
-    def allow(self, key: str, **kw) -> Result:
-        return self.allow_n(key, 1, **kw)
-
-    def allow_n(self, key: str, n: int = 1, *,
-                deadline: Optional[float] = None) -> Result:
-        dl = deadline if deadline is not None else self.deadline
-        owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-        try:
-            return self._client(owner).allow_n(key, n, deadline=dl)
-        except Exception as exc:
-            if not self._refresh_from_error(exc):
-                raise
-            owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-            return self._client(owner).allow_n(key, n, deadline=dl)
-
-    # ------------------------------------------------------------- frames
-
-    def _fan_out_rows(self, n_rows, owners_of, call):
-        """Shared frame fan-out: partition rows by owner
-        (FleetMap.partition — the one partition rule), run one call per
-        owner concurrently, and on a redirect/connection error refresh
-        the map ONCE and retry ONLY the failed rows, re-partitioned
-        under the fresh owner table (a failed-over range's rows re-route
-        to the successor; healthy owners' rows are never re-sent, which
-        would double-charge their quota). Returns
-        ``[(row_positions, leg_result)]``; bounded to one retry."""
-        import numpy as np
-
-        pending = np.arange(n_rows)
-        parts = []
-        for attempt in (0, 1):
-            groups = self.map.partition(owners_of(pending))
-            ex = self._executor()
-            futs = [(pos, ex.submit(call, o, pending[pos]))
-                    for o, pos in groups.items()]
-            failed = []
-            first_exc = None
-            for pos, fut in futs:
-                try:
-                    parts.append((pending[pos], fut.result()))
-                except Exception as exc:  # noqa: BLE001 — retried below
-                    if first_exc is None:
-                        first_exc = exc
-                    failed.append(pending[pos])
-            if not failed:
-                return parts
-            if attempt == 1 or not self._refresh_from_error(first_exc):
-                raise first_exc
-            pending = np.concatenate(failed)
-            pending.sort()
-        return parts
-
-    def allow_batch(self, keys: Sequence[str],
-                    ns: Optional[Sequence[int]] = None, *,
-                    deadline: Optional[float] = None) -> list:
-        """One logical frame routed across the fleet: results in
-        request order (list of Result, like Client.allow_batch)."""
-        keys = list(keys)
-        ns = [1] * len(keys) if ns is None else list(ns)
-        dl = deadline if deadline is not None else self.deadline
-        h64 = self._hash(keys)
-
-        def owners_of(rows):
-            return self.map.owner_of_hash(h64[rows])
-
-        def call(o, rows):
-            return self._client(o).allow_batch(
-                [keys[i] for i in rows], [int(ns[i]) for i in rows],
-                deadline=dl)
-
-        parts = self._fan_out_rows(len(keys), owners_of, call)
-        results = [None] * len(keys)
-        for rows, out in parts:
-            for i, r in zip(rows.tolist(), out):
-                results[i] = r
-        return results
-
-    def allow_hashed(self, ids, ns=None, *,
-                     deadline: Optional[float] = None):
-        """One raw-u64-id frame routed across the fleet (the zero-copy
-        bulk lane); returns the frame's BatchResult in request order."""
-        import numpy as np
-
-        from ratelimiter_tpu.fleet.forwarder import scatter_merge
-        from ratelimiter_tpu.ops.hashing import splitmix64
-
-        ids = np.ascontiguousarray(ids, dtype=np.uint64)
-        ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
-                  else np.asarray(ns, dtype=np.int64))
-        dl = deadline if deadline is not None else self.deadline
-        h64 = splitmix64(ids)
-
-        def owners_of(rows):
-            return self.map.owner_of_hash(h64[rows])
-
-        def call(o, rows):
-            return self._client(o).allow_hashed(ids[rows], ns_arr[rows],
-                                                deadline=dl)
-
-        if not ids.shape[0]:
-            return scatter_merge(0, 0, [])
-        parts = self._fan_out_rows(int(ids.shape[0]), owners_of, call)
-        if len(parts) == 1:
-            return parts[0][1]
-        limit = parts[0][1].limit
-        return scatter_merge(int(ids.shape[0]), limit, parts)
-
-    # -------------------------------------------------------- control plane
-
-    def reset(self, key: str) -> None:
-        owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-        self._client(owner).reset(key)
-
-    def set_override(self, key: str, limit=None,
-                     window_scale: float = 1.0):
-        """Tiered override applied on EVERY member (the cross-host form
-        of set_override_all: keys hash-route, non-owners' copies are
-        idempotent and make later failovers/reshards safe)."""
-        out = None
-        for o in range(len(self.map.hosts)):
-            out = self._client(o).set_override(key, limit,
-                                               window_scale=window_scale)
-        return out
-
-    def get_override(self, key: str):
-        owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-        return self._client(owner).get_override(key)
-
-    def delete_override(self, key: str) -> bool:
-        existed = False
-        for o in range(len(self.map.hosts)):
-            existed = self._client(o).delete_override(key) or existed
-        return existed
-
-    def close(self) -> None:
-        with self._lock:
-            clients = list(self._clients.values())
-            self._clients.clear()
-            pool = self._pool
-            self._pool = None
-        for c in clients:
-            c.close()
-        if pool is not None:
-            pool.shutdown(wait=False)
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        self.close()
-
-
-class AsyncFleetClient:
-    """Pipelined fleet client: one :class:`AsyncClient` per member,
-    frames partitioned by owner and fanned out with ``asyncio.gather``
-    — the loadgen-grade surface (benchmarks/fleet.py drives it)."""
-
-    def __init__(self):
-        self.map = None
-        self.prefix = ""
-        self.deadline: Optional[float] = None
-        self._clients: Dict[int, AsyncClient] = {}
-        self._client_kw: dict = {}
-
-    @classmethod
-    async def connect(cls, fleet_map=None, *,
-                      seed: Optional[tuple] = None,
-                      prefix: Optional[str] = None,
-                      deadline: Optional[float] = None,
-                      **client_kw) -> "AsyncFleetClient":
-        from ratelimiter_tpu.core.config import DEFAULT_PREFIX
-
-        self = cls()
-        if fleet_map is None:
-            if seed is None:
-                raise ValueError("AsyncFleetClient needs fleet_map or seed")
-            c = await AsyncClient.connect(seed[0], seed[1], **client_kw)
-            try:
-                fleet_map = await c.fleet_map()
-            finally:
-                await c.close()
-        self.map = _fleet_map_of(fleet_map)
-        self.prefix = DEFAULT_PREFIX if prefix is None else prefix
-        self.deadline = deadline
-        self._client_kw = client_kw
-        return self
-
-    async def _client(self, ordinal: int) -> AsyncClient:
-        c = self._clients.get(ordinal)
-        host = self.map.hosts[ordinal]
-        if c is None or (c._host, c._port) != (host.host, host.port):
-            if c is not None:
-                await c.close()
-            c = await AsyncClient.connect(host.host, host.port,
-                                          **self._client_kw)
-            self._clients[ordinal] = c
-        return c
-
-    def _hash(self, keys):
-        from ratelimiter_tpu.ops.hashing import hash_prefixed_u64
-
-        return hash_prefixed_u64(list(keys), self.prefix)
-
-    async def refresh_map(self) -> bool:
-        for ordinal in range(len(self.map.hosts)):
-            try:
-                c = await self._client(ordinal)
-                d = await c.fleet_map()
-            except Exception:  # noqa: BLE001 — try the next member
-                continue
-            m = _fleet_map_of(d)
-            if m.epoch > self.map.epoch:
-                self.map = m
-                return True
-            return False
-        return False
-
-    async def _refresh_from_error(self, exc: Exception) -> bool:
-        from ratelimiter_tpu.core.errors import NotOwnerError
-
-        if isinstance(exc, NotOwnerError):
-            await self.refresh_map()
-            return True
-        if isinstance(exc, (ConnectionError, OSError)):
-            return await self.refresh_map()
-        return False
-
-    async def allow(self, key: str, **kw) -> Result:
-        return await self.allow_n(key, 1, **kw)
-
-    async def allow_n(self, key: str, n: int = 1, *,
-                      deadline: Optional[float] = None) -> Result:
-        dl = deadline if deadline is not None else self.deadline
-        owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-        try:
-            c = await self._client(owner)
-            return await c.allow_n(key, n, deadline=dl)
-        except Exception as exc:
-            if not await self._refresh_from_error(exc):
-                raise
-            owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-            c = await self._client(owner)
-            return await c.allow_n(key, n, deadline=dl)
-
-    def _partition(self, h64):
-        import numpy as np
-
-        owners = self.map.owner_of_hash(h64)
-        groups = {}
-        order = np.argsort(owners, kind="stable")
-        sowners = owners[order]
-        bounds = np.searchsorted(sowners,
-                                 np.arange(len(self.map.hosts) + 1))
-        for o in range(len(self.map.hosts)):
-            lo, hi = int(bounds[o]), int(bounds[o + 1])
-            if lo < hi:
-                groups[o] = order[lo:hi]
-        return groups
-
-    async def allow_batch(self, keys, ns=None, *,
-                          deadline: Optional[float] = None) -> list:
-        keys = list(keys)
-        if ns is None:
-            ns = [1] * len(keys)
-        dl = deadline if deadline is not None else self.deadline
-        groups = self._partition(self._hash(keys))
-
-        async def leg(o, pos):
-            c = await self._client(o)
-            return await c.allow_batch([keys[i] for i in pos],
-                                       [int(ns[i]) for i in pos],
-                                       deadline=dl)
-
-        try:
-            outs = await asyncio.gather(
-                *(leg(o, pos) for o, pos in groups.items()))
-        except Exception as exc:
-            if not await self._refresh_from_error(exc):
-                raise
-            groups = self._partition(self._hash(keys))
-            outs = await asyncio.gather(
-                *(leg(o, pos) for o, pos in groups.items()))
-        results = [None] * len(keys)
-        for (o, pos), out in zip(groups.items(), outs):
-            for i, r in zip(pos.tolist(), out):
-                results[i] = r
-        return results
-
-    async def allow_hashed(self, ids, ns=None, *,
-                           deadline: Optional[float] = None):
-        import numpy as np
-
-        from ratelimiter_tpu.core.types import BatchResult
-        from ratelimiter_tpu.ops.hashing import splitmix64
-
-        ids = np.ascontiguousarray(ids, dtype=np.uint64)
-        ns_arr = (np.ones(ids.shape[0], dtype=np.int64) if ns is None
-                  else np.asarray(ns, dtype=np.int64))
-        dl = deadline if deadline is not None else self.deadline
-        groups = self._partition(splitmix64(ids))
-
-        async def leg(o, pos):
-            c = await self._client(o)
-            return await c.allow_hashed(ids[pos], ns_arr[pos],
-                                        deadline=dl)
-
-        try:
-            outs = await asyncio.gather(
-                *(leg(o, pos) for o, pos in groups.items()))
-        except Exception as exc:
-            if not await self._refresh_from_error(exc):
-                raise
-            groups = self._partition(splitmix64(ids))
-            outs = await asyncio.gather(
-                *(leg(o, pos) for o, pos in groups.items()))
-        if len(groups) == 1:
-            return outs[0]
-        b = int(ids.shape[0])
-        allowed = np.zeros(b, dtype=bool)
-        remaining = np.zeros(b, dtype=np.int64)
-        retry = np.zeros(b, dtype=np.float64)
-        reset_at = np.zeros(b, dtype=np.float64)
-        fail_open = False
-        limit = 0
-        for (o, pos), out in zip(groups.items(), outs):
-            allowed[pos] = out.allowed
-            remaining[pos] = out.remaining
-            retry[pos] = out.retry_after
-            reset_at[pos] = out.reset_at
-            fail_open = fail_open or out.fail_open
-            limit = out.limit
-        return BatchResult(allowed=allowed, limit=limit,
-                           remaining=remaining, retry_after=retry,
-                           reset_at=reset_at, fail_open=fail_open)
 
     async def reset(self, key: str) -> None:
         owner = int(self.map.owner_of_hash(self._hash([key]))[0])
@@ -1690,6 +1185,11 @@ class AsyncFleetClient:
             c = await self._client(o)
             existed = await c.delete_override(key) or existed
         return existed
+
+    async def fleet_map(self) -> dict:
+        """This client's CURRENT ownership map as a dict (refreshes
+        ride :meth:`refresh_map`)."""
+        return self.map.to_dict()
 
     async def close(self) -> None:
         clients = list(self._clients.values())

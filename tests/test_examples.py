@@ -8,12 +8,22 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: Examples that spawn server subprocesses (each pays a full JAX boot
+#: per process) — slow lane to respect the 870 s tier-1 budget; their
+#: CI lanes run them explicitly (ci.yml: 11/12 ride the mesh lane, 15
+#: the fleet lane, 16 the resharding lane).
+SLOW_EXAMPLES = {"11_mesh_serving.py", "12_mixed_traffic.py",
+                 "13_tracing.py", "14_accuracy_observatory.py",
+                 "15_fleet.py", "16_elastic.py"}
 EXAMPLES = sorted(
     f for f in os.listdir(os.path.join(REPO, "examples"))
     if f.endswith(".py"))
 
 
-@pytest.mark.parametrize("script", EXAMPLES)
+@pytest.mark.parametrize(
+    "script",
+    [pytest.param(f, marks=[pytest.mark.slow] if f in SLOW_EXAMPLES
+                  else []) for f in EXAMPLES])
 def test_example_runs(script):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Offline snapshot re-bucketing: resize a checkpoint onto a new slice
+count without booting a server (the cold half of ADR-018's elastic
+resharding; the live half is ``SlicedMeshLimiter.restore``).
+
+    python tools/rebucket.py IN.npz OUT.npz --slices M
+
+Accepts both snapshot shapes:
+
+* a combined mesh snapshot (kind ``mesh:<kind>``, ``slice{i}:`` arrays) —
+  re-bucketed onto M slices (M == 1 emits a plain single-unit snapshot);
+* a plain single-unit snapshot (kind ``sketch`` — the PR 2 durability
+  format) — treated as a 1-slice mesh; M == 1 round-trips it unchanged,
+  M > 1 splits it into a combined ``mesh:`` snapshot.
+
+The config fingerprint is carried through verbatim: re-bucketing changes
+WHERE state lives (the ``mesh`` spec is excluded from the fingerprint,
+checkpoint.py), never what it means — the output restores under the same
+flags plus the new ``--mesh-devices``.
+
+Pure host numpy; no JAX, no device, no running server required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import sys
+
+import numpy as np
+
+# Runnable straight from a checkout: python tools/rebucket.py ...
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_META_KEY = "__ratelimiter_tpu_meta__"  # checkpoint._META_KEY
+
+
+def load_raw(path: str):
+    with np.load(path, allow_pickle=False) as z:
+        if _META_KEY not in z.files:
+            raise SystemExit(f"{path}: not a ratelimiter_tpu checkpoint")
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+    return arrays, meta
+
+
+def save_raw(path: str, arrays: dict, meta: dict) -> None:
+    from ratelimiter_tpu.checkpoint import write_atomic
+
+    buf = io.BytesIO()
+    np.savez(buf, **arrays,
+             **{_META_KEY: np.frombuffer(
+                 json.dumps(meta).encode(), dtype=np.uint8)})
+    write_atomic(path, buf.getvalue())
+
+
+def rebucket_file(src: str, dst: str, new_n: int) -> dict:
+    from ratelimiter_tpu.parallel import reshard
+
+    arrays, meta = load_raw(src)
+    kind = str(meta.get("kind", ""))
+    if kind.startswith("mesh:"):
+        states, extras = reshard.split_combined(arrays, meta)
+        base_kind = kind[len("mesh:"):]
+    else:
+        # Plain single-unit snapshot == a 1-slice mesh.
+        states, extras = [dict(arrays)], [
+            {k: meta[k] for k in ("saved_at", "host_period")
+             if k in meta}]
+        base_kind = kind
+    old_n = len(states)
+    new_states, new_extras = reshard.rebucket(states, extras, new_n)
+    out_meta = dict(meta)
+    out_meta["rebucketed_from"] = old_n
+    if new_n == 1:
+        out_arrays = new_states[0]
+        out_meta["kind"] = base_kind
+        out_meta.pop("n_slices", None)
+        out_meta.pop("slice_extras", None)
+        out_meta.update(new_extras[0])
+    else:
+        out_arrays, out_meta = reshard.join_combined(
+            new_states, new_extras, out_meta)
+        out_meta["kind"] = f"mesh:{base_kind}"
+    save_raw(dst, out_arrays, out_meta)
+    return {"old_slices": old_n, "new_slices": new_n,
+            "kind": out_meta["kind"], "arrays": len(out_arrays)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/rebucket.py",
+        description="resize a ratelimiter_tpu snapshot onto a new "
+                    "slice count (offline elastic resharding, ADR-018)")
+    ap.add_argument("src", help="input snapshot (.npz)")
+    ap.add_argument("dst", help="output snapshot (.npz)")
+    ap.add_argument("--slices", type=int, required=True,
+                    help="target slice count (>= 1)")
+    args = ap.parse_args(argv)
+    if args.slices < 1:
+        ap.error("--slices must be >= 1")
+    info = rebucket_file(args.src, args.dst, args.slices)
+    print(f"rebucketed {args.src} ({info['old_slices']} slice(s)) -> "
+          f"{args.dst} ({info['new_slices']} slice(s), "
+          f"kind={info['kind']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
