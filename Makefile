@@ -7,9 +7,9 @@ REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
-        test-audit test-fleet test-reshard lint check native bench \
-        bench-quick bench-audit bench-chaos bench-fleet bench-reshard \
-        bench-matrix serve verify clean
+        test-audit test-fleet test-fleet-forward test-reshard lint check \
+        native bench bench-quick bench-audit bench-chaos bench-fleet \
+        bench-reshard bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -41,12 +41,15 @@ test-audit:      ## live accuracy observatory (ADR-016): engine, taps, /debug/au
 test-fleet:      ## fleet tier (ADR-017): map/routing/forwarding/failover, 2+ real server processes
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q
 
+test-fleet-forward: ## coalesced forward lanes (ADR-019): ordering oracle, window failure attribution, 4-host routing
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_forward.py -q
+
 test-reshard:    ## elastic lifecycle (ADR-018): re-bucketing oracle, migration/rejoin/departure, handoff chaos
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -m pytest tests/test_reshard.py tests/test_elastic.py -q
 
-bench-fleet:     ## fleet scale-out numbers (single vs N-host affine/mixed + failover JSON)
-	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 2
+bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
+	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
 bench-reshard:   ## elastic lifecycle numbers (migration window / rolling-restart retention / rejoin JSON)
 	JAX_PLATFORMS=cpu $(PY) bench.py --reshard

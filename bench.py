@@ -908,13 +908,16 @@ def main() -> None:
                          "serving rate per count). On CPU this forces N "
                          "virtual host devices")
     ap.add_argument("--fleet-hosts", type=int, default=None, metavar="N",
-                    help="run ONLY the fleet scale-out bench (ADR-017) "
-                         "over N real server processes and emit the "
+                    help="run ONLY the fleet scale-out bench (ADR-017, "
+                         "forward lanes ADR-019) and emit the "
                          "fleet_scaling JSON block: single-host "
-                         "baseline, N-host affine, N-host mixed with "
-                         "the measured forwarded fraction, and the "
-                         "kill -9 failover row (the multi-HOST sibling "
-                         "of --mesh-devices' multichip_scaling)")
+                         "baseline, then affine + mixed rows at 2 AND "
+                         "N hosts (N > 2 adds the routing-vs-N^2-"
+                         "chatter row: per-host mixed throughput "
+                         "should stay flat), expected vs measured "
+                         "forwarded fraction over GO-aligned windows, "
+                         "and the kill -9 failover row (the multi-HOST "
+                         "sibling of --mesh-devices' multichip_scaling)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "

@@ -1,4 +1,5 @@
-"""Fleet scale-out benchmark (ADR-017): the ``fleet_scaling`` block.
+"""Fleet scale-out benchmark (ADR-017, forward lanes ADR-019): the
+``fleet_scaling`` block.
 
 Topology per row: N real ``python -m ratelimiter_tpu.serving`` fleet
 members (asyncio door, sketch backend) + one LOADGEN PROCESS per member
@@ -12,13 +13,28 @@ knob: each connection's ids are drawn from the bucket ranges of
 ``spread`` hosts starting at its home host. spread=1 is pure host-affine
 traffic (what a consistent-hash LB or FleetClient produces — zero
 forwarding); spread=N is uniform mixed traffic, so roughly (N-1)/N of
-every frame is mis-routed and exercises the server-side forwarder. The
-measured forwarded fraction is read back from the members'
-``rate_limiter_fleet_forwarded_decisions_total`` counters, not assumed.
+every frame is mis-routed and exercises the server-side forwarder.
 
-Rows: single-host baseline, N-host affine, N-host mixed (with forwarded
-fraction), plus a kill -9 failover row (recovery window + override
-exactness + bounded counter loss). Published as FLEET_r01.json via
+**Forwarded-fraction honesty (ISSUE-12 satellite).** FLEET_r01 reported
+a measured fraction of 0.9017 where 0.5 was expected at spread=2. The
+increment sites were correct — the HARNESS mixed measurement windows:
+the numerator (scraped ``rate_limiter_fleet_forwarded_decisions_total``
+deltas) covered warmup + measure while the denominator (client-side
+counted decisions) was post-warmup only, and mixed warmup runs at burst
+throughput (empty forward queues, cold in-flight windows), inflating
+the ratio. This harness aligns the windows: loadgens signal READY, the
+parent fires one GO event, everyone derives the same measurement start
+from it, and the parent scrapes the forwarded counters AT measurement
+start and again after the drain — numerator and denominator now cover
+the same interval (residual skew: rows in flight at the boundary
+scrapes). Every row emits BOTH ``forwarded_fraction_expected`` and
+``forwarded_fraction_measured``.
+
+Rows: single-host baseline, then per host count in the sweep (default
+2 and N for ``--fleet-hosts N``): affine and mixed — with per-host
+mixed throughput so the ≥4-host row shows whether ROUTING (flat
+per-host rate) or N^2 chatter (collapsing per-host rate) sets the
+slope — plus a kill -9 failover row. Published as FLEET_r02.json via
 ``bench.py --fleet-hosts N``.
 """
 
@@ -80,6 +96,10 @@ def _spawn_member(port: int, cfgpath: str, self_id: str, *,
             "--inflight", "4", "--port", str(port),
             "--fleet-config", cfgpath, "--fleet-self", self_id,
             "--fleet-forward-deadline", "60",
+            # ADR-019 forward-lane defaults, explicit for the record:
+            "--fleet-forward-inflight", "2",
+            "--fleet-forward-conns", "1",
+            "--fleet-forward-coalesce", "16384",
             "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5"]
     if snap:
         argv += ["--snapshot-dir", snap, "--snapshot-interval", "500"]
@@ -128,11 +148,14 @@ def _id_pools(fleet: dict, per_host: int = 1 << 16,
 
 def _loadgen_entry(home: int, port: int, pool_bytes: bytes,
                    seconds: float, warmup: float, conns: int,
-                   frame: int, depth: int, out_q) -> None:
+                   frame: int, depth: int, out_q, go) -> None:
     """One loadgen process: per-connection home-host affinity — every
     frame goes to ``port`` with ids from ``pool`` (which the parent
-    built for the connection's spread window). Counts decisions after
-    warmup; samples per-frame RTTs."""
+    built for the connection's spread window). Signals READY once its
+    connections are open, then waits for the shared GO event; the
+    measurement window starts ``warmup`` seconds after GO on every
+    process — the same instant the parent scrapes the forwarded
+    counters, so numerator and denominator cover one interval."""
     import asyncio
 
     pool = np.frombuffer(pool_bytes, dtype=np.uint64)
@@ -142,6 +165,8 @@ def _loadgen_entry(home: int, port: int, pool_bytes: bytes,
 
         clients = [await AsyncClient.connect(port=port)
                    for _ in range(conns)]
+        out_q.put(("ready", home))
+        go.wait()
         counted = 0
         lats: List[float] = []
         t_measure = time.perf_counter() + warmup
@@ -185,7 +210,7 @@ def _loadgen_entry(home: int, port: int, pool_bytes: bytes,
         return counted, max(end - t_measure, 1e-9), lats
 
     counted, span, lats = asyncio.run(run())
-    out_q.put((home, counted, span, lats))
+    out_q.put(("done", home, counted, span, lats))
 
 
 def _scrape_forwarded(ports: List[int]) -> int:
@@ -211,9 +236,9 @@ def _run_traffic(fleet: dict, ports: List[int], *, spread: int,
                  depth: int, log=print) -> Dict:
     pools = _id_pools(fleet, seed=1)
     n = len(ports)
-    fwd_before = _scrape_forwarded(ports)
     ctx = mp.get_context("spawn")
     out_q = ctx.Queue()
+    go = ctx.Event()
     procs = []
     for home in range(n):
         window = np.concatenate([pools[(home + j) % n]
@@ -222,20 +247,40 @@ def _run_traffic(fleet: dict, ports: List[int], *, spread: int,
         procs.append(ctx.Process(
             target=_loadgen_entry,
             args=(home, ports[home], window.tobytes(), seconds, warmup,
-                  conns, frame, depth, out_q)))
+                  conns, frame, depth, out_q, go)))
     for pr in procs:
         pr.start()
-    results = [out_q.get(timeout=seconds + 300) for _ in procs]
+    msgs = []
+    ready = 0
+    while ready < n:
+        msg = out_q.get(timeout=300)
+        if msg[0] == "ready":
+            ready += 1
+        else:
+            msgs.append(msg)
+    go.set()
+    # Scrape the forwarded counters AT measurement start (aligned with
+    # every loadgen's t_measure = GO + warmup) so the fraction's
+    # numerator and denominator cover the same window.
+    time.sleep(warmup)
+    fwd_start = _scrape_forwarded(ports)
+    results = [m for m in msgs if m[0] == "done"]
+    while len(results) < n:
+        msg = out_q.get(timeout=seconds + 300)
+        if msg[0] == "done":
+            results.append(msg)
     for pr in procs:
         pr.join(timeout=60)
-    counted = sum(r[1] for r in results)
-    span = max(r[2] for r in results)
-    lats = np.array(sorted(x for r in results for x in r[3]))
-    fwd = _scrape_forwarded(ports) - fwd_before
+    fwd = _scrape_forwarded(ports) - fwd_start
+    counted = sum(r[2] for r in results)
+    span = max(r[3] for r in results)
+    lats = np.array(sorted(x for r in results for x in r[4]))
+    per_host = round(counted / span / n, 1)
     row = {
         "n_hosts": n,
         "spread": spread,
         "decisions_per_sec": round(counted / span, 1),
+        "decisions_per_sec_per_host": per_host,
         "completed": counted,
         "frame_p50_ms": (round(float(np.percentile(lats, 50)) * 1e3, 2)
                          if lats.size else None),
@@ -244,10 +289,11 @@ def _run_traffic(fleet: dict, ports: List[int], *, spread: int,
         "connections_per_host": conns,
         "ids_per_frame": frame,
         "frames_in_flight_per_conn": depth,
-        # Numerator scraped from the members' forwarded-decisions
-        # counters over the WHOLE run (warmup included); denominator is
-        # post-warmup client decisions — so the mixed row reads high
-        # (an upper bound), and the affine row's 0.0 is exact.
+        # Numerator (member forwarded-decisions counter deltas) and
+        # denominator (client-side counted decisions) cover the SAME
+        # post-warmup window — both scrapes align with the loadgens'
+        # shared GO-derived measurement start; residual skew is the
+        # rows in flight at each boundary scrape.
         "forwarded_fraction_measured": (round(fwd / counted, 4)
                                         if counted else None),
         "forwarded_fraction_expected": round((spread - 1) / spread, 4),
@@ -259,7 +305,9 @@ def _run_traffic(fleet: dict, ports: List[int], *, spread: int,
     }
     log(f"fleet n={n} spread={spread}: "
         f"{row['decisions_per_sec']:.0f}/s "
-        f"fwd={row['forwarded_fraction_measured']}")
+        f"(p99 {row['frame_p99_ms']}ms) "
+        f"fwd={row['forwarded_fraction_measured']} "
+        f"(expected {row['forwarded_fraction_expected']})")
     return row
 
 
@@ -336,19 +384,77 @@ def _run_failover(tmp: str, *, log=print) -> Dict:
                 pr.kill()
 
 
+def _run_host_count(n_hosts: int, tmp: str, *, seconds: float,
+                    warmup: float, conns: int, frame: int, depth: int,
+                    log=print) -> Dict:
+    """Affine + mixed rows for one host count. For n > 2 a THIRD row
+    runs at spread=2 — the same ~0.5 mis-routed fraction as the 2-host
+    mixed row, across more hosts — because uniform mixed (spread=n)
+    raises the mis-routed fraction to (n-1)/n BY CONSTRUCTION: the
+    fixed-spread row isolates the routing slope (per-host throughput
+    vs host count at constant forwarding share; N^2 chatter would
+    collapse it) from the cost of forwarding more of the traffic."""
+    ports = [_free_port() for _ in range(n_hosts)]
+    fleetN = _fleet_config_dict(ports, 16 * n_hosts)
+    cfgN = os.path.join(tmp, f"fleet{n_hosts}.json")
+    with open(cfgN, "w", encoding="utf-8") as f:
+        json.dump(fleetN, f)
+    members = [_spawn_member(ports[i], cfgN, f"h{i}")
+               for i in range(n_hosts)]
+    try:
+        _wait_members(members)
+        affine = _run_traffic(
+            fleetN, ports, spread=1, seconds=seconds, warmup=warmup,
+            conns=conns, frame=frame, depth=depth, log=log)
+        mixed = _run_traffic(
+            fleetN, ports, spread=n_hosts, seconds=seconds,
+            warmup=warmup, conns=conns, frame=frame, depth=depth,
+            log=log)
+        mixed_fixed = (None if n_hosts <= 2 else _run_traffic(
+            fleetN, ports, spread=2, seconds=seconds, warmup=warmup,
+            conns=conns, frame=frame, depth=depth, log=log))
+    finally:
+        for pr in members:
+            pr.terminate()
+        for pr in members:
+            try:
+                pr.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+    row = {"n_hosts": n_hosts, "affine": affine, "mixed": mixed}
+    if mixed_fixed is not None:
+        row["mixed_fixed_spread2"] = mixed_fixed
+    if affine["decisions_per_sec"]:
+        row["mixed_vs_affine"] = round(
+            mixed["decisions_per_sec"] / affine["decisions_per_sec"], 2)
+    if affine["frame_p99_ms"]:
+        row["mixed_p99_vs_affine_p99"] = round(
+            mixed["frame_p99_ms"] / affine["frame_p99_ms"], 2)
+    return row
+
+
 def run_fleet_scaling(n_hosts: int = 2, *, seconds: float = 4.0,
                       warmup: float = 2.0, conns: int = 4,
-                      frame: int = 2048, depth: int = 4,
+                      frame: int = 2048, depth: int = 12,
                       log=print) -> Dict:
-    """The whole fleet_scaling block: single-host baseline, N-host
-    affine, N-host mixed (forwarded), and the failover row."""
+    """The whole fleet_scaling block: single-host baseline, a host-count
+    sweep (2 and ``n_hosts`` when it exceeds 2 — the >=4-host row shows
+    whether routing or N^2 chatter sets the slope), and the failover
+    row. ``affine``/``mixed`` stay as top-level aliases of the 2-host
+    rows for FLEET_r01 readers."""
     import tempfile
 
+    counts = sorted({2, max(2, n_hosts)})
     out: Dict = {
         "harness": ("N asyncio-door sketch members + one loadgen "
                     "process per member (pipelined raw-id frames, "
                     "per-connection home-host affinity, spread knob "
-                    "dials the mis-routed fraction)"),
+                    "dials the mis-routed fraction; GO-synchronized "
+                    "measurement windows — forwarded fraction numerator "
+                    "and denominator cover the same interval)"),
+        "forward_lane": ("ADR-019 coalesced columnar peer lanes: "
+                         "inflight 2 x 1 conn per peer, coalesce cap "
+                         "16384 rows/wire frame"),
     }
     with tempfile.TemporaryDirectory() as tmp:
         # -------- single-host baseline (a fleet of one)
@@ -367,39 +473,36 @@ def run_fleet_scaling(n_hosts: int = 2, *, seconds: float = 4.0,
         finally:
             m0.terminate()
             m0.wait(timeout=30)
-        # -------- N hosts: affine then mixed
-        ports = [_free_port() for _ in range(n_hosts)]
-        fleetN = _fleet_config_dict(ports, 16 * n_hosts)
-        cfgN = os.path.join(tmp, "fleetN.json")
-        with open(cfgN, "w", encoding="utf-8") as f:
-            json.dump(fleetN, f)
-        members = [_spawn_member(ports[i], cfgN, f"h{i}")
-                   for i in range(n_hosts)]
-        try:
-            _wait_members(members)
-            out["affine"] = _run_traffic(
-                fleetN, ports, spread=1, seconds=seconds, warmup=warmup,
-                conns=conns, frame=frame, depth=depth, log=log)
-            out["mixed"] = _run_traffic(
-                fleetN, ports, spread=n_hosts, seconds=seconds,
-                warmup=warmup, conns=conns, frame=frame, depth=depth,
-                log=log)
-        finally:
-            for pr in members:
-                pr.terminate()
-            for pr in members:
-                try:
-                    pr.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    pr.kill()
+        # -------- the sweep: affine + mixed per host count
+        out["sweep"] = [
+            _run_host_count(n, tmp, seconds=seconds, warmup=warmup,
+                            conns=conns, frame=frame, depth=depth,
+                            log=log)
+            for n in counts]
+        base = out["sweep"][0]
+        out["affine"] = base["affine"]
+        out["mixed"] = base["mixed"]
         single = out["single_host"]["decisions_per_sec"]
         out["affine_scaling_vs_single_host"] = (
-            round(out["affine"]["decisions_per_sec"] / single, 2)
+            round(base["affine"]["decisions_per_sec"] / single, 2)
             if single else None)
-        out["mixed_vs_affine"] = (
-            round(out["mixed"]["decisions_per_sec"]
-                  / out["affine"]["decisions_per_sec"], 2)
-            if out["affine"]["decisions_per_sec"] else None)
+        out["mixed_vs_affine"] = base.get("mixed_vs_affine")
+        big = out["sweep"][-1]
+        if big["n_hosts"] > 2 and base["mixed"]["decisions_per_sec"]:
+            # Routing-vs-chatter check (1.0 = perfectly flat slope):
+            # per-host throughput at the largest count relative to the
+            # 2-host mixed row, AT THE SAME mis-routed fraction
+            # (spread=2, ~0.5) — uniform mixed raises the fraction to
+            # (n-1)/n by construction, which measures the cost of
+            # forwarding MORE traffic, not of having more hosts; that
+            # ratio is reported separately.
+            per2 = base["mixed"]["decisions_per_sec_per_host"]
+            fixed = big.get("mixed_fixed_spread2")
+            if fixed is not None:
+                out["mixed_per_host_ratio_vs_2_hosts"] = round(
+                    fixed["decisions_per_sec_per_host"] / per2, 2)
+            out["uniform_mixed_per_host_ratio_vs_2_hosts"] = round(
+                big["mixed"]["decisions_per_sec_per_host"] / per2, 2)
         # -------- failover
         out["failover"] = _run_failover(tmp, log=log)
     return out

@@ -8,7 +8,10 @@ buckets; a fleet of them is ONE limiter:
   (bucket ranges per host, epoch-versioned);
 * :class:`~ratelimiter_tpu.fleet.forwarder.FleetCore` /
   :class:`~ratelimiter_tpu.fleet.forwarder.FleetForwarder` — per-process
-  routing + the bounded server-side forwarder for mis-routed rows;
+  routing + the server-side forwarder for mis-routed rows, riding the
+  coalesced columnar peer lanes of ``fleet/lanes.py`` (ADR-019:
+  pipelined multi-connection links, cross-frame coalescing windows,
+  zero-copy row-view reassembly);
 * :class:`~ratelimiter_tpu.fleet.membership.FleetMembership` —
   announce/heartbeat gossip over the authenticated DCN channel plus
   per-range failover onto the configured successor (restored from the

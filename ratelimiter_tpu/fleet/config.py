@@ -53,6 +53,16 @@ class FleetHost:
     #: (shared filesystem / replicated volume): the successor restores
     #: the adopted ranges from the newest snapshot + WAL suffix here.
     snapshot_dir: Optional[str] = None
+    #: Dispatch shards behind this member's door (ADR-019). 1 (the
+    #: default, and always true for the asyncio door) lets peers
+    #: hash-forward STRING rows on the columnar lane — a single-shard
+    #: receiver decides ``splitmix64_inv(h64)`` bit-identically to the
+    #: direct string. A MULTI-shard native member routes string frames
+    #: by FNV over raw key bytes, so it MUST declare its shard count
+    #: here; peers then forward its string rows as strings. The server
+    #: binary refuses to start when its own entry disagrees with its
+    #: actual shard count.
+    shards: int = 1
 
     @property
     def addr(self) -> str:
@@ -65,6 +75,8 @@ class FleetHost:
             d["successor"] = self.successor
         if self.snapshot_dir is not None:
             d["snapshot_dir"] = self.snapshot_dir
+        if self.shards != 1:
+            d["shards"] = self.shards
         return d
 
 
@@ -91,7 +103,8 @@ class FleetMap:
                       ranges=tuple((int(lo), int(hi))
                                    for lo, hi in h.get("ranges", [])),
                       successor=h.get("successor"),
-                      snapshot_dir=h.get("snapshot_dir"))
+                      snapshot_dir=h.get("snapshot_dir"),
+                      shards=int(h.get("shards", 1)))
             for h in d["hosts"])
         m = cls(buckets=int(d["buckets"]), hosts=hosts,
                 epoch=int(d.get("epoch", 1)))
@@ -118,6 +131,11 @@ class FleetMap:
         ids = [h.id for h in self.hosts]
         if len(set(ids)) != len(ids):
             raise InvalidConfigError(f"duplicate fleet host ids: {ids}")
+        for h in self.hosts:
+            if h.shards < 1:
+                raise InvalidConfigError(
+                    f"fleet host {h.id!r} declares shards={h.shards}; "
+                    f"must be >= 1")
         covered = np.zeros(self.buckets, dtype=np.int32)
         for h in self.hosts:
             if h.successor is not None and h.successor not in ids:

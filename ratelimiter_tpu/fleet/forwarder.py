@@ -1,8 +1,9 @@
-"""Fleet routing core + the server-side bounded forwarder (ADR-017).
+"""Fleet routing core + the server-side coalesced forwarder (ADR-017,
+forward lanes reworked by ADR-019).
 
 ``FleetCore`` is one process's view of the fleet: the live ownership map
 (swapped atomically on epoch bumps), this host's identity, per-peer
-forward channels, the adopted-range standby unit installed by failover,
+forward lanes, the adopted-range standby unit installed by failover,
 and the shared metrics. Both front doors route through one core:
 
 * the asyncio door wraps its serving limiter in :class:`FleetForwarder`
@@ -10,31 +11,35 @@ and the shared metrics. Both front doors route through one core:
   calls partition per frame);
 * the native (C++) door calls the core directly from its bridge
   callbacks (serving/native_server.py), where the key blob is still in
-  hand — foreign STRING rows forward as strings so a multi-shard
-  receiver's FNV router lands them on the same shard as that key's
-  direct traffic.
+  hand.
 
-Forwarding rides the PLAIN decision lanes (T_ALLOW_BATCH for string
-rows, T_ALLOW_HASHED for raw-id rows — already-finalized hashes recover
-their raw ids via ``splitmix64_inv``), so every server parses forwarded
-traffic natively and the receiver's decisions are bit-identical to the
-same rows arriving directly. Per-peer channels are single-worker FIFO
-queues over ONE pooled connection: same-key frames forwarded to a peer
-arrive (and decide) in send order — the cross-host half of the in-batch
-sequencing contract, pinned by tests/test_fleet.py.
+Forwarding rides ONE columnar lane (ADR-019): every foreign row reduces
+to its finalized u64 hash, the lane ships ``splitmix64_inv(h64)`` on
+the plain ``T_ALLOW_HASHED`` wire (the receiver re-finalizes to the
+bit-identical hash — splitmix64 is a bijection), and fragments from
+MANY inbound frames coalesce into one wire frame per peer connection
+per window (fleet/lanes.py). String rows hash-forward on the same lane
+when the receiver is single-shard — decisions and policy overrides key
+on the finalized hash, so the answer is bit-identical to the string
+arriving directly; a MULTI-shard native receiver routes string frames
+by FNV over the raw key bytes, so string rows bound for one (declared
+``shards`` > 1 in the fleet map) still forward as strings, pipelined on
+the same connection. Same-key send order survives the multi-connection
+links via per-key connection affinity (``h64 % conns``).
 
-Bounded-ness: each peer channel has a finite queue and every forwarded
-call carries the fleet forward deadline (the ADR-015 wire extension —
-the peer sheds expired work). Overflow / peer failure degrades the rows
-per the configured fail-open/fail-closed policy, exactly the quarantine
-contract (ADR-015), and feeds the membership failure classifier.
+Bounded-ness: each peer lane bounds outstanding fragments
+(``--fleet-forward-queue``) and in-flight wire frames per connection
+(``--fleet-forward-inflight``); every forwarded frame carries the fleet
+forward deadline (the ADR-015 wire extension — the peer sheds expired
+work). Overflow / peer failure degrades exactly the failed wire
+frame's member rows per the configured fail-open/fail-closed policy,
+and feeds the membership failure classifier.
 """
 
 from __future__ import annotations
 
 import concurrent.futures
 import logging
-import queue as queue_mod
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -51,6 +56,7 @@ from ratelimiter_tpu.core.types import (
     fail_open_result,
 )
 from ratelimiter_tpu.fleet.config import FleetMap
+from ratelimiter_tpu.fleet.lanes import ForwardRuntime, PeerLane
 from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.observability.decorators import LimiterDecorator
 from ratelimiter_tpu.ops.hashing import (
@@ -61,97 +67,50 @@ from ratelimiter_tpu.ops.hashing import (
 
 log = logging.getLogger("ratelimiter_tpu.fleet")
 
+#: Forward RTT histogram buckets: a LAN hop under load — finer than the
+#: dispatch buckets below 1 ms, out to the multi-second failure tail.
+FORWARD_RTT_BUCKETS = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2,
+                       2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
-class _PeerChannel:
-    """FIFO forward channel to ONE peer: a single daemon worker drains a
-    bounded queue over one blocking Client connection. One worker per
-    peer = frames to a peer decide in send order (same-key sequencing
-    across the forwarding hop); the queue bound is the forwarder's
-    backpressure (overflow answers degraded, never buffers unbounded)."""
 
-    def __init__(self, host: str, port: int, *, deadline: float,
-                 queue_cap: int, label: str):
-        self.host, self.port = host, port
-        self.deadline = float(deadline)
-        self.label = label
-        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=queue_cap)
-        self._client = None
-        self._thread = threading.Thread(
-            target=self._run, name=f"rl-fleet-fwd-{label}", daemon=True)
-        self._thread.start()
+class LaneMetrics:
+    """Per-peer coalescing/occupancy instruments shared by every lane
+    of one core (ADR-019 observability)."""
 
-    def _get_client(self):
-        if self._client is None:
-            from ratelimiter_tpu.serving.client import Client
+    def __init__(self, reg: m.Registry):
+        self._g_rows = reg.gauge(
+            "rate_limiter_fleet_forward_window_rows",
+            "Rows in the most recent coalesced forward window per peer "
+            "(occupancy: how much each wire frame amortizes)")
+        self._g_frames = reg.gauge(
+            "rate_limiter_fleet_forward_window_frames",
+            "Member fragments merged into the most recent coalesced "
+            "forward window per peer (depth: how many inbound frames "
+            "share one wire round-trip)")
+        self._c_frames = reg.counter(
+            "rate_limiter_fleet_forward_wire_frames_total",
+            "Coalesced wire frames sent to each peer (rows_total / "
+            "frames_total = mean window occupancy)")
+        self._c_rows = reg.counter(
+            "rate_limiter_fleet_forward_wire_rows_total",
+            "Rows shipped inside coalesced wire frames per peer")
+        self._h_rtt = reg.histogram(
+            "rate_limiter_fleet_forward_rtt_seconds",
+            "Wire round-trip of one coalesced forward frame (send to "
+            "parsed reply)", FORWARD_RTT_BUCKETS)
 
-            self._client = Client(
-                self.host, self.port,
-                connect_timeout=min(self.deadline, 5.0),
-                call_timeout=self.deadline + 1.0,
-                retries=1, backoff=0.02, backoff_max=0.2)
-        return self._client
+    def window(self, peer: str, frames: int, rows: int) -> None:
+        self._g_rows.set(float(rows), peer=peer)
+        self._g_frames.set(float(frames), peer=peer)
+        self._c_frames.inc(1, peer=peer)
+        self._c_rows.inc(rows, peer=peer)
 
-    def _drop_client(self) -> None:
-        if self._client is not None:
-            try:
-                self._client.close()
-            except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
-            self._client = None
-
-    def _run(self) -> None:
-        while True:
-            item = self._q.get()
-            if item is None:
-                self._drop_client()
-                return
-            fut, kind, payload = item
-            if not fut.set_running_or_notify_cancel():
-                continue
-            try:
-                c = self._get_client()
-                if kind == "batch":
-                    keys, ns = payload
-                    out = c.allow_batch(keys, ns, deadline=self.deadline)
-                elif kind == "ids":
-                    ids, ns = payload
-                    out = c.allow_hashed(ids, ns, deadline=self.deadline)
-                elif kind == "allow_n":
-                    key, n = payload
-                    out = c.allow_n(key, n, deadline=self.deadline)
-                elif kind == "reset":
-                    c.reset(payload)
-                    out = None
-                elif kind == "map":
-                    out = c.fleet_map()
-                else:  # pragma: no cover - programming error
-                    raise ValueError(f"unknown forward kind {kind}")
-                fut.set_result(out)
-            except BaseException as exc:  # noqa: BLE001 — future carries it
-                # A failed call may leave the connection desynced/dead;
-                # rebuild it next job rather than risk misaligned frames.
-                self._drop_client()
-                fut.set_exception(exc)
-
-    def submit(self, kind: str, payload) -> "concurrent.futures.Future":
-        fut: concurrent.futures.Future = concurrent.futures.Future()
-        try:
-            self._q.put_nowait((fut, kind, payload))
-        except queue_mod.Full:
-            raise StorageUnavailableError(
-                f"fleet forward queue to {self.host}:{self.port} is full "
-                f"({self._q.maxsize} frames) — peer slow or dead") from None
-        return fut
-
-    def close(self) -> None:
-        try:
-            self._q.put_nowait(None)
-        except queue_mod.Full:
-            pass
+    def rtt(self, seconds: float) -> None:
+        self._h_rtt.observe(seconds)
 
 
 class FleetCore:
-    """One process's fleet state: live map + identity + peer channels +
+    """One process's fleet state: live map + identity + peer lanes +
     adopted-range unit + metrics. Thread-safe: the map reference swaps
     atomically; routing reads never lock."""
 
@@ -159,6 +118,9 @@ class FleetCore:
                  prefix: str = "", forward: bool = True,
                  forward_deadline: float = 1.0,
                  forward_queue: int = 128,
+                 forward_inflight: int = 2,
+                 forward_conns: int = 1,
+                 forward_coalesce: int = 16384,
                  registry: Optional[m.Registry] = None):
         fleet_map.validate()
         self.self_id = self_id
@@ -166,8 +128,15 @@ class FleetCore:
         self.forward_enabled = bool(forward)
         self.forward_deadline = float(forward_deadline)
         self.forward_queue = int(forward_queue)
+        self.forward_inflight = max(1, int(forward_inflight))
+        self.forward_conns = max(1, int(forward_conns))
+        # Bounded by the wire: the coalesced REPLY costs ~24.1 B/row
+        # against the 1 MiB MAX_FRAME (the request is cheaper at
+        # 12 B/row), so the window may never exceed ~43K rows.
+        self.forward_coalesce = max(1, min(int(forward_coalesce), 32768))
         self._lock = threading.Lock()
-        self._channels: Dict[int, _PeerChannel] = {}
+        self._lanes: Dict[int, PeerLane] = {}
+        self._runtime: Optional[ForwardRuntime] = None
         #: Adopted-range standby unit (failover): decisions for adopted
         #: buckets run on this limiter, restored from the dead peer's
         #: snapshot + WAL suffix before it serves (restore-before-rejoin).
@@ -189,6 +158,7 @@ class FleetCore:
         #: classified forward failures count toward peer-death detection.
         self.on_peer_failure = None
         reg = registry if registry is not None else m.DEFAULT
+        self._lane_metrics = LaneMetrics(reg)
         self._g_epoch = reg.gauge(
             "rate_limiter_fleet_epoch",
             "Current fleet ownership-map epoch (bumps on failover)")
@@ -201,11 +171,13 @@ class FleetCore:
             "(nonzero only after a failover adoption)")
         self._c_forwarded = reg.counter(
             "rate_limiter_fleet_forwarded_decisions_total",
-            "Decisions proxied to their owning host because they "
-            "arrived mis-routed (ADR-017 server-side forwarding)")
+            "Decisions submitted to their owning host because they "
+            "arrived mis-routed (ADR-017 server-side forwarding; "
+            "counted at submit — a later lane failure degrades the "
+            "rows AND counts them in forward_errors/degraded)")
         self._c_forward_errors = reg.counter(
             "rate_limiter_fleet_forward_errors_total",
-            "Forward calls that failed (peer dead/slow/queue-full); "
+            "Forward jobs that failed (peer dead/slow/queue-full); "
             "their rows answered per fail-open/closed policy")
         self._c_redirects = reg.counter(
             "rate_limiter_fleet_redirects_total",
@@ -219,6 +191,7 @@ class FleetCore:
         # recorded here by the membership so routing can degrade fast
         # instead of timing out per frame.
         self._dead_ordinals: frozenset = frozenset()
+        self._closed = False
         self._install(fleet_map, adopted_buckets=None)
 
     # ------------------------------------------------------------- state
@@ -399,22 +372,45 @@ class FleetCore:
                        self.map.partition(owners[fpos]).items()}
         return local_pos, adopted_pos, foreign
 
-    def channel(self, ordinal: int) -> _PeerChannel:
-        ch = self._channels.get(ordinal)
+    def lane(self, ordinal: int) -> PeerLane:
+        """The forward lane to one peer (built lazily; rebuilt when a
+        map swap moved that ordinal's address)."""
+        ln = self._lanes.get(ordinal)
         host = self.map.hosts[ordinal]
-        if ch is None or (ch.host, ch.port) != (host.host, host.port):
+        if ln is None or (ln.host, ln.port) != (host.host, host.port):
             with self._lock:
-                ch = self._channels.get(ordinal)
-                if ch is None or (ch.host, ch.port) != (host.host,
+                if self._closed:
+                    raise StorageUnavailableError(
+                        "fleet core is closed; forwarding unavailable")
+                ln = self._lanes.get(ordinal)
+                if ln is None or (ln.host, ln.port) != (host.host,
                                                         host.port):
-                    if ch is not None:
-                        ch.close()
-                    ch = _PeerChannel(
-                        host.host, host.port,
+                    if ln is not None:
+                        ln.close()
+                    if self._runtime is None or not self._runtime.alive:
+                        self._runtime = ForwardRuntime()
+                    ln = PeerLane(
+                        self._runtime, host.host, host.port,
+                        label=host.id,
                         deadline=self.forward_deadline,
-                        queue_cap=self.forward_queue, label=host.id)
-                    self._channels[ordinal] = ch
-        return ch
+                        inflight=self.forward_inflight,
+                        conns=self.forward_conns,
+                        coalesce=self.forward_coalesce,
+                        queue_cap=self.forward_queue,
+                        metrics=self._lane_metrics)
+                    self._lanes[ordinal] = ln
+        return ln
+
+    def peer_columnar(self, ordinal: int) -> bool:
+        """True when STRING rows may hash-forward to this peer on the
+        columnar lane: a single-shard receiver decides a forwarded
+        ``splitmix64_inv(h64)`` bit-identically to the direct string
+        (decisions and overrides key on the finalized hash). A
+        multi-shard native receiver routes string frames by FNV over
+        the raw key bytes — hash-routing them would split a key's
+        quota across shards — so its entry must declare ``shards`` in
+        the fleet map and its string rows forward as strings."""
+        return self.map.hosts[ordinal].shards <= 1
 
     # ------------------------------------------------------- redirecting
 
@@ -442,31 +438,172 @@ class FleetCore:
 
     # ------------------------------------------------------- forwarding
 
-    def forward_keys(self, ordinal: int, keys: List[str],
-                     ns: np.ndarray) -> "concurrent.futures.Future":
-        self._c_forwarded.inc(len(keys), peer=self.map.hosts[ordinal].id)
-        return self.channel(ordinal).submit(
-            "batch", (keys, [int(x) for x in ns]))
+    def forward_jobs(self, ordinal: int, pos: np.ndarray,
+                     h64: np.ndarray, ns: np.ndarray, *,
+                     keys_fn=None) -> list:
+        """Submit one peer's foreign rows onto its lane, split by
+        per-key connection affinity. ``pos`` holds the rows' global
+        frame positions; ``h64``/``ns`` are the FULL frame columns.
+        Returns ``[(positions, future)]`` — one job per touched
+        connection, each future resolving to that job's BatchResult
+        (a row-range VIEW of the coalesced reply). Never raises: a
+        submit failure (lane closed / queue full) yields a pre-failed
+        future so sibling connections' rows still decide."""
+        host = self.map.hosts[ordinal]
+        self._c_forwarded.inc(int(pos.shape[0]), peer=host.id)
+        try:
+            lane = self.lane(ordinal)
+        except StorageUnavailableError as exc:
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            fut.set_exception(exc)
+            return [(pos, fut)]
+        sub_h = h64[pos]
+        sub_ns = ns[pos]
+        columnar = keys_fn is None or self.peer_columnar(ordinal)
+        if lane.conns == 1:
+            groups = [(0, pos, sub_h, sub_ns)]
+        else:
+            ci = lane.conn_of(sub_h)
+            groups = []
+            for c in range(lane.conns):
+                sel = ci == c
+                if sel.any():
+                    groups.append((c, pos[sel], sub_h[sel], sub_ns[sel]))
+        jobs = []
+        for conn, g_pos, g_h, g_ns in groups:
+            try:
+                if columnar:
+                    fut = lane.submit_rows(splitmix64_inv(g_h), g_ns,
+                                           conn)
+                else:
+                    keys = keys_fn(g_pos)
+                    build, parse = self._string_call(
+                        keys, [int(x) for x in g_ns])
+                    fut = lane.submit_call(build, parse, conn,
+                                           rows=len(keys))
+            except StorageUnavailableError as exc:
+                fut = concurrent.futures.Future()
+                fut.set_exception(exc)
+            jobs.append((g_pos, fut))
+        return jobs
+
+    def _string_call(self, keys: List[str], ns_list: List[int]):
+        """Build/parse pair for the multi-shard string fallback: a
+        pipelined T_ALLOW_BATCH whose reply parses COLUMNAR
+        (protocol.parse_result_batch_columnar) so scatter_merge stays
+        on the numpy path."""
+        from ratelimiter_tpu.serving import protocol as p
+
+        dl = self.forward_deadline
+
+        def build(req_id: int) -> bytes:
+            # FORWARD_FLAG: the multi-shard receiver's dispatcher also
+            # keeps forward windows out of client-frame dispatches.
+            return p.with_forward(p.with_deadline(
+                p.encode_allow_batch(req_id, keys, ns_list), dl))
+
+        def parse(type_: int, body: bytes):
+            if type_ != p.T_RESULT_BATCH:
+                raise p.ProtocolError(
+                    f"unexpected forward response type {type_}")
+            return p.parse_result_batch_columnar(body)
+
+        return build, parse
+
+    @staticmethod
+    def _combine_jobs(jobs: list, b: int):
+        """Legacy single-future surface over per-connection jobs: one
+        job covering the whole fragment passes its future through
+        (zero-copy); a multi-connection split scatters back to fragment
+        order once every job lands."""
+        if len(jobs) == 1 and int(jobs[0][0].shape[0]) == b:
+            return jobs[0][1]
+        out: concurrent.futures.Future = concurrent.futures.Future()
+        lock = threading.Lock()
+        state = {"left": len(jobs), "parts": [], "exc": None}
+
+        def _done(pos):
+            def cb(f):
+                with lock:
+                    try:
+                        state["parts"].append((pos, f.result()))
+                    except BaseException as exc:  # noqa: BLE001
+                        if state["exc"] is None:
+                            state["exc"] = exc
+                    state["left"] -= 1
+                    fire = state["left"] == 0
+                if not fire:
+                    return
+                if state["exc"] is not None:
+                    out.set_exception(state["exc"])
+                else:
+                    parts = state["parts"]
+                    out.set_result(scatter_merge(
+                        b, parts[0][1].limit, parts))
+            return cb
+
+        for pos, fut in jobs:
+            fut.add_done_callback(_done(pos))
+        return out
+
+    def forward_ids(self, ordinal: int, raw_ids: np.ndarray,
+                    ns) -> "concurrent.futures.Future":
+        """Single-future convenience over :meth:`forward_jobs` for one
+        raw-id fragment (tests and ad-hoc callers; the doors submit
+        jobs directly)."""
+        raw_ids = np.ascontiguousarray(raw_ids, dtype=np.uint64)
+        jobs = self.forward_jobs(
+            ordinal, np.arange(raw_ids.shape[0]), splitmix64(raw_ids),
+            np.asarray(ns, dtype=np.int64))
+        return self._combine_jobs(jobs, int(raw_ids.shape[0]))
 
     def forward_allow_n(self, ordinal: int, key: str,
                         n: int) -> "concurrent.futures.Future":
+        """Scalar forward on the key's affinity connection (FIFO with
+        its batch rows): keeps the full scalar Result fidelity
+        (override limits ride the scalar wire path)."""
+        from ratelimiter_tpu.serving import protocol as p
+
         self._c_forwarded.inc(peer=self.map.hosts[ordinal].id)
-        return self.channel(ordinal).submit("allow_n", (key, int(n)))
+        lane = self.lane(ordinal)
+        h64 = self.hash_keys([key])
+        dl = self.forward_deadline
 
-    def forward_ids(self, ordinal: int, raw_ids: np.ndarray,
-                    ns: np.ndarray) -> "concurrent.futures.Future":
-        self._c_forwarded.inc(int(raw_ids.shape[0]),
-                              peer=self.map.hosts[ordinal].id)
-        return self.channel(ordinal).submit(
-            "ids", (np.ascontiguousarray(raw_ids, dtype=np.uint64),
-                    np.ascontiguousarray(ns, dtype=np.uint32)))
+        def build(req_id: int) -> bytes:
+            return p.with_deadline(p.encode_allow_n(req_id, key, int(n)),
+                                   dl)
 
-    def forward_hashes(self, ordinal: int, h64: np.ndarray,
-                       ns: np.ndarray) -> "concurrent.futures.Future":
-        """Forward FINALIZED hashes: recover the raw ids (splitmix64 is
-        a bijection) and ride the plain hashed lane — the receiver
-        re-finalizes to bit-identical hashes."""
-        return self.forward_ids(ordinal, splitmix64_inv(h64), ns)
+        def parse(type_: int, body: bytes):
+            if type_ != p.T_RESULT:
+                raise p.ProtocolError(
+                    f"unexpected forward response type {type_}")
+            return p.parse_result(body)
+
+        return lane.submit_call(build, parse,
+                                int(h64[0] % np.uint64(lane.conns)))
+
+    def forward_op(self, ordinal: int, kind: str,
+                   key: str) -> "concurrent.futures.Future":
+        """Control-plane forward (today: reset) on the key's affinity
+        connection so it serializes with that key's decision rows."""
+        from ratelimiter_tpu.serving import protocol as p
+
+        if kind != "reset":  # pragma: no cover - programming error
+            raise ValueError(f"unknown forward op {kind}")
+        lane = self.lane(ordinal)
+        h64 = self.hash_keys([key])
+
+        def build(req_id: int) -> bytes:
+            return p.encode_reset(req_id, key)
+
+        def parse(type_: int, body: bytes):
+            if type_ != p.T_OK:
+                raise p.ProtocolError(
+                    f"unexpected forward response type {type_}")
+            return None
+
+        return lane.submit_call(build, parse,
+                                int(h64[0] % np.uint64(lane.conns)))
 
     def note_forward_failure(self, ordinal: int, exc: BaseException,
                              count: int) -> None:
@@ -510,6 +647,10 @@ class FleetCore:
         """/healthz fleet block (membership adds liveness)."""
         mp = self.map
         me = mp.host(self.self_id)
+        with self._lock:  # lane() inserts under the same lock
+            lanes = list(self._lanes.values())
+        wire_frames = sum(ln.wire_frames for ln in lanes)
+        wire_rows = sum(ln.wire_rows for ln in lanes)
         return {
             "self": self.self_id,
             "epoch": mp.epoch,
@@ -522,6 +663,12 @@ class FleetCore:
             "forwarded_total": int(self._c_forwarded.total()),
             "forward_errors_total": int(self._c_forward_errors.total()),
             "redirects_total": int(self._c_redirects.total()),
+            "forward_wire_frames_total": wire_frames,
+            "forward_wire_rows_total": wire_rows,
+            "forward_mean_window_rows": (
+                round(wire_rows / wire_frames, 1) if wire_frames else None),
+            "forward_inflight_per_conn": self.forward_inflight,
+            "forward_conns_per_peer": self.forward_conns,
         }
 
     def map_payload(self) -> dict:
@@ -529,10 +676,15 @@ class FleetCore:
 
     def close(self) -> None:
         with self._lock:
-            chans = list(self._channels.values())
-            self._channels.clear()
-        for ch in chans:
-            ch.close()
+            self._closed = True
+            lanes = list(self._lanes.values())
+            self._lanes.clear()
+            runtime = self._runtime
+            self._runtime = None
+        for ln in lanes:
+            ln.close()
+        if runtime is not None:
+            runtime.stop()
         with self._adopted_lock:
             if self._adopted_exec is not None:
                 self._adopted_exec.shutdown(wait=False)
@@ -549,10 +701,11 @@ class FleetCore:
 def collect_jobs(core: FleetCore, jobs, cfg, now: float):
     """Wait out a fleet ticket's forward/adopted futures: returns
     ``(parts, err)`` where ``parts`` is ``[(positions, result)]`` ready
-    for :func:`scatter_merge`. A failed forward degrades its rows per
-    the fail-open/closed policy (fail-closed keeps the FIRST error to
-    raise after every job is drained — the ADR-013 non-transactional
-    frame contract: other hosts' quota stands)."""
+    for :func:`scatter_merge`. A failed job degrades EXACTLY its rows
+    per the fail-open/closed policy — one failed coalesced wire frame
+    touches only its member fragments (fail-closed keeps the FIRST
+    error to raise after every job is drained — the ADR-013
+    non-transactional frame contract: other hosts' quota stands)."""
     parts = []
     err = None
     budget = core.forward_deadline + 2.0
@@ -573,12 +726,23 @@ def collect_jobs(core: FleetCore, jobs, cfg, now: float):
     return parts, err
 
 
+#: One-pass record view of a list of scalar Results (the forwarded-
+#: string in-process legs); columnar assembly replaces the former
+#: six per-row list comprehensions.
+_RESULT_REC = np.dtype([("allowed", "?"), ("remaining", "<i8"),
+                        ("retry", "<f8"), ("reset", "<f8"),
+                        ("fail_open", "?"), ("limit", "<i8")])
+
+
 def scatter_merge(b: int, limit: int, parts) -> BatchResult:
     """Scatter per-group results back to frame order: ``parts`` is
     ``[(positions | None, BatchResult | list[Result])]`` (None =
     positions are the whole frame). ``fail_open`` ORs over groups (the
     multi-shard contract, ADR-013); per-row ``limits`` materialize when
-    any group carried overrides."""
+    any group carried overrides. Forwarded legs arrive as BatchResult
+    row-range VIEWS of the coalesced lane reply (ADR-019) and assemble
+    with four vectorized scatters; list[Result] legs collapse to one
+    structured-array pass."""
     allowed = np.zeros(b, dtype=bool)
     remaining = np.zeros(b, dtype=np.int64)
     retry = np.zeros(b, dtype=np.float64)
@@ -588,12 +752,15 @@ def scatter_merge(b: int, limit: int, parts) -> BatchResult:
     for pos, out in parts:
         sel = slice(None) if pos is None else pos
         if isinstance(out, list):  # forwarded string rows: Result objects
-            allowed[sel] = [r.allowed for r in out]
-            remaining[sel] = [r.remaining for r in out]
-            retry[sel] = [r.retry_after for r in out]
-            reset_at[sel] = [r.reset_at for r in out]
-            fail_open = fail_open or any(r.fail_open for r in out)
-            if any(r.limit != limit for r in out):
+            rec = np.array([(r.allowed, r.remaining, r.retry_after,
+                             r.reset_at, r.fail_open, r.limit)
+                            for r in out], dtype=_RESULT_REC)
+            allowed[sel] = rec["allowed"]
+            remaining[sel] = rec["remaining"]
+            retry[sel] = rec["retry"]
+            reset_at[sel] = rec["reset"]
+            fail_open = fail_open or bool(rec["fail_open"].any())
+            if (rec["limit"] != limit).any():
                 # Keep whatever limit fidelity the leg carried. NOTE:
                 # the RESULT_BATCH wire stamps every row with the
                 # DEFAULT limit (overridden keys' true limits ride the
@@ -602,7 +769,7 @@ def scatter_merge(b: int, limit: int, parts) -> BatchResult:
                 # matters for in-process legs and future wire upgrades.
                 if limits is None:
                     limits = np.full(b, limit, dtype=np.int64)
-                limits[sel] = [r.limit for r in out]
+                limits[sel] = rec["limit"]
         else:
             allowed[sel] = out.allowed
             remaining[sel] = out.remaining
@@ -636,11 +803,11 @@ class FleetTicket(DispatchTicket):
 class FleetForwarder(LimiterDecorator):
     """Asyncio-door fleet decorator: partitions every decision frame by
     keyspace owner — local rows dispatch on the inner limiter, adopted
-    rows on the failover standby unit, foreign rows forward to their
-    owner — and reassembles per-frame answers in frame order. Wraps the
-    TOP of the serving stack (outside persistence: forwarded rows must
-    not consume local quota, and decisions are never WAL-logged
-    anyway)."""
+    rows on the failover standby unit, foreign rows submit onto their
+    owner's coalesced forward lane — and reassembles per-frame answers
+    in frame order. Wraps the TOP of the serving stack (outside
+    persistence: forwarded rows must not consume local quota, and
+    decisions are never WAL-logged anyway)."""
 
     def __init__(self, inner, core: FleetCore):
         super().__init__(inner)
@@ -653,11 +820,13 @@ class FleetForwarder(LimiterDecorator):
     # ------------------------------------------------------------ helpers
 
     def _launch_fleet(self, h64: np.ndarray, ns: np.ndarray, now: float,
-                      *, keys: Optional[List[str]] = None,
+                      *, owners: Optional[np.ndarray] = None,
+                      keys: Optional[List[str]] = None,
                       raw_ids: Optional[np.ndarray] = None,
                       wire: bool = False) -> FleetTicket:
         core = self.core
-        owners = core.owners_of_hash(h64)
+        if owners is None:
+            owners = core.owners_of_hash(h64)
         if core.all_local(owners):
             # Fast path: the whole frame is ours — one owner check, no
             # split, the inner ticket passes through (wire buffers
@@ -693,6 +862,8 @@ class FleetForwarder(LimiterDecorator):
                          core.decide_adopted_hashed(h64[adopted_pos],
                                                     ns[adopted_pos]),
                          None))
+        keys_fn = (None if keys is None
+                   else (lambda p_: [keys[i] for i in p_]))
         for o, pos in foreign.items():
             if o in core._dead_ordinals:
                 # Known-dead owner mid-failover: degrade now rather than
@@ -703,18 +874,9 @@ class FleetForwarder(LimiterDecorator):
                     f"(failover pending)"))
                 jobs.append((pos, fut, o))
                 continue
-            try:
-                if keys is not None:
-                    fut = core.forward_keys(o, [keys[i] for i in pos],
-                                            ns[pos])
-                elif raw_ids is not None:
-                    fut = core.forward_ids(o, raw_ids[pos], ns[pos])
-                else:
-                    fut = core.forward_hashes(o, h64[pos], ns[pos])
-            except StorageUnavailableError as exc:  # queue full
-                fut = concurrent.futures.Future()
-                fut.set_exception(exc)
-            jobs.append((pos, fut, o))
+            for sub_pos, fut in core.forward_jobs(o, pos, h64, ns,
+                                                  keys_fn=keys_fn):
+                jobs.append((sub_pos, fut, o))
         t.jobs = tuple(jobs)
         return t
 
@@ -734,10 +896,13 @@ class FleetForwarder(LimiterDecorator):
             ns_arr = np.asarray(ns, dtype=np.int64)
         t = self.clock.now() if now is None else float(now)
         h64 = self.core.hash_keys(keys)
+        # Owners computed ONCE and threaded through (_launch_fleet used
+        # to recompute the same table gather per frame).
         owners = self.core.owners_of_hash(h64)
         if self.core.all_local(owners):
             return self.inner.launch_batch(keys, ns, now=now)
-        return self._launch_fleet(h64, ns_arr, t, keys=keys)
+        return self._launch_fleet(h64, ns_arr, t, owners=owners,
+                                  keys=keys)
 
     def launch_ids(self, ids, ns=None, *, now=None, wire: bool = False):
         ids = np.asarray(ids, dtype=np.uint64)
@@ -835,7 +1000,7 @@ class FleetForwarder(LimiterDecorator):
             return
         if not core.forward_enabled:
             raise core.redirect_error(int(h64[0]), owner)
-        core.channel(owner).submit("reset", key).result(
+        core.forward_op(owner, "reset", key).result(
             timeout=core.forward_deadline + 2.0)
 
     # Policy overrides apply on the LOCAL stack only: fleet-wide

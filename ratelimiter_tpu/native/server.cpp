@@ -134,6 +134,14 @@ constexpr uint8_t TRACE_FLAG = 0x40;
 // answering per the fail-open policy instead of burning a dispatch
 // slot.
 constexpr uint8_t DEADLINE_FLAG = 0x20;
+// Forward-lane hint (ADR-019, serving/protocol.py FORWARD_FLAG):
+// request frames with bit 4 set are fleet forward windows — every row
+// is owned by THIS host, and the frame must never share a dispatch
+// with client frames whose resolve waits on our own forward legs
+// (coupling the two builds the unbounded cross-host dependency chain
+// behind the FLEET_r01 mixed p99). Pure hint, no body prefix; the
+// dispatcher cuts its drain at forward/non-forward boundaries.
+constexpr uint8_t FORWARD_FLAG = 0x10;
 
 // Span clock: CLOCK_MONOTONIC ns — the SAME domain as Python's
 // time.monotonic_ns(), so C++ io/dispatch stamps and Python device-side
@@ -261,6 +269,9 @@ struct Pending {
   // ADR-015; 0 = none): anchored at frame arrival from the frame's
   // relative budget. Expired items are shed at the dispatch boundary.
   uint64_t deadline_ns = 0;
+  // Fleet forward-lane window (FORWARD_FLAG, ADR-019): the dispatcher
+  // never mixes forward and non-forward Pendings in one drained run.
+  bool fwd = false;
 };
 
 inline size_t pending_count(const Pending& p) {
@@ -1357,6 +1368,11 @@ void dispatcher_main(Server* s, uint32_t shard) {
       while (!q.queue.empty() && run_keys < s->max_batch) {
         // RESET/METRICS ride the same queue (keys empty or kind marker).
         Pending& front = q.queue.front();
+        // Forward-lane boundary (ADR-019): never mix forward windows
+        // (all rows local) with client frames (whose bridge resolve
+        // may wait on OUR forward legs) in one dispatch — the shared
+        // barrier would couple the forward reply to a peer's progress.
+        if (!run.empty() && front.fwd != run.back().fwd) break;
         size_t nk = pending_count(front);
         size_t room = s->max_batch - run_keys;
         // Cut BEFORE crossing max_batch (never overshoot the largest
@@ -1542,6 +1558,8 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     uint8_t type = traced ? (uint8_t)(rawtype & ~TRACE_FLAG) : rawtype;
     bool deadlined = (type & DEADLINE_FLAG) != 0 && rawtype < 0x80;
     if (deadlined) type = (uint8_t)(type & ~DEADLINE_FLAG);
+    bool fwd_hint = (type & FORWARD_FLAG) != 0 && rawtype < 0x80;
+    if (fwd_hint) type = (uint8_t)(type & ~FORWARD_FLAG);
     uint64_t req_id;
     memcpy(&req_id, c->rbuf.data() + off + 5, 8);
     uint32_t cap =
@@ -1652,6 +1670,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
       p.t_io = mono_ns();
       p.trace_id = trace_id;
       p.deadline_ns = deadline_ns;
+      p.fwd = fwd_hint;
       p.keys.reserve(count);
       p.ns.reserve(count);
       size_t pos = 4;
@@ -1721,6 +1740,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
             part.t_io = p.t_io;
             part.trace_id = p.trace_id;
             part.deadline_ns = p.deadline_ns;
+            part.fwd = p.fwd;
             part.join = j;
             part.pos = std::move(per[sh]);
             part.keys.reserve(part.pos.size());
@@ -1758,6 +1778,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
         p.t_io = mono_ns();
         p.trace_id = trace_id;
         p.deadline_ns = deadline_ns;
+        p.fwd = fwd_hint;
         p.hashed = true;
         p.ids.reserve(count);
         p.ns.reserve(count);
@@ -1803,6 +1824,7 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
               part.t_io = p.t_io;
               part.trace_id = p.trace_id;
               part.deadline_ns = p.deadline_ns;
+              part.fwd = p.fwd;
               part.hashed = true;
               part.join = j;
               part.pos = std::move(per[sh]);
@@ -2359,7 +2381,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 10; }
+int64_t rl_server_abi_version() { return 11; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -258,8 +258,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "frames; rides the wire so the owner sheds "
                          "expired work (ADR-015)")
     ap.add_argument("--fleet-forward-queue", type=int, default=128,
-                    help="bounded per-peer forward queue (frames); "
-                         "overflow answers per fail-open/closed policy")
+                    help="bounded per-peer forward queue (outstanding "
+                         "fragments); overflow answers per "
+                         "fail-open/closed policy")
+    ap.add_argument("--fleet-forward-inflight", type=int, default=2,
+                    help="pipelined wire frames in flight per forward "
+                         "connection (ADR-019: the PR 3 bounded window "
+                         "one level up). Small windows coalesce MORE "
+                         "rows per wire frame — 2 measured best on "
+                         "loopback; raise it on high-RTT links")
+    ap.add_argument("--fleet-forward-conns", type=int, default=1,
+                    help="pipelined connections per peer; rows pick "
+                         "their connection by key hash, so same-key "
+                         "send order survives the multi-connection "
+                         "link (ADR-019). 1 maximizes window "
+                         "occupancy; >1 buys wire parallelism where "
+                         "one TCP stream can't fill the NIC")
+    ap.add_argument("--fleet-forward-coalesce", type=int, default=16384,
+                    help="max rows merged into one coalesced forward "
+                         "wire frame (ADR-019; capped at 32768 — the "
+                         "coalesced REPLY costs ~24 B/row against the "
+                         "1 MiB wire bound)")
     ap.add_argument("--dcn-secret", default=None,
                     help="shared secret HMAC-gating T_DCN_PUSH frames "
                          "(both sides must set it; prefer the "
@@ -761,6 +780,9 @@ async def amain(args) -> None:
             forward=not args.fleet_no_forward,
             forward_deadline=args.fleet_forward_deadline,
             forward_queue=args.fleet_forward_queue,
+            forward_inflight=args.fleet_forward_inflight,
+            forward_conns=args.fleet_forward_conns,
+            forward_coalesce=args.fleet_forward_coalesce,
             registry=obs_metrics.DEFAULT)
 
         def _fleet_adopt(dead):
@@ -882,6 +904,22 @@ async def amain(args) -> None:
     pushers = []
     if args.native:
         from ratelimiter_tpu.serving.native_server import NativeRateLimitServer
+
+        if fleet_core is not None:
+            # ADR-019 columnar-forwarding contract: peers hash-forward
+            # this member's STRING rows on the raw-id lane unless its
+            # map entry declares shards > 1 (FNV string routing). An
+            # undeclared multi-shard member would silently split a
+            # key's quota across shards — refuse to start instead.
+            actual = len(slices) if mesh_native else args.shards
+            declared = fleet_core.map.host(args.fleet_self).shards
+            if actual > 1 and declared != actual:
+                raise SystemExit(
+                    f"--fleet-config entry {args.fleet_self!r} declares "
+                    f"shards={declared} but this native door runs "
+                    f"{actual} shards; set \"shards\": {actual} on this "
+                    f"host in the fleet map so peers forward its string "
+                    f"rows as strings (ADR-019)")
 
         server = NativeRateLimitServer(
             limiter, args.host, args.port,

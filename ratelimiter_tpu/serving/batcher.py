@@ -104,6 +104,16 @@ class MicroBatcher:
         #: event-loop thread and break the per-thread span invariant.
         self._pending_hashed: List[tuple] = []
         self._pending_hashed_ids = 0
+        #: Fleet forward-lane windows (protocol.FORWARD_FLAG, ADR-019):
+        #: coalesced SEPARATELY from the client lanes. Forward windows
+        #: hold only locally-owned rows, so merging them with each
+        #: other is safe batching — but merging them into a window
+        #: that also holds client rows needing onward forwarding would
+        #: couple the forward reply to OUR peers' progress (the
+        #: unbounded cross-host dependency chain behind FLEET_r01's
+        #: mixed p99).
+        self._pending_fwd: List[tuple] = []
+        self._pending_fwd_ids = 0
         #: Flight-recorder window context (ADR-014): first-enqueue stamp
         #: and the first sampled trace id of the current coalescing
         #: window. Zero cost while tracing is off (RECORDER is None).
@@ -261,7 +271,8 @@ class MicroBatcher:
         # Queue depth counts BOTH lanes in max_batch units: pending
         # string decisions plus queued hashed-frame ids — the adaptive
         # window reacts to total offered load, whichever door it enters.
-        depth = len(self._pending) + self._pending_hashed_ids
+        depth = (len(self._pending) + self._pending_hashed_ids
+                 + self._pending_fwd_ids)
         self._queue_depth.set(depth)
         if not depth:
             return
@@ -350,7 +361,8 @@ class MicroBatcher:
 
     def submit_hashed_nowait(self, ids: np.ndarray, ns: np.ndarray,
                              trace_id: int = 0,
-                             deadline: float = 0.0) -> asyncio.Future:
+                             deadline: float = 0.0,
+                             standalone: bool = False) -> asyncio.Future:
         """Queue one whole ALLOW_HASHED frame into the current coalescing
         window (the zero-copy bulk lane, ADR-011 + the scatter-gather
         scheduler, ADR-013): every hashed frame queued within
@@ -406,7 +418,7 @@ class MicroBatcher:
             # carries no device-packed wire buffers, so the encoder
             # takes its packbits path — one host re-pack on a frame
             # shape that is rare by construction).
-            if self._pending_hashed:
+            if self._pending_hashed or self._pending_fwd:
                 self._flush()
             seg_futs: List[asyncio.Future] = []
             for off in range(0, b, self.max_batch):
@@ -420,6 +432,31 @@ class MicroBatcher:
             join = asyncio.ensure_future(self._join_segments(seg_futs, fut))
             self._inflight.add(join)
             join.add_done_callback(self._inflight.discard)
+            return fut
+        if standalone:
+            # Fleet forward-lane window (protocol.FORWARD_FLAG,
+            # ADR-019): wholly owned by this host, while the CLIENT
+            # window may hold rows whose resolve waits on OUR forward
+            # legs. Coalescing the two would couple this reply to a
+            # peer's progress — under symmetric mixed fleet traffic
+            # that dependency chain extends without bound (each reply
+            # waiting on legs of a window formed later: the FLEET_r01
+            # 1.35 s p99 and the 4-host forward-deadline expiry).
+            # Forward windows therefore coalesce in their OWN buffer —
+            # with each other (windows from 3 peers merge into one
+            # dispatch at n >= 4, where per-peer windows shrink to
+            # 1/(n-1) of the 2-host size) but never with client rows.
+            # b <= 2*max_batch here (the carve above already segmented
+            # larger frames), so pad shapes stay prewarmed.
+            if (self._pending_fwd
+                    and self._pending_fwd_ids + b > 2 * self.max_batch):
+                self._flush_fwd()
+            self._pending_fwd.append((ids, ns, fut, trace_id, deadline))
+            self._pending_fwd_ids += b
+            if self._pending_fwd_ids >= self.max_batch:
+                self._flush_fwd()
+            else:
+                self._arm_timer(loop)
             return fut
         if (self._pending_hashed
                 and self._pending_hashed_ids + b > 2 * self.max_batch):
@@ -657,11 +694,25 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- flush
 
+    def _flush_fwd(self) -> None:
+        """Dispatch the coalesced forward-lane windows as their OWN
+        launch (ADR-019): local-only rows, never merged with the
+        client lanes."""
+        if not self._pending_fwd:
+            return
+        frames = self._pending_fwd
+        self._pending_fwd = []
+        self._pending_fwd_ids = 0
+        task = asyncio.ensure_future(self._dispatch_hashed_window(frames))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
     def _flush(self) -> None:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
-        if not self._pending and not self._pending_hashed:
+        if (not self._pending and not self._pending_hashed
+                and not self._pending_fwd):
             return
         self._queue_depth.set(0)
         rec = tracing.RECORDER
@@ -671,7 +722,8 @@ class MicroBatcher:
             # flush, in max_batch units across both lanes.
             rec.record("coalesce", self._q_t0, tracing.now(),
                        trace_id=trace,
-                       batch=len(self._pending) + self._pending_hashed_ids)
+                       batch=(len(self._pending) + self._pending_hashed_ids
+                              + self._pending_fwd_ids))
         self._q_t0 = 0
         self._q_trace = 0
         if self._pending:
@@ -687,6 +739,7 @@ class MicroBatcher:
             task = asyncio.ensure_future(self._dispatch_hashed_window(frames))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
+        self._flush_fwd()
 
     def _launch_work(self, keys, ns, trace_id=0, t_q=0):
         """Launch stage (runs on the launch executor thread): acquire an

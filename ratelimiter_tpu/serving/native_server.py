@@ -40,7 +40,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 10
+_ABI = 11
 
 
 def _load_extension():
@@ -356,8 +356,7 @@ class NativeRateLimitServer:
                 for i in pos.tolist()]
 
     def _fleet_split(self, h64: np.ndarray, ns: np.ndarray, *,
-                     blob=None, offsets=None, lengths=None,
-                     raw_ids=None):
+                     blob=None, offsets=None, lengths=None):
         """Partition one frame by fleet owner and fire the forwards.
         Returns ``(local_pos, jobs)``; ``(None, ())`` = whole frame
         local (caller keeps the untouched fast path). Raises the typed
@@ -390,24 +389,24 @@ class NativeRateLimitServer:
                          core.decide_adopted_hashed(h64[adopted_pos],
                                                     ns[adopted_pos]),
                          None))
+        # String rows carry a LAZY key extractor: the coalesced lane
+        # (ADR-019) hash-forwards them columnar to single-shard peers
+        # without ever decoding the key blob — keys materialize only
+        # for a peer that declared shards > 1 (FNV-routed strings).
+        keys_fn = (None if blob is None else
+                   (lambda pos_: self._keys_from_blob(blob, offsets,
+                                                      lengths, pos_)))
         for o, pos in foreign.items():
-            try:
-                if o in core._dead_ordinals:
-                    raise StorageUnavailableError(
-                        f"fleet owner {core.map.hosts[o].id} is down "
-                        f"(failover pending)")
-                if raw_ids is not None:
-                    fut = core.forward_ids(o, raw_ids[pos], ns[pos])
-                elif blob is not None:
-                    fut = core.forward_keys(
-                        o, self._keys_from_blob(blob, offsets, lengths,
-                                                pos), ns[pos])
-                else:
-                    fut = core.forward_hashes(o, h64[pos], ns[pos])
-            except StorageUnavailableError as exc:
+            if o in core._dead_ordinals:
                 fut = cf.Future()
-                fut.set_exception(exc)
-            jobs.append((pos, fut, o))
+                fut.set_exception(StorageUnavailableError(
+                    f"fleet owner {core.map.hosts[o].id} is down "
+                    f"(failover pending)"))
+                jobs.append((pos, fut, o))
+                continue
+            for sub_pos, fut in core.forward_jobs(o, pos, h64, ns,
+                                                  keys_fn=keys_fn):
+                jobs.append((sub_pos, fut, o))
         return local_pos, jobs
 
     def _fleet_decide(self, shard: int, h64: np.ndarray, ns: np.ndarray,
@@ -533,7 +532,7 @@ class NativeRateLimitServer:
             if self._fleet is not None:
                 # Hashed-lane ids arrive FINALIZED (C++ splitmix64);
                 # foreign rows forward via the inverse (bit-identical
-                # at the owner — forwarder.forward_hashes).
+                # at the owner — the forward_jobs columnar lane).
                 local_pos, jobs = self._fleet_split(h64, ns)
                 if local_pos is not None or jobs:
                     out = self._fleet_decide(shard, h64, ns, local_pos,
@@ -835,7 +834,7 @@ class NativeRateLimitServer:
             if owner != core.self_ordinal:
                 if not core.forward_enabled:
                     raise core.redirect_error(int(h64[0]), owner)
-                core.channel(owner).submit("reset", key).result(
+                core.forward_op(owner, "reset", key).result(
                     timeout=core.forward_deadline + 2.0)
                 return
             if core._adopted_buckets.any() and bool(

@@ -177,6 +177,42 @@ DEADLINE_FLAG = 0x20
 _DEADLINE = struct.Struct("<d")
 _REQ_FLAGS = TRACE_FLAG | DEADLINE_FLAG
 
+# ------------------------------------------- forward hint (ADR-019)
+#
+# Bit 4 (0x10) on a REQUEST type byte marks a fleet forward-lane frame:
+# a coalesced window of rows that are ALL owned by the receiving host
+# (the sender routed them). It carries no body prefix — it is a pure
+# dispatch hint: the receiver's batcher must dispatch the frame
+# STANDALONE, never coalesced into a window that also holds client
+# rows needing onward forwarding. Coalescing the two couples this
+# reply to the receiver's own forward legs, and under symmetric mixed
+# fleet traffic that dependency chain extends without bound (each
+# reply waits on legs of a window formed later — the FLEET_r01 1.35 s
+# p99, and outright forward-deadline expiry at 4 hosts). Misuse by an
+# ordinary client is harmless: the hint only steers batching. Applied
+# OUTERMOST (after with_deadline / before nothing): with_forward sets
+# only the bit.
+FORWARD_FLAG = 0x10
+
+
+def with_forward(frame: bytes) -> bytes:
+    """Mark a request frame as a fleet forward-lane window (dispatch
+    hint; no body change). Apply LAST — after with_deadline/with_trace."""
+    length, type_, req_id = _HDR.unpack_from(frame)
+    if type_ & FORWARD_FLAG or type_ >= 128:
+        raise ProtocolError(f"type {type_} cannot carry the forward hint")
+    return (_HDR.pack(length, type_ | FORWARD_FLAG, req_id)
+            + frame[HEADER_SIZE:])
+
+
+def split_forward(type_: int):
+    """(base_type, is_forward) — strip the forward hint bit. Call AFTER
+    split_request (the hint is a bare bit, the other extensions carry
+    body prefixes)."""
+    if type_ < 128 and type_ & FORWARD_FLAG:
+        return type_ & ~FORWARD_FLAG, True
+    return type_, False
+
 
 def with_deadline(frame: bytes, budget_s: float) -> bytes:
     """Re-frame a request with the deadline extension (flag bit on the
@@ -550,6 +586,38 @@ def parse_result_batch(body: bytes):
     return out
 
 
+#: Structured view of one RESULT_BATCH row (exactly _BATCH_RES_ITEM's
+#: packed little-endian layout — 25 bytes, no padding).
+_BATCH_RES_REC = None
+
+
+def parse_result_batch_columnar(body: bytes):
+    """RESULT_BATCH as a columnar BatchResult (ADR-019): one structured
+    ``np.frombuffer`` over the packed per-row records instead of
+    ``count`` struct unpacks + Result objects — the fleet forwarder's
+    string-fallback legs merge through scatter_merge's numpy path."""
+    import numpy as np
+
+    from ratelimiter_tpu.core.types import BatchResult
+
+    global _BATCH_RES_REC
+    if _BATCH_RES_REC is None:
+        _BATCH_RES_REC = np.dtype([("flags", "u1"), ("remaining", "<i8"),
+                                   ("retry", "<f8"), ("reset", "<f8")])
+        assert _BATCH_RES_REC.itemsize == _BATCH_RES_ITEM.size
+    limit, count = _BATCH_RES_HEAD.unpack_from(body)
+    if len(body) != _BATCH_RES_HEAD.size + count * _BATCH_RES_ITEM.size:
+        raise ProtocolError(
+            f"bad RESULT_BATCH body ({len(body)}B for count={count})")
+    rec = np.frombuffer(body, dtype=_BATCH_RES_REC, count=count,
+                        offset=_BATCH_RES_HEAD.size)
+    flags = rec["flags"]
+    return BatchResult(allowed=(flags & 1).astype(bool), limit=limit,
+                       remaining=rec["remaining"],
+                       retry_after=rec["retry"], reset_at=rec["reset"],
+                       fail_open=bool((flags & 2).any()))
+
+
 # ---------------------------------------------- hashed bulk lane (ADR-011)
 
 _HASHED_HEAD = _U32                        # count
@@ -725,7 +793,7 @@ def parse_header(buf: bytes, *, allow_dcn: bool = False) -> Tuple[int, int, int]
     # The size cap keys on the BASE type: a traced and/or deadline-
     # stamped DCN push (TRACE_FLAG/DEADLINE_FLAG) still deserves the
     # slab-sized cap on a DCN-enabled server.
-    base = type_ & ~_REQ_FLAGS if type_ < 128 else type_
+    base = type_ & ~(_REQ_FLAGS | FORWARD_FLAG) if type_ < 128 else type_
     cap = MAX_DCN_FRAME if (allow_dcn and base == T_DCN_PUSH) else MAX_FRAME
     if length < 9 or length > cap:
         raise ProtocolError(f"bad frame length {length}")

@@ -201,6 +201,10 @@ class RateLimitServer:
                     # work whose deadline has already passed.
                     type_, trace_id, budget, body = p.split_request(
                         type_, body)
+                    # Forward-lane hint (ADR-019): the frame's rows are
+                    # all locally owned — dispatch it standalone so its
+                    # reply never waits on OUR forward legs.
+                    type_, fwd_hint = p.split_forward(type_)
                 except (p.ProtocolError, asyncio.IncompleteReadError) as exc:
                     log.warning("protocol error, dropping connection: %s", exc)
                     break
@@ -246,7 +250,8 @@ class RateLimitServer:
 
                             self.fleet.check_frame_owned(splitmix64(ids))
                         fut = self.batcher.submit_hashed_nowait(
-                            ids, ns, trace_id, deadline)
+                            ids, ns, trace_id, deadline,
+                            standalone=fwd_hint)
                     except Exception as exc:
                         write_out(p.encode_error(req_id, p.code_for(exc),
                                                  str(exc)))

@@ -221,9 +221,11 @@ class TestFleetCoreSplit:
         assert "b@127.0.0.1:2" in str(ei.value)
 
     def test_forward_queue_bound(self):
-        """A slow/unresponsive peer cannot buffer unbounded: the channel
-        queue overflows with a typed error (rows then answer per
-        policy)."""
+        """A slow/unresponsive peer cannot buffer unbounded: once
+        ``forward_queue`` fragments are outstanding, the next submit's
+        future carries the typed overflow error IMMEDIATELY (ADR-019:
+        the lane never raises at submit so sibling connections' rows
+        still decide; the overflow rows answer per policy)."""
         import socket
 
         sink = socket.socket()
@@ -241,16 +243,18 @@ class TestFleetCoreSplit:
         core = FleetCore(m, "a", forward_deadline=5.0, forward_queue=1,
                          registry=Registry())
         try:
-            # First job occupies the worker (blocked on the silent
-            # peer), the second fills the queue, the third overflows.
+            # First fragment is in flight against the silent peer, the
+            # second fills the outstanding allowance, the third
+            # overflows without waiting on the peer.
             core.forward_ids(1, np.asarray([2], np.uint64),
                              np.asarray([1]))
             time.sleep(0.2)
             core.forward_ids(1, np.asarray([2], np.uint64),
                              np.asarray([1]))
+            fut = core.forward_ids(1, np.asarray([2], np.uint64),
+                                   np.asarray([1]))
             with pytest.raises(StorageUnavailableError, match="full"):
-                core.forward_ids(1, np.asarray([2], np.uint64),
-                                 np.asarray([1]))
+                fut.result(timeout=1.0)
         finally:
             core.close()
             sink.close()

@@ -1,0 +1,641 @@
+"""Coalesced columnar forward-lane tests (ADR-019).
+
+The correctness bar mirrors the ADR-013 scatter-gather scheduler's, one
+level up: rows forwarded through the coalesced peer lanes must decide
+BIT-IDENTICALLY to the same rows arriving directly at their owner, with
+same-key send order preserved under (a) cross-frame coalescing into one
+wire window, (b) pipelined multi-frame links, and (c) multi-connection
+peers (per-key connection affinity). Failure attribution is window-
+scoped: one failed coalesced wire frame degrades exactly its member
+rows' frames. Routing is owner-scoped: a frame opens lanes only to the
+owners of its rows.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from netutil import free_port
+
+from ratelimiter_tpu import Algorithm, Config, SketchParams
+from ratelimiter_tpu.core.clock import ManualClock
+from ratelimiter_tpu.core.types import BatchResult
+from ratelimiter_tpu.fleet import (
+    FleetCore,
+    FleetForwarder,
+    FleetMap,
+)
+from ratelimiter_tpu.ops.hashing import splitmix64
+from ratelimiter_tpu.serving import protocol as p
+
+
+def _cfg(limit=20, window=600.0, **kw):
+    return Config(algorithm=Algorithm.TPU_SKETCH, limit=limit,
+                  window=window,
+                  sketch=SketchParams(depth=4, width=4096, sub_windows=6),
+                  **kw)
+
+
+def _map(hosts_spec, buckets=32):
+    """hosts_spec: [(id, port, (lo, hi), extra_dict?), ...]"""
+    hosts = []
+    for spec in hosts_spec:
+        hid, port, (lo, hi) = spec[:3]
+        h = {"id": hid, "host": "127.0.0.1", "port": port,
+             "ranges": [[lo, hi]]}
+        if len(spec) > 3:
+            h.update(spec[3])
+        hosts.append(h)
+    return FleetMap.from_dict(
+        {"buckets": buckets, "epoch": 1, "hosts": hosts})
+
+
+def _server_on_thread(limiter):
+    from ratelimiter_tpu.serving import RateLimitServer
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    srv = RateLimitServer(limiter, "127.0.0.1", 0)
+    asyncio.run_coroutine_threadsafe(srv.start(), loop).result(10)
+    return srv, loop, t
+
+
+def _stop(srv, loop, t):
+    asyncio.run_coroutine_threadsafe(srv.shutdown(), loop).result(10)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+    loop.close()
+
+
+# ===================================================================
+#                       protocol-level units
+# ===================================================================
+
+
+class TestForwardFlag:
+    def test_with_forward_round_trip(self):
+        frame = p.encode_allow_hashed(7, np.arange(4, dtype=np.uint64))
+        flagged = p.with_forward(p.with_deadline(frame, 1.5))
+        _, type_, req_id = struct.unpack_from("<IBQ", flagged)
+        assert req_id == 7
+        assert type_ & p.FORWARD_FLAG
+        base, trace_id, budget, body = p.split_request(
+            type_, flagged[p.HEADER_SIZE:])
+        base, fwd = p.split_forward(base)
+        assert fwd and base == p.T_ALLOW_HASHED
+        assert trace_id == 0 and abs(budget - 1.5) < 1e-9
+        ids, ns = p.parse_allow_hashed(body)
+        assert (ids == np.arange(4)).all()
+
+    def test_split_forward_passthrough(self):
+        assert p.split_forward(p.T_ALLOW_HASHED) == (p.T_ALLOW_HASHED,
+                                                     False)
+        # Response types never carry the hint.
+        assert p.split_forward(p.T_RESULT_HASHED) == (p.T_RESULT_HASHED,
+                                                      False)
+
+    def test_double_flag_rejected(self):
+        frame = p.with_forward(
+            p.encode_allow_hashed(1, np.arange(2, dtype=np.uint64)))
+        with pytest.raises(p.ProtocolError):
+            p.with_forward(frame)
+
+
+class TestColumnarBatchParse:
+    def test_matches_scalar_parse(self):
+        from ratelimiter_tpu.core.types import Result
+
+        results = [
+            Result(True, 100, 42, 0.0, 123.5),
+            Result(False, 100, 0, 2.5, 124.0),
+            Result(True, 100, 7, 0.0, 125.0, fail_open=True),
+        ]
+        body = p.encode_result_batch(9, 100, results)[p.HEADER_SIZE:]
+        want = p.parse_result_batch(body)
+        got = p.parse_result_batch_columnar(body)
+        assert isinstance(got, BatchResult)
+        assert got.limit == 100 and len(got) == 3
+        assert got.fail_open  # any row's flag ORs
+        for i, r in enumerate(want):
+            assert bool(got.allowed[i]) == r.allowed
+            assert int(got.remaining[i]) == r.remaining
+            assert float(got.retry_after[i]) == r.retry_after
+            assert float(got.reset_at[i]) == r.reset_at
+
+    def test_bad_body_rejected(self):
+        with pytest.raises(p.ProtocolError):
+            p.parse_result_batch_columnar(b"\x00" * 13)
+
+
+class TestScatterMergeVectorized:
+    def test_list_leg_merges_columnar(self):
+        from ratelimiter_tpu.core.types import Result
+        from ratelimiter_tpu.fleet.forwarder import scatter_merge
+
+        legs = [
+            (np.array([0, 2]), [Result(True, 100, 5, 0.0, 10.0),
+                                Result(False, 200, 0, 1.5, 11.0)]),
+            (np.array([1]), BatchResult(
+                allowed=np.array([True]), limit=100,
+                remaining=np.array([9], dtype=np.int64),
+                retry_after=np.array([0.0]),
+                reset_at=np.array([12.0]))),
+        ]
+        out = scatter_merge(3, 100, legs)
+        assert out.allowed.tolist() == [True, True, False]
+        assert out.remaining.tolist() == [5, 9, 0]
+        assert out.retry_after.tolist() == [0.0, 0.0, 1.5]
+        assert not out.fail_open
+        # The 200-limit row materialized per-row limits.
+        assert out.limits is not None
+        assert out.limits.tolist() == [100, 100, 200]
+
+    def test_list_leg_fail_open_ors(self):
+        from ratelimiter_tpu.core.types import Result
+        from ratelimiter_tpu.fleet.forwarder import scatter_merge
+
+        out = scatter_merge(1, 10, [
+            (None, [Result(True, 10, 0, 0.0, 1.0, fail_open=True)])])
+        assert out.fail_open
+
+
+class TestFleetMapShards:
+    def test_shards_round_trip_and_validation(self):
+        m = _map([("a", 1, (0, 16)),
+                  ("b", 2, (16, 32), {"shards": 4})])
+        assert m.hosts[0].shards == 1
+        assert m.hosts[1].shards == 4
+        d = m.to_dict()
+        assert "shards" not in d["hosts"][0]
+        assert d["hosts"][1]["shards"] == 4
+        assert FleetMap.from_dict(d) == m
+        with pytest.raises(Exception, match="shards"):
+            _map([("a", 1, (0, 32), {"shards": 0})])
+
+
+# ===================================================================
+#            deterministic in-process lanes (ManualClock)
+# ===================================================================
+
+
+class TestCoalescedOrderingOracle:
+    """Host A = FleetForwarder over a local slice; host B = a REAL
+    asyncio server. Frames launch PIPELINED (several in flight before
+    the first resolve) so their foreign fragments genuinely coalesce
+    into shared wire windows; decisions must stay bit-identical to the
+    oracle fed each host's rows in send order."""
+
+    def _fleet(self, clock, limit=20, **core_kw):
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        cfg = _cfg(limit=limit)
+        lim_a = SketchLimiter(cfg, clock)
+        lim_b = SketchLimiter(cfg, clock)
+        srv, loop, t = _server_on_thread(lim_b)
+        m = _map([("a", 1, (0, 16)), ("b", srv.port, (16, 32))])
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=30.0, registry=Registry(),
+                         **core_kw)
+        fwd = FleetForwarder(lim_a, core)
+        oracle_a = SketchLimiter(cfg, clock)
+        oracle_b = SketchLimiter(cfg, clock)
+        return cfg, fwd, core, (srv, loop, t), (oracle_a, oracle_b)
+
+    def _drive_pipelined(self, fwd, core, frames):
+        """Launch every frame, then resolve in launch order — foreign
+        fragments of frames 2..k queue behind frame 1's window and
+        coalesce (forward_inflight bounds wire frames in flight)."""
+        tickets = [fwd.launch_ids(ids) for ids in frames]
+        return [fwd.resolve(t) for t in tickets]
+
+    def test_interleaved_frames_coalesce_bit_identical(self):
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, (oa, ob) = self._fleet(
+            clock, limit=10, forward_inflight=1)
+        srv, loop, t = server
+        try:
+            rng = np.random.default_rng(11)
+            # A hot id owned by B, present in EVERY frame, plus noise:
+            # send order across frames must be its decision order.
+            hot = next(i for i in range(1, 200)
+                       if int(core.owners_of_ids(
+                           np.asarray([i], np.uint64))[0]) == 1)
+            frames = []
+            for k in range(10):
+                ids = rng.integers(0, 64, size=30).astype(np.uint64)
+                ids[5] = hot
+                ids[17] = hot
+                frames.append(ids)
+            outs = self._drive_pipelined(fwd, core, frames)
+            # Oracle: each host's rows, frame by frame, in send order.
+            hot_remaining = []
+            for ids, got in zip(frames, outs):
+                owners = core.owners_of_ids(ids)
+                want_allowed = np.zeros(len(ids), dtype=bool)
+                want_remaining = np.zeros(len(ids), dtype=np.int64)
+                for host, oracle in ((0, oa), (1, ob)):
+                    pos = np.nonzero(owners == host)[0]
+                    if not pos.shape[0]:
+                        continue
+                    out = oracle.allow_ids(ids[pos])
+                    want_allowed[pos] = out.allowed
+                    want_remaining[pos] = out.remaining
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+                np.testing.assert_array_equal(got.remaining,
+                                              want_remaining)
+                hot_remaining.extend(
+                    got.remaining[ids == hot].tolist())
+            # The hot key's trajectory is strictly non-increasing —
+            # send order survived the coalesced hop.
+            assert hot_remaining == sorted(hot_remaining, reverse=True)
+            # And coalescing actually happened: 10 frames' fragments
+            # crossed in fewer wire windows.
+            lane = core.lane(1)
+            assert 0 < lane.wire_frames < 10
+            assert lane.wire_rows == sum(
+                int((core.owners_of_ids(ids) == 1).sum())
+                for ids in frames)
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_multi_connection_affinity_preserves_order(self):
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, (oa, ob) = self._fleet(
+            clock, limit=10, forward_inflight=2, forward_conns=3)
+        srv, loop, t = server
+        try:
+            rng = np.random.default_rng(3)
+            frames = [rng.integers(0, 48, size=40).astype(np.uint64)
+                      for _ in range(8)]
+            outs = self._drive_pipelined(fwd, core, frames)
+            per_id_remaining: dict = {}
+            for ids, got in zip(frames, outs):
+                owners = core.owners_of_ids(ids)
+                want_allowed = np.zeros(len(ids), dtype=bool)
+                want_remaining = np.zeros(len(ids), dtype=np.int64)
+                for host, oracle in ((0, oa), (1, ob)):
+                    pos = np.nonzero(owners == host)[0]
+                    if not pos.shape[0]:
+                        continue
+                    out = oracle.allow_ids(ids[pos])
+                    want_allowed[pos] = out.allowed
+                    want_remaining[pos] = out.remaining
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+                np.testing.assert_array_equal(got.remaining,
+                                              want_remaining)
+                for i, rid in enumerate(ids.tolist()):
+                    per_id_remaining.setdefault(rid, []).append(
+                        int(got.remaining[i]))
+            for rid, seq in per_id_remaining.items():
+                assert seq == sorted(seq, reverse=True), rid
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_string_frames_hash_forward_columnar(self):
+        """Single-shard receiver: string rows ride the columnar lane
+        (wire_frames counts coalesced hashed windows) and stay
+        bit-identical — including a policy-overridden key, whose
+        override the receiver resolves from the finalized hash."""
+        clock = ManualClock(1000.0)
+        cfg, fwd, core, server, (oa, ob) = self._fleet(clock, limit=5)
+        srv, loop, t = server
+        try:
+            keys = [f"user:{i}" for i in range(30)]
+            vip = next(k for k in keys if int(core.owners_of_hash(
+                core.hash_keys([k]))[0]) == 1)
+            # Override at the OWNER (lim_b inside the server) and on
+            # the oracle twin.
+            srv_lim = srv.batcher.limiter
+            srv_lim.set_override(vip, 2)
+            ob.set_override(vip, 2)
+            for _ in range(4):
+                got = fwd.allow_batch(keys)
+                owners = core.owners_of_hash(core.hash_keys(keys))
+                want_allowed = np.zeros(len(keys), dtype=bool)
+                for host, oracle in ((0, oa), (1, ob)):
+                    pos = np.nonzero(owners == host)[0]
+                    out = oracle.allow_batch([keys[i] for i in pos])
+                    want_allowed[pos] = out.allowed
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+            # Columnar lane used for the string rows:
+            assert core.lane(1).wire_frames > 0
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+    def test_multi_shard_peer_gets_strings(self):
+        """A peer declaring shards > 1 must receive STRING rows as
+        strings (FNV routing contract): the columnar window counter
+        stays at zero while decisions remain bit-identical."""
+        clock = ManualClock(1000.0)
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        cfg = _cfg(limit=8)
+        lim_a = SketchLimiter(cfg, clock)
+        lim_b = SketchLimiter(cfg, clock)
+        srv, loop, t = _server_on_thread(lim_b)
+        m = _map([("a", 1, (0, 16)),
+                  ("b", srv.port, (16, 32), {"shards": 2})])
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=30.0, registry=Registry())
+        fwd = FleetForwarder(lim_a, core)
+        ob = SketchLimiter(cfg, clock)
+        oa = SketchLimiter(cfg, clock)
+        try:
+            keys = [f"k:{i}" for i in range(40)]
+            got = fwd.allow_batch(keys, [2] * 40)
+            owners = core.owners_of_hash(core.hash_keys(keys))
+            want_allowed = np.zeros(40, dtype=bool)
+            for host, oracle in ((0, oa), (1, ob)):
+                pos = np.nonzero(owners == host)[0]
+                out = oracle.allow_batch([keys[i] for i in pos],
+                                         [2] * len(pos))
+                want_allowed[pos] = out.allowed
+            np.testing.assert_array_equal(got.allowed, want_allowed)
+            assert core.lane(1).wire_frames == 0  # string fallback
+            assert not core.peer_columnar(1)
+            # Raw-id frames still ride the columnar lane regardless.
+            fwd.allow_ids(np.arange(64, dtype=np.uint64))
+            assert core.lane(1).wire_frames > 0
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+
+class _OneShotPeer:
+    """Fake peer: answers the FIRST hashed window correctly (allow-all)
+    after ``reply_delay`` seconds — long enough for later fragments to
+    queue behind the in-flight bound and coalesce — then reads the
+    second window and closes cold: one failed coalesced wire frame."""
+
+    def __init__(self, reply_delay: float = 0.5):
+        self.reply_delay = reply_delay
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self.windows: list = []
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._t.start()
+
+    def _recv_frame(self, conn):
+        buf = b""
+        while len(buf) < p.HEADER_SIZE:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None, None, None
+            buf += chunk
+        length, type_, req_id = p.parse_header(buf[:p.HEADER_SIZE])
+        body = buf[p.HEADER_SIZE:]
+        while len(body) < length - 9:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return None, None, None
+            body += chunk
+        return type_, req_id, body
+
+    def _run(self):
+        conn, _ = self.sock.accept()
+        try:
+            type_, req_id, body = self._recv_frame(conn)
+            if type_ is None:
+                return
+            base, _, _, body = p.split_request(type_, body)
+            base, fwd = p.split_forward(base)
+            assert base == p.T_ALLOW_HASHED and fwd
+            ids, ns = p.parse_allow_hashed(body)
+            b = int(ids.shape[0])
+            self.windows.append(b)
+            # Hold the reply so later fragments coalesce behind the
+            # sender's in-flight bound.
+            time.sleep(self.reply_delay)
+            res = BatchResult(
+                allowed=np.ones(b, dtype=bool), limit=99,
+                remaining=np.full(b, 7, dtype=np.int64),
+                retry_after=np.zeros(b), reset_at=np.full(b, 5.0))
+            conn.sendall(p.encode_result_hashed(req_id, res))
+            # Read the second (coalesced) window, record it, then die
+            # without answering.
+            type2, _, body2 = self._recv_frame(conn)
+            if type2 is not None:
+                base2, _, _, body2 = p.split_request(type2, body2)
+                base2, _ = p.split_forward(base2)
+                ids2, _ = p.parse_allow_hashed(body2)
+                self.windows.append(int(ids2.shape[0]))
+        finally:
+            conn.close()
+            self.sock.close()
+
+
+class TestWindowFailureAttribution:
+    def test_failed_wire_frame_degrades_only_its_members(self):
+        """inflight=1 forces frames 2+3 to coalesce into window 2;
+        the peer answers window 1 and kills the connection. Frame 1
+        must carry REAL results; frames 2 and 3 degrade fail-open;
+        nothing else is touched."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        clock = ManualClock(1000.0)
+        peer = _OneShotPeer()
+        cfg = _cfg(limit=10, fail_open=True)
+        lim = SketchLimiter(cfg, clock)
+        m = _map([("a", 1, (0, 16)), ("b", peer.port, (16, 32))])
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=2.0, forward_inflight=1,
+                         registry=Registry())
+        fwd = FleetForwarder(lim, core)
+        try:
+            foreign = np.array(
+                [i for i in range(1, 400)
+                 if int(core.owners_of_ids(
+                     np.asarray([i], np.uint64))[0]) == 1][:30],
+                dtype=np.uint64)
+            t1 = fwd.launch_ids(foreign[:10])
+            # Give window 1 a moment to fly alone; 2+3 then share
+            # window 2 behind the in-flight bound.
+            deadline = time.time() + 5
+            while not peer.windows and time.time() < deadline:
+                time.sleep(0.01)
+            assert peer.windows == [10]
+            t2 = fwd.launch_ids(foreign[10:20])
+            t3 = fwd.launch_ids(foreign[20:30])
+            r1 = fwd.resolve(t1)
+            r2 = fwd.resolve(t2)
+            r3 = fwd.resolve(t3)
+            # Window 1's members: REAL peer answers.
+            assert not r1.fail_open
+            assert (r1.remaining == 7).all()
+            # Frames 2 and 3 genuinely shared ONE wire window:
+            assert peer.windows == [10, 20]
+            # Window 2's members: degraded fail-open, attributed only
+            # to them.
+            assert r2.fail_open and r3.fail_open
+            assert r2.allowed.all() and r3.allowed.all()
+            assert (r2.remaining == 0).all()
+            assert int(core._c_degraded.total()) == 20
+        finally:
+            fwd.close()
+
+    def test_dead_owner_degrades_only_its_rows(self):
+        """3-host frame: rows owned by a live peer answer REAL, rows
+        owned by a dead peer degrade, local rows decide locally — the
+        per-job attribution of one frame's split."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        clock = ManualClock(1000.0)
+        cfg = _cfg(limit=10, fail_open=True)
+        lim_a = SketchLimiter(cfg, clock)
+        lim_b = SketchLimiter(cfg, clock)
+        srv, loop, t = _server_on_thread(lim_b)
+        dead = free_port()
+        m = _map([("a", 1, (0, 11)), ("b", srv.port, (11, 22)),
+                  ("c", dead, (22, 32))])
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=0.5, registry=Registry())
+        fwd = FleetForwarder(lim_a, core)
+        ob = SketchLimiter(cfg, clock)
+        try:
+            ids = np.arange(1, 120, dtype=np.uint64)
+            out = fwd.allow_ids(ids)
+            owners = core.owners_of_ids(ids)
+            live = owners == 1
+            deadrows = owners == 2
+            # Live-peer rows bit-identical to the oracle:
+            want = ob.allow_ids(ids[live])
+            np.testing.assert_array_equal(out.allowed[live],
+                                          want.allowed)
+            np.testing.assert_array_equal(out.remaining[live],
+                                          want.remaining)
+            # Dead-peer rows: fail-open allowances (remaining 0).
+            assert out.allowed[deadrows].all()
+            assert (out.remaining[deadrows] == 0).all()
+            assert out.fail_open
+            assert int(core._c_degraded.total()) == int(deadrows.sum())
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+
+class TestFourHostRouting:
+    def test_frame_contacts_only_owners_of_its_rows(self):
+        """4-host map, one live peer (c): frames whose rows are owned
+        only by {a, c} must open a lane to c alone — the routed fleet
+        talks O(owners-touched), not O(N^2)."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        clock = ManualClock(1000.0)
+        cfg = _cfg(limit=10)
+        lim_a = SketchLimiter(cfg, clock)
+        lim_c = SketchLimiter(cfg, clock)
+        srv, loop, t = _server_on_thread(lim_c)
+        m = _map([("a", 1, (0, 8)), ("b", free_port(), (8, 16)),
+                  ("c", srv.port, (16, 24)), ("d", free_port(), (24, 32))])
+        core = FleetCore(m, "a", prefix=cfg.prefix,
+                         forward_deadline=30.0, registry=Registry())
+        fwd = FleetForwarder(lim_a, core)
+        oc = SketchLimiter(cfg, clock)
+        oa = SketchLimiter(cfg, clock)
+        try:
+            pool = np.array(
+                [i for i in range(1, 2000)
+                 if int(core.owners_of_ids(
+                     np.asarray([i], np.uint64))[0]) in (0, 2)][:120],
+                dtype=np.uint64)
+            assert pool.shape[0] == 120
+            for k in range(3):
+                ids = pool[k * 40:(k + 1) * 40]
+                got = fwd.allow_ids(ids)
+                owners = core.owners_of_ids(ids)
+                want_allowed = np.zeros(40, dtype=bool)
+                want_remaining = np.zeros(40, dtype=np.int64)
+                for host, oracle in ((0, oa), (2, oc)):
+                    pos = np.nonzero(owners == host)[0]
+                    if not pos.shape[0]:
+                        continue
+                    out = oracle.allow_ids(ids[pos])
+                    want_allowed[pos] = out.allowed
+                    want_remaining[pos] = out.remaining
+                np.testing.assert_array_equal(got.allowed, want_allowed)
+                np.testing.assert_array_equal(got.remaining,
+                                              want_remaining)
+            # Only c's lane exists; b and d were never contacted.
+            assert set(core._lanes.keys()) == {2}
+            assert core.lane(2).wire_frames > 0
+        finally:
+            fwd.close()
+            _stop(srv, loop, t)
+
+
+class TestBatcherForwardLaneSeparation:
+    def test_standalone_never_coalesces_with_client_window(self):
+        """A FORWARD_FLAG frame must dispatch in its own window: the
+        limiter sees two launches, not one concatenation — while two
+        standalone frames DO coalesce with each other."""
+        from ratelimiter_tpu.algorithms.sketch import SketchLimiter
+        from ratelimiter_tpu.serving.batcher import MicroBatcher
+
+        clock = ManualClock(1000.0)
+        lim = SketchLimiter(_cfg(limit=50), clock)
+        sizes = []
+        orig = lim.launch_ids
+
+        def spy(ids, ns=None, *, now=None, wire=False):
+            sizes.append(int(np.asarray(ids).shape[0]))
+            return orig(ids, ns, now=now, wire=wire)
+
+        lim.launch_ids = spy
+
+        async def drive():
+            b = MicroBatcher(lim, max_batch=1024, max_delay=0.05,
+                             inflight=2)
+            f1 = b.submit_hashed_nowait(
+                np.arange(10, dtype=np.uint64),
+                np.ones(10, dtype=np.uint32))
+            f2 = b.submit_hashed_nowait(
+                np.arange(100, 120, dtype=np.uint64),
+                np.ones(20, dtype=np.uint32), standalone=True)
+            f3 = b.submit_hashed_nowait(
+                np.arange(200, 230, dtype=np.uint64),
+                np.ones(30, dtype=np.uint32), standalone=True)
+            out = [await f for f in (f1, f2, f3)]
+            await b.drain()
+            return out
+
+        r1, r2, r3 = asyncio.run(drive())
+        assert len(r1) == 10 and len(r2) == 20 and len(r3) == 30
+        # One client window (10) and ONE coalesced forward window (50)
+        # — never a 60-row concatenation of the two classes.
+        assert sorted(sizes) == [10, 50]
+
+
+class TestForwardJobsApi:
+    def test_submit_failure_yields_prefailed_future_not_raise(self):
+        """forward_jobs never raises: sibling connections' rows still
+        decide when one submit overflows (the jobs carry the error)."""
+        from ratelimiter_tpu.observability.metrics import Registry
+
+        core = FleetCore(_map([("a", 1, (0, 16)),
+                               ("b", free_port(), (16, 32))]),
+                         "a", forward_deadline=0.2, registry=Registry())
+        core.close()  # lane submits now fail
+        h = np.arange(8, dtype=np.uint64)
+        jobs = core.forward_jobs(1, np.arange(8), splitmix64(h),
+                                 np.ones(8, dtype=np.int64))
+        assert jobs
+        for pos, fut in jobs:
+            assert fut.exception(timeout=1) is not None
