@@ -7,9 +7,10 @@ REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
-        test-audit test-fleet test-fleet-forward test-reshard lint check \
-        native bench bench-quick bench-audit bench-chaos bench-fleet \
-        bench-reshard bench-matrix serve verify clean
+        test-audit test-fleet test-fleet-forward test-reshard \
+        test-hierarchy lint check native bench bench-quick bench-audit \
+        bench-chaos bench-fleet bench-reshard bench-hierarchy \
+        bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -48,6 +49,10 @@ test-reshard:    ## elastic lifecycle (ADR-018): re-bucketing oracle, migration/
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -m pytest tests/test_reshard.py tests/test_elastic.py -q
 
+test-hierarchy:  ## hierarchical cascades + AIMD (ADR-020): oracle pinning, fair share, controller, both doors, mesh
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -m pytest tests/test_hierarchy.py tests/test_hierarchy_serving.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
@@ -59,6 +64,9 @@ bench-audit:     ## live-vs-offline accuracy agreement + audit overhead A/B JSON
 
 bench-chaos:     ## degraded-serving numbers (retention/entry/recovery JSON)
 	$(PY) bench.py --chaos slow-slice
+
+bench-hierarchy: ## cascade overhead ratio + abuse-scenario numbers (tighten/recover timeline JSON, ADR-020)
+	JAX_PLATFORMS=cpu $(PY) bench.py --hierarchy
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -34,6 +34,7 @@ from ratelimiter_tpu.core.config import (
     Config,
     SketchParams,
     DenseParams,
+    HierarchySpec,
     MeshSpec,
     PersistenceSpec,
     DEFAULT_PREFIX,
@@ -62,6 +63,7 @@ __all__ = [
     "Config",
     "SketchParams",
     "DenseParams",
+    "HierarchySpec",
     "MeshSpec",
     "PersistenceSpec",
     "DEFAULT_PREFIX",

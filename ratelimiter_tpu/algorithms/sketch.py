@@ -52,7 +52,7 @@ def _pad_size(n: int) -> int:
 
 class SketchLimiter(RateLimiter):
     def __init__(self, config: Config, clock: Optional[Clock] = None, *,
-                 device=None):
+                 device=None, hier_divisor: int = 1):
         """``device`` pins this limiter's state (and every staged batch)
         to one specific ``jax.Device`` instead of the process default —
         the slice seam of the slice-parallel serving tier (ADR-012,
@@ -95,6 +95,7 @@ class SketchLimiter(RateLimiter):
         self._warned_period = -1
         self.overload_periods = 0
         self._init_policy()
+        self._init_hierarchy(hier_divisor)
 
     # ------------------------------------------------------------- policy
 
@@ -147,6 +148,74 @@ class SketchLimiter(RateLimiter):
 
         h1, h2 = split_hash(np.asarray(h64, np.uint64), self._seed)
         return self._policy_table.limits_for(pack_halves_host(h1, h2))
+
+    # ---------------------------------------------------------- hierarchy
+
+    def _init_hierarchy(self, divisor: int = 1) -> None:
+        """Tenant + global cascade scopes (ADR-020), resolved in-kernel
+        like the policy table. ``divisor`` is the per-unit share a
+        hash-partitioned slice enforces (sliced mesh: n_slices)."""
+        self._hier_table = None
+        self._hier_dev = None
+        self._hier_dev_version = -1
+        if self.config.hierarchy.enabled:
+            from ratelimiter_tpu.hierarchy import TenantTable
+
+            self._hier_table = TenantTable(
+                self.config, key_fn=self._policy_key, divisor=divisor)
+
+    def _hier_device(self):
+        """Replicated device copy of the cascade tables (key→tenant map +
+        limit/weight columns). Lock must be held; rebuilt when the table
+        version moved. None when the hierarchy is disabled."""
+        t = self._hier_table
+        if t is None:
+            return None
+        if self._hier_dev is None or self._hier_dev_version != t.version:
+            host = t.host_arrays()
+            self._hier_dev = {k: self._place_replicated(v)
+                              for k, v in host.items()}
+            self._hier_dev_version = t.version
+        return self._hier_dev
+
+    def _hier_counts(self) -> np.ndarray:
+        """(T+1,) in-window admitted counts per scope (global at index
+        T). Lock held for one reference read only (jax arrays are
+        immutable — the consumer_stats discipline)."""
+        with self._lock:
+            # tn_totals only refreshes inside a dispatch; with zero
+            # traffic an idle limiter would keep reporting the LAST
+            # window's mass to the controller (tighten forever, relax
+            # never). Kick the same rollover sweep a decision would.
+            self._sync_period(to_micros(self.clock.now()))
+            ref = self._state["tn_totals"]
+        return np.asarray(ref)
+
+    def hierarchy_stats(self) -> dict:
+        from ratelimiter_tpu.core.config import HIER_UNLIMITED
+        from ratelimiter_tpu.hierarchy.tenants import GLOBAL
+
+        t = self._hier_table
+        if t is None:
+            return super().hierarchy_stats()
+        counts = self._hier_counts()
+        tenants = {}
+        for name in t.tenant_names():
+            ten = t.get_tenant(name)
+            tenants[name] = {
+                "tid": ten.tid,
+                "in_window": int(counts[ten.tid]),
+                "effective": t.effective_of(name),
+                "ceiling": ten.limit or HIER_UNLIMITED,
+                "floor": ten.floor,
+                "weight": ten.weight,
+            }
+        return {"tenants": tenants,
+                "global": {"in_window": int(counts[t.capacity]),
+                           "effective": t.effective_of(GLOBAL),
+                           "ceiling": t.global_ceiling},
+                "divisor": t.divisor,
+                "assignments": len(t.assignments())}
 
     def _sync_period(self, now_us: int) -> None:
         """Dispatch the rollover kernel if now_us entered a new sub-window.
@@ -279,9 +348,13 @@ class SketchLimiter(RateLimiter):
                     # ring.
                     return DispatchTicket(result=self._deny_all(b, now_us))
                 step = self._get_ids_step() if premix else self._step
-                self._state, outs = step(
-                    self._state, self._place(h64p), self._place(nsp),
-                    jnp.int64(now_us), self._policy_device())
+                args = (self._state, self._place(h64p), self._place(nsp),
+                        jnp.int64(now_us), self._policy_device())
+                if self._hier_table is not None:
+                    # Cascade tables ride as one extra replicated operand
+                    # — tenant ids derive on device, same dispatch.
+                    args = args + (self._hier_device(),)
+                self._state, outs = step(*args)
                 # Inside the lock: a concurrent set/delete_override
                 # rebuilds the table's sorted views, and a torn read
                 # would mis-index. Raw-id launches finalize host-side
@@ -743,6 +816,8 @@ class SketchLimiter(RateLimiter):
         with self._lock:
             arrays = {k: np.asarray(v) for k, v in self._state.items()}
             arrays.update(self._policy_table.snapshot_arrays())
+            if self._hier_table is not None:
+                arrays.update(self._hier_table.snapshot_arrays())
             extra = {"saved_at": self.clock.now()}
             hp = getattr(self, "_host_period", None)
             if hp is not None:
@@ -773,6 +848,12 @@ class SketchLimiter(RateLimiter):
             # older checkpoints -> empty table).
             self._policy_table.restore_arrays(arrays)
             self._policy_dev = None
+            if self._hier_table is not None:
+                # Cascade tables + controller-moved effective limits
+                # (hier_* columns) — adaptive state resumes, it does not
+                # snap back to the ceilings (ADR-020).
+                self._hier_table.restore_arrays(arrays)
+                self._hier_dev = None
             # Arrays added in later releases may default when absent from
             # an older checkpoint (each class lists the safe ones).
             for k in self._CKPT_OPTIONAL:
@@ -874,7 +955,7 @@ class SketchTokenBucketLimiter(SketchLimiter):
     _CKPT_OPTIONAL = ("acc",)
 
     def __init__(self, config: Config, clock: Optional[Clock] = None, *,
-                 device=None):
+                 device=None, hier_divisor: int = 1):
         RateLimiter.__init__(self, config, clock)
         self._device = device
         from ratelimiter_tpu.ops import bucket_kernels
@@ -893,6 +974,7 @@ class SketchTokenBucketLimiter(SketchLimiter):
         self._strict = False
         self._injected_failure: Optional[Exception] = None
         self._init_policy()
+        self._init_hierarchy(hier_divisor)
 
     def _policy_validate(self, limit: int, _window_us: int) -> None:
         # Batch admission does exact int64 micro-token cumsums; the same
@@ -906,6 +988,18 @@ class SketchTokenBucketLimiter(SketchLimiter):
 
     def _sync_period(self, now_us: int) -> None:
         """No ring, no rollover: decay happens inside every step."""
+
+    def _hier_counts(self) -> np.ndarray:
+        """Bucket-backend scope counters are fixed-window: counts from a
+        previous window read as zero (the step zeroes them lazily)."""
+        with self._lock:
+            counts_ref = self._state["tn_counts"]
+            period_ref = self._state["tn_period"]
+        counts = np.asarray(counts_ref)
+        cur_p = to_micros(self.clock.now()) // self._window_us
+        if int(np.asarray(period_ref)) < cur_p:
+            return np.zeros_like(counts)
+        return counts
 
     def _build_ids_step(self):
         from ratelimiter_tpu.ops import bucket_kernels

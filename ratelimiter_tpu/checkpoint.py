@@ -70,6 +70,13 @@ def config_fingerprint(config: Config) -> str:
     fields.pop("mesh", None)
     if isinstance(fields.get("sketch"), dict):
         fields["sketch"].pop("kernels", None)
+    h = fields.get("hierarchy")
+    if isinstance(h, dict) and not h.get("tenants"):
+        # Hierarchy disabled is the pre-ADR-020 world: dropping the spec
+        # keeps every existing snapshot's fingerprint (golden pinned).
+        # When ENABLED, the cascade geometry shapes the tn_* state
+        # arrays, so it must participate like any other geometry field.
+        fields.pop("hierarchy", None)
     payload = json.dumps(
         {**fields, "algorithm": str(config.algorithm)},
         sort_keys=True, default=str)

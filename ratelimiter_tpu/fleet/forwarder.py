@@ -41,6 +41,7 @@ from __future__ import annotations
 import concurrent.futures
 import logging
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -705,14 +706,27 @@ def collect_jobs(core: FleetCore, jobs, cfg, now: float):
     per the fail-open/closed policy — one failed coalesced wire frame
     touches only its member fragments (fail-closed keeps the FIRST
     error to raise after every job is drained — the ADR-013
-    non-transactional frame contract: other hosts' quota stands)."""
+    non-transactional frame contract: other hosts' quota stands).
+
+    Per-leg completion (ADR-019 residual): legs harvest AS THEY FINISH
+    under ONE shared deadline anchored at collect start, not
+    sequentially in launch order each with a fresh budget. An
+    early-finishing leg surfaces its rows (and releases its lane reply
+    buffer reference) immediately even when an earlier-launched leg is
+    the slow one, and the whole barrier is bounded by max(leg), never
+    sum(timeouts) — the old loop could stall a pipelined completer
+    thread for n_legs × deadline behind one wedged peer."""
     parts = []
     err = None
-    budget = core.forward_deadline + 2.0
-    for pos, fut, ordinal in jobs:
+    deadline = time.monotonic() + core.forward_deadline + 2.0
+    by_fut = {id(fut): (pos, ordinal) for pos, fut, ordinal in jobs}
+
+    def _harvest(fut) -> None:
+        nonlocal err
+        pos, ordinal = by_fut[id(fut)]
         k = int(pos.shape[0])
         try:
-            out = fut.result(timeout=budget)
+            out = fut.result(timeout=0)
         except Exception as exc:
             if ordinal is not None:
                 core.note_forward_failure(ordinal, exc, k)
@@ -720,9 +734,23 @@ def collect_jobs(core: FleetCore, jobs, cfg, now: float):
                 err = err if err is not None else StorageUnavailableError(
                     f"fleet forward failed ({exc}); rows fail closed "
                     f"per config")
-                continue
+                return
             out = batch_fail_open(k, cfg.limit, now + float(cfg.window))
         parts.append((pos, out))
+
+    pending = {fut for _, fut, _ in jobs}
+    while pending:
+        done, pending = concurrent.futures.wait(
+            pending, timeout=max(0.0, deadline - time.monotonic()),
+            return_when=concurrent.futures.FIRST_COMPLETED)
+        for fut in done:
+            _harvest(fut)
+        if pending and not done:
+            # Shared budget exhausted with legs still in flight: fail
+            # exactly those rows (fut.result(0) raises TimeoutError).
+            for fut in pending:
+                _harvest(fut)
+            break
     return parts, err
 
 

@@ -129,6 +129,8 @@ class FleetMembership:
                  absorb_fn: Optional[Callable] = None,
                  auto_rejoin: bool = True,
                  secret: Optional[str] = None,
+                 hier_payload_fn: Optional[Callable[[], dict]] = None,
+                 hier_apply_fn: Optional[Callable[[dict], bool]] = None,
                  registry: Optional[m.Registry] = None):
         import secrets as _secrets
 
@@ -146,6 +148,13 @@ class FleetMembership:
         self.absorb_fn = absorb_fn
         self.auto_rejoin = bool(auto_rejoin)
         self.secret = secret
+        #: Hierarchy effective-limit gossip (ADR-020): when set, every
+        #: announce carries the local cascade's revision-stamped
+        #: effective-limit frame and every received announce offers its
+        #: peer's frame to the local table (last-writer-wins on
+        #: revision) — the AIMD controller's fleet convergence path.
+        self.hier_payload_fn = hier_payload_fn
+        self.hier_apply_fn = hier_apply_fn
         self._sender = _secrets.randbits(64)
         self._last_seq = 0
         self._ids = itertools.count(1)
@@ -206,9 +215,15 @@ class FleetMembership:
         return self._last_seq
 
     def announce_payload(self) -> dict:
-        return {"kind": "announce", "from": self.core.self_id,
-                "map": self.core.map_payload(),
-                "sent_at": time.time()}
+        out = {"kind": "announce", "from": self.core.self_id,
+               "map": self.core.map_payload(),
+               "sent_at": time.time()}
+        if self.hier_payload_fn is not None:
+            try:
+                out["hier"] = self.hier_payload_fn()
+            except Exception:  # noqa: BLE001 — gossip rides best-effort
+                log.exception("fleet: hierarchy payload hook failed")
+        return out
 
     def _push_frame(self, host: FleetHost, payload: dict) -> None:
         """Encode + push one DCN fleet frame to ``host`` (raises on
@@ -289,6 +304,15 @@ class FleetMembership:
                 # ADR-018).
                 self._dead.discard(peer)
         self._g_alive.set(1.0, peer=peer)
+        hier = payload.get("hier")
+        if hier and self.hier_apply_fn is not None:
+            # Before the steady-state map short-circuit below: effective
+            # limits move independently of map epochs (the controller
+            # ticks far more often than ownership changes).
+            try:
+                self.hier_apply_fn(hier)
+            except Exception:  # noqa: BLE001 — gossip is best-effort
+                log.exception("fleet: hierarchy apply hook failed")
         if was_dead:
             self.core.set_dead([self.core.map.ordinal(p_id)
                                 for p_id in self._dead

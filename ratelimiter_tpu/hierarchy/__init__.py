@@ -1,0 +1,27 @@
+"""Hierarchical cascades + adaptive control (ADR-020).
+
+``tenants``    — the host-authoritative tenant registry + key→tenant map
+                 (the cascade's control plane; device half in
+                 ops/hier_kernels.py).
+``controller`` — the AIMD loop closing ROADMAP item 3: tightens/relaxes
+                 *effective* scope limits off the live observatory
+                 signals (ADR-016 audit rates, SLO burn, per-tenant
+                 in-window mass) and publishes them through the existing
+                 update machinery so mesh slices and fleet members
+                 converge.
+``fanout``     — write-all/read-one/sum-stats facade over the native
+                 door's per-shard limiter list (the serving mount).
+"""
+
+from ratelimiter_tpu.hierarchy.controller import AIMDController, AIMDGains
+from ratelimiter_tpu.hierarchy.fanout import HierarchyFanout
+from ratelimiter_tpu.hierarchy.tenants import GLOBAL, Tenant, TenantTable
+
+__all__ = [
+    "AIMDController",
+    "AIMDGains",
+    "GLOBAL",
+    "HierarchyFanout",
+    "Tenant",
+    "TenantTable",
+]

@@ -213,6 +213,49 @@ class LimiterDecorator(RateLimiter):
         # its slices); the base impl would wrongly answer [decorator].
         return self.inner.sub_limiters()
 
+    # Hierarchy surface (ADR-020): same explicit-delegation rule as the
+    # policy surface — the base class defines these, so __getattr__
+    # never fires, and the sliced mesh OVERRIDES them with write-all
+    # semantics that must survive any decorator stack.
+
+    def set_tenant(self, name: str, limit: Optional[int] = None, *,
+                   weight: int = 1, floor: Optional[int] = None):
+        return self.inner.set_tenant(name, limit, weight=weight,
+                                     floor=floor)
+
+    def delete_tenant(self, name: str) -> bool:
+        return self.inner.delete_tenant(name)
+
+    def assign_tenant(self, key: str, tenant: str) -> None:
+        return self.inner.assign_tenant(key, tenant)
+
+    def unassign_tenant(self, key: str) -> bool:
+        return self.inner.unassign_tenant(key)
+
+    def tenant_of(self, key: str) -> str:
+        return self.inner.tenant_of(key)
+
+    def list_tenants(self):
+        return self.inner.list_tenants()
+
+    def set_global_limit(self, limit) -> None:
+        return self.inner.set_global_limit(limit)
+
+    def set_effective(self, scope: str, limit: int) -> int:
+        return self.inner.set_effective(scope, limit)
+
+    def effective_limits(self):
+        return self.inner.effective_limits()
+
+    def hierarchy_payload(self) -> dict:
+        return self.inner.hierarchy_payload()
+
+    def apply_hierarchy_payload(self, payload: dict) -> bool:
+        return self.inner.apply_hierarchy_payload(payload)
+
+    def hierarchy_stats(self) -> dict:
+        return self.inner.hierarchy_stats()
+
     # Pass-through for backend extras (allow_hashed, inject_failure, ...) --
 
     def __getattr__(self, name: str):

@@ -76,12 +76,27 @@ def init_state(cfg: Config) -> State:
     only — so exports can never re-ship foreign traffic (the bucket
     analog of the windowed tier's completed-slab watermark)."""
     d, w = cfg.sketch.depth, cfg.sketch.width
-    return {
+    state = {
         "debt": jnp.zeros((d, w), jnp.int64),
         "acc": jnp.zeros((d, w), jnp.int64),
         "rem": jnp.asarray(0, jnp.int64),
         "last": jnp.asarray(0, jnp.int64),
     }
+    T = cfg.hierarchy.tenants
+    if T:
+        # Hierarchical cascade (ADR-020): tenant/global scopes on the
+        # debt-sketch backend are FIXED-WINDOW request counters (index T
+        # is the global scope) — a deliberate divergence from the key
+        # scope's GCRA meter, keeping tenant math exact int64 at any
+        # window length (per-tenant decay rates over a dynamic limit
+        # array cannot stay overflow-safe at 365-day windows). tn_period
+        # is the window index of the counts; a step in a later window
+        # zeroes them lazily.
+        state.update({
+            "tn_counts": jnp.zeros((T + 1,), jnp.int64),
+            "tn_period": jnp.asarray(-(1 << 40), jnp.int64),
+        })
+    return state
 
 
 def _decay(state: State, now_us, *, rate_num: int, rate_den: int):
@@ -99,9 +114,10 @@ def _decay(state: State, now_us, *, rate_num: int, rate_den: int):
     return decay, acc % rate_den
 
 
-def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
+def _bucket_step(state: State, h1, h2, n, now_us, policy=None, hier=None, *,
                  limit: int, rate_num: int, rate_den: int,
-                 d: int, w: int, iters: int,
+                 d: int, w: int, iters: int, tenants: int = 0,
+                 window_us: int = 0,
                  axis_name: str | None = None, use_pallas: bool = False):
     """One batched decision step. Returns (state, (allowed, remaining,
     retry_us)) — the limiter-side retry/reset plumbing is shared with the
@@ -145,6 +161,49 @@ def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, consumed = admit(sid, n_units, avail, iters)
 
+    tn_hist = None
+    if tenants and hier is not None:
+        # Cascade stages 2+3 (ADR-020): fixed-window tenant/global
+        # request counters, rolled lazily when the step's timestamp
+        # enters a new window. All-or-nothing — the final mask gates the
+        # key-scope debt write below, and every scope's consumption view
+        # is recomputed under it.
+        from ratelimiter_tpu.ops import hier_kernels
+        from ratelimiter_tpu.ops.segment import segment_consumption
+
+        tid = hier_kernels.derive_tids(hier, h1, h2, tenants)
+        hp = now_us // window_us
+        rolled = hp > state["tn_period"]
+        counts = jnp.where(rolled, jnp.int64(0), state["tn_counts"])
+        avail_sc = hier_kernels.scope_avail(hier["limit"], counts)
+        allowed_casc, tn_hist = hier_kernels.cascade_admit(
+            allowed, tid, n, avail_sc, hier["weight"], tenants, iters)
+        # Final-mask consumption view, cond'd on the cascade having
+        # flipped any verdict (same rule as the windowed kernel): no
+        # contention → stage-1 seen already reflects the final mask.
+        seen = jax.lax.cond(
+            jnp.any(allowed_casc != allowed),
+            lambda: avail - segment_consumption(
+                sid, jnp.where(allowed_casc, n_units, jnp.int64(0))),
+            lambda: seen)
+        allowed = allowed_casc
+        consumed = jnp.where(allowed, n_units, jnp.int64(0))
+        if axis_name is not None:
+            tn_hist = jax.lax.psum(tn_hist, axis_name)
+        tn_out = {"tn_counts": counts + tn_hist,
+                  "tn_period": jnp.maximum(state["tn_period"], hp)}
+        # Retry for a request the key scope would admit but the cascade
+        # denied: the tenant/global window boundary (when those counters
+        # reset), not the refill-deficit formula (whose deficit is <= 0
+        # for key-fitting requests).
+        cascade_retry = (hp + 1) * window_us - now_us
+    elif "tn_counts" in state:
+        tn_out = {k: state[k] for k in ("tn_counts", "tn_period")}
+        cascade_retry = None
+    else:
+        tn_out = {}
+        cascade_retry = None
+
     if use_pallas:
         from ratelimiter_tpu.ops import pallas_sketch
 
@@ -165,12 +224,18 @@ def _bucket_step(state: State, h1, h2, n, now_us, policy=None, *,
     new_state = {"debt": debt,
                  "acc": acc,
                  "rem": rem,
-                 "last": jnp.maximum(state["last"], now_us)}
+                 "last": jnp.maximum(state["last"], now_us),
+                 **tn_out}
     remaining = (seen - jnp.where(allowed, n_units, 0)) // MICROS
     # Reference retry semantics (``tokenbucket.go:122-130``): time to refill
     # the deficit, ceil'd to whole microseconds.
     deficit = jnp.maximum(0, n_units - seen)
     retry_us = jnp.where(allowed, 0, -((-deficit * rate_den) // rate_num))
+    if cascade_retry is not None:
+        # Cascade-denied rows (deficit 0 at the key scope) retry at the
+        # tenant/global window boundary.
+        retry_us = jnp.where(~allowed & (deficit <= 0), cascade_retry,
+                             retry_us)
     return new_state, (allowed, remaining, retry_us)
 
 
@@ -193,8 +258,13 @@ def _bucket_reset(state: State, h1, h2, now_us, *,
     # it forgives was already exported (or will be) as real local traffic,
     # and a negative export could under-count remotely (over-admission).
     # Cross-pod, a reset key simply recovers locally first.
-    return {"debt": debt, "acc": state["acc"], "rem": rem,
-            "last": jnp.maximum(state["last"], now_us)}
+    out = {"debt": debt, "acc": state["acc"], "rem": rem,
+           "last": jnp.maximum(state["last"], now_us)}
+    if "tn_counts" in state:
+        # Key-scope forgiveness only — tenant/global counters stand
+        # (same rule as the windowed sketch's _sketch_reset, ADR-020).
+        out.update({k: state[k] for k in ("tn_counts", "tn_period")})
+    return out
 
 
 def _bucket_scan(state: State, h1s, h2s, ns, now0_us, dt_us, *, step_kw):
@@ -236,6 +306,13 @@ def _params(cfg: Config) -> tuple:
             cfg.max_batch_admission_iters)
 
 
+def _hier_params(cfg: Config) -> tuple:
+    """(tenants, window_us) for the cascade's fixed-window tenant
+    counters; (0, window_us) when the hierarchy is disabled."""
+    W, _, _ = _check_gates(cfg)
+    return cfg.hierarchy.tenants, W
+
+
 def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
     """Returns (step, reset) jitted callables, memoized per static config.
     ``step`` accepts an optional trailing ``policy`` operand."""
@@ -243,14 +320,16 @@ def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
 
     ensure_x64()
     limit, num, den, d, w, iters = _params(cfg)
+    tenants, wus = _hier_params(cfg)
     use_pallas = _resolve_pallas(cfg, bucket=True)
-    key = (limit, num, den, d, w, iters, use_pallas)
+    key = (limit, num, den, d, w, iters, tenants, wus, use_pallas)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_bucket_step, limit=limit, rate_num=num, rate_den=den,
-                d=d, w=w, iters=iters, use_pallas=use_pallas),
+                d=d, w=w, iters=iters, tenants=tenants, window_us=wus,
+                use_pallas=use_pallas),
         donate_argnums=(0,))
     reset = jax.jit(
         partial(_bucket_reset, rate_num=num, rate_den=den, d=d, w=w),
@@ -262,7 +341,7 @@ def build_steps(cfg: Config) -> Tuple[Callable, Callable]:
 _HASHED_CACHE: Dict[tuple, Callable] = {}
 
 
-def _bucket_step_h64(state: State, h64, n, now_us, policy=None, *,
+def _bucket_step_h64(state: State, h64, n, now_us, policy=None, hier=None, *,
                      seed: int, premix: bool, **step_kw):
     from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
 
@@ -270,7 +349,7 @@ def _bucket_step_h64(state: State, h64, n, now_us, policy=None, *,
     if premix:
         h = splitmix64_dev(h)
     h1, h2 = split_hash_dev(h, seed)
-    return _bucket_step(state, h1, h2, n, now_us, policy, **step_kw)
+    return _bucket_step(state, h1, h2, n, now_us, policy, hier, **step_kw)
 
 
 def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
@@ -281,16 +360,19 @@ def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
 
     ensure_x64()
     limit, num, den, d, w, iters = _params(cfg)
+    tenants, wus = _hier_params(cfg)
     use_pallas = _resolve_pallas(cfg, bucket=True)
     seed = cfg.sketch.seed
-    key = (limit, num, den, d, w, iters, use_pallas, seed, premix)
+    key = (limit, num, den, d, w, iters, tenants, wus, use_pallas, seed,
+           premix)
     cached = _HASHED_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_bucket_step_h64, seed=seed, premix=premix,
                 limit=limit, rate_num=num, rate_den=den,
-                d=d, w=w, iters=iters, use_pallas=use_pallas),
+                d=d, w=w, iters=iters, tenants=tenants, window_us=wus,
+                use_pallas=use_pallas),
         donate_argnums=(0,))
     _HASHED_CACHE[key] = step
     return step

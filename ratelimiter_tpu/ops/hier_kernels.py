@@ -1,0 +1,197 @@
+"""Device-side hierarchical cascade — tenant derivation, tiered
+admission, weighted fair sharing (ADR-020).
+
+The cascade extends a backend's jitted decision step (sketch windowed,
+sketched token bucket, their mesh twins) to evaluate THREE nested scopes
+per request — key → tenant → global — in the same single device
+dispatch. Nothing new crosses the wire: tenant ids derive ON DEVICE from
+a policy-table-style sorted key→tenant map (the same branchless binary
+search as ops/policy_kernels.lookup_i64, over the same packed (h1, h2)
+search-key domain), and the per-tenant + global counter slab updates in
+the same kernel pass as the key-scope sketch write.
+
+Admission semantics (the contract tests/test_hierarchy.py pins against a
+host-side sequential reference):
+
+* **Stage 1 — key scope**: the backend's existing greedy in-batch-order
+  admission (ops/segment.admit) against per-key availability, exactly as
+  without the hierarchy.
+* **Stage 2 — tenant scope**: among stage-1 survivors, greedy in-batch-
+  order admission per tenant segment against that tenant's availability
+  (limit − in-window count).
+* **Stage 3 — global scope + fair share**: per-tenant demand is the
+  stage-2 survivor mass. When total demand fits the global availability
+  G, every survivor passes. Under contention each ACTIVE tenant's
+  admissible mass is clipped to ``G * weight_t // Σ active weights``
+  (exact int64 math; floor division means the clipped caps can only
+  under-fill G — toward denying, never over-admission), and survivors
+  admit greedily in batch order within their tenant up to the cap.
+
+Admission is **all-or-nothing**: a request is allowed iff it passes all
+three scopes, and a denied request consumes nothing at ANY scope — the
+caller recomputes every scope's consumption under the final mask
+(ops/segment.segment_consumption) before writing state. One documented
+in-batch artifact follows from staging: a request that passes the key
+scope but dies at a later scope still occupied key/tenant budget during
+the earlier stages' in-batch sequencing, so a later same-key request in
+the SAME batch may be denied where a fully sequential joint evaluation
+would have admitted it. The artifact lasts one batch, errs toward
+denying, and preserves the module-wide never-over-admit direction.
+
+Quantities are plain int64 request counts at the tenant/global scopes
+(both backends), so limits up to the HIER_UNLIMITED sentinel (2^40) stay
+exact regardless of the key scope's f32/micro-unit domain.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import HIER_UNLIMITED
+from ratelimiter_tpu.ops import policy_kernels
+from ratelimiter_tpu.ops.segment import admit
+
+#: Device-side mirror of core.config.HIER_UNLIMITED (re-exported so
+#: kernels and the host TenantTable agree on one sentinel).
+UNLIMITED = HIER_UNLIMITED
+
+
+def derive_tids(hier, h1, h2, tenants: int):
+    """(B,) int32 tenant ids for a batch: binary-search the sorted
+    key→tenant map on the packed (h1, h2) search key; misses land on the
+    default tenant 0. ``hier`` is the device table dict
+    {key, tid, limit, weight} (hierarchy/tenants.py host_arrays)."""
+    q = policy_kernels.pack_halves(h1, h2)
+    idx, found = policy_kernels.lookup_i64(hier["key"], q)
+    tid = jnp.where(found, hier["tid"][idx], jnp.int64(0))
+    # Clamp defends against a corrupt table row; tid is a gather index
+    # into (tenants+1,) slabs where index ``tenants`` is the global slot.
+    return jnp.clip(tid, 0, tenants - 1).astype(jnp.int32)
+
+
+#: Widest tenant domain the dense (one-hot) admission path materializes
+#: as a [B, tenants+1] expansion; beyond it the generic sort-based
+#: ops/segment.admit runs instead. 64 int32 columns keep the expansion
+#: under 1 MB/4k-batch while covering every realistic tenant count.
+_DENSE_MAX_SCOPES = 64
+
+
+def _admit_dense(tid, n, avail, scopes: int, iters: int):
+    """Sort-free twin of ops/segment.admit for a SMALL id domain.
+
+    Same greedy fixpoint + safety intersection, bit-identical masks: the
+    segment-exclusive cumsum is computed as an exclusive per-column
+    cumsum of the one-hot expansion (requests are already in batch
+    order, so no sort/unsort passes — the generic admit's dominant
+    cost). int32 accumulation is exact under the same total-batch-
+    consumption < 2^31 precondition the f32-exact path documents;
+    comparisons run in int64 (tenant/global avail carries the 2^40
+    UNLIMITED sentinel).
+    """
+    onehot = tid[:, None] == jnp.arange(scopes, dtype=tid.dtype)[None, :]
+    oh32 = onehot.astype(jnp.int32)
+    n32 = n.astype(jnp.int32)
+    zero32 = jnp.zeros((), jnp.int32)
+
+    def cons_under(mask):
+        x = jnp.where(mask, n32, zero32)[:, None] * oh32
+        pref = jnp.cumsum(x, axis=0) - x      # exclusive, per column
+        return jnp.sum(jnp.where(onehot, pref, 0),
+                       axis=1).astype(jnp.int64)
+
+    allowed = jnp.ones(tid.shape, dtype=bool)
+    for _ in range(iters):
+        allowed = cons_under(allowed) + n <= avail
+    cons = cons_under(allowed)
+    return allowed & (cons + n <= avail)
+
+
+def _admit_scope(tid, n, avail, tenants: int, iters: int):
+    """Tenant-domain admission: dense one-hot path for realistic tenant
+    counts, generic sort-based admit for very wide configs."""
+    if tenants + 1 <= _DENSE_MAX_SCOPES:
+        return _admit_dense(tid, n, avail, tenants + 1, iters)
+    allowed, _, _ = admit(tid, n, avail, iters)
+    return allowed
+
+
+def cascade_admit(allowed_key, tid, n, avail_scopes, weights,
+                  tenants: int, iters: int):
+    """Stages 2+3 of the cascade over one batch.
+
+    Args:
+        allowed_key: bool[B] — stage-1 (key scope) verdicts.
+        tid: int32[B] tenant id per request (0 = default tenant).
+        n: int64[B] requested amounts (request counts).
+        avail_scopes: int64[tenants+1] free quota per tenant, with the
+            GLOBAL scope's availability at index ``tenants``.
+        weights: int64[tenants+1] fair-share weights (>= 1; the global
+            slot's weight is ignored).
+        tenants: static tenant capacity (slab width − 1).
+        iters: admission fixpoint iterations (the backend's
+            max_batch_admission_iters — same exactness contract as
+            ops/segment.admit).
+
+    Returns ``(allowed bool[B], hist int64[tenants+1])`` — the final
+    all-or-nothing mask and the admitted-mass histogram (per tenant,
+    global total at index ``tenants``) ready to fold into the counter
+    slab.
+    """
+    n = n.astype(jnp.int64)
+    n2 = jnp.where(allowed_key, n, jnp.int64(0))
+    # Key-survivor demand per tenant (global slot stays 0 — tids clamp
+    # to [0, tenants)), and its total: the contention predicate.
+    demand2 = jnp.zeros((tenants + 1,), jnp.int64).at[tid].add(n2)
+    total2 = jnp.sum(demand2)
+    g_avail = avail_scopes[tenants]
+    uncontended = (jnp.all(demand2[:tenants] <= avail_scopes[:tenants])
+                   & (total2 <= g_avail))
+
+    def _uncontended():
+        # Every tenant's whole demand fits its availability and the
+        # batch total fits the global scope: greedy admission passes
+        # every key-scope survivor at both stages (exactly — greedy
+        # only ever denies when some cumulative crosses its bound), so
+        # the verdicts ARE the stage-1 mask and the histogram is the
+        # demand histogram. This is the steady-state serving case; the
+        # staged machinery below only runs under real contention.
+        return allowed_key, demand2.at[tenants].set(total2)
+
+    def _contended():
+        # Stage 2: tenant-scope greedy among key-scope survivors.
+        # Masked requests (n=0) always fit; the intersection removes
+        # them.
+        a2 = _admit_scope(tid, n2, avail_scopes[tid], tenants, iters)
+        surv = allowed_key & a2
+
+        # Stage 3: weighted fair share of the global scope. Demand is
+        # the survivor mass per tenant; under contention each active
+        # tenant's cap is its weight's proportional share of G (floor —
+        # under-fills, never over-admits). Uncontended, cap == demand
+        # and the admit below passes every survivor (each tenant's
+        # cumulative mass is exactly its demand).
+        n3 = jnp.where(surv, n, jnp.int64(0))
+        demand = jnp.zeros((tenants + 1,), jnp.int64).at[tid].add(n3)
+        total = jnp.sum(demand)
+        active_w = jnp.where(demand > 0, weights, jnp.int64(0))
+        w_sum = jnp.maximum(jnp.sum(active_w), 1)
+        # g_avail <= 2^40 (HIER_UNLIMITED) and weights <= 2^20: the
+        # product stays < 2^62, exact in int64.
+        share = (g_avail * weights) // w_sum
+        cap = jnp.where(total > g_avail, jnp.minimum(demand, share),
+                        demand)
+        a3 = _admit_scope(tid, n3, cap[tid], tenants, iters)
+        allowed = surv & a3
+
+        adm = jnp.where(allowed, n, jnp.int64(0))
+        hist = jnp.zeros((tenants + 1,), jnp.int64).at[tid].add(adm)
+        return allowed, hist.at[tenants].add(jnp.sum(adm))
+
+    return jax.lax.cond(uncontended, _uncontended, _contended)
+
+
+def scope_avail(limits, counts):
+    """int64[T+1] per-scope availability: max(limit − in-window count, 0).
+    ``limits`` carries the UNLIMITED sentinel for uncapped scopes."""
+    return jnp.maximum(limits - counts.astype(jnp.int64), 0)

@@ -154,3 +154,31 @@ def admit(
     allowed_o = allowed_i.astype(bool)
     consumed_o = jnp.where(allowed_o, n_units, zero)
     return allowed_o, seen_o, consumed_o
+
+
+def segment_consumption(sid: jnp.ndarray, n_units: jnp.ndarray) -> jnp.ndarray:
+    """Segment-exclusive cumsum of (already-masked) consumption, returned
+    in ORIGINAL request order: cons[i] = sum of n_units[j] for j < i in
+    the same slot. The cascade path (ops/hier_kernels.py) uses this to
+    recompute each scope's per-request consumption view under the FINAL
+    all-or-nothing mask — a request denied at a later scope must not
+    appear consumed in the quantities (seen/remaining, CU targets) the
+    earlier scopes report or write. Same sort/cumsum machinery and f32
+    exactness guard as :func:`admit`."""
+    B = sid.shape[0]
+    iota = jax.lax.iota(jnp.int32, B)
+    s, nn, orig = jax.lax.sort((sid, n_units, iota), num_keys=1,
+                               is_stable=True)
+    seg_head = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), s[1:] != s[:-1]])
+    if jnp.issubdtype(nn.dtype, jnp.floating):
+        total = jnp.sum(nn.astype(jnp.int64))
+        cons = jax.lax.cond(
+            total < _F32_EXACT,
+            lambda: _segment_exclusive_cumsum(nn, seg_head),
+            lambda: _segment_exclusive_cumsum_exact_f32(nn, seg_head),
+        )
+    else:
+        cons = _segment_exclusive_cumsum(nn, seg_head)
+    _, cons_o = jax.lax.sort((orig, cons), num_keys=1, is_stable=True)
+    return cons_o

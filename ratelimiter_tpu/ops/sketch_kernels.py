@@ -105,6 +105,17 @@ def init_state(cfg: Config) -> State:
         "slab_period": jnp.full((S,), _NEVER, jnp.int64),
         "last_period": jnp.asarray(_NEVER, jnp.int64),
     }
+    T = cfg.hierarchy.tenants
+    if T:
+        # Hierarchical cascade (ADR-020): per-tenant + global in-window
+        # counters riding the SAME sub-window ring clock as the CMS —
+        # one extra (S, T+1) slab, flushed/recomputed by the same
+        # rollover sweep. Index T is the global scope.
+        state.update({
+            "tn_cur": jnp.zeros((T + 1,), jnp.int32),
+            "tn_slabs": jnp.zeros((S, T + 1), jnp.int32),
+            "tn_totals": jnp.zeros((T + 1,), jnp.int32),
+        })
     K = cfg.sketch.hh_slots
     if K:
         # Heavy-hitter side table: direct-mapped (slot = h1 mod K) private
@@ -153,6 +164,16 @@ def _rollover(state: State, p, *, SW: int, S: int) -> State:
     out = {"cur": jnp.zeros_like(state["cur"]), "slabs": slabs,
            "totals": totals, "slab_period": periods,
            "last_period": jnp.asarray(p, jnp.int64)}
+    if "tn_cur" in state:
+        # Tenant/global counters share the ring clock (ADR-020): same
+        # flush + masked-sum recompute as the CMS and hh slabs.
+        tn_slabs = state["tn_slabs"].at[slot].set(state["tn_cur"])
+        out.update({
+            "tn_cur": jnp.zeros_like(state["tn_cur"]),
+            "tn_slabs": tn_slabs,
+            "tn_totals": jnp.tensordot(in_window.astype(jnp.int32),
+                                       tn_slabs, axes=1),
+        })
     if "hh_owner" in state:
         # The side table rides the same period clock: flush, recompute,
         # and reclaim slots idle a full window (their in-window counts are
@@ -274,10 +295,10 @@ def _hh_boundary_slab(state: State, p, *, SW: int, S: int):
                                         keepdims=False)
 
 
-def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
+def _sketch_step(state: State, h1, h2, n, now_us, policy=None, hier=None, *,
                  limit: int, sub_us: int, SW: int, S: int, d: int, w: int,
                  iters: int, weighted: bool, conservative: bool,
-                 hh: int = 0, hh_thresh: float = 0.0,
+                 hh: int = 0, hh_thresh: float = 0.0, tenants: int = 0,
                  axis_name: str | None = None, pre=None, pre_hh=None,
                  use_pallas: bool = False):
     # Precondition (host-enforced via _sync_period): state.last_period is
@@ -351,6 +372,46 @@ def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
     n_f = n.astype(jnp.float32)
     sid = jax.lax.bitcast_convert_type(h1, jnp.int32)
     allowed, seen, _ = admit(sid, n_f, avail, iters)
+
+    tn_hist = None
+    if tenants and hier is not None:
+        # Hierarchical cascade (ADR-020): key-scope survivors run the
+        # tenant + global stages against the tn counter slab, still in
+        # THIS dispatch. The tenant boundary sub-window rides the same
+        # frac scalar as the CMS boundary (frac is 0 when the boundary
+        # period is absent); its fractional part ceils — conservative,
+        # toward denying — so tenant/global admission stays exact int64.
+        from ratelimiter_tpu.ops import hier_kernels
+        from ratelimiter_tpu.ops.segment import segment_consumption
+
+        tid = hier_kernels.derive_tids(hier, h1, h2, tenants)
+        est_tn = state["tn_totals"].astype(jnp.int64)
+        if weighted:
+            tn_b = jax.lax.dynamic_index_in_dim(
+                state["tn_slabs"], (p % S).astype(jnp.int32),
+                keepdims=False)
+            est_tn = est_tn + jnp.ceil(
+                frac * jnp.maximum(tn_b, 0).astype(jnp.float32)
+            ).astype(jnp.int64)
+        avail_sc = hier_kernels.scope_avail(hier["limit"],
+                                            jnp.maximum(est_tn, 0))
+        allowed_casc, tn_hist = hier_kernels.cascade_admit(
+            allowed, tid, n, avail_sc, hier["weight"], tenants, iters)
+        # All-or-nothing: recompute the key scope's consumption view
+        # under the FINAL mask so writes (CU targets / adds), hh
+        # promotion targets, and the reported remaining all reflect
+        # only what was actually admitted. Cond'd on the cascade having
+        # flipped any verdict: under no tenant/global contention (the
+        # common case) the masks are equal and the stage-1 view already
+        # IS the final view — the extra sort pass is skipped.
+        seen = jax.lax.cond(
+            jnp.any(allowed_casc != allowed),
+            lambda: avail - segment_consumption(
+                sid, jnp.where(allowed_casc, n_f, jnp.float32(0.0))),
+            lambda: seen)
+        allowed = allowed_casc
+        if axis_name is not None:
+            tn_hist = jax.lax.psum(tn_hist, axis_name)
     not_mine = True if mine is None else ~mine
 
     if conservative and axis_name is None:
@@ -409,6 +470,19 @@ def _sketch_step(state: State, h1, h2, n, now_us, policy=None, *,
     new_state = {"cur": cur, "slabs": state["slabs"], "totals": totals,
                  "slab_period": state["slab_period"],
                  "last_period": state["last_period"]}
+
+    if "tn_cur" in state:
+        if tn_hist is not None:
+            th = tn_hist.astype(jnp.int32)
+            new_state.update({"tn_cur": state["tn_cur"] + th,
+                              "tn_slabs": state["tn_slabs"],
+                              "tn_totals": state["tn_totals"] + th})
+        else:
+            # Hierarchy-shaped state on a path that did not receive the
+            # table operand (reset-adjacent internal calls, the scan
+            # bench path): counters carry through untouched.
+            new_state.update({k: state[k] for k in
+                              ("tn_cur", "tn_slabs", "tn_totals")})
 
     if hh:
         # Owned-key consumption goes to the private cells (exact counts).
@@ -498,6 +572,13 @@ def _sketch_reset(state: State, h1, h2, now_us, *,
            "totals": state["totals"] - hists,
            "slab_period": state["slab_period"],
            "last_period": state["last_period"]}
+    if "tn_cur" in state:
+        # Reset forgives a KEY's usage only: tenant/global counters track
+        # actually-admitted aggregate traffic and deliberately stand
+        # (ADR-020 — subtracting one key's estimate from its tenant would
+        # let a reset-hammering key drain its whole tenant's accounting).
+        out.update({k: state[k] for k in
+                    ("tn_cur", "tn_slabs", "tn_totals")})
     if hh:
         out.update({
             "hh_owner": state["hh_owner"],
@@ -621,16 +702,17 @@ def build_steps(cfg: Config) -> tuple[Callable, Callable, Callable]:
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
     hh, hh_thresh = _hh_params(cfg)
+    tenants = cfg.hierarchy.tenants
     use_pallas = _resolve_pallas(cfg)
     key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
-           hh, hh_thresh, use_pallas)
+           hh, hh_thresh, tenants, use_pallas)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
     step = jax.jit(
         partial(_sketch_step, limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                 iters=cfg.max_batch_admission_iters, weighted=weighted,
-                conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                conservative=cu, hh=hh, hh_thresh=hh_thresh, tenants=tenants,
                 use_pallas=use_pallas),
         donate_argnums=(0,))
     reset = jax.jit(
@@ -662,7 +744,7 @@ def _resolve_pallas(cfg: Config, *, bucket: bool = False) -> bool:
 _HASHED_CACHE: Dict[tuple, Callable] = {}
 
 
-def _sketch_step_h64(state: State, h64, n, now_us, policy=None, *,
+def _sketch_step_h64(state: State, h64, n, now_us, policy=None, hier=None, *,
                      seed: int, premix: bool, **step_kw):
     from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
 
@@ -670,7 +752,7 @@ def _sketch_step_h64(state: State, h64, n, now_us, policy=None, *,
     if premix:
         h = splitmix64_dev(h)
     h1, h2 = split_hash_dev(h, seed)
-    return _sketch_step(state, h1, h2, n, now_us, policy, **step_kw)
+    return _sketch_step(state, h1, h2, n, now_us, policy, hier, **step_kw)
 
 
 def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
@@ -688,10 +770,11 @@ def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
     hh, hh_thresh = _hh_params(cfg)
+    tenants = cfg.hierarchy.tenants
     use_pallas = _resolve_pallas(cfg)
     seed = cfg.sketch.seed
     key = (limit, W, SW, d, w, cfg.max_batch_admission_iters, weighted, cu,
-           hh, hh_thresh, use_pallas, seed, premix)
+           hh, hh_thresh, tenants, use_pallas, seed, premix)
     cached = _HASHED_CACHE.get(key)
     if cached is not None:
         return cached
@@ -699,7 +782,7 @@ def build_hashed_step(cfg: Config, *, premix: bool = False) -> Callable:
         partial(_sketch_step_h64, seed=seed, premix=premix,
                 limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                 iters=cfg.max_batch_admission_iters, weighted=weighted,
-                conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                conservative=cu, hh=hh, hh_thresh=hh_thresh, tenants=tenants,
                 use_pallas=use_pallas),
         donate_argnums=(0,))
     _HASHED_CACHE[key] = step
@@ -763,6 +846,17 @@ def _migrate_window(state: State, now_us, *, sub_o: int, SWo: int, So: int,
     out = {"cur": new_cur, "slabs": new_slabs, "totals": totals_n,
            "slab_period": periods_n,
            "last_period": jnp.asarray(p_now, jnp.int64)}
+    if "tn_cur" in state:
+        # Tenant/global counters re-bucket with the same conservative
+        # last-overlapped-period rule as the CMS ring (rebucket() is
+        # shape-generic over the trailing axes).
+        tn_slabs, tn_cur = rebucket(state["tn_slabs"], state["tn_cur"])
+        out.update({
+            "tn_cur": tn_cur,
+            "tn_slabs": tn_slabs,
+            "tn_totals": (jnp.tensordot(in_window, tn_slabs, axes=1)
+                          .astype(tn_cur.dtype) + tn_cur),
+        })
     if hh:
         hh_slabs, hh_cur = rebucket(state["hh_slabs"], state["hh_cur"])
         hh_totals = (jnp.tensordot(in_window, hh_slabs, axes=1)

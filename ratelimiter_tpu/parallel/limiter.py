@@ -241,7 +241,11 @@ def build_slices(config: Config, clock: Optional[Clock] = None, *,
         devices = list(devices)[:n]
     cls = (SketchTokenBucketLimiter
            if config.algorithm is Algorithm.TOKEN_BUCKET else SketchLimiter)
-    return [cls(config, clock, device=d) for d in devices]
+    # Hierarchy scopes on a hash-partitioned mesh: each slice enforces an
+    # equal share of every tenant/global limit (effective // n_slices —
+    # the static-split rule; ADR-020), since slices share no counters.
+    return [cls(config, clock, device=d, hier_divisor=len(list(devices)))
+            for d in devices]
 
 
 class MeshDispatchTicket(DispatchTicket):
@@ -669,6 +673,58 @@ class SlicedMeshLimiter(RateLimiter):
 
     def override_count(self) -> int:
         return self.slices[0].override_count()
+
+    # Hierarchy surface: HierarchyFanout's write-all / read-one /
+    # sum-stats semantics over the slices (each enforces its equal
+    # share of the scope limits — ADR-020). Built per call: restore()
+    # may rebuild self.slices.
+
+    def _hier(self):
+        from ratelimiter_tpu.hierarchy.fanout import HierarchyFanout
+
+        self._check_open()
+        return HierarchyFanout(self.slices)
+
+    def set_tenant(self, name, limit=None, *, weight=1, floor=None):
+        return self._hier().set_tenant(name, limit, weight=weight,
+                                       floor=floor)
+
+    def delete_tenant(self, name: str) -> bool:
+        return self._hier().delete_tenant(name)
+
+    def assign_tenant(self, key: str, tenant: str) -> None:
+        self._hier().assign_tenant(key, tenant)
+
+    def unassign_tenant(self, key: str) -> bool:
+        return self._hier().unassign_tenant(key)
+
+    def tenant_of(self, key: str) -> str:
+        return self._hier().tenant_of(key)
+
+    def list_tenants(self):
+        return self._hier().list_tenants()
+
+    def set_global_limit(self, limit) -> None:
+        self._hier().set_global_limit(limit)
+
+    def set_effective(self, scope: str, limit: int) -> int:
+        return self._hier().set_effective(scope, limit)
+
+    def effective_limits(self):
+        return self._hier().effective_limits()
+
+    def hierarchy_payload(self) -> dict:
+        return self._hier().hierarchy_payload()
+
+    def apply_hierarchy_payload(self, payload: dict) -> bool:
+        return self._hier().apply_hierarchy_payload(payload)
+
+    def hierarchy_stats(self) -> dict:
+        """Per-scope stats summed across slices (each slice's counters
+        cover its hash-owned keys; the sum is the whole deployment's
+        in-window mass). Effective/ceiling values come from slice 0's
+        table — mutations are write-all, so the tables agree."""
+        return self._hier().hierarchy_stats()
 
     # ------------------------------------------------- checkpoint seam
 

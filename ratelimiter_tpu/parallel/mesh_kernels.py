@@ -48,25 +48,33 @@ else:
 
 MERGE_MODES = ("gather", "delta")
 
+#: Replicated cascade-table spec (key→tenant map + limit/weight columns,
+#: ADR-020) — appended to in_specs only when the hierarchy is enabled so
+#: disabled configs keep their exact pre-hierarchy call shape.
+_HIER_SPEC = {"key": P(), "tid": P(), "limit": P(), "weight": P()}
 
-def _gather_step(state, h1, h2, n, now_us, policy, *, step_kw):
+
+def _gather_step(state, h1, h2, n, now_us, policy, hier=None, *, step_kw):
     """Gather-mode per-chip body: all_gather shards, decide globally,
-    slice local verdicts. The policy table is replicated like the state."""
+    slice local verdicts. The policy (and cascade) tables are replicated
+    like the state."""
     Bl = h1.shape[0]
     h1g = jax.lax.all_gather(h1, AXIS).reshape(-1)
     h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
     ng = jax.lax.all_gather(n, AXIS).reshape(-1)
     state, (allowed, remaining, est) = sketch_kernels._sketch_step(
-        state, h1g, h2g, ng, now_us, policy, **step_kw)
+        state, h1g, h2g, ng, now_us, policy, hier, **step_kw)
     i = jax.lax.axis_index(AXIS)
     sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
     return state, (sl(allowed), sl(remaining), sl(est))
 
 
-def _delta_step(state, h1, h2, n, now_us, policy, *, step_kw):
-    """Delta-mode per-chip body: local decide, collective-merged write."""
+def _delta_step(state, h1, h2, n, now_us, policy, hier=None, *, step_kw):
+    """Delta-mode per-chip body: local decide, collective-merged write
+    (the cascade's tenant histogram psums alongside the CMS write —
+    same bounded-staleness contract)."""
     return sketch_kernels._sketch_step(
-        state, h1, h2, n, now_us, policy, axis_name=AXIS, **step_kw)
+        state, h1, h2, n, now_us, policy, hier, axis_name=AXIS, **step_kw)
 
 
 _MESH_CACHE: Dict[tuple, Tuple[Callable, Callable, Callable]] = {}
@@ -95,19 +103,26 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     # Key on the mesh's *identity-bearing contents* (device objects + axis
     # names), not id(mesh): a GC'd mesh's id can be reused by a new mesh,
     # which would receive a stale compiled step bound to dead devices.
+    tenants = cfg.hierarchy.tenants
     mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
     key = (mesh_key, merge, limit, W, SW, d, w,
-           cfg.max_batch_admission_iters, weighted, cu, hh, hh_thresh)
+           cfg.max_batch_admission_iters, weighted, cu, hh, hh_thresh,
+           tenants)
     cached = _MESH_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                    iters=cfg.max_batch_admission_iters, weighted=weighted,
-                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                   tenants=tenants)
     body = _gather_step if merge == "gather" else _delta_step
 
     state_keys = ["cur", "slabs", "totals", "slab_period", "last_period"]
+    if tenants:
+        # Cascade counter slab: replicated like the sketch (gather mode
+        # recomputes it deterministically; delta mode psums tn_hist).
+        state_keys += ["tn_cur", "tn_slabs", "tn_totals"]
     if hh:
         # Side-table state is replicated like the sketch: gather mode
         # updates it with a replicated computation; delta mode psums the
@@ -116,6 +131,9 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
                        "hh_totals", "hh_last"]
     state_spec = {k: P() for k in state_keys}
     policy_spec = {"key": P(), "limit": P()}  # replicated override table
+    in_specs = [state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec]
+    if tenants:
+        in_specs.append(_HIER_SPEC)
     # check_vma=False: the state outputs ARE replicated — they are a
     # deterministic function of replicated state and all_gathered/psum'd
     # batch data — but the static checker cannot prove that through
@@ -124,7 +142,7 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     mapped = shard_map(
         partial(body, step_kw=step_kw),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec),
+        in_specs=tuple(in_specs),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )
@@ -144,13 +162,21 @@ def build_mesh_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
 _MESH_HASHED_CACHE: Dict[tuple, Callable] = {}
 
 
-def _hashed_body(body, seed: int, premix: bool, step_kw):
+def _hashed_body(body, seed: int, premix: bool, step_kw,
+                 hier_arity: bool = False):
     from ratelimiter_tpu.ops.hashing import split_hash_dev, splitmix64_dev
 
-    def f(state, h64, n, now_us, policy):
-        h = splitmix64_dev(h64) if premix else h64
-        h1, h2 = split_hash_dev(h, seed)
-        return body(state, h1, h2, n, now_us, policy, step_kw=step_kw)
+    if hier_arity:
+        def f(state, h64, n, now_us, policy, hier):
+            h = splitmix64_dev(h64) if premix else h64
+            h1, h2 = split_hash_dev(h, seed)
+            return body(state, h1, h2, n, now_us, policy, hier,
+                        step_kw=step_kw)
+    else:
+        def f(state, h64, n, now_us, policy):
+            h = splitmix64_dev(h64) if premix else h64
+            h1, h2 = split_hash_dev(h, seed)
+            return body(state, h1, h2, n, now_us, policy, step_kw=step_kw)
 
     return f
 
@@ -168,30 +194,37 @@ def build_mesh_hashed_step(cfg: Config, mesh: Mesh, merge: str = "gather",
     weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
     cu = cfg.sketch.conservative_update
     hh, hh_thresh = sketch_kernels._hh_params(cfg)
+    tenants = cfg.hierarchy.tenants
     seed = cfg.sketch.seed
     mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
     key = ("sketch", mesh_key, merge, limit, W, SW, d, w,
            cfg.max_batch_admission_iters, weighted, cu, hh, hh_thresh,
-           seed, premix)
+           tenants, seed, premix)
     cached = _MESH_HASHED_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
                    iters=cfg.max_batch_admission_iters, weighted=weighted,
-                   conservative=cu, hh=hh, hh_thresh=hh_thresh)
+                   conservative=cu, hh=hh, hh_thresh=hh_thresh,
+                   tenants=tenants)
     body = _gather_step if merge == "gather" else _delta_step
 
     state_keys = ["cur", "slabs", "totals", "slab_period", "last_period"]
+    if tenants:
+        state_keys += ["tn_cur", "tn_slabs", "tn_totals"]
     if hh:
         state_keys += ["hh_owner", "hh_owner2", "hh_cur", "hh_slabs",
                        "hh_totals", "hh_last"]
     state_spec = {k: P() for k in state_keys}
     policy_spec = {"key": P(), "limit": P()}
+    in_specs = [state_spec, P(AXIS), P(AXIS), P(), policy_spec]
+    if tenants:
+        in_specs.append(_HIER_SPEC)
     mapped = shard_map(
-        _hashed_body(body, seed, premix, step_kw),
+        _hashed_body(body, seed, premix, step_kw, hier_arity=bool(tenants)),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(), policy_spec),
+        in_specs=tuple(in_specs),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )
@@ -209,23 +242,30 @@ def build_mesh_hashed_bucket_step(cfg: Config, mesh: Mesh,
     if merge not in MERGE_MODES:
         raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
     limit, num, den, d, w, iters = bucket_kernels._params(cfg)
+    tenants, wus = bucket_kernels._hier_params(cfg)
     seed = cfg.sketch.seed
     mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
     key = ("bucket", mesh_key, merge, limit, num, den, d, w, iters,
-           seed, premix)
+           tenants, wus, seed, premix)
     cached = _MESH_HASHED_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
-                   iters=iters)
+                   iters=iters, tenants=tenants, window_us=wus)
     body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
-    state_spec = {k: P() for k in ("debt", "acc", "rem", "last")}
+    state_keys = ["debt", "acc", "rem", "last"]
+    if tenants:
+        state_keys += ["tn_counts", "tn_period"]
+    state_spec = {k: P() for k in state_keys}
     policy_spec = {"key": P(), "limit": P()}
+    in_specs = [state_spec, P(AXIS), P(AXIS), P(), policy_spec]
+    if tenants:
+        in_specs.append(_HIER_SPEC)
     mapped = shard_map(
-        _hashed_body(body, seed, premix, step_kw),
+        _hashed_body(body, seed, premix, step_kw, hier_arity=bool(tenants)),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(), policy_spec),
+        in_specs=tuple(in_specs),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )
@@ -236,7 +276,8 @@ def build_mesh_hashed_bucket_step(cfg: Config, mesh: Mesh,
 
 # ------------------------------------------------------------ token bucket
 
-def _bucket_gather_step(state, h1, h2, n, now_us, policy, *, step_kw):
+def _bucket_gather_step(state, h1, h2, n, now_us, policy, hier=None, *,
+                        step_kw):
     """Gather-mode bucket body: all_gather shards, decide globally on the
     replicated debt slab, slice local verdicts (same shape as _gather_step;
     the decided tuple is (allowed, remaining, retry_us))."""
@@ -247,20 +288,21 @@ def _bucket_gather_step(state, h1, h2, n, now_us, policy, *, step_kw):
     h2g = jax.lax.all_gather(h2, AXIS).reshape(-1)
     ng = jax.lax.all_gather(n, AXIS).reshape(-1)
     state, (allowed, remaining, retry_us) = bucket_kernels._bucket_step(
-        state, h1g, h2g, ng, now_us, policy, **step_kw)
+        state, h1g, h2g, ng, now_us, policy, hier, **step_kw)
     i = jax.lax.axis_index(AXIS)
     sl = lambda x: jax.lax.dynamic_slice_in_dim(x, i * Bl, Bl)
     return state, (sl(allowed), sl(remaining), sl(retry_us))
 
 
-def _bucket_delta_step(state, h1, h2, n, now_us, policy, *, step_kw):
+def _bucket_delta_step(state, h1, h2, n, now_us, policy, hier=None, *,
+                       step_kw):
     """Delta-mode bucket body: local admission, psum'd debt increments.
     The scalar decay is a deterministic function of replicated (rem, last),
     so replication is preserved without a collective for it."""
     from ratelimiter_tpu.ops import bucket_kernels
 
     return bucket_kernels._bucket_step(
-        state, h1, h2, n, now_us, policy, axis_name=AXIS, **step_kw)
+        state, h1, h2, n, now_us, policy, hier, axis_name=AXIS, **step_kw)
 
 
 _MESH_BUCKET_CACHE: Dict[tuple, Tuple[Callable, Callable]] = {}
@@ -275,21 +317,28 @@ def build_mesh_bucket_steps(cfg: Config, mesh: Mesh, merge: str = "gather",
     if merge not in MERGE_MODES:
         raise ValueError(f"merge must be one of {MERGE_MODES}, got {merge!r}")
     limit, num, den, d, w, iters = bucket_kernels._params(cfg)
+    tenants, wus = bucket_kernels._hier_params(cfg)
     mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
-    key = (mesh_key, merge, limit, num, den, d, w, iters)
+    key = (mesh_key, merge, limit, num, den, d, w, iters, tenants, wus)
     cached = _MESH_BUCKET_CACHE.get(key)
     if cached is not None:
         return cached
 
     step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
-                   iters=iters)
+                   iters=iters, tenants=tenants, window_us=wus)
     body = _bucket_gather_step if merge == "gather" else _bucket_delta_step
-    state_spec = {k: P() for k in ("debt", "acc", "rem", "last")}
+    state_keys = ["debt", "acc", "rem", "last"]
+    if tenants:
+        state_keys += ["tn_counts", "tn_period"]
+    state_spec = {k: P() for k in state_keys}
     policy_spec = {"key": P(), "limit": P()}
+    in_specs = [state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec]
+    if tenants:
+        in_specs.append(_HIER_SPEC)
     mapped = shard_map(
         partial(body, step_kw=step_kw),
         mesh=mesh,
-        in_specs=(state_spec, P(AXIS), P(AXIS), P(AXIS), P(), policy_spec),
+        in_specs=tuple(in_specs),
         out_specs=(state_spec, (P(AXIS), P(AXIS), P(AXIS))),
         check_vma=False,
     )

@@ -103,6 +103,54 @@ def build_parser() -> argparse.ArgumentParser:
                     help="sketch hot-loop kernels (ADR-011): fused Pallas "
                          "TPU kernels, the jnp/XLA reference path, or "
                          "auto (pallas on TPU, jnp elsewhere)")
+    # Hierarchical cascades + adaptive control (ADR-020).
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="enable hierarchical cascades (ADR-020): tenant "
+                         "capacity (power of two >= 2; 0 = off). Every "
+                         "decision then evaluates key -> tenant -> "
+                         "global scopes in the same device dispatch; "
+                         "tenant ids derive on device from the "
+                         "key->tenant map (protocol unchanged)")
+    ap.add_argument("--tenant-map", type=int, default=1024,
+                    help="key->tenant assignment map capacity (power of "
+                         "two)")
+    ap.add_argument("--global-limit", type=int, default=0,
+                    help="global-scope limit, requests per window across "
+                         "ALL keys (0 = unlimited)")
+    ap.add_argument("--default-tenant-limit", type=int, default=0,
+                    help="per-window limit of the default tenant (every "
+                         "unassigned key; 0 = unlimited)")
+    ap.add_argument("--tenant", action="append", default=[],
+                    metavar="NAME=LIMIT[:WEIGHT[:FLOOR]]",
+                    help="register a tenant at boot (repeatable); "
+                         "LIMIT 0 = unlimited")
+    ap.add_argument("--assign", action="append", default=[],
+                    metavar="KEY=TENANT",
+                    help="assign a key to a tenant at boot (repeatable)")
+    ap.add_argument("--controller", action="store_true",
+                    help="run the AIMD adaptive controller (ADR-020): a "
+                         "background loop that tightens/relaxes EFFECTIVE "
+                         "scope limits off the live observatory signals "
+                         "(SLO burn rate, audited false-deny Wilson "
+                         "bound, per-tenant in-window mass) between each "
+                         "scope's floor and its configured ceiling; "
+                         "needs --tenants > 0 (wire --audit for the "
+                         "false-deny tighten veto)")
+    ap.add_argument("--controller-interval", type=float, default=1.0,
+                    help="seconds between AIMD controller ticks")
+    ap.add_argument("--http-tenants", action="store_true",
+                    help="expose tenant management (GET/POST/PUT/DELETE "
+                         "/v1/tenants) on the HTTP gateway (OFF by "
+                         "default: a quota lever in both directions on "
+                         "a curl-able surface)")
+    ap.add_argument("--http-tenants-token", default=None,
+                    help="bearer token required by /v1/tenants (implies "
+                         "--http-tenants); Authorization header only")
+    ap.add_argument("--http-migrate-token", default=None,
+                    help="enable POST /v1/fleet/migrate (live range "
+                         "migration, ADR-018) on the HTTP gateway, gated "
+                         "by this bearer token. No token, no endpoint — "
+                         "an ownership-move lever is never open")
     ap.add_argument("--max-batch", type=int, default=4096,
                     help="micro-batcher flush size")
     ap.add_argument("--max-delay-us", type=float, default=200.0,
@@ -477,6 +525,95 @@ def _slo_health(slo) -> dict:
     return {"slo": slo.status()} if slo is not None else {}
 
 
+def _hierarchy_health(hier, controller) -> dict:
+    """Cascade block for /healthz (ADR-020): per-scope in-window mass +
+    effective/ceiling limits (summed across dispatch units by the
+    fanout), plus the AIMD controller's move counters when it runs."""
+    if hier is None:
+        return {}
+    st = hier.hierarchy_stats()
+    if controller is not None:
+        st["controller"] = {"ticks": controller.ticks,
+                            "tightened": controller.tightened,
+                            "relaxed": controller.relaxed,
+                            "interval": controller.interval}
+    return {"hierarchy": st}
+
+
+def _boot_tenants(hier, args) -> None:
+    """Apply --tenant NAME=LIMIT[:WEIGHT[:FLOOR]] and --assign
+    KEY=TENANT boot flags (after recovery, so operator flags win over a
+    snapshot's registry for the names they touch)."""
+    for spec in args.tenant:
+        name, _, rest = spec.partition("=")
+        if not name or not rest:
+            raise SystemExit(f"bad --tenant {spec!r}; expected "
+                             f"NAME=LIMIT[:WEIGHT[:FLOOR]]")
+        parts = rest.split(":")
+        try:
+            limit = int(parts[0]) or None
+            weight = int(parts[1]) if len(parts) > 1 and parts[1] else 1
+            floor = (int(parts[2])
+                     if len(parts) > 2 and parts[2] else None)
+        except ValueError:
+            raise SystemExit(f"bad --tenant {spec!r}; expected "
+                             f"NAME=LIMIT[:WEIGHT[:FLOOR]]") from None
+        hier.set_tenant(name, limit, weight=weight, floor=floor)
+    for spec in args.assign:
+        key, _, tenant = spec.partition("=")
+        if not key or not tenant:
+            raise SystemExit(f"bad --assign {spec!r}; expected "
+                             f"KEY=TENANT")
+        hier.assign_tenant(key, tenant)
+
+
+def _setup_hierarchy(args, cfg, units, *, slo_tracker, auditor,
+                     fleet_membership):
+    """Mount the cascade's management surface over the door's dispatch
+    units and (optionally) start the AIMD controller over it. Returns
+    ``(hier, controller)`` — (None, None) when the hierarchy is off."""
+    if not cfg.hierarchy.enabled:
+        return None, None
+    from ratelimiter_tpu.hierarchy import AIMDController, HierarchyFanout
+
+    hier = HierarchyFanout(list(units))
+    _boot_tenants(hier, args)
+    if fleet_membership is not None:
+        # Effective limits gossip on every announce; members adopt the
+        # newest revision (last-writer-wins) so the fleet converges on
+        # whichever member's controller moved last.
+        fleet_membership.hier_payload_fn = hier.hierarchy_payload
+        fleet_membership.hier_apply_fn = hier.apply_hierarchy_payload
+    controller = None
+    if args.controller:
+        controller = AIMDController(
+            hier,
+            slo_status=(slo_tracker.status if slo_tracker is not None
+                        else None),
+            audit_status=(auditor.status if auditor is not None
+                          else None),
+            interval=args.controller_interval,
+            publish=((lambda _payload: fleet_membership.announce_once())
+                     if fleet_membership is not None else None),
+            registry=obs_metrics.DEFAULT)
+    return hier, controller
+
+
+def _make_fleet_migrate(args, fleet_core, fleet_membership):
+    """POST /v1/fleet/migrate hook (ADR-018 operator surface): bound to
+    migrate_ranges, reporting the post-move epoch. None unless this is a
+    fleet member AND an operator token is set."""
+    if fleet_membership is None or not args.http_migrate_token:
+        return None
+
+    def migrate(ranges, to, wait):
+        ok = fleet_membership.migrate_ranges(ranges, to, wait=wait)
+        return {"ok": bool(ok), "epoch": int(fleet_core.map.epoch),
+                "to": to, "ranges": [list(r) for r in ranges]}
+
+    return migrate
+
+
 def make_threadsafe_decide(batcher, loop):
     """Single-decision bridge from gateway/gRPC worker threads into the
     event loop's micro-batcher: every surface shares device dispatches.
@@ -581,7 +718,7 @@ def _configure_jax(args) -> None:
 async def amain(args) -> None:
     logging.basicConfig(level=args.log_level.upper())
     _configure_jax(args)
-    from ratelimiter_tpu import MeshSpec, PersistenceSpec
+    from ratelimiter_tpu import HierarchySpec, MeshSpec, PersistenceSpec
     from ratelimiter_tpu.observability import tracing
 
     if args.flight_recorder:
@@ -611,7 +748,19 @@ async def amain(args) -> None:
                       slice_deadline=args.slice_deadline_ms * 1e-3,
                       probe_interval=args.probe_interval,
                       failure_threshold=args.quarantine_threshold),
+        hierarchy=HierarchySpec(tenants=args.tenants,
+                                map_capacity=args.tenant_map,
+                                global_limit=args.global_limit,
+                                default_tenant_limit=args.
+                                default_tenant_limit),
     )
+    if cfg.hierarchy.enabled and args.backend not in ("sketch", "mesh"):
+        raise SystemExit("--tenants needs a sketch-family backend "
+                         "(--backend sketch or --backend mesh)")
+    if args.controller and not cfg.hierarchy.enabled:
+        raise SystemExit("--controller needs --tenants > 0")
+    if (args.tenant or args.assign) and not cfg.hierarchy.enabled:
+        raise SystemExit("--tenant/--assign need --tenants > 0")
     if args.mesh_devices is not None and args.backend != "mesh":
         raise SystemExit("--mesh-devices needs --backend mesh")
     if args.quarantine and args.backend != "mesh":
@@ -702,7 +851,16 @@ async def amain(args) -> None:
                       for i, s in enumerate(slices)]
         limiter = decorate(slices[0])
     else:
-        limiter = decorate(create_limiter(cfg, backend=args.backend))
+        lim_kw = {}
+        if (cfg.hierarchy.enabled and args.native and args.shards > 1
+                and args.backend == "sketch"):
+            # Multi-shard native door (ADR-020): each dispatch shard
+            # enforces its equal share of every tenant/global limit
+            # (keys hash-route, shards share no counters); the clone
+            # shards inherit the divisor in native_server.
+            lim_kw["hier_divisor"] = args.shards
+        limiter = decorate(create_limiter(cfg, backend=args.backend,
+                                          **lim_kw))
         if args.backend == "mesh":
             from ratelimiter_tpu.observability.decorators import undecorated
 
@@ -975,6 +1133,15 @@ async def amain(args) -> None:
                     interval=args.dcn_interval, secret=dcn_secret))
             for pu in pushers:
                 pu.start()
+        # Hierarchical cascades (ADR-020): management surface over every
+        # dispatch shard + the optional AIMD controller. After recovery
+        # (hier_* checkpoint columns restore first), before the gateway
+        # (whose /healthz and /v1/tenants mount it).
+        hier, controller = _setup_hierarchy(
+            args, cfg, server.shard_limiters, slo_tracker=slo_tracker,
+            auditor=auditor, fleet_membership=fleet_membership)
+        fleet_migrate = _make_fleet_migrate(args, fleet_core,
+                                            fleet_membership)
         gateway = None
         if args.http_port is not None:
             from ratelimiter_tpu.serving.http_gateway import HttpGateway
@@ -996,6 +1163,7 @@ async def amain(args) -> None:
                                 **_consumers_health(server.shard_limiters),
                                 **_audit_health(),
                                 **_slo_health(slo_tracker),
+                                **_hierarchy_health(hier, controller),
                                 **_fleet_health(),
                                 **({"quarantine": qmgr.status()}
                                    if qmgr is not None else {}),
@@ -1014,7 +1182,13 @@ async def amain(args) -> None:
                 debug_token=args.debug_token,
                 audit_status=(make_audit_status(server.shard_limiters)
                               if args.audit else None),
-                audit_token=args.audit_token)
+                audit_token=args.audit_token,
+                tenants=hier,
+                enable_tenants=bool(args.http_tenants
+                                    or args.http_tenants_token),
+                tenants_token=args.http_tenants_token,
+                fleet_migrate=fleet_migrate,
+                migrate_token=args.http_migrate_token)
             gateway.start()
         grpc_srv = None
         if args.grpc_port is not None:
@@ -1041,9 +1215,15 @@ async def amain(args) -> None:
               + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
         if fleet_membership is not None:
             fleet_membership.start()
+        if controller is not None:
+            controller.start()
         if start_chaos is not None:
             start_chaos()
         await stop.wait()
+        if controller is not None:
+            # Before the doors drain: a controller tick against a
+            # closing limiter would race teardown.
+            controller.stop()
         if fleet_membership is not None:
             # Departure announce BEFORE the doors close (ADR-018): hand
             # our ranges to the successor (final-ish snapshot + restore
@@ -1142,6 +1322,15 @@ async def amain(args) -> None:
     # the binary protocol: all surfaces share device dispatches.
     threadsafe_decide = make_threadsafe_decide(server.batcher, loop)
 
+    # Hierarchical cascades (ADR-020) on the asyncio door: ONE dispatch
+    # unit (a SlicedMeshLimiter already spans its slices write-all, and
+    # the FleetForwarder decorator delegates inward). After recovery, so
+    # boot flags win over a snapshot's registry for the names they touch.
+    hier, controller = _setup_hierarchy(
+        args, cfg, [limiter], slo_tracker=slo_tracker, auditor=auditor,
+        fleet_membership=fleet_membership)
+    fleet_migrate = _make_fleet_migrate(args, fleet_core, fleet_membership)
+
     if args.http_port is not None:
         from ratelimiter_tpu.serving.http_gateway import HttpGateway
 
@@ -1157,6 +1346,7 @@ async def amain(args) -> None:
                             **_consumers_health([limiter]),
                             **_audit_health(),
                             **_slo_health(slo_tracker),
+                            **_hierarchy_health(hier, controller),
                             **_fleet_health(),
                             **({"quarantine": qmgr.status()}
                                if qmgr is not None else {}),
@@ -1174,7 +1364,13 @@ async def amain(args) -> None:
             debug_token=args.debug_token,
             audit_status=(make_audit_status([limiter])
                           if args.audit else None),
-            audit_token=args.audit_token)
+            audit_token=args.audit_token,
+            tenants=hier,
+            enable_tenants=bool(args.http_tenants
+                                or args.http_tenants_token),
+            tenants_token=args.http_tenants_token,
+            fleet_migrate=fleet_migrate,
+            migrate_token=args.http_migrate_token)
         gateway.start()
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
@@ -1199,9 +1395,15 @@ async def amain(args) -> None:
           + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
     if fleet_membership is not None:
         fleet_membership.start()
+    if controller is not None:
+        controller.start()
     if start_chaos is not None:
         start_chaos()
     await stop.wait()
+    if controller is not None:
+        # Before the door drains: a controller tick against a closing
+        # limiter would race teardown.
+        controller.stop()
     if fleet_membership is not None:
         # Departure announce BEFORE the door drains (ADR-018) — see the
         # native path above; off-loop so the server keeps receiving the

@@ -126,7 +126,12 @@ class HttpGateway:
                  enable_debug: bool = False,
                  debug_token: Optional[str] = None,
                  audit_status: Optional[Callable[[], dict]] = None,
-                 audit_token: Optional[str] = None):
+                 audit_token: Optional[str] = None,
+                 tenants: Optional[object] = None,
+                 enable_tenants: bool = False,
+                 tenants_token: Optional[str] = None,
+                 fleet_migrate: Optional[Callable] = None,
+                 migrate_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -196,6 +201,126 @@ class HttpGateway:
                 else:
                     self._send(405, {"error": f"method {self.command} not "
                                      "allowed on /v1/policy"})
+
+            def _handle_tenants(self, q) -> None:
+                """Hierarchical-cascade management (ADR-020): tenant
+                registry + key assignments + effective-limit overrides.
+                A quota lever in BOTH directions (raising a tenant
+                ceiling grants, forcing an effective limit denies), so
+                gated exactly like /v1/policy: explicit opt-in plus a
+                header-only bearer token."""
+                if not gateway.enable_tenants:
+                    self._send(403, {"error": "tenant endpoint is disabled "
+                                     "on this gateway"})
+                    return
+                if not self._bearer_ok(gateway.tenants_token):
+                    self._send(403, {"error": "bad tenants token"})
+                    return
+                hier = gateway.tenants
+                if self.command == "GET":
+                    st = hier.hierarchy_stats()
+                    st["effective"] = hier.effective_limits()
+                    self._send(200, st)
+                    return
+                if self.command == "DELETE":
+                    name = q.get("name", [None])[0]
+                    if not name:
+                        self._send(400, {"error": "missing name"})
+                        return
+                    self._send(200, {"ok": True, "name": name,
+                                     "deleted": bool(
+                                         hier.delete_tenant(name))})
+                    return
+                if self.command not in ("POST", "PUT"):
+                    self._send(405, {"error": f"method {self.command} not "
+                                     "allowed on /v1/tenants"})
+                    return
+                if "assign" in q:
+                    key = q["assign"][0]
+                    tenant = q.get("tenant", [None])[0]
+                    if not tenant:
+                        self._send(400, {"error": "assign needs tenant"})
+                        return
+                    hier.assign_tenant(key, tenant)
+                    self._send(200, {"ok": True, "key": key,
+                                     "tenant": tenant})
+                elif "unassign" in q:
+                    key = q["unassign"][0]
+                    self._send(200, {"ok": True, "key": key,
+                                     "unassigned": bool(
+                                         hier.unassign_tenant(key))})
+                elif "global_limit" in q:
+                    raw = q["global_limit"][0]
+                    lim = int(raw) if raw else None
+                    hier.set_global_limit(lim or None)
+                    self._send(200, {"ok": True, "global_limit": lim or 0})
+                elif "effective" in q:
+                    scope = q["effective"][0]
+                    raw = q.get("limit", [None])[0]
+                    if raw is None:
+                        self._send(400, {"error": "effective needs limit"})
+                        return
+                    new = hier.set_effective(scope, int(raw))
+                    self._send(200, {"ok": True, "scope": scope,
+                                     "effective": int(new)})
+                else:
+                    name = q.get("name", [None])[0]
+                    if not name:
+                        self._send(400, {"error": "missing name (or one of "
+                                         "assign/unassign/global_limit/"
+                                         "effective)"})
+                        return
+                    raw = q.get("limit", [None])[0]
+                    limit = int(raw) if raw is not None else None
+                    weight = int(q.get("weight", ["1"])[0])
+                    rawf = q.get("floor", [None])[0]
+                    floor = int(rawf) if rawf is not None else None
+                    t = hier.set_tenant(name, limit, weight=weight,
+                                        floor=floor)
+                    self._send(200, {"ok": True, "name": name,
+                                     "tid": int(t.tid),
+                                     "limit": int(t.limit),
+                                     "weight": int(t.weight),
+                                     "floor": int(t.floor)})
+
+            def _handle_migrate(self, q) -> None:
+                """Operator surface for live range migration (ADR-018,
+                the PR 11 residual): POST /v1/fleet/migrate?to=HOST&
+                ranges=lo:hi[,lo:hi...]&wait=S. An ownership-move lever,
+                so it only exists when the embedding wired BOTH the
+                fleet hook AND a bearer token — there is no tokenless
+                migrate surface."""
+                if gateway.fleet_migrate is None or \
+                        gateway.migrate_token is None:
+                    self._send(403, {"error": "fleet migration is not "
+                                     "exposed on this gateway (needs "
+                                     "--http-migrate-token on a fleet "
+                                     "member)"})
+                    return
+                if not self._bearer_ok(gateway.migrate_token):
+                    self._send(403, {"error": "bad migrate token"})
+                    return
+                if self.command != "POST":
+                    self._send(405, {"error": "POST only"})
+                    return
+                to = q.get("to", [None])[0]
+                raw = q.get("ranges", [None])[0]
+                if not to or not raw:
+                    self._send(400, {"error": "missing to= or ranges= "
+                                     "(lo:hi[,lo:hi...])"})
+                    return
+                try:
+                    ranges = []
+                    for part in raw.split(","):
+                        lo, hi = part.split(":")
+                        ranges.append((int(lo), int(hi)))
+                except ValueError:
+                    self._send(400, {"error": f"bad ranges {raw!r}; "
+                                     "expected lo:hi[,lo:hi...]"})
+                    return
+                wait = float(q.get("wait", ["10.0"])[0])
+                out = gateway.fleet_migrate(ranges, to, wait)
+                self._send(200 if out.get("ok") else 504, out)
 
             def _handle_debug_trace(self) -> None:
                 """Flight-recorder dump as Perfetto/Chrome-trace JSON
@@ -397,6 +522,10 @@ class HttpGateway:
                         self._send(200, {"ok": True})
                     elif url.path == "/v1/policy":
                         self._handle_policy(q)
+                    elif url.path == "/v1/tenants":
+                        self._handle_tenants(q)
+                    elif url.path == "/v1/fleet/migrate":
+                        self._handle_migrate(q)
                     elif (url.path == "/v1/snapshot"
                           and self.command == "POST"):
                         # Durability trigger: bearer-gated like reset
@@ -487,6 +616,15 @@ class HttpGateway:
         # Accuracy observatory (ADR-016): wired iff auditing is on.
         self.audit_status = audit_status
         self.audit_token = audit_token
+        # Hierarchy management (ADR-020): opt-in + wired surface, like
+        # policy.
+        self.tenants = tenants
+        self.enable_tenants = bool(enable_tenants and tenants is not None)
+        self.tenants_token = tenants_token
+        # Fleet migration (ADR-018 operator surface): hook AND token
+        # both required — _handle_migrate refuses otherwise.
+        self.fleet_migrate = fleet_migrate
+        self.migrate_token = migrate_token
         self._profile_lock = threading.Lock()
         self._decide_trace = _accepts_trace(decide)
         self._decide_deadline = _accepts_kw(decide, "deadline")

@@ -235,7 +235,15 @@ class NativeRateLimitServer:
                 # shard's traffic — per-shard labeled, not just the 1/N
                 # of keys that land on the caller's limiter. Without it
                 # the clones are raw state shards (the pre-r5 behavior).
-                clone = type(base)(base.config, clock=base.clock)
+                kw = {}
+                if getattr(base, "_hier_table", None) is not None:
+                    # Cascade scopes on a multi-shard door (ADR-020):
+                    # every clone enforces the same per-shard share of
+                    # the tenant/global limits as the base (keys hash-
+                    # route, shards share no counters — the sliced-mesh
+                    # static-split rule).
+                    kw["hier_divisor"] = base._hier_table.divisor
+                clone = type(base)(base.config, clock=base.clock, **kw)
                 self._shard_limiters.append(
                     shard_decorate(clone, i) if shard_decorate else clone)
         self._locks = [threading.Lock() for _ in range(shards)]

@@ -1,0 +1,791 @@
+"""Hierarchical cascades + adaptive control (ratelimiter_tpu/hierarchy/,
+ADR-020).
+
+Pins the cascade contract the kernels document (ops/hier_kernels.py):
+
+* per-scope oracle pinning — cascade decisions bit-identical to a
+  sequential key → tenant → global reference limiter (per-request
+  traces) and to the staged in-batch reference (randomized batches);
+* weighted fair sharing — contended global mass clipped proportionally
+  to tenant weights, exact integer caps;
+* all-or-nothing — a request denied at a later scope consumes nothing
+  at any scope;
+* the AIMD controller converging (tighten under a seeded hot-tenant
+  storm, additive recovery after it clears);
+* durability — tenant registry, assignments, and controller-moved
+  effective limits ride checkpoints; enabled-geometry mismatches refuse;
+* mesh twins — sliced (per-slice share divisor) and replicated
+  (collective) cascade enforcement.
+
+Doors (HTTP gateway /v1/tenants, native server, the migrate surface)
+live in tests/test_hierarchy_serving.py.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    CheckpointError,
+    Config,
+    HierarchySpec,
+    InvalidConfigError,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.core.config import HIER_UNLIMITED, SketchParams
+from ratelimiter_tpu.hierarchy import (
+    GLOBAL,
+    AIMDController,
+    AIMDGains,
+    HierarchyFanout,
+    TenantTable,
+)
+
+T0 = 1_700_000_000.0
+
+
+def make(limit=1_000_000, window=60.0, *, tenants=8, map_capacity=128,
+         global_limit=0, default_tenant_limit=0,
+         algo=Algorithm.SLIDING_WINDOW, backend="sketch", **kw):
+    clock = ManualClock(T0)
+    cfg = Config(
+        algorithm=algo, limit=limit, window=window,
+        sketch=SketchParams(depth=3, width=1 << 14, sub_windows=4),
+        hierarchy=HierarchySpec(tenants=tenants, map_capacity=map_capacity,
+                                global_limit=global_limit,
+                                default_tenant_limit=default_tenant_limit),
+        **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+# ------------------------------------------------------------- spec + table
+
+
+class TestSpecAndTable:
+    def test_spec_validation(self):
+        for bad in ({"tenants": 3}, {"tenants": 1}, {"tenants": 1 << 13},
+                    {"map_capacity": 7}, {"map_capacity": 3},
+                    {"global_limit": -1}, {"global_limit": HIER_UNLIMITED},
+                    {"default_tenant_limit": -5}):
+            with pytest.raises(InvalidConfigError):
+                Config(algorithm=Algorithm.SLIDING_WINDOW, limit=4,
+                       window=60.0,
+                       hierarchy=HierarchySpec(**{"tenants": 4, **bad}),
+                       ).validate()
+
+    def test_disabled_backend_raises(self):
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=4,
+                     window=60.0)
+        lim = create_limiter(cfg, backend="sketch", clock=clock)
+        with pytest.raises(NotImplementedError, match="hierarchy"):
+            lim.set_tenant("acme", 10)
+        lim.close()
+
+    def test_tenant_validation(self):
+        lim, _ = make(tenants=2)  # capacity 2: default + one more
+        with pytest.raises(InvalidConfigError):
+            lim.set_tenant("", 10)
+        with pytest.raises(InvalidConfigError):
+            lim.set_tenant("a", -1)
+        with pytest.raises(InvalidConfigError):
+            lim.set_tenant("a", 10, weight=0)
+        with pytest.raises(InvalidConfigError):
+            lim.set_tenant("a", 10, floor=11)  # floor > ceiling
+        lim.set_tenant("a", 10)
+        with pytest.raises(InvalidConfigError, match="full"):
+            lim.set_tenant("b", 10)
+        with pytest.raises(InvalidConfigError):
+            lim.assign_tenant("k", "nope")
+        with pytest.raises(InvalidConfigError):
+            lim.delete_tenant("default")
+        lim.close()
+
+    def test_map_capacity_enforced(self):
+        lim, _ = make(tenants=4, map_capacity=8)
+        lim.set_tenant("t", 10)
+        for i in range(8):
+            lim.assign_tenant(f"k{i}", "t")
+        with pytest.raises(InvalidConfigError, match="map full"):
+            lim.assign_tenant("k8", "t")
+        # Re-assigning an existing key is not growth.
+        lim.assign_tenant("k0", "t")
+        assert lim.unassign_tenant("k0")
+        lim.assign_tenant("k8", "t")
+        lim.close()
+
+    def test_delete_falls_back_to_default(self):
+        lim, _ = make()
+        lim.set_tenant("t", 10)
+        lim.assign_tenant("k", "t")
+        assert lim.tenant_of("k") == "t"
+        assert lim.delete_tenant("t")
+        assert lim.tenant_of("k") == "default"
+        lim.close()
+
+    def test_effective_clamped_to_floor_and_ceiling(self):
+        lim, _ = make()
+        lim.set_tenant("t", 100, floor=20)
+        assert lim.set_effective("t", 5) == 20        # floor clamp
+        assert lim.set_effective("t", 10_000) == 100  # ceiling clamp
+        assert lim.set_effective("t", 60) == 60
+        assert lim.effective_limits()["t"] == 60
+        # Lowering the ceiling drags an out-of-range effective down.
+        lim.set_tenant("t", 50, floor=20)
+        assert lim.effective_limits()["t"] == 50
+        lim.close()
+
+    def test_payload_last_writer_wins(self):
+        a, _ = make(global_limit=100)
+        b, _ = make(global_limit=100)
+        for lim in (a, b):
+            lim.set_tenant("t", 50)
+        a.set_effective("t", 25)
+        payload = a.hierarchy_payload()
+        assert b.apply_hierarchy_payload(payload)
+        assert b.effective_limits()["t"] == 25
+        # Same revision again: stale, refused.
+        assert not b.apply_hierarchy_payload(payload)
+        # Unknown tenants in a newer frame are skipped, not fatal.
+        assert b.apply_hierarchy_payload(
+            {"revision": 99, "effective": {"ghost": 1, "t": 30}})
+        assert b.effective_limits()["t"] == 30
+        a.close()
+        b.close()
+
+    def test_adoption_lands_exactly_at_peer_revision(self):
+        """Adopting a multi-scope frame must not inflate the local
+        revision past the peer's (each set_effective bumps it): an
+        inflated revision would reject the origin's NEXT move and LWW
+        would roll the fleet back to stale limits."""
+        a, _ = make(global_limit=100)
+        b, _ = make(global_limit=100)
+        for lim in (a, b):
+            lim.set_tenant("t1", 50)
+            lim.set_tenant("t2", 60)
+        a.set_effective("t1", 25)
+        a.set_effective("t2", 30)
+        a.set_effective(GLOBAL, 80)          # a at revision 3
+        assert b.apply_hierarchy_payload(a.hierarchy_payload())
+        # b adopted 3 scopes but sits AT rev 3, not 3 + bumps.
+        assert b.hierarchy_payload()["revision"] == 3
+        # ... so a's next single move (rev 4) is adopted, not refused.
+        a.set_effective("t1", 20)
+        assert b.apply_hierarchy_payload(a.hierarchy_payload())
+        assert b.effective_limits()["t1"] == 20
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------- sequential oracle pin
+
+
+class SequentialReference:
+    """Sequential key → tenant → global reference limiter: each request
+    is allowed iff ALL three scopes have room, and consumes at all three
+    iff allowed (the per-request cascade contract)."""
+
+    def __init__(self, key_limit, tenant_limits, global_limit):
+        self.key_limit = key_limit
+        self.tenant_limits = tenant_limits    # name -> limit (None = unl)
+        self.global_limit = global_limit      # None = unlimited
+        self.keys = defaultdict(int)
+        self.tenants = defaultdict(int)
+        self.total = 0
+
+    def allow(self, key, tenant, n=1):
+        tl = self.tenant_limits.get(tenant)
+        ok = (self.keys[key] + n <= self.key_limit
+              and (tl is None or self.tenants[tenant] + n <= tl)
+              and (self.global_limit is None
+                   or self.total + n <= self.global_limit))
+        if ok:
+            self.keys[key] += n
+            self.tenants[tenant] += n
+            self.total += n
+        return ok
+
+
+@pytest.mark.parametrize("algo", [Algorithm.SLIDING_WINDOW,
+                                  Algorithm.FIXED_WINDOW,
+                                  Algorithm.TOKEN_BUCKET])
+def test_sequential_oracle_pinning(algo):
+    """Per-request cascade decisions bit-identical to the sequential
+    reference across a seeded mixed trace (both sketch backends)."""
+    tenant_limits = {"a": 15, "b": 9, "default": 30}
+    lim, _ = make(limit=12, algo=algo, global_limit=40,
+                  default_tenant_limit=30)
+    lim.set_tenant("a", 15)
+    lim.set_tenant("b", 9)
+    keys = [f"k{i}" for i in range(12)]
+    tenant_of = {}
+    for i, k in enumerate(keys):
+        t = ("a", "b", "default")[i % 3]
+        tenant_of[k] = t
+        if t != "default":
+            lim.assign_tenant(k, t)
+    ref = SequentialReference(12, tenant_limits, 40)
+    rng = np.random.default_rng(7)
+    trace = rng.integers(0, len(keys), size=300)
+    mismatches = []
+    for step, ki in enumerate(trace):
+        k = keys[int(ki)]
+        got = lim.allow(k).allowed
+        want = ref.allow(k, tenant_of[k])
+        if got != want:
+            mismatches.append((step, k, got, want))
+    assert not mismatches, mismatches[:10]
+    st = lim.hierarchy_stats()
+    assert st["global"]["in_window"] == ref.total
+    for name in ("a", "b", "default"):
+        assert st["tenants"][name]["in_window"] == ref.tenants[name]
+    lim.close()
+
+
+# ---------------------------------------------------- staged batch oracle
+
+
+def staged_reference(tids, ns, avail_tn, g_avail, weights):
+    """Host model of ops/hier_kernels.cascade_admit stages 2+3 (stage 1
+    assumed all-pass: key limits set far above any demand)."""
+    B = len(tids)
+    cum = defaultdict(int)
+    surv = []
+    for i in range(B):
+        t = int(tids[i])
+        ok = cum[t] + ns[i] <= avail_tn[t]
+        if ok:
+            cum[t] += ns[i]
+        surv.append(ok)
+    demand = defaultdict(int)
+    for i in range(B):
+        if surv[i]:
+            demand[int(tids[i])] += ns[i]
+    total = sum(demand.values())
+    if total > g_avail:
+        active = [t for t, d in demand.items() if d > 0]
+        w_sum = max(sum(weights[t] for t in active), 1)
+        cap = {t: min(d, g_avail * weights[t] // w_sum)
+               for t, d in demand.items()}
+    else:
+        cap = dict(demand)
+    cum3 = defaultdict(int)
+    out = []
+    for i in range(B):
+        t = int(tids[i])
+        ok = surv[i] and cum3[t] + ns[i] <= cap.get(t, 0)
+        if ok:
+            cum3[t] += ns[i]
+        out.append(ok)
+    return out
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("algo", [Algorithm.SLIDING_WINDOW,
+                                  Algorithm.TOKEN_BUCKET])
+def test_batch_staged_oracle(algo, seed):
+    """Randomized single batches bit-identical to the documented staged
+    semantics (tenant greedy over key survivors, then weighted fair
+    share of the global scope)."""
+    rng = np.random.default_rng(seed)
+    T = 8
+    names = [f"t{j}" for j in range(1, T - 1)]  # leave slack capacity
+    tn_limit = {j + 1: int(rng.integers(3, 25)) for j in range(len(names))}
+    tn_weight = {j + 1: int(rng.integers(1, 6)) for j in range(len(names))}
+    g_limit = int(rng.integers(10, 40))
+    lim, _ = make(tenants=T, map_capacity=128, global_limit=g_limit,
+                  default_tenant_limit=17, algo=algo)
+    for j, name in enumerate(names):
+        lim.set_tenant(name, tn_limit[j + 1], weight=tn_weight[j + 1])
+    B = 64
+    keys = [f"k{i}" for i in range(B)]
+    tids = rng.integers(0, len(names) + 1, size=B)  # 0 = default tenant
+    for k, t in zip(keys, tids):
+        if t > 0:
+            lim.assign_tenant(k, names[int(t) - 1])
+    ns = rng.integers(1, 4, size=B).astype(int).tolist()
+    out = lim.allow_batch(keys, ns)
+    avail_tn = {0: 17, **tn_limit}
+    weights = {0: 1, **tn_weight}
+    want = staged_reference(tids, ns, avail_tn, g_limit, weights)
+    got = [bool(x) for x in out.allowed]
+    assert got == want, [
+        (i, int(tids[i]), ns[i], got[i], want[i])
+        for i in range(B) if got[i] != want[i]]
+    st = lim.hierarchy_stats()
+    assert st["global"]["in_window"] == sum(
+        n for n, a in zip(ns, want) if a)
+    lim.close()
+
+
+# --------------------------------------------------------------- fair share
+
+
+class TestFairShare:
+    def test_contended_mass_clipped_by_weight(self):
+        """G=40 contended 3:1 → caps 30/10 exactly (floor division)."""
+        lim, _ = make(tenants=4, global_limit=40)
+        lim.set_tenant("gold", 1000, weight=3)
+        lim.set_tenant("bronze", 1000, weight=1)
+        keys, ns = [], []
+        for i in range(50):
+            for t in ("gold", "bronze"):
+                k = f"{t}{i}"
+                lim.assign_tenant(k, t)
+                keys.append(k)
+                ns.append(1)
+        out = lim.allow_batch(keys, ns)
+        st = lim.hierarchy_stats()
+        assert st["tenants"]["gold"]["in_window"] == 30
+        assert st["tenants"]["bronze"]["in_window"] == 10
+        assert st["global"]["in_window"] == 40
+        assert int(out.allowed.sum()) == 40
+        lim.close()
+
+    def test_inactive_tenants_excluded_from_share(self):
+        """Idle tenants' weights do not dilute active tenants' shares."""
+        lim, _ = make(tenants=8, global_limit=40)
+        lim.set_tenant("busy", 1000, weight=1)
+        lim.set_tenant("idle", 1000, weight=100)
+        keys = []
+        for i in range(60):
+            k = f"b{i}"
+            lim.assign_tenant(k, "busy")
+            keys.append(k)
+        out = lim.allow_batch(keys)
+        # Only 'busy' demands: its share is the whole global availability
+        # even though 'idle' carries a huge weight.
+        assert int(out.allowed.sum()) == 40
+        lim.close()
+
+    def test_uncontended_demand_all_admitted(self):
+        lim, _ = make(tenants=4, global_limit=100)
+        lim.set_tenant("a", 1000, weight=1)
+        lim.set_tenant("b", 1000, weight=9)
+        keys = []
+        for i in range(20):
+            for t in ("a", "b"):
+                k = f"{t}{i}"
+                lim.assign_tenant(k, t)
+                keys.append(k)
+        out = lim.allow_batch(keys)
+        assert int(out.allowed.sum()) == 40  # 40 <= 100: nobody clipped
+        lim.close()
+
+
+# ------------------------------------------------------------ all-or-nothing
+
+
+class TestAllOrNothing:
+    def test_cascade_denial_consumes_nothing(self):
+        """Requests denied at the global scope must not burn key or
+        tenant quota: after the global effective limit is relaxed, the
+        key's full remaining quota is still there."""
+        lim, _ = make(limit=5, tenants=4, global_limit=100)
+        lim.set_tenant("t", 50)
+        lim.assign_tenant("k", "t")
+        assert lim.set_effective(GLOBAL, 10) == 10
+        fill = [f"f{i}" for i in range(10)]
+        assert int(lim.allow_batch(fill).allowed.sum()) == 10
+        # Global exhausted: every 'k' attempt denies...
+        for _ in range(4):
+            assert not lim.allow("k").allowed
+        # ...and consumed NOTHING at the key or tenant scope.
+        st = lim.hierarchy_stats()
+        assert st["tenants"]["t"]["in_window"] == 0
+        lim.set_effective(GLOBAL, 100)
+        got = sum(lim.allow("k").allowed for _ in range(7))
+        assert got == 5  # the key's whole limit, untouched by the denials
+        lim.close()
+
+    def test_tenant_denial_preserves_key_quota(self):
+        lim, _ = make(limit=8, tenants=4)
+        lim.set_tenant("t", 3, floor=1)
+        lim.assign_tenant("k", "t")
+        assert sum(lim.allow("k").allowed for _ in range(6)) == 3
+        st = lim.hierarchy_stats()
+        assert st["tenants"]["t"]["in_window"] == 3
+        # Raise the tenant ceiling: key quota (8 - 3 = 5) still intact.
+        lim.set_tenant("t", 100)
+        assert sum(lim.allow("k").allowed for _ in range(8)) == 5
+        lim.close()
+
+
+# -------------------------------------------------------- windows + retry
+
+
+class TestWindows:
+    def test_windowed_tenant_counters_decay(self):
+        lim, clock = make(limit=1000, tenants=4, global_limit=10,
+                          window=60.0)
+        keys = [f"k{i}" for i in range(20)]
+        assert int(lim.allow_batch(keys).allowed.sum()) == 10
+        # Sliding window: advance past the window AND its boundary
+        # sub-window (whose mass still counts, frac-weighted).
+        clock.advance(121.0)
+        assert int(lim.allow_batch(keys).allowed.sum()) == 10
+        lim.close()
+
+    def test_bucket_cascade_retry_at_window_boundary(self):
+        lim, clock = make(limit=1000, tenants=4, global_limit=5,
+                          window=60.0, algo=Algorithm.TOKEN_BUCKET)
+        keys = [f"k{i}" for i in range(5)]
+        assert int(lim.allow_batch(keys).allowed.sum()) == 5
+        res = lim.allow("fresh")
+        assert not res.allowed
+        # Key scope has full tokens (deficit 0): the retry hint is the
+        # tenant/global fixed-window boundary, not the refill formula.
+        boundary = 60.0 - (T0 % 60.0)
+        assert res.retry_after == pytest.approx(boundary, abs=1e-3)
+        clock.advance(boundary + 0.5)
+        assert lim.allow("fresh").allowed
+        lim.close()
+
+    def test_key_reset_leaves_tenant_counters(self):
+        """reset() forgives the KEY only — aggregate tenant/global
+        accounting stands (a reset-hammering key cannot drain its
+        tenant, ADR-020)."""
+        lim, _ = make(limit=4, tenants=4, global_limit=100)
+        lim.set_tenant("t", 50)
+        lim.assign_tenant("k", "t")
+        assert sum(lim.allow("k").allowed for _ in range(4)) == 4
+        lim.reset("k")
+        st = lim.hierarchy_stats()
+        assert st["tenants"]["t"]["in_window"] == 4
+        assert sum(lim.allow("k").allowed for _ in range(6)) == 4
+        assert lim.hierarchy_stats()["tenants"]["t"]["in_window"] == 8
+        lim.close()
+
+
+# ------------------------------------------------------- AIMD controller
+
+
+class TestController:
+    GAINS = AIMDGains(decrease_factor=0.5, increase_fraction=0.25,
+                      saturation=0.9, hot_share=2.0, cooldown_s=0.0)
+
+    def _storm_limiter(self):
+        lim, clock = make(limit=100_000, tenants=4, global_limit=100)
+        lim.set_tenant("attacker", 1000, weight=1, floor=5)
+        lim.set_tenant("victim", 1000, weight=6, floor=5)
+        for i in range(40):
+            lim.assign_tenant(f"a{i}", "attacker")
+        for i in range(8):
+            lim.assign_tenant(f"v{i}", "victim")
+        return lim, clock
+
+    def test_converges_on_seeded_storm(self):
+        """Hot-tenant storm: the controller tightens the HOT tenant
+        (never the victim), then additively recovers to the ceiling
+        after the storm clears."""
+        lim, clock = self._storm_limiter()
+        ctl = AIMDController(lim, gains=self.GAINS, interval=999)
+        # Storm: attacker floods 90+ of the 100 global; victim trickles.
+        lim.allow_batch([f"a{i}" for i in range(40)] * 3)   # 120 demanded
+        lim.allow_batch([f"v{i}" for i in range(8)])
+        st = lim.hierarchy_stats()
+        assert st["global"]["in_window"] >= 90  # saturated
+        now = 0.0
+        moved = ctl.tick(now)
+        assert "attacker" in moved
+        assert "victim" not in moved and GLOBAL not in moved
+        assert moved["attacker"] == 500  # 1000 * 0.5
+        assert ctl.tightened == 1
+        # Second tick while still saturated: tighten again (cooldown 0).
+        moved = ctl.tick(now + 1)
+        assert moved.get("attacker") == 250
+        # Storm ends; window (and its boundary sub-window) rolls; a
+        # throwaway decision kicks the rollover sweep that recomputes
+        # the in-window counters the controller reads.
+        clock.advance(121.0)
+        lim.allow("warmup")
+        eff = lim.effective_limits()["attacker"]
+        steps = 0
+        while eff < 1000 and steps < 20:
+            ctl.tick(now + 10 + steps)
+            eff = lim.effective_limits()["attacker"]
+            steps += 1
+        assert eff == 1000  # fully recovered to the ceiling
+        assert ctl.relaxed > 0
+        lim.close()
+
+    def test_tighten_vetoed_by_false_deny_bound(self):
+        """A high audited false-deny Wilson bound vetoes tightening —
+        the controller must not amplify the limiter's own error."""
+        lim, _ = self._storm_limiter()
+        audit = {"false_deny_wilson95": [0.05, 0.2]}
+        ctl = AIMDController(lim, gains=self.GAINS,
+                             audit_status=lambda: audit, interval=999)
+        lim.allow_batch([f"a{i}" for i in range(40)] * 3)
+        assert ctl.tick(0.0) == {}  # saturated + hot, but vetoed
+        assert ctl.tightened == 0
+        audit["false_deny_wilson95"] = [0.0, 0.001]
+        assert "attacker" in ctl.tick(1.0)
+        lim.close()
+
+    def test_slo_pressure_tightens_global_without_hot_tenant(self):
+        lim, _ = make(tenants=4, global_limit=100)
+        slo = {"windows": {"300s": {"burn_rate": 5.0}}}
+        ctl = AIMDController(lim, gains=self.GAINS,
+                             slo_status=lambda: slo, interval=999)
+        moved = ctl.tick(0.0)
+        assert moved.get(GLOBAL) == 50
+        slo["windows"]["300s"]["burn_rate"] = 0.0
+        moved = ctl.tick(1.0)
+        assert moved.get(GLOBAL) == 75  # 50 + 100 * 0.25
+        lim.close()
+
+    def test_idle_limiter_reports_expired_mass_as_zero(self):
+        """Storm mass must not haunt an IDLE limiter: with zero traffic
+        after the window rolls, hierarchy_stats re-syncs the ring
+        instead of replaying the last dispatch's totals — otherwise the
+        controller keeps tightening a storm that already ended and the
+        relax leg never engages."""
+        lim, clock = self._storm_limiter()
+        ctl = AIMDController(lim, gains=self.GAINS, interval=999)
+        lim.allow_batch([f"a{i}" for i in range(40)] * 3)
+        assert ctl.tick(0.0).get("attacker") == 500
+        # Storm ends; the window rolls with NO further decisions.
+        clock.advance(121.0)
+        assert lim.hierarchy_stats()["global"]["in_window"] == 0
+        moved = ctl.tick(10.0)
+        assert moved.get("attacker", 0) > 500   # relaxing, not tightening
+        lim.close()
+
+    def test_unlimited_ceiling_never_tightened(self):
+        """A scope with no configured ceiling has no real limit to
+        move: the controller must skip it (installing 0.7 x 2^40 would
+        log/count a containment that contains nothing)."""
+        lim, _ = make(tenants=4, global_limit=100)   # default tenant uncapped
+        lim.set_tenant("victim", 1000, weight=6)
+        for i in range(8):
+            lim.assign_tenant(f"v{i}", "victim")
+        ctl = AIMDController(lim, gains=self.GAINS, interval=999)
+        # Unassigned keys flood the default (UNCAPPED) tenant past the
+        # global saturation threshold; 'default' is the hot tenant.
+        lim.allow_batch([f"free{i}" for i in range(95)])
+        moved = ctl.tick(0.0)
+        assert "default" not in moved
+        assert ctl.tightened == 0
+        assert lim.effective_limits()["default"] >= HIER_UNLIMITED
+        lim.close()
+
+    def test_publish_hook_fires_on_moves(self):
+        lim, _ = self._storm_limiter()
+        frames = []
+        ctl = AIMDController(lim, gains=self.GAINS, interval=999,
+                             publish=frames.append)
+        lim.allow_batch([f"a{i}" for i in range(40)] * 3)
+        ctl.tick(0.0)
+        assert frames and frames[-1]["revision"] >= 1
+        assert frames[-1]["effective"]["attacker"] == 500
+        lim.close()
+
+    def test_start_stop_thread(self):
+        lim, _ = make(tenants=4, global_limit=100)
+        ctl = AIMDController(lim, interval=0.01)
+        ctl.start()
+        ctl.start()  # idempotent
+        import time as _t
+        deadline = _t.monotonic() + 5.0
+        while ctl.ticks == 0 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        ctl.stop()
+        assert ctl.ticks > 0
+        lim.close()
+
+
+# ------------------------------------------------------------- durability
+
+
+class TestCheckpoint:
+    def test_hier_state_round_trips(self, tmp_path):
+        lim, _ = make(tenants=4, global_limit=100)
+        lim.set_tenant("t", 50, weight=3, floor=7)
+        lim.assign_tenant("k", "t")
+        lim.set_effective("t", 21)            # controller-moved state
+        lim.set_effective(GLOBAL, 80)
+        lim.allow_batch([f"x{i}" for i in range(10)])
+        path = str(tmp_path / "snap.npz")
+        lim.save(path)
+        lim2, _ = make(tenants=4, global_limit=100)
+        lim2.restore(path)
+        t = dict(lim2.list_tenants())["t"]
+        assert (t.limit, t.weight, t.floor) == (50, 3, 7)
+        assert lim2.tenant_of("k") == "t"
+        assert lim2.effective_limits()["t"] == 21
+        assert lim2.effective_limits()[GLOBAL] == 80
+        # Revision restored too: the pre-snapshot frame is stale.
+        assert not lim2.apply_hierarchy_payload(
+            {"revision": 1, "effective": {"t": 40}})
+        # In-window global mass restored with the sketch state.
+        assert lim2.hierarchy_stats()["global"]["in_window"] == 10
+        lim.close()
+        lim2.close()
+
+    def test_enabled_geometry_mismatch_refused(self, tmp_path):
+        lim, _ = make(tenants=4)
+        path = str(tmp_path / "snap.npz")
+        lim.save(path)
+        lim2, _ = make(tenants=8)
+        with pytest.raises(CheckpointError, match="fingerprint"):
+            lim2.restore(path)
+        lim.close()
+        lim2.close()
+
+    def test_disabled_hierarchy_keeps_pre_adr020_fingerprint(self):
+        """A disabled HierarchySpec must not change any existing
+        snapshot's fingerprint (golden-pinned seed compatibility)."""
+        from dataclasses import replace
+
+        from ratelimiter_tpu.checkpoint import config_fingerprint
+
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=4,
+                     window=60.0)
+        same = replace(cfg, hierarchy=HierarchySpec(map_capacity=1 << 16))
+        assert config_fingerprint(cfg) == config_fingerprint(same)
+        enabled = replace(cfg, hierarchy=HierarchySpec(tenants=4))
+        assert config_fingerprint(cfg) != config_fingerprint(enabled)
+
+
+# ------------------------------------------------------------ mesh twins
+
+
+class TestSlicedMesh:
+    def _mesh(self, n=2, global_limit=40, **kw):
+        from ratelimiter_tpu.core.config import MeshSpec
+
+        clock = ManualClock(T0)
+        cfg = Config(
+            algorithm=Algorithm.SLIDING_WINDOW, limit=100_000, window=60.0,
+            sketch=SketchParams(depth=3, width=1 << 14, sub_windows=4),
+            mesh=MeshSpec(devices=n),
+            hierarchy=HierarchySpec(tenants=4, global_limit=global_limit,
+                                    **kw))
+        return create_limiter(cfg, backend="mesh", clock=clock), clock
+
+    def test_slice_share_divisor(self):
+        """Each hash-routed slice enforces global_limit // n_slices; the
+        deployment-wide admitted mass is the sum of slice shares."""
+        mesh, _ = self._mesh(n=2, global_limit=40)
+        st = mesh.hierarchy_stats()
+        assert st["divisor"] == 2
+        keys = [f"k{i}" for i in range(200)]
+        out = mesh.allow_batch(keys)
+        # Both slices see >> 20 keys, so each fills its 20-share.
+        assert int(out.allowed.sum()) == 40
+        assert mesh.hierarchy_stats()["global"]["in_window"] == 40
+        mesh.close()
+
+    def test_write_all_mutations_and_stats_sum(self):
+        mesh, _ = self._mesh(n=2, global_limit=0)
+        mesh.set_tenant("t", 30, weight=2)
+        for i in range(100):
+            mesh.assign_tenant(f"k{i}", "t")
+        out = mesh.allow_batch([f"k{i}" for i in range(100)])
+        # Tenant limit 30 → 15 per slice; both slices fill their share.
+        assert int(out.allowed.sum()) == 30
+        st = mesh.hierarchy_stats()
+        assert st["tenants"]["t"]["in_window"] == 30
+        for s in mesh.slices:
+            assert s.effective_limits()["t"] == 30
+        assert mesh.set_effective("t", 16) == 16
+        for s in mesh.slices:
+            assert s.effective_limits()["t"] == 16
+        mesh.close()
+
+
+class TestReplicatedMesh:
+    @pytest.mark.parametrize("merge", ["gather", "delta"])
+    def test_cascade_on_collective_step(self, merge):
+        from ratelimiter_tpu.parallel import MeshSketchLimiter, make_mesh
+
+        clock = ManualClock(T0)
+        cfg = Config(
+            algorithm=Algorithm.SLIDING_WINDOW, limit=100_000, window=60.0,
+            sketch=SketchParams(depth=3, width=1 << 14, sub_windows=4),
+            hierarchy=HierarchySpec(tenants=4, global_limit=10))
+        lim = MeshSketchLimiter(cfg, clock, mesh=make_mesh(n_devices=8),
+                                merge=merge)
+        out = lim.allow_batch([f"k{i}" for i in range(32)])
+        st = lim.hierarchy_stats()
+        if merge == "gather":
+            # Gather mode decides globally: exactly the global limit.
+            assert int(out.allowed.sum()) == 10
+        # Either mode: the psum'd counter slab agrees with the verdicts
+        # (delta admits per-chip against bounded-stale counters, so the
+        # total may overshoot within the first batch — but accounting
+        # must match what was actually admitted).
+        assert st["global"]["in_window"] == int(out.allowed.sum())
+        # Once counters reflect saturation, later batches deny.
+        out2 = lim.allow_batch([f"m{i}" for i in range(32)])
+        assert int(out2.allowed.sum()) == 0
+        lim.close()
+
+
+# -------------------------------------------------------------- fanout
+
+
+class TestFanout:
+    def test_write_all_read_one_sum_stats(self):
+        a, _ = make(tenants=4, global_limit=100)
+        b, _ = make(tenants=4, global_limit=100)
+        fan = HierarchyFanout([a, b])
+        fan.set_tenant("t", 40, weight=2)
+        fan.assign_tenant("k", "t")
+        assert fan.tenant_of("k") == "t"
+        assert fan.set_effective("t", 20) == 20
+        assert a.effective_limits()["t"] == 20
+        assert b.effective_limits()["t"] == 20
+        a.allow("k")
+        b.allow("k")
+        b.allow("k")
+        st = fan.hierarchy_stats()
+        assert st["tenants"]["t"]["in_window"] == 3
+        assert st["global"]["in_window"] == 3
+        assert fan.apply_hierarchy_payload(
+            {"revision": 9, "effective": {"t": 25}})
+        assert b.effective_limits()["t"] == 25
+        with pytest.raises(ValueError):
+            HierarchyFanout([])
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------------- table unit tests
+
+
+class TestTenantTableDirect:
+    def _table(self, divisor=1, tenants=4, global_limit=100):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0,
+                     hierarchy=HierarchySpec(tenants=tenants,
+                                             global_limit=global_limit))
+        return TenantTable(cfg, key_fn=lambda k: hash(k) or 1,
+                           divisor=divisor)
+
+    def test_host_arrays_sorted_and_divided(self):
+        t = self._table(divisor=4)
+        t.set_tenant("t", 40)
+        for i in range(5):
+            t.assign(f"k{i}", "t")
+        arrs = t.host_arrays()
+        keys = arrs["key"][:5]
+        assert list(keys) == sorted(keys)
+        tid = t.get_tenant("t").tid
+        assert arrs["limit"][tid] == 10      # 40 // divisor 4
+        assert arrs["limit"][4] == 25        # global 100 // 4
+        assert arrs["limit"][2] == HIER_UNLIMITED  # unregistered slot
+        t2 = self._table(divisor=64, global_limit=10)
+        assert t2.host_arrays()["limit"][4] == 1  # share floors at 1
+
+    def test_needs_enabled_spec(self):
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10,
+                     window=60.0)
+        with pytest.raises(InvalidConfigError):
+            TenantTable(cfg, key_fn=hash)

@@ -1,0 +1,489 @@
+"""Hierarchical cascades through the real serving doors (ADR-020) plus
+the fleet-migrate operator surface (the ADR-018 residual).
+
+In-process gateway tests pin the /v1/tenants and /v1/fleet/migrate
+endpoint contracts (opt-in, bearer gating, CRUD). Server-binary tests
+prove the cascade through BOTH front doors of a real
+``python -m ratelimiter_tpu.serving`` process — the wire protocol is
+UNCHANGED (tenant scope derives on device from the key), decisions over
+HTTP and the binary protocol share one cascade, and the AIMD controller
+runs off the hot path — and through the mesh backend (per-slice share
+enforcement on a 2-slice deployment).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal as sig
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from netutil import free_port
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    HierarchySpec,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.core.config import SketchParams
+from ratelimiter_tpu.serving.http_gateway import HttpGateway
+
+T0 = 1_700_000_000.0
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _req(url, method="GET", token=None):
+    req = urllib.request.Request(url, method=method)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def _wait_banner(proc, timeout=180):
+    t0 = time.time()
+    lines = []
+    while time.time() - t0 < timeout:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        lines.append(line)
+        if line.startswith("serving"):
+            return lines
+    raise AssertionError("server never came up:\n" + "".join(lines))
+
+
+# ----------------------------------------------------- gateway endpoints
+
+
+def _hier_limiter():
+    cfg = Config(
+        algorithm=Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+        sketch=SketchParams(depth=2, width=1 << 12, sub_windows=4),
+        hierarchy=HierarchySpec(tenants=4, global_limit=50))
+    return create_limiter(cfg, backend="sketch", clock=ManualClock(T0))
+
+
+class TestTenantsEndpoint:
+    def _gw(self, **kw):
+        lim = _hier_limiter()
+        gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                         tenants=lim, **kw)
+        gw.start()
+        return gw, lim
+
+    def test_disabled_by_default(self):
+        gw, lim = self._gw()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"http://127.0.0.1:{gw.port}/v1/tenants")
+            assert ei.value.code == 403
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_token_gating_and_crud(self):
+        gw, lim = self._gw(enable_tenants=True, tenants_token="tok")
+        base = f"http://127.0.0.1:{gw.port}/v1/tenants"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(base)  # no token
+            assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(base, token="wrong")
+            assert ei.value.code == 403
+
+            st, out = _req(f"{base}?name=gold&limit=30&weight=3&floor=6",
+                           method="POST", token="tok")
+            assert st == 200 and out["tid"] == 1 and out["weight"] == 3
+            st, out = _req(f"{base}?assign=k1&tenant=gold",
+                           method="POST", token="tok")
+            assert st == 200
+            assert lim.tenant_of("k1") == "gold"
+            st, out = _req(f"{base}?effective=gold&limit=12",
+                           method="POST", token="tok")
+            assert out["effective"] == 12
+            assert lim.effective_limits()["gold"] == 12
+            st, out = _req(f"{base}?global_limit=40", method="POST",
+                           token="tok")
+            assert st == 200
+            st, out = _req(base, token="tok")
+            assert out["tenants"]["gold"]["ceiling"] == 30
+            assert out["effective"]["gold"] == 12
+            st, out = _req(f"{base}?unassign=k1", method="POST",
+                           token="tok")
+            assert out["unassigned"] is True
+            st, out = _req(f"{base}?name=gold", method="DELETE",
+                           token="tok")
+            assert out["deleted"] is True
+        finally:
+            gw.shutdown()
+            lim.close()
+
+
+class TestMigrateEndpoint:
+    def test_unwired_or_tokenless_is_403(self):
+        lim = _hier_limiter()
+        calls = []
+        for kw in ({}, {"fleet_migrate": lambda r, t, w: calls.append(1)},
+                   {"migrate_token": "tok"}):
+            gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                             **kw)
+            gw.start()
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _req(f"http://127.0.0.1:{gw.port}/v1/fleet/migrate"
+                         f"?to=b&ranges=0:4", method="POST", token="tok")
+                assert ei.value.code == 403
+            finally:
+                gw.shutdown()
+        assert not calls
+        lim.close()
+
+    def test_wired_migrate_contract(self):
+        lim = _hier_limiter()
+        calls = []
+
+        def migrate(ranges, to, wait):
+            calls.append((ranges, to, wait))
+            return {"ok": to == "b", "epoch": 2, "to": to,
+                    "ranges": [list(r) for r in ranges]}
+
+        gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                         fleet_migrate=migrate, migrate_token="tok")
+        gw.start()
+        base = f"http://127.0.0.1:{gw.port}/v1/fleet/migrate"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{base}?to=b&ranges=0:4", method="POST")
+            assert ei.value.code == 403          # bad token
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{base}?to=b&ranges=0:4", token="tok")  # GET
+            assert ei.value.code == 405
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{base}?to=b&ranges=nope", method="POST",
+                     token="tok")
+            assert ei.value.code == 400
+            st, out = _req(f"{base}?to=b&ranges=0:4,8:12&wait=3",
+                           method="POST", token="tok")
+            assert st == 200 and out["ok"] and out["epoch"] == 2
+            assert calls[-1] == ([(0, 4), (8, 12)], "b", 3.0)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{base}?to=c&ranges=0:4", method="POST",
+                     token="tok")
+            assert ei.value.code == 504          # migrate reports not-ok
+        finally:
+            gw.shutdown()
+            lim.close()
+
+    def test_cli_wrapper(self):
+        """tools/fleet_migrate.py drives the endpoint end to end: exit 0
+        + the donor's JSON on success, exit 1 + the gateway's error body
+        (not a traceback) on a bad token, and client-side range
+        validation refuses before any request is made."""
+        lim = _hier_limiter()
+
+        def migrate(ranges, to, wait):
+            return {"ok": True, "epoch": 3, "to": to,
+                    "ranges": [list(r) for r in ranges]}
+
+        gw = HttpGateway(lambda k, n: lim.allow_n(k, n), lim.reset,
+                         fleet_migrate=migrate, migrate_token="tok")
+        gw.start()
+        script = os.path.join(REPO, "tools", "fleet_migrate.py")
+        base = f"http://127.0.0.1:{gw.port}"
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable, script, base, "--to", "b:9433"] +
+                list(extra), env=_env(), capture_output=True, text=True,
+                timeout=60)
+
+        try:
+            out = run("--ranges", "0:4,8:12", "--wait", "3",
+                      "--token", "tok")
+            assert out.returncode == 0, out.stderr
+            body = json.loads(out.stdout)
+            assert body["epoch"] == 3 and body["ranges"] == [[0, 4],
+                                                             [8, 12]]
+            out = run("--ranges", "0:4", "--token", "wrong")
+            assert out.returncode == 1
+            body = json.loads(out.stdout)
+            assert body["http_status"] == 403 and "token" in body["error"]
+            out = run("--ranges", "4:4", "--token", "tok")
+            assert out.returncode != 0 and "empty range" in out.stderr
+        finally:
+            gw.shutdown()
+            lim.close()
+
+
+# ------------------------------------------------------- real server doors
+
+
+def _spawn(extra, *, http_port, port, backend="sketch"):
+    argv = [sys.executable, "-m", "ratelimiter_tpu.serving",
+            "--backend", backend, "--algorithm", "sliding_window",
+            "--limit", "1000", "--window", "60",
+            "--sketch-width", "4096", "--sub-windows", "4",
+            "--port", str(port), "--http-port", str(http_port),
+            "--no-prewarm"] + list(extra)
+    return subprocess.Popen(argv, env=_env(), stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+class TestServerBinaryHierarchy:
+    def test_cascade_both_doors_and_controller(self):
+        """One real server, both doors: the tenant cap set at boot binds
+        decisions arriving over HTTP AND the binary protocol (shared
+        cascade, wire protocol unchanged — no tenant field anywhere),
+        /v1/tenants manages it live, /healthz carries the hierarchy
+        block with AIMD controller counters."""
+        from ratelimiter_tpu.serving import Client
+
+        port, http_port = free_port(), free_port()
+        proc = _spawn(
+            ["--tenants", "4", "--global-limit", "100",
+             "--tenant", "gold=5:3:2", "--assign", "g1=gold",
+             "--assign", "g2=gold",
+             "--controller", "--controller-interval", "0.05",
+             "--http-tenants-token", "tok"],
+            http_port=http_port, port=port)
+        try:
+            _wait_banner(proc)
+            base = f"http://127.0.0.1:{http_port}"
+            # Wire unchanged: a plain allow, no tenant anything.
+            st, out = _req(f"{base}/v1/allow?key=g1")
+            assert st == 200
+            # Binary door shares the same cascade: gold has 5/window
+            # across BOTH doors and BOTH its keys.
+            with Client(port=port, timeout=30.0) as c:
+                got = sum(c.allow("g2").allowed for _ in range(6))
+            assert got == 4  # 1 (HTTP) + 4 = gold's 5
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _req(f"{base}/v1/allow?key=g1")
+            assert ei.value.code == 429
+            # Unassigned keys ride the default tenant, not gold's cap.
+            st, _ = _req(f"{base}/v1/allow?key=other")
+            assert st == 200
+            # Live management over /v1/tenants: raise gold's ceiling.
+            st, _ = _req(f"{base}/v1/tenants?name=gold&limit=50",
+                         method="POST", token="tok")
+            assert st == 200
+            st, _ = _req(f"{base}/v1/allow?key=g1")
+            assert st == 200
+            # /healthz hierarchy block + controller counters.
+            time.sleep(0.3)
+            st, h = _req(f"{base}/healthz")
+            hier = h["hierarchy"]
+            assert hier["tenants"]["gold"]["ceiling"] == 50
+            assert hier["tenants"]["gold"]["in_window"] >= 5
+            assert hier["controller"]["ticks"] > 0
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    def test_flag_validation(self):
+        """--controller/--tenant/--assign without --tenants refuse at
+        boot; --tenants on a non-sketch backend refuses."""
+        for extra, backend in ((["--controller"], "sketch"),
+                               (["--tenant", "a=5"], "sketch"),
+                               (["--assign", "k=a"], "sketch"),
+                               (["--tenants", "4"], "exact")):
+            argv = [sys.executable, "-m", "ratelimiter_tpu.serving",
+                    "--backend", backend, "--limit", "10",
+                    "--window", "60", "--port", str(free_port()),
+                    "--no-prewarm"] + extra
+            out = subprocess.run(argv, env=_env(), capture_output=True,
+                                 text=True, timeout=120)
+            assert out.returncode != 0
+            assert "--tenants" in out.stderr
+
+
+class _DoorAdapter:
+    """The abuse-scenario drivers (evaluation/scenarios.py) program
+    against the limiter surface; this adapter satisfies it THROUGH the
+    real doors of a server process — allow/allow_batch ride the binary
+    protocol (or HTTP when no client is given), hierarchy stats and
+    effective limits come from /healthz. Scenario clocks are real time
+    here (a fresh server's window is already fresh), so `advance` is a
+    no-op."""
+
+    class _Batch:
+        def __init__(self, allowed):
+            self.allowed = allowed
+
+    def __init__(self, http_base, client=None):
+        self.http_base = http_base
+        self.client = client
+
+    def advance(self, _seconds):     # the scenario drivers' clock hook
+        pass
+
+    def allow(self, key):
+        try:
+            _req(f"{self.http_base}/v1/allow?key={key}")
+            return type("R", (), {"allowed": True})()
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+            return type("R", (), {"allowed": False})()
+
+    def allow_batch(self, keys):
+        if self.client is not None:
+            rows = self.client.allow_batch(keys)
+            return self._Batch([bool(r.allowed) for r in rows])
+        return self._Batch([self.allow(k).allowed for k in keys])
+
+    def hierarchy_stats(self):
+        _, h = _req(f"{self.http_base}/healthz")
+        return h["hierarchy"]
+
+    def effective_limits(self):
+        st = self.hierarchy_stats()
+        out = {name: int(t["effective"])
+               for name, t in st["tenants"].items()}
+        out["global"] = int(st["global"]["effective"])
+        return out
+
+
+class TestAbuseScenariosThroughDoors:
+    def test_rotating_key_contained_via_both_doors(self):
+        """The rotating-key attacker through a REAL server, frames
+        alternating between the binary and HTTP doors: fresh keys every
+        frame never hit a per-key limit or the hh table, yet the
+        default-tenant ceiling contains the aggregate while the stable
+        legit tenant keeps serving — one shared cascade behind both
+        doors."""
+        from ratelimiter_tpu.evaluation import scenarios as sc
+        from ratelimiter_tpu.serving import Client
+
+        port, http_port = free_port(), free_port()
+        args = ["--tenants", "4", "--global-limit", "10000",
+                "--default-tenant-limit", "200",
+                "--tenant", "legit=10000:4"]
+        for i in range(16):
+            args += ["--assign", f"legit{i}=legit"]
+        proc = _spawn(args, http_port=http_port, port=port)
+        try:
+            _wait_banner(proc)
+            base = f"http://127.0.0.1:{http_port}"
+            with Client(port=port, timeout=30.0) as c:
+                binary = _DoorAdapter(base, client=c)
+                http = _DoorAdapter(base)
+
+                class Alternating(_DoorAdapter):
+                    def __init__(self):
+                        super().__init__(base, client=c)
+                        self._n = 0
+
+                    def allow_batch(self, keys):
+                        door = binary if self._n % 2 == 0 else http
+                        self._n += 1
+                        return door.allow_batch(keys)
+
+                res = sc.run_rotating_key(Alternating(), Alternating(),
+                                          batch=128, frames=6)
+            out = res.as_dict()
+            assert out["contained"] is True
+            assert out["legit_allow_rate"] == 1.0
+            assert out["attacker_admitted"] <= 200   # default ceiling
+            assert out["attacker_admit_rate"] < 0.5
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    def test_thundering_herd_fair_split_through_door(self):
+        """The synchronized window-rollover herd through a real
+        server's binary door: the global scope clips the surge to its
+        limit and the admitted mass splits by tenant weight (1:2:5),
+        measured off /healthz — fair sharing arbitrated on device, not
+        by the test."""
+        from ratelimiter_tpu.evaluation import scenarios as sc
+        from ratelimiter_tpu.serving import Client
+
+        weights = {"small": 1, "mid": 2, "big": 5}
+        port, http_port = free_port(), free_port()
+        args = ["--tenants", "4", "--global-limit", "96"]
+        for name, w in weights.items():
+            args += ["--tenant", f"{name}=10000:{w}"]
+            for i in range(16):
+                args += ["--assign", f"{name}_k{i}={name}"]
+        proc = _spawn(args, http_port=http_port, port=port)
+        try:
+            _wait_banner(proc)
+            base = f"http://127.0.0.1:{http_port}"
+            with Client(port=port, timeout=30.0) as c:
+                door = _DoorAdapter(base, client=c)
+                res = sc.run_thundering_herd(
+                    door, door, tenants=weights, keys_per_tenant=16,
+                    bursts_per_key=4)
+            out = res.as_dict()
+            # The warmup decision consumed 1 of global 96; the shares
+            # floor(95 * w / 8) are deterministic: 11 / 23 / 59.
+            assert out["admitted"] == 93
+            assert out["per_tenant_admitted"] == {"big": 59, "mid": 23,
+                                                  "small": 11}
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+class TestServerMeshHierarchy:
+    def test_mesh_backend_cascade_through_door(self):
+        """--backend mesh + --tenants: per-slice share enforcement
+        (global 20 over 2 slices → each slice admits its 10-share) on a
+        real server, decisions through the HTTP door."""
+        port, http_port = free_port(), free_port()
+        proc = _spawn(
+            ["--tenants", "4", "--global-limit", "20",
+             "--mesh-devices", "2"],
+            http_port=http_port, port=port, backend="mesh")
+        try:
+            _wait_banner(proc)
+            base = f"http://127.0.0.1:{http_port}"
+            allowed = 0
+            for i in range(60):
+                try:
+                    st, _ = _req(f"{base}/v1/allow?key=mk{i}")
+                    allowed += int(st == 200)
+                except urllib.error.HTTPError as e:
+                    assert e.code == 429
+            assert allowed == 20
+            st, h = _req(f"{base}/healthz")
+            hier = h["hierarchy"]
+            assert hier["divisor"] == 2
+            assert hier["global"]["in_window"] == 20
+            proc.send_signal(sig.SIGTERM)
+            assert proc.wait(timeout=30) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Operator CLI for live range migration (ADR-018's residual operator
+surface): a thin wrapper over the bearer-gated gateway endpoint
+
+    POST /v1/fleet/migrate?to=HOST:PORT&ranges=lo:hi[,lo:hi...]&wait=S
+
+so a live rebalance stops requiring a library call into
+``FleetMembership.migrate_ranges``.
+
+    python tools/fleet_migrate.py http://donor-host:8433 \
+        --to receiver-host:9433 --ranges 48:64 --token $MIGRATE_TOKEN
+
+The gateway must have been started with ``--http-migrate-token`` on a
+fleet member (there is no tokenless migrate surface). The donor performs
+the capture → WAL-suffix replay → epoch-flip handoff (ADR-018) and the
+command returns the post-move epoch on success, or the donor's error
+with a non-zero exit code.
+
+Pure stdlib (urllib); no client library import, so it runs from any
+operator box that can reach the gateway port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def parse_ranges(raw: str):
+    """Validate lo:hi[,lo:hi...] client-side so typos fail before the
+    donor starts a capture."""
+    out = []
+    for part in raw.split(","):
+        try:
+            lo, hi = part.split(":")
+            lo_i, hi_i = int(lo), int(hi)
+        except ValueError:
+            raise SystemExit(f"bad range {part!r}; expected lo:hi")
+        if lo_i >= hi_i:
+            raise SystemExit(f"empty range {part!r} (lo must be < hi)")
+        out.append((lo_i, hi_i))
+    return out
+
+
+def migrate(gateway: str, *, to: str, ranges: str, wait: float,
+            token: str, timeout: float) -> dict:
+    q = urllib.parse.urlencode(
+        {"to": to, "ranges": ranges, "wait": wait})
+    url = f"{gateway.rstrip('/')}/v1/fleet/migrate?{q}"
+    req = urllib.request.Request(
+        url, method="POST",
+        headers={"Authorization": f"Bearer {token}"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        # The gateway answers errors as JSON too (403/400/504); surface
+        # its body, not a bare traceback.
+        try:
+            body = json.loads(exc.read().decode())
+        except Exception:  # noqa: BLE001 — non-JSON error page
+            body = {"error": str(exc)}
+        body.setdefault("ok", False)
+        body["http_status"] = exc.code
+        return body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live range migration via a fleet member's HTTP "
+                    "gateway (POST /v1/fleet/migrate).")
+    ap.add_argument("gateway",
+                    help="donor's gateway base URL, e.g. http://host:8433")
+    ap.add_argument("--to", required=True,
+                    help="receiver fleet address host:port")
+    ap.add_argument("--ranges", required=True,
+                    help="bucket ranges to move: lo:hi[,lo:hi...]")
+    ap.add_argument("--wait", type=float, default=10.0,
+                    help="seconds the donor waits for the handoff flip "
+                         "(default 10)")
+    ap.add_argument("--token", required=True,
+                    help="bearer token (the server's --http-migrate-token)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="HTTP timeout (default: wait + 15s)")
+    args = ap.parse_args(argv)
+
+    parse_ranges(args.ranges)
+    out = migrate(args.gateway, to=args.to, ranges=args.ranges,
+                  wait=args.wait, token=args.token,
+                  timeout=args.timeout or args.wait + 15.0)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
