@@ -7,10 +7,10 @@ REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
-        test-audit test-fleet test-fleet-forward test-reshard \
-        test-hierarchy lint check native bench bench-quick bench-audit \
-        bench-chaos bench-fleet bench-reshard bench-hierarchy \
-        bench-matrix serve verify clean
+        test-audit test-fleet test-fleet-forward test-fleet-obs \
+        test-reshard test-hierarchy lint check native bench bench-quick \
+        bench-audit bench-chaos bench-fleet bench-fleet-obs \
+        bench-reshard bench-hierarchy bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -45,6 +45,10 @@ test-fleet:      ## fleet tier (ADR-017): map/routing/forwarding/failover, 2+ re
 test-fleet-forward: ## coalesced forward lanes (ADR-019): ordering oracle, window failure attribution, 4-host routing
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_forward.py -q
 
+test-fleet-obs:  ## fleet control tower (ADR-021): trace stitching, mergeable rollup, event journal, metric-name drift gate (slow lane unfiltered)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_obs.py \
+	    tests/test_metrics_docs.py -q
+
 test-reshard:    ## elastic lifecycle (ADR-018): re-bucketing oracle, migration/rejoin/departure, handoff chaos
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -m pytest tests/test_reshard.py tests/test_elastic.py -q
@@ -55,6 +59,9 @@ test-hierarchy:  ## hierarchical cascades + AIMD (ADR-020): oracle pinning, fair
 
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
+
+bench-fleet-obs: ## all-observability-on fleet retention (interleaved off/on pairs, OBS_r01 JSON, ADR-021)
+	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-obs
 
 bench-reshard:   ## elastic lifecycle numbers (migration window / rolling-restart retention / rejoin JSON)
 	JAX_PLATFORMS=cpu $(PY) bench.py --reshard

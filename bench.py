@@ -1078,6 +1078,15 @@ def main() -> None:
                          "forwarded fraction over GO-aligned windows, "
                          "and the kill -9 failover row (the multi-HOST "
                          "sibling of --mesh-devices' multichip_scaling)")
+    ap.add_argument("--fleet-obs", action="store_true",
+                    help="run ONLY the all-observability-on fleet "
+                         "retention bench (ADR-021) and emit the "
+                         "fleet_obs JSON block: 2-host mixed traffic, "
+                         "INTERLEAVED off/on pairs (flight recorder + "
+                         "audit + hh + event journal + tower surfaces "
+                         "scraped mid-run vs everything off), best "
+                         "paired retention ratio; bar >= 0.97 "
+                         "(published as OBS_r01.json)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "
@@ -1104,6 +1113,19 @@ def main() -> None:
             "platform": jax.devices()[0].platform,
             "reshard": run_reshard(
                 seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                log=lambda *a: print(*a, file=sys.stderr)),
+        }))
+        return
+
+    if args.fleet_obs:
+        from benchmarks.obs import run_fleet_obs
+
+        print(json.dumps({
+            "metric": "fleet_obs",
+            "platform": jax.devices()[0].platform,
+            "fleet_obs": run_fleet_obs(
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                pairs=int(os.environ.get("BENCH_OBS_PAIRS", "3")),
                 log=lambda *a: print(*a, file=sys.stderr)),
         }))
         return

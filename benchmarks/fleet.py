@@ -65,7 +65,8 @@ def _free_port() -> int:
 
 
 def _fleet_config_dict(ports: List[int], buckets: int,
-                       snap_dirs: Optional[List[str]] = None) -> dict:
+                       snap_dirs: Optional[List[str]] = None,
+                       http_ports: Optional[List[int]] = None) -> dict:
     n = len(ports)
     per = buckets // n
     hosts = []
@@ -79,13 +80,16 @@ def _fleet_config_dict(ports: List[int], buckets: int,
             del h["successor"]
         if snap_dirs:
             h["snapshot_dir"] = snap_dirs[i]
+        if http_ports:
+            h["http"] = http_ports[i]
         hosts.append(h)
     return {"buckets": buckets, "epoch": 1, "hosts": hosts}
 
 
 def _spawn_member(port: int, cfgpath: str, self_id: str, *,
                   snap: Optional[str] = None,
-                  max_batch: int = 8192) -> subprocess.Popen:
+                  max_batch: int = 8192,
+                  extra: tuple = ()) -> subprocess.Popen:
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
@@ -100,7 +104,8 @@ def _spawn_member(port: int, cfgpath: str, self_id: str, *,
             "--fleet-forward-inflight", "2",
             "--fleet-forward-conns", "1",
             "--fleet-forward-coalesce", "16384",
-            "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5"]
+            "--fleet-heartbeat", "0.3", "--fleet-dead-after", "1.5",
+            *extra]
     if snap:
         argv += ["--snapshot-dir", snap, "--snapshot-interval", "500"]
     return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,

@@ -1,0 +1,173 @@
+"""All-observability-on fleet retention bench (ADR-021).
+
+The control tower's cost claim — flight recorder + audit + hh analytics
++ event journal + the fan-out surfaces, ALL on at once, on a 2-host
+fleet under mixed forwarded traffic — measured exactly the way ADR-016
+measured audit overhead: INTERLEAVED off/on pairs (the box baseline
+drifts percent-scale over minutes, so a sequential A/B would measure
+the drift, not the feature), best paired ratio reported as the
+headline retention.
+
+Off side: every observability subsystem disabled incl. the event
+journal (``--no-event-journal``) — byte-identical hot path. On side:
+``--flight-recorder`` (every forward window then ALSO carries a wire
+trace id + host-side links), ``--audit`` 1/64, ``--hh-slots``, the
+journal, and the debug/tower HTTP surfaces mounted and SCRAPED
+mid-measurement (one /metrics + one /v1/fleet/status + one
+/debug/trace?fleet=1 per run) — observing while observed, the honest
+operating point.
+
+Published as OBS_r01.json via ``bench.py --fleet-obs``; acceptance bar
+retention >= 0.97.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import urllib.request
+from typing import Dict, List
+
+from benchmarks.fleet import (
+    _fleet_config_dict,
+    _free_port,
+    _run_traffic,
+    _spawn_member,
+    _wait_members,
+)
+
+#: All-on observability flags (per member). The event journal is on by
+#: default; the OFF side passes --no-event-journal instead.
+_ON_FLAGS = ("--flight-recorder", "--audit", "--audit-sample", "64",
+             "--hh-slots", "64", "--debug-token", "tok")
+_OFF_FLAGS = ("--no-event-journal",)
+
+
+def _scrape_surfaces(https: List[int], log) -> Dict:
+    """One mid-run pull of the tower surfaces (the realistic operating
+    point: a scraper and an operator exist). Returns summary numbers
+    for the JSON."""
+    out: Dict = {}
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{https[0]}/metrics",
+                timeout=10) as r:
+            out["metrics_bytes"] = len(r.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{https[0]}/v1/fleet/status",
+                timeout=10) as r:
+            st = json.loads(r.read())
+        out["fleet_status_reachable"] = st.get("reachable")
+        out["fleet_status_audit_samples"] = (st.get("audit") or {}).get(
+            "samples")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{https[0]}/debug/trace?fleet=1")
+        req.add_header("Authorization", "Bearer tok")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            tr = json.loads(r.read())
+        out["stitched_spans"] = sum(
+            1 for e in tr.get("traceEvents", ()) if e.get("ph") == "X")
+        out["stitched_hosts_aligned"] = sum(
+            1 for h in tr.get("otherData", {}).get("hosts", {}).values()
+            if h.get("aligned"))
+    except Exception as exc:  # noqa: BLE001 — the bench must finish
+        out["scrape_error"] = str(exc)
+        log(f"fleet-obs: mid-run surface scrape failed: {exc}")
+    return out
+
+
+def _one_run(obs_on: bool, tmp: str, tag: str, *, seconds: float,
+             warmup: float, conns: int, frame: int, depth: int,
+             log) -> Dict:
+    ports = [_free_port(), _free_port()]
+    https = [_free_port(), _free_port()] if obs_on else None
+    fleet = _fleet_config_dict(ports, 32, http_ports=https)
+    cfgpath = os.path.join(tmp, f"fleet-obs-{tag}.json")
+    with open(cfgpath, "w", encoding="utf-8") as f:
+        json.dump(fleet, f)
+    members = []
+    for i, port in enumerate(ports):
+        extra = list(_ON_FLAGS if obs_on else _OFF_FLAGS)
+        if obs_on:
+            extra += ["--http-port", str(https[i])]
+        members.append(_spawn_member(port, cfgpath, f"h{i}",
+                                     extra=tuple(extra)))
+    try:
+        _wait_members(members)
+        scrape: Dict = {}
+        if obs_on:
+            # Pull the tower surfaces once, mid-measurement, from a
+            # side thread (an operator reading dashboards during the
+            # run — the honest cost point).
+            timer = threading.Timer(
+                warmup + seconds / 2,
+                lambda: scrape.update(_scrape_surfaces(https, log)))
+            timer.daemon = True
+            timer.start()
+        row = _run_traffic(fleet, ports, spread=2, seconds=seconds,
+                           warmup=warmup, conns=conns, frame=frame,
+                           depth=depth, log=log)
+        if obs_on:
+            timer.join(timeout=30)
+            row["surfaces"] = scrape
+        return row
+    finally:
+        for m in members:
+            m.terminate()
+        for m in members:
+            try:
+                m.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                m.kill()
+
+
+def run_fleet_obs(*, pairs: int = 3, seconds: float = 4.0,
+                  warmup: float = 2.0, conns: int = 4,
+                  frame: int = 2048, depth: int = 12,
+                  log=print) -> Dict:
+    """The OBS_r01 block: ``pairs`` interleaved off/on rounds of 2-host
+    spread=2 mixed traffic (≈0.5 forwarded fraction — every frame
+    exercises the forward lanes both ways), per-pair retention ratios,
+    best pair as the headline."""
+    out: Dict = {
+        "harness": ("2-host asyncio-door fleet, spread=2 mixed raw-id "
+                    "loadgen (≈0.5 forwarded), INTERLEAVED off/on "
+                    "pairs, best paired ratio — the ADR-016 A/B "
+                    "method"),
+        "observability_on": list(_ON_FLAGS) + ["event journal (default "
+                                               "on)", "http surfaces "
+                                               "scraped mid-run"],
+        "pairs": [],
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        for k in range(pairs):
+            off = _one_run(False, tmp, f"off{k}", seconds=seconds,
+                           warmup=warmup, conns=conns, frame=frame,
+                           depth=depth, log=log)
+            on = _one_run(True, tmp, f"on{k}", seconds=seconds,
+                          warmup=warmup, conns=conns, frame=frame,
+                          depth=depth, log=log)
+            ratio = (on["decisions_per_sec"] / off["decisions_per_sec"]
+                     if off["decisions_per_sec"] else None)
+            out["pairs"].append({
+                "off_decisions_per_sec": off["decisions_per_sec"],
+                "on_decisions_per_sec": on["decisions_per_sec"],
+                "off_p99_ms": off["frame_p99_ms"],
+                "on_p99_ms": on["frame_p99_ms"],
+                "retention": round(ratio, 4) if ratio else None,
+                "on_surfaces": on.get("surfaces", {}),
+            })
+            log(f"fleet-obs pair {k}: off="
+                f"{off['decisions_per_sec']:.0f}/s on="
+                f"{on['decisions_per_sec']:.0f}/s retention="
+                f"{ratio:.3f}")
+        ratios = [p["retention"] for p in out["pairs"]
+                  if p["retention"] is not None]
+        out["retention_best_pair"] = max(ratios) if ratios else None
+        out["retention_median_pair"] = (
+            sorted(ratios)[len(ratios) // 2] if ratios else None)
+        out["bar"] = 0.97
+        out["pass"] = bool(ratios and max(ratios) >= 0.97)
+    return out

@@ -1,0 +1,197 @@
+"""The fleet control tower: cross-host traces, merged rollup, event
+journal (ADR-021).
+
+Spins up a TWO-member fleet (real server subprocesses, all
+observability on), drives traced traffic across the forwarding hop,
+then reads the three tower surfaces any ONE member answers for the
+whole fleet:
+
+1. ``GET /debug/trace?fleet=1`` — ONE offset-aligned Perfetto timeline
+   (a process lane per host); the traced frame's spans cross the hop
+   under one trace id (the forward window's wire id is linked back to
+   the client frame host-side);
+2. ``GET /v1/fleet/status`` — the merged rollup: audit tallies summed
+   with Wilson bounds recomputed over the merged n, fleet-wide top-K
+   consumers joined by (h1,h2) token, pooled SLO burn, per-member
+   liveness/epochs;
+3. ``GET /debug/events?fleet=1`` — the control-plane journal, merged:
+   a policy mutation on member h1 read from member h0, host-tagged and
+   clock-aligned.
+
+    JAX_PLATFORMS=cpu python examples/18_control_tower.py
+
+CLI twins: tools/fleet_trace.py and tools/fleet_status.py.
+Runbook: docs/OPERATIONS.md §12 (incident triage).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TOKEN = "example-debug-token"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn(port, http_port, cfgpath, self_id):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + env.get("PYTHONPATH", "").split(os.pathsep))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ratelimiter_tpu.serving",
+         "--backend", "sketch", "--limit", "100", "--window", "600",
+         "--sketch-width", "8192", "--sub-windows", "6",
+         "--port", str(port), "--http-port", str(http_port),
+         "--no-prewarm",
+         "--fleet-config", cfgpath, "--fleet-self", self_id,
+         "--fleet-heartbeat", "0.3", "--fleet-dead-after", "30",
+         # --no-prewarm: the first forwarded window pays the receiver's
+         # XLA compile; the forward deadline must cover it.
+         "--fleet-forward-deadline", "60",
+         # The control tower's inputs: recorder + audit + hh + journal.
+         "--flight-recorder", "--debug-token", TOKEN,
+         "--audit", "--audit-sample", "1", "--hh-slots", "16",
+         "--http-policy-token", "policy-token"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def wait_banner(proc):
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("member died during start")
+        if line.startswith("serving"):
+            return
+
+
+def get(url, token=None):
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def post(url, token=None):
+    req = urllib.request.Request(url, method="POST")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def main() -> None:
+    from ratelimiter_tpu.observability import tracing
+    from ratelimiter_tpu.serving.client import Client
+
+    ports = [free_port(), free_port()]
+    https = [free_port(), free_port()]
+    fleet = {"buckets": 32, "epoch": 1, "hosts": [
+        {"id": "h0", "host": "127.0.0.1", "port": ports[0],
+         "http": https[0], "ranges": [[0, 16]]},
+        {"id": "h1", "host": "127.0.0.1", "port": ports[1],
+         "http": https[1], "ranges": [[16, 32]]},
+    ]}
+    with tempfile.TemporaryDirectory() as tmp:
+        cfgpath = os.path.join(tmp, "fleet.json")
+        with open(cfgpath, "w", encoding="utf-8") as f:
+            json.dump(fleet, f)
+        print("== starting a 2-member fleet (all observability on) ==")
+        members = [spawn(ports[i], https[i], cfgpath, f"h{i}")
+                   for i in range(2)]
+        try:
+            for m in members:
+                wait_banner(m)
+            # Traced traffic through member h0: half the ids are owned
+            # by h1 and cross the forwarding hop.
+            c = Client(port=ports[0])
+            trace_id = tracing.new_trace_id()
+            c.allow_hashed(np.arange(1, 201, dtype=np.uint64),
+                           trace_id=trace_id)
+            hot = np.repeat(np.arange(1, 9, dtype=np.uint64), 10)
+            for _ in range(6):
+                c.allow_hashed(hot)   # hh promotions on both members
+            c.close()
+            time.sleep(1.5)          # heartbeats estimate clock offsets
+
+            print("\n== 1. stitched fleet trace "
+                  "(GET /debug/trace?fleet=1) ==")
+            tr = get(f"http://127.0.0.1:{https[0]}/debug/trace?fleet=1",
+                     TOKEN)
+            t_hex = f"{trace_id:016x}"
+            spans = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
+            mine = [e for e in spans
+                    if e["args"].get("trace_id") == t_hex]
+            print(f"   spans total: {len(spans)}; under our trace id: "
+                  f"{len(mine)} across hosts "
+                  f"{sorted({e['args']['host'] for e in mine})}")
+            for e in sorted(mine, key=lambda e: e["ts"])[:12]:
+                print(f"     {e['args']['host']:>3} {e['name']:<10} "
+                      f"{e['dur']:>9.1f}us"
+                      + ("  (wire window "
+                         f"{e['args']['window_id'][:8]}…)"
+                         if "window_id" in e["args"] else ""))
+            out = os.path.join(tmp, "fleet_trace.json")
+            with open(out, "w", encoding="utf-8") as f:
+                json.dump(tr, f)
+            print(f"   full timeline written to {out} "
+                  f"(open in ui.perfetto.dev)")
+
+            print("\n== 2. merged rollup (GET /v1/fleet/status) ==")
+            st = get(f"http://127.0.0.1:{https[1]}/v1/fleet/status")
+            print(f"   members reachable: {st['reachable']}/"
+                  f"{st['members']}, epoch {st['epoch']} "
+                  f"(converged={st['epoch_converged']})")
+            a = st.get("audit") or {}
+            print(f"   merged audit: {a.get('samples')} samples, "
+                  f"false-deny {a.get('false_deny_rate')} "
+                  f"wilson95 {a.get('false_deny_wilson95')}")
+            for i, row in enumerate(
+                    (st.get("consumers") or {}).get("top", [])[:3], 1):
+                print(f"   top consumer #{i}: {row['consumer']} "
+                      f"mass={row['in_window']} hosts="
+                      f"{sorted(row['hosts'])}")
+
+            print("\n== 3. fleet event journal "
+                  "(GET /debug/events?fleet=1) ==")
+            post(f"http://127.0.0.1:{https[1]}/v1/policy"
+                 f"?key=vip&limit=500", "policy-token")
+            evs = get(f"http://127.0.0.1:{https[0]}/debug/events"
+                      f"?fleet=1", TOKEN)
+            for e in evs["events"][-6:]:
+                print(f"   [{e['host']}] {e['category']}/{e['action']} "
+                      f"actor={e['actor'] or '-'} "
+                      f"payload={json.dumps(e['payload'])[:60]}")
+            print("\n   (the h1 policy mutation is visible from h0 — "
+                  "one journal, fleet-wide)")
+        finally:
+            for m in members:
+                m.terminate()
+            for m in members:
+                try:
+                    m.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    m.kill()
+    print("\nOK — one fleet, one timeline, one rollup, one journal.")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,11 +30,13 @@ from ratelimiter_tpu.fleet.config import FleetHost, FleetMap, affine_map
 from ratelimiter_tpu.fleet.forwarder import FleetCore, FleetForwarder
 from ratelimiter_tpu.fleet.handoff import build_standby
 from ratelimiter_tpu.fleet.membership import FleetMembership
+from ratelimiter_tpu.fleet.tower import ControlTower
 
 __all__ = [
     "FleetHost",
     "FleetMap",
     "affine_map",
+    "ControlTower",
     "FleetCore",
     "FleetForwarder",
     "FleetMembership",

@@ -63,10 +63,20 @@ class FleetHost:
     #: binary refuses to start when its own entry disagrees with its
     #: actual shard count.
     shards: int = 1
+    #: This member's HTTP gateway port (ADR-021 control tower). The
+    #: fleet fan-out surfaces — /v1/fleet/status, /debug/trace?fleet=1,
+    #: /debug/events?fleet=1, and the offline tools — pull peers'
+    #: /healthz, trace, and event payloads from it. None = this member
+    #: is skipped by rollups (reported as unreachable, never a failure).
+    http: Optional[int] = None
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
+
+    @property
+    def http_addr(self) -> Optional[str]:
+        return f"{self.host}:{self.http}" if self.http else None
 
     def to_dict(self) -> dict:
         d = {"id": self.id, "host": self.host, "port": self.port,
@@ -77,6 +87,8 @@ class FleetHost:
             d["snapshot_dir"] = self.snapshot_dir
         if self.shards != 1:
             d["shards"] = self.shards
+        if self.http is not None:
+            d["http"] = self.http
         return d
 
 
@@ -104,7 +116,8 @@ class FleetMap:
                                    for lo, hi in h.get("ranges", [])),
                       successor=h.get("successor"),
                       snapshot_dir=h.get("snapshot_dir"),
-                      shards=int(h.get("shards", 1)))
+                      shards=int(h.get("shards", 1)),
+                      http=(int(h["http"]) if h.get("http") else None))
             for h in d["hosts"])
         m = cls(buckets=int(d["buckets"]), hosts=hosts,
                 epoch=int(d.get("epoch", 1)))

@@ -58,6 +58,7 @@ from ratelimiter_tpu.core.types import (
 )
 from ratelimiter_tpu.fleet.config import FleetMap
 from ratelimiter_tpu.fleet.lanes import ForwardRuntime, PeerLane
+from ratelimiter_tpu.observability import events, tracing
 from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.observability.decorators import LimiterDecorator
 from ratelimiter_tpu.ops.hashing import (
@@ -204,12 +205,26 @@ class FleetCore:
         adopted = (adopted_buckets if adopted_buckets is not None
                    else np.zeros(fleet_map.buckets, dtype=bool))
         with self._lock:
+            prev_epoch = getattr(self, "map", None)
+            prev_epoch = prev_epoch.epoch if prev_epoch is not None else None
             self.map = fleet_map
             self.self_ordinal = self_ord
             self._adopted_buckets = adopted
         self._g_epoch.set(float(fleet_map.epoch))
         self._g_owned.set(float(fleet_map.owned_buckets(self.self_id)))
         self._g_adopted.set(float(int(adopted.sum())))
+        if prev_epoch is not None and fleet_map.epoch != prev_epoch:
+            # Control-plane journal (ADR-021): every ownership-map
+            # install with a new epoch, whoever minted it.
+            events.emit(
+                "epoch", "install", actor=self.self_id,
+                payload={
+                    "epoch": fleet_map.epoch, "from_epoch": prev_epoch,
+                    "owned_buckets": int(
+                        fleet_map.owned_buckets(self.self_id)),
+                    "adopted_buckets": int(adopted.sum()),
+                    "assigns": {h.id: [list(r) for r in h.ranges]
+                                for h in fleet_map.hosts}})
 
     def swap_map(self, new_map: FleetMap,
                  adopted_buckets: Optional[np.ndarray] = None) -> None:
@@ -470,12 +485,17 @@ class FleetCore:
                 sel = ci == c
                 if sel.any():
                     groups.append((c, pos[sel], sub_h[sel], sub_ns[sel]))
+        # Originating frame's trace id (thread-local, set by the
+        # batcher around the launch; 0 when tracing is off): rides the
+        # fragment so the lane can link it to the coalesced window's
+        # wire-level id (ADR-021 cross-host stitching).
+        trace = tracing.current() if tracing.RECORDER is not None else 0
         jobs = []
         for conn, g_pos, g_h, g_ns in groups:
             try:
                 if columnar:
                     fut = lane.submit_rows(splitmix64_inv(g_h), g_ns,
-                                           conn)
+                                           conn, trace=trace)
                 else:
                     keys = keys_fn(g_pos)
                     build, parse = self._string_call(
@@ -656,6 +676,12 @@ class FleetCore:
             "self": self.self_id,
             "epoch": mp.epoch,
             "buckets": mp.buckets,
+            # Member addresses incl. the declared gateway ports, so
+            # offline tools (tools/fleet_trace.py --offline,
+            # tools/fleet_status.py --offline) can reach every member
+            # from one /healthz read (ADR-021).
+            "hosts": {h.id: {"addr": h.addr, "http": h.http}
+                      for h in mp.hosts},
             "owned_ranges": [list(r) for r in me.ranges],
             "adopted_buckets": int(self._adopted_buckets.sum()),
             "adopted_origins": {o: [list(r) for r in rs] for o, rs in

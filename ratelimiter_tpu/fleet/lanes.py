@@ -59,6 +59,7 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from ratelimiter_tpu.core.errors import StorageUnavailableError
+from ratelimiter_tpu.observability import tracing
 
 
 class ForwardRuntime:
@@ -117,16 +118,20 @@ class ForwardRuntime:
 class _Frag:
     """One forwarded fragment: a contiguous run of one inbound frame's
     rows bound for one peer connection. ``fut`` resolves to the
-    BatchResult row-range VIEW of the coalesced reply."""
+    BatchResult row-range VIEW of the coalesced reply. ``trace`` is the
+    originating frame's trace id (0 = unsampled): the sender links it
+    to the coalesced window's WINDOW-level id so the receiving host's
+    spans stitch back to the client frame (ADR-021)."""
 
-    __slots__ = ("ids", "ns", "b", "fut")
+    __slots__ = ("ids", "ns", "b", "fut", "trace")
 
     def __init__(self, ids: np.ndarray, ns: np.ndarray,
-                 fut: "concurrent.futures.Future"):
+                 fut: "concurrent.futures.Future", trace: int = 0):
         self.ids = ids
         self.ns = ns
         self.b = int(ids.shape[0])
         self.fut = fut
+        self.trace = trace
 
 
 class _Call:
@@ -269,12 +274,30 @@ class _PeerConn:
             else:
                 ids = np.concatenate([f.ids for f in frags])
                 ns = np.concatenate([f.ns for f in frags])
+            frame = p.with_deadline(
+                p.encode_allow_hashed(req_id, ids, ns), lane.deadline)
+            # Cross-host trace stitching (ADR-021): when the flight
+            # recorder is on, the coalesced window gets ONE fresh
+            # WINDOW-level trace id on the wire (TRACE_FLAG) — the
+            # receiver's io/coalesce/launch/device spans record under
+            # it — and each member fragment's client trace id links to
+            # it host-side, so the stitcher (fleet/tower.py) can join
+            # the hop back to the client frame. Recorder off: no flag,
+            # wire bytes unchanged (the PR 12 shape).
+            rec = tracing.RECORDER
+            wid = 0
+            if rec is not None:
+                wid = tracing.new_trace_id()
+                frame = p.with_trace(frame, wid)
+                for f in frags:
+                    if f.trace:
+                        rec.link(f.trace, wid)
             # FORWARD_FLAG (ADR-019): the receiver dispatches this
             # window standalone — its reply must never wait on the
             # receiver's own forward legs (the cross-host dependency
-            # chain behind FLEET_r01's p99).
-            frame = p.with_forward(p.with_deadline(
-                p.encode_allow_hashed(req_id, ids, ns), lane.deadline))
+            # chain behind FLEET_r01's p99). Outermost, after the trace
+            # extension.
+            frame = p.with_forward(frame)
             rfut = self._loop.create_future()
             self._waiting[req_id] = rfut
             self._writer.write(frame)
@@ -298,10 +321,12 @@ class _PeerConn:
         lane.note_window(len(frags), rows)
         t0 = time.perf_counter()
         self._loop.create_task(
-            self._complete_window(req_id, rfut, frags, rows, t0))
+            self._complete_window(req_id, rfut, frags, rows, t0, wid,
+                                  tracing.now() if wid else 0))
 
     async def _complete_window(self, req_id: int, rfut, frags: List[_Frag],
-                               rows: int, t0: float) -> None:
+                               rows: int, t0: float, wid: int = 0,
+                               t_send_ns: int = 0) -> None:
         from ratelimiter_tpu.serving import protocol as p
 
         lane = self.lane
@@ -330,6 +355,14 @@ class _PeerConn:
                     f"forward reply carries {len(res)} rows for a "
                     f"{rows}-row window")
             lane.note_rtt(time.perf_counter() - t0)
+            if wid:
+                rec = tracing.RECORDER
+                if rec is not None:
+                    # The sender-side wire span of this coalesced
+                    # window, under its window-level id — the hop's
+                    # envelope on the stitched timeline (ADR-021).
+                    rec.record("forward", t_send_ns, tracing.now(),
+                               trace_id=wid, batch=rows)
             off = 0
             for f in frags:
                 if not f.fut.done():
@@ -495,15 +528,17 @@ class PeerLane:
                 % np.uint64(self.conns)).astype(np.int64)
 
     def submit_rows(self, ids: np.ndarray, ns: np.ndarray,
-                    conn_idx: int = 0) -> "concurrent.futures.Future":
+                    conn_idx: int = 0, *,
+                    trace: int = 0) -> "concurrent.futures.Future":
         """Queue one columnar fragment (raw u64 ids + ns) on a
         connection; resolves to the BatchResult row-range view of the
-        coalesced reply."""
+        coalesced reply. ``trace`` is the originating frame's trace id
+        — linked to the window-level wire id at send (ADR-021)."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         self._admit(fut)
         self._dispatch(int(conn_idx), _Frag(
             np.ascontiguousarray(ids, dtype=np.uint64),
-            np.ascontiguousarray(ns, dtype=np.uint32), fut))
+            np.ascontiguousarray(ns, dtype=np.uint32), fut, trace))
         return fut
 
     def submit_call(self, build, parse, conn_idx: int = 0,

@@ -62,6 +62,7 @@ from typing import Callable, Dict, Optional
 
 from ratelimiter_tpu.fleet.config import FleetHost, FleetMap
 from ratelimiter_tpu.fleet.forwarder import FleetCore
+from ratelimiter_tpu.observability import events, tracing
 from ratelimiter_tpu.observability import metrics as m
 
 log = logging.getLogger("ratelimiter_tpu.fleet")
@@ -161,6 +162,22 @@ class FleetMembership:
         self._lock = threading.Lock()
         self._last_seen: Dict[str, float] = {}
         self._peer_epoch: Dict[str, int] = {}
+        #: Per-peer clock alignment (ADR-021 trace stitching): announce
+        #: frames carry the sender's CLOCK_MONOTONIC ns; on receipt we
+        #: note delta_in = our_mono - sender_mono (true offset + one-way
+        #: delay), and our own announce pushes to that peer measure the
+        #: round trip (push waits for the T_OK ack). offset ≈ delta_in -
+        #: rtt/2 maps the peer's span/event timestamps into OUR
+        #: monotonic domain (t_mine = t_peer + offset) — the NTP
+        #: estimate, good to ~rtt/2 (sub-ms on a LAN, exactly the
+        #: precision a cross-host Perfetto lane needs). BOTH sides
+        #: min-filter over a short window: delay (connect handshakes,
+        #: GC pauses, a loaded receive path) only ever INFLATES
+        #: delta_in exactly as it inflates RTT — a latest-sample
+        #: delta against a min RTT would shift a lane by one slow
+        #: announce's full delay.
+        self._peer_deltas: Dict[str, list] = {}
+        self._peer_rtts: Dict[str, list] = {}
         self._failures: Dict[str, int] = {}
         self._dead: set = set()
         self._started_at = time.monotonic()
@@ -217,7 +234,11 @@ class FleetMembership:
     def announce_payload(self) -> dict:
         out = {"kind": "announce", "from": self.core.self_id,
                "map": self.core.map_payload(),
-               "sent_at": time.time()}
+               "sent_at": time.time(),
+               # Sender's span clock (CLOCK_MONOTONIC ns, the ADR-014
+               # domain): receivers estimate the cross-host clock
+               # offset from it (see _peer_delta_in above).
+               "mono_ns": time.monotonic_ns()}
         if self.hier_payload_fn is not None:
             try:
                 out["hier"] = self.hier_payload_fn()
@@ -235,6 +256,12 @@ class FleetMembership:
 
         with self._send_lock:
             req_id = next(self._ids)
+            if payload.get("kind") == "announce":
+                # Re-stamp the span clock PER PUSH (the shared payload
+                # was built before earlier peers' round trips): the
+                # offset estimate's one-way-delay term must be this
+                # push's, not the announce cycle's.
+                payload = {**payload, "mono_ns": time.monotonic_ns()}
             frame = p.encode_dcn_fleet(
                 req_id, payload, secret=self.secret, sender=self._sender,
                 seq=(self._next_seq() if self.secret is not None
@@ -244,7 +271,16 @@ class FleetMembership:
                                                           host.port):
                 conn = _PeerConn(host.host, host.port, timeout=2.0)
                 self._conns[host.id] = conn
+            t0 = time.monotonic_ns()
             conn.push(frame, req_id)
+            rtt = time.monotonic_ns() - t0
+        # Round trip of push -> T_OK ack: the one-way-delay estimate in
+        # the peer clock offset. Keep a short window and use its MIN
+        # (first-connect handshakes and GC pauses only ever inflate).
+        with self._lock:
+            rtts = self._peer_rtts.setdefault(host.id, [])
+            rtts.append(rtt)
+            del rtts[:-8]
 
     def announce_once(self) -> int:
         """Push one announce to every peer; returns deliveries. Never
@@ -287,10 +323,19 @@ class FleetMembership:
             return
         map_d = payload.get("map") or {}
         epoch = int(map_d.get("epoch", 0))
+        mono = payload.get("mono_ns")
         with self._lock:
             self._last_seen[peer] = time.monotonic()
             self._peer_epoch[peer] = epoch
             self._failures[peer] = 0
+            if mono is not None:
+                # Offset raw material: our mono at receipt minus the
+                # peer's mono at send (= true offset + one-way delay;
+                # the delay half subtracts out in peer_clock()).
+                # Min-filtered like the RTTs — see _peer_deltas above.
+                deltas = self._peer_deltas.setdefault(peer, [])
+                deltas.append(time.monotonic_ns() - int(mono))
+                del deltas[:-8]
             was_dead = peer in self._dead
             if was_dead:
                 # A declared-dead peer announcing again is back AS A
@@ -303,6 +348,9 @@ class FleetMembership:
                 # range would split counters — single owner per epoch,
                 # ADR-018).
                 self._dead.discard(peer)
+        if was_dead:
+            events.emit("membership", "peer-returned", actor=peer,
+                        payload={"epoch": epoch})
         self._g_alive.set(1.0, peer=peer)
         hier = payload.get("hier")
         if hier and self.hier_apply_fn is not None:
@@ -371,6 +419,29 @@ class FleetMembership:
 
     # ---------------------------------------------------------- liveness
 
+    def _peer_clock_locked(self, host_id: str) -> dict:
+        """``self._lock`` held. The ONE offset estimator (peer_clock
+        and status() both render it — server-side and offline stitches
+        must agree on alignment): min over the window on BOTH terms,
+        since delay only ever inflates delta_in exactly as it inflates
+        RTT."""
+        deltas = self._peer_deltas.get(host_id, ())
+        rtts = self._peer_rtts.get(host_id, ())
+        rtt = min(rtts) if rtts else None
+        if not deltas:
+            return {"offset_ns": None, "rtt_ns": rtt}
+        return {"offset_ns": int(min(deltas) - (rtt or 0) // 2),
+                "rtt_ns": rtt}
+
+    def peer_clock(self, host_id: str) -> dict:
+        """Estimated mapping of ``host_id``'s CLOCK_MONOTONIC domain
+        into OURS: ``t_mine ≈ t_peer + offset_ns`` (ADR-021 trace/event
+        stitching). ``offset_ns`` is None until the peer's first
+        announce lands; ``rtt_ns`` is the min observed announce round
+        trip (None until we delivered one)."""
+        with self._lock:
+            return self._peer_clock_locked(host_id)
+
     def note_peer_failure(self, host_id: str, exc: BaseException) -> None:
         """Forward-path failure sink (FleetCore.on_peer_failure): only
         quarantine-classified backend faults count toward death — a
@@ -403,6 +474,9 @@ class FleetMembership:
             self._g_alive.set(0.0, peer=host.id)
             log.warning("fleet peer %s (%s) declared dead (%s)",
                         host.id, host.addr, why)
+            events.emit("membership", "peer-dead", actor=host.id,
+                        severity="warning",
+                        payload={"reason": why, "addr": host.addr})
             self.core.set_dead([self.core.map.ordinal(p_id)
                                 for p_id in self._dead
                                 if self._in_map(p_id)])
@@ -440,6 +514,12 @@ class FleetMembership:
             self._notify_adopt(dead.id, cur.ranges)
         self.failovers += 1
         self._c_failovers.inc()
+        events.emit("failover", "adopt-ranges", actor=dead.id,
+                    severity="warning",
+                    payload={"successor": self.core.self_id,
+                             "ranges": [list(r) for r in cur.ranges],
+                             "epoch": new_map.epoch,
+                             "restored": unit is not None})
         # Converge fast: don't wait a heartbeat to tell the fleet.
         self.announce_once()
 
@@ -488,6 +568,11 @@ class FleetMembership:
         proposed = cur.move_ranges(ranges, self.core.self_id, to_id)
         if proposed.epoch == cur.epoch:   # nothing to move
             return True
+        # One correlation id for the whole move: stamped on the send /
+        # receive / confirm journal events ON BOTH SIDES (it rides the
+        # handoff frame), so an operator can follow one migration
+        # across hosts from /debug/events?fleet=1 alone (ADR-021).
+        corr = tracing.new_trace_id()
         self._chaos_phase("capture")
         if self.snapshot_fn is not None:
             try:
@@ -502,16 +587,24 @@ class FleetMembership:
                    "ranges": [list(r) for r in ranges],
                    "map": proposed.to_dict(),
                    "snapshot_dir": me.snapshot_dir,
-                   "sent_at": time.time()}
+                   "sent_at": time.time(),
+                   "corr": f"{corr:016x}"}
         if origin is not None:
             payload["origin"] = origin
         try:
             self._push_frame(cur.host(to_id), payload)
             self._c_handoffs.inc(role="send", reason=reason)
+            events.emit("handoff", "send", actor=to_id, corr=corr,
+                        payload={"reason": reason, "origin": origin,
+                                 "ranges": [list(r) for r in ranges],
+                                 "proposed_epoch": proposed.epoch})
         except Exception as exc:  # noqa: BLE001 — move simply didn't happen
             log.warning("fleet handoff to %s failed to send: %s", to_id,
                         exc)
             self._c_handoffs.inc(role="send_error", reason=reason)
+            events.emit("handoff", "send-error", actor=to_id, corr=corr,
+                        severity="warning",
+                        payload={"reason": reason, "error": str(exc)})
             return False
         # Flip confirmation is OWNERSHIP-level, never epoch-level: a
         # concurrent unrelated bump (a failover elsewhere) also raises
@@ -524,8 +617,16 @@ class FleetMembership:
         while True:
             mp = self.core.map
             if mp.epoch > cur.epoch and mp.assigns(ranges, to_id):
+                events.emit("handoff", "flip-confirmed", actor=to_id,
+                            corr=corr,
+                            payload={"reason": reason,
+                                     "epoch": mp.epoch})
                 return True
             if time.monotonic() >= deadline:
+                events.emit("handoff", "flip-timeout", actor=to_id,
+                            corr=corr, severity="warning",
+                            payload={"reason": reason,
+                                     "waited_s": round(float(wait), 3)})
                 return False
             time.sleep(0.02)
 
@@ -560,6 +661,10 @@ class FleetMembership:
                        for lo, hi in payload.get("ranges", []))
         reason = str(payload.get("reason", "migrate"))
         try:
+            corr = int(str(payload.get("corr", "") or "0"), 16)
+        except ValueError:
+            corr = 0
+        try:
             self._chaos_phase("restore")
             unit = None
             if self.handoff_restore_fn is not None:
@@ -579,6 +684,11 @@ class FleetMembership:
                         [list(r) for r in ranges])
                     self._c_handoffs.inc(role="receive_aborted",
                                          reason=reason)
+                    events.emit(
+                        "handoff", "receive-aborted", actor=frm,
+                        corr=corr, severity="warning",
+                        payload={"reason": reason, "phase": "restore",
+                                 "ranges": [list(r) for r in ranges]})
                     return
             self._chaos_phase("flip")
             origin = str(payload.get("origin") or frm)
@@ -611,9 +721,17 @@ class FleetMembership:
             log.warning("fleet handoff from %s abandoned before the "
                         "flip (%s); ownership unchanged", frm, exc)
             self._c_handoffs.inc(role="receive_aborted", reason=reason)
+            events.emit("handoff", "receive-aborted", actor=frm,
+                        corr=corr, severity="warning",
+                        payload={"reason": reason, "error": str(exc)})
             return
         self.handoffs += 1
         self._c_handoffs.inc(role="receive", reason=reason)
+        events.emit("handoff", "receive", actor=frm, corr=corr,
+                    payload={"reason": reason,
+                             "ranges": [list(r) for r in ranges],
+                             "epoch": new_map.epoch,
+                             "absorbed": absorbed})
         log.warning("fleet: received %s handoff of %s from %s; now "
                     "serving at epoch %d", reason,
                     [list(r) for r in ranges], frm, new_map.epoch)
@@ -652,6 +770,8 @@ class FleetMembership:
             log.warning("fleet: %s returned; handing its adopted ranges "
                         "%s back (rejoin)", origin,
                         [list(r) for r in ranges])
+            events.emit("handoff", "rejoin-giveback", actor=origin,
+                        payload={"ranges": [list(r) for r in ranges]})
             try:
                 if self.migrate_ranges(ranges, origin, reason="rejoin",
                                        origin=origin,
@@ -752,6 +872,7 @@ class FleetMembership:
                 if host.id == self.core.self_id:
                     continue
                 seen = self._last_seen.get(host.id)
+                clk = self._peer_clock_locked(host.id)
                 peers[host.id] = {
                     "addr": host.addr,
                     "alive": host.id not in self._dead,
@@ -760,6 +881,14 @@ class FleetMembership:
                     "epoch": self._peer_epoch.get(host.id),
                     "ranges": [list(r) for r in
                                self.core.map.host(host.id).ranges],
+                    # Clock alignment (ADR-021): t_mine ≈ t_peer +
+                    # offset. Exposed here so OFFLINE stitchers
+                    # (tools/fleet_trace.py --offline) can align dumps
+                    # without the server-side fan-out.
+                    "mono_offset_ns": clk["offset_ns"],
+                    "announce_rtt_ms": (round(clk["rtt_ns"] / 1e6, 3)
+                                        if clk["rtt_ns"] is not None
+                                        else None),
                 }
         return {"peers": peers, "failovers": self.failovers,
                 "handoffs": self.handoffs, "rejoins": self.rejoins,

@@ -1,9 +1,10 @@
 """Observability: metrics registry + decorator wrappers (reference L4,
 ``docs/ADR/003-decorator-pattern-for-observability.md``) + the
 flight-recorder tracing subsystem (ADR-014, ``tracing.py``) + the live
-accuracy observatory (ADR-016, ``audit.py``/``slo.py``)."""
+accuracy observatory (ADR-016, ``audit.py``/``slo.py``) + the
+control-plane event journal (ADR-021, ``events.py``)."""
 
-from ratelimiter_tpu.observability import audit, slo, tracing
+from ratelimiter_tpu.observability import audit, events, slo, tracing
 from ratelimiter_tpu.observability.metrics import (
     BATCH_BUCKETS,
     Counter,
@@ -37,6 +38,7 @@ __all__ = [
     "Registry",
     "TracingDecorator",
     "audit",
+    "events",
     "slo",
     "tracing",
 ]

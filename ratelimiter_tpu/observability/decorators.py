@@ -888,12 +888,11 @@ class LoggingDecorator(LimiterDecorator):
     def _fmt_key(self, key: str) -> str:
         if not self.redact_keys:
             return key
-        from ratelimiter_tpu.ops.hashing import hash_strings_u64, splitmix64
+        from ratelimiter_tpu.ops.hashing import key_token
 
-        # Hash-of-hash: hash_strings_u64 feeds decisions and wire
-        # routing, so its raw value is quasi-public; the extra splitmix
-        # keeps log tokens uncorrelatable with routing hashes.
-        return f"key#{int(splitmix64(hash_strings_u64([key]))[0]):016x}"
+        # Shared token rule (ops/hashing.key_token): redacted log lines
+        # stay joinable with journal key_hash fields.
+        return key_token(key)
 
     @staticmethod
     def _fo_slices(res) -> str:

@@ -1,0 +1,199 @@
+"""Control-plane event journal: WHY the limiter changed its mind (ADR-021).
+
+The flight recorder (ADR-014) answers "where did this frame's latency
+go" and the observatory (ADR-016) answers "how accurate are we being" —
+but after PRs 10-13 turned N hosts into ONE limiter, the questions an
+operator actually asks during an incident are control-plane ones: *why
+did tenant X get tightened at 14:02, who adopted h1's ranges, when did
+slice 3 quarantine, which member published epoch 9?* Until now those
+answers lived in scattered WARNING log lines on N machines. This module
+is the structured, bounded, queryable record of every control-plane
+transition, exposed per member via bearer-gated ``GET /debug/events``
+(cursor-paginated) and fleet-wide via ``GET /debug/events?fleet=1``
+(merged on the membership's estimated clock offsets, fleet/tower.py).
+
+Design rules:
+
+* **Events are rare.** Controller moves, quarantine transitions,
+  handoffs, failovers, epoch bumps, policy/tenant mutations — tens per
+  minute at the very worst. A plain lock + deque is the right cost
+  model; nothing here is ever on the decide path.
+* **Same module-global seam** as ``tracing.RECORDER`` / ``audit.AUDITOR``
+  / the chaos injector: library code calls :func:`emit`, which is one
+  None check when the journal is off. The server binary enables it by
+  default (``--no-event-journal`` opts out) because the whole point is
+  being able to reconstruct an incident you did not predict.
+* **Every event carries both clocks**: wall time (human correlation,
+  NTP-grade) and CLOCK_MONOTONIC ns (the span clock, ADR-014) — the
+  fleet merge aligns members on the same per-peer monotonic offsets the
+  trace stitcher uses, so events interleave correctly with spans on one
+  Perfetto timeline.
+* **Correlation ids** join an event to its cause: a controller tick
+  stamps one id on every move it makes (and into its log line), handoff
+  events share the giver's id across send/receive/flip, and a traced
+  frame's trace id can ride along. Ids render as 16-hex tokens, the
+  trace-id convention.
+
+Cursor pagination contract (``read``): the caller passes ``after`` (the
+last ``seq`` it has seen; 0 = from the oldest held) and gets events with
+``seq > after`` in order, up to ``limit``, plus ``cursor`` (pass it back
+as the next ``after``) and ``truncated`` (True when the bounded ring
+dropped events the cursor never saw — the caller's history has a hole).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+#: Category vocabulary (free-form strings are accepted — a dump must
+#: never be lost to a new subsystem — but the known set is documented
+#: so dashboards can enumerate it).
+CATEGORIES = (
+    "controller",   # AIMD tighten/relax with the triggering signals
+    "quarantine",   # slice state transitions (ADR-015)
+    "handoff",      # live migration / departure / rejoin phases (ADR-018)
+    "failover",     # dead-peer range adoption (ADR-017)
+    "epoch",        # ownership-map installs/adoptions
+    "membership",   # peer liveness transitions
+    "policy",       # per-key override + reset mutations
+    "tenant",       # tenant registry / assignment / effective-limit moves
+)
+
+
+class EventJournal:
+    """Bounded in-memory ring of structured control-plane events."""
+
+    def __init__(self, capacity: int = 4096, *, host: str = "",
+                 registry=None):
+        if capacity < 16:
+            raise ValueError(f"capacity must be >= 16, got {capacity}")
+        self.capacity = int(capacity)
+        self.host = host
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._counter = None
+        if registry is not None:
+            self._counter = registry.counter(
+                "rate_limiter_events_total",
+                "Control-plane events recorded in the event journal "
+                "(ADR-021), by category")
+
+    # ----------------------------------------------------------- record
+
+    def record(self, category: str, action: str, *, actor: str = "",
+               corr: int = 0, severity: str = "info",
+               payload: Optional[dict] = None) -> int:
+        """Append one event; returns its seq. ``corr`` is a u64
+        correlation id (0 = none), rendered as the 16-hex trace-id
+        convention so it joins against flight-recorder spans."""
+        now_wall = time.time()
+        now_mono = time.monotonic_ns()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self._events.append({
+                "seq": seq,
+                "ts": round(now_wall, 6),
+                "mono_ns": now_mono,
+                "category": str(category),
+                "action": str(action),
+                "actor": str(actor),
+                "corr": (f"{corr & 0xFFFFFFFFFFFFFFFF:016x}" if corr
+                         else ""),
+                "severity": str(severity),
+                "payload": dict(payload) if payload else {},
+            })
+        c = self._counter
+        if c is not None:
+            c.inc(category=str(category))
+        return seq
+
+    # ------------------------------------------------------------- read
+
+    def read(self, after: int = 0, limit: int = 256,
+             category: Optional[str] = None) -> Dict:
+        """Events with ``seq > after`` (oldest first), up to ``limit``.
+        See the module docstring for the pagination contract."""
+        limit = max(1, min(int(limit), self.capacity))
+        with self._lock:
+            events = list(self._events)
+            newest = self._seq
+        oldest = events[0]["seq"] if events else newest + 1
+        out: List[dict] = []
+        for e in events:
+            if e["seq"] <= after:
+                continue
+            if category is not None and e["category"] != category:
+                continue
+            out.append(e)
+            if len(out) >= limit:
+                break
+        cursor = out[-1]["seq"] if out else max(after, newest)
+        return {
+            "enabled": True,
+            "host": self.host,
+            "events": out,
+            "cursor": cursor,
+            "newest": newest,
+            # The ring dropped events this cursor never saw: the reader
+            # asked for history older than the oldest held event.
+            "truncated": bool(after + 1 < oldest and after < newest),
+        }
+
+    def tail(self, limit: int = 256,
+             category: Optional[str] = None) -> Dict:
+        """The NEWEST ``limit`` events (still oldest-first in the
+        returned list) — the fleet-merge fetch shape, where per-host
+        cursors don't compose."""
+        limit = max(1, min(int(limit), self.capacity))
+        with self._lock:
+            events = list(self._events)
+            newest = self._seq
+        if category is not None:
+            events = [e for e in events if e["category"] == category]
+        out = events[-limit:]
+        return {"enabled": True, "host": self.host, "events": out,
+                "cursor": out[-1]["seq"] if out else newest,
+                "newest": newest, "truncated": False}
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"capacity": self.capacity, "held": len(self._events),
+                    "seq": self._seq}
+
+
+#: Process-wide journal; None = journaling off. Library emit sites pay
+#: one None check when off — the same seam as tracing.RECORDER,
+#: audit.AUDITOR, and chaos.INJECTOR. The server binary enables it by
+#: default (events are rare; reconstructing an unpredicted incident is
+#: the feature).
+JOURNAL: Optional[EventJournal] = None
+
+
+def enable(capacity: int = 4096, *, host: str = "",
+           registry=None) -> EventJournal:
+    """Install (and return) the process-wide journal, replacing any
+    previous one."""
+    global JOURNAL
+    JOURNAL = EventJournal(capacity, host=host, registry=registry)
+    return JOURNAL
+
+
+def disable() -> None:
+    global JOURNAL
+    JOURNAL = None
+
+
+def get() -> Optional[EventJournal]:
+    return JOURNAL
+
+
+def emit(category: str, action: str, **kw) -> None:
+    """Guarded record: one None check when journaling is off."""
+    j = JOURNAL
+    if j is not None:
+        j.record(category, action, **kw)

@@ -144,6 +144,15 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def clear(self) -> None:
+        """Drop every label set. For identity/info gauges whose label
+        VALUES change over time (e.g. the fleet map epoch on
+        ``rate_limiter_member_info``): a gauge only overwrites label
+        sets it is told about, so a collect hook clears before it sets
+        or stale identities would persist forever."""
+        with self._lock:
+            self._values.clear()
+
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} gauge"]

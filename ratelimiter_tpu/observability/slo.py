@@ -187,6 +187,15 @@ class SloBurnTracker:
                 "latency_bad_fraction": round(slow_frac, 6),
                 "availability_bad_fraction": round(bad_frac, 6),
                 "burn_rate": round(max(slow_frac, bad_frac) / budget, 3),
+                # Raw windowed deltas behind the fractions — the
+                # MERGEABLE form (ADR-021): a fleet rollup sums these
+                # across members and recomputes the fractions/burn over
+                # the merged counts, instead of averaging ratios (which
+                # would let an idle member dilute a burning one).
+                "spans": int(newest[1] - base[1]),
+                "spans_slow": int(newest[2] - base[2]),
+                "decisions": int(newest[3] - base[3]),
+                "decisions_bad": int(newest[4] - base[4]),
             }
         return {
             "objective": self.objective,

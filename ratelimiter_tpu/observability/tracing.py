@@ -42,6 +42,7 @@ Design (ADR-014):
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -70,6 +71,9 @@ STAGES = (
     "grpc",       # gRPC decision (traceparent metadata attribution)
     "dcn",        # one DCN push round-trip to a peer
     "client",     # client-side request span (loadgen sampling)
+    "forward",    # fleet forward lane: one coalesced wire window's
+                  # round trip to a peer (send -> parsed reply), recorded
+                  # under the WINDOW-level trace id (ADR-021)
 )
 _STAGE_CODE: Dict[str, int] = {s: i for i, s in enumerate(STAGES)}
 
@@ -154,6 +158,15 @@ class FlightRecorder:
         self._rings: List[_Ring] = []
         self._rings_lock = threading.Lock()
         self._registries: list = []
+        #: Parent-child trace-id links (ADR-021): a fleet forward lane
+        #: re-frames member fragments under one WINDOW-level trace id
+        #: and records (client frame id -> window id) here, so the
+        #: cross-host stitcher can join the receiving member's
+        #: window-id spans back to the client frame. Bounded; links are
+        #: per-window (rare next to spans), appended under a lock.
+        self._links: collections.deque = collections.deque(
+            maxlen=max(1024, cap))
+        self._links_lock = threading.Lock()
 
     # ------------------------------------------------------------ record
 
@@ -177,6 +190,24 @@ class FlightRecorder:
                        stage if isinstance(stage, int)
                        else _STAGE_CODE[stage], outcome)
         ring.idx += 1
+
+    def link(self, parent_id: int, child_id: int) -> None:
+        """Record a parent->child trace-id relation (the fleet forward
+        lane's fragment -> wire-window linkage, ADR-021). Not a
+        hot-path call: one link per coalesced wire window."""
+        if not parent_id or not child_id or parent_id == child_id:
+            return
+        with self._links_lock:
+            self._links.append((parent_id & 0xFFFFFFFFFFFFFFFF,
+                                child_id & 0xFFFFFFFFFFFFFFFF, now()))
+
+    def links(self) -> List[dict]:
+        """Recorded trace-id links as dicts (ids in the 16-hex trace-id
+        rendering)."""
+        with self._links_lock:
+            snap = list(self._links)
+        return [{"parent": f"{p:016x}", "child": f"{c:016x}",
+                 "t_ns": t} for p, c, t in snap]
 
     # ------------------------------------------------------------- drain
 
@@ -250,7 +281,14 @@ class FlightRecorder:
             "displayTimeUnit": "ms",
             "otherData": {"clock": "CLOCK_MONOTONIC",
                           "threads": {str(r.tid): r.name
-                                      for r in list(self._rings)}},
+                                      for r in list(self._rings)},
+                          # Fragment -> wire-window linkage plus a
+                          # (mono, wall) clock stamp, so an offline
+                          # stitcher can join and align dumps pulled
+                          # from several hosts (fleet/tower.py).
+                          "links": self.links(),
+                          "mono_ns": now(),
+                          "wall_s": time.time()},
         }
 
     def stage_summary(self) -> Dict[str, dict]:
@@ -349,3 +387,26 @@ def record(stage, t_start: int, t_end: int, **kw) -> None:
     rec = RECORDER
     if rec is not None:
         rec.record(stage, t_start, t_end, **kw)
+
+
+# ----------------------------------------------- current-trace context
+#
+# A thread-local "trace id of the work currently being launched": the
+# micro-batcher sets it (recorder-on only) around the limiter launch
+# call, so layers WITHOUT a trace-id parameter in their signature — the
+# fleet forwarder splitting a frame onto peer lanes is the one that
+# matters (ADR-021) — can attribute the rows they ship. For a coalesced
+# window the id is the window's representative (first sampled frame),
+# the same id its coalesce/launch/device spans carry.
+
+_CTX = threading.local()
+
+
+def set_current(trace_id: int) -> None:
+    _CTX.trace_id = trace_id
+
+
+def current() -> int:
+    """Trace id of the frame/window being launched on this thread
+    (0 = none/unsampled)."""
+    return getattr(_CTX, "trace_id", 0)

@@ -194,11 +194,22 @@ class QuarantineManager:
         """Lock held by caller."""
         if self._state[idx] == state:
             return
+        prev = self._state[idx]
         self._state[idx] = state
         self.transitions += 1
         self._c_transitions.inc(slice=str(idx), to=state)
         self._g_quarantined.set(0.0 if state == "healthy" else 1.0,
                                 slice=str(idx))
+        # Control-plane journal (ADR-021): quarantine transitions are
+        # exactly the "why did range X degrade at 14:02" record.
+        from ratelimiter_tpu.observability import events
+
+        events.emit("quarantine", state, actor=f"slice{idx}",
+                    severity=("info" if state == "healthy"
+                              else "warning"),
+                    payload={"slice": idx, "from": prev,
+                             "consecutive_failures":
+                                 self._consecutive[idx]})
         cb = self.on_state_change
         if cb is not None:
             try:

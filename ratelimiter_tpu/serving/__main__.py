@@ -212,6 +212,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bearer token required by the /debug endpoints "
                          "(implies --debug-trace); Authorization header "
                          "only, like every other token")
+    # Control-plane event journal (ADR-021).
+    ap.add_argument("--no-event-journal", action="store_true",
+                    help="disable the control-plane event journal "
+                         "(ADR-021). ON by default: controller moves, "
+                         "quarantine transitions, handoffs, failovers, "
+                         "epoch bumps, and policy/tenant mutations are "
+                         "recorded in a bounded in-memory ring (never "
+                         "the decide path) and served over bearer-gated "
+                         "GET /debug/events")
+    ap.add_argument("--event-journal-capacity", type=int, default=4096,
+                    help="events held in the journal ring (oldest "
+                         "evicted; ~300 B/event)")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the MetricsDecorator (on by default)")
     # Live accuracy observatory (ADR-016).
@@ -516,6 +528,12 @@ def _audit_health() -> dict:
         "false_deny_rate": st["false_deny_rate"],
         "false_deny_wilson95": st["false_deny_wilson95"],
         "false_allow_rate": st["false_allow_rate"],
+        # Raw tallies — the MERGEABLE form (ADR-021): the fleet rollup
+        # sums these across members and recomputes rates + Wilson over
+        # the merged counts (fleet/tower.merge_audit).
+        "false_denies": st["false_denies"],
+        "false_allows": st["false_allows"],
+        "oracle_allows": st["oracle_allows"],
         "fail_open_samples": st["fail_open_samples"],
         "dropped_decisions": st["dropped_decisions"],
         "oracle_errors": st["oracle_errors"]}}
@@ -523,6 +541,59 @@ def _audit_health() -> dict:
 
 def _slo_health(slo) -> dict:
     return {"slo": slo.status()} if slo is not None else {}
+
+
+def _events_health() -> dict:
+    from ratelimiter_tpu.observability import events as events_mod
+
+    j = events_mod.JOURNAL
+    return {"events": j.status()} if j is not None else {}
+
+
+def _make_member_info(args, fleet_core):
+    """Member identity (ADR-021 satellite): the dict mirrored into
+    /healthz AND exported as the ``rate_limiter_member_info`` identity
+    gauge, so rolled-up series and stitched traces are attributable to
+    a member (who am I, which map epoch am I serving, which door/ABI,
+    which backend)."""
+    abi = "py"
+    if args.native:
+        from ratelimiter_tpu.serving.native_server import _ABI
+
+        abi = str(_ABI)
+
+    def info() -> dict:
+        return {
+            "self": args.fleet_self or f"{args.host}:{args.port}",
+            "backend": args.backend,
+            "algorithm": args.algorithm,
+            "door": "native" if args.native else "asyncio",
+            "abi": abi,
+            "fleet_epoch": (int(fleet_core.map.epoch)
+                            if fleet_core is not None else None),
+        }
+
+    g_info = obs_metrics.DEFAULT.gauge(
+        "rate_limiter_member_info",
+        "Identity gauge (value always 1): fleet self id, current "
+        "ownership-map epoch, serving door + native ABI, and backend "
+        "kind as labels — joins rolled-up series and stitched traces "
+        "to a member (ADR-021)")
+
+    def collect() -> None:
+        # clear-then-set: the epoch LABEL changes over time, and a
+        # gauge only overwrites label sets it is told about — stale
+        # identities would otherwise persist across failovers. The
+        # member id renders under the label "id" ("self" cannot ride
+        # a **labels kwarg — it collides with the bound method).
+        g_info.clear()
+        d = info()
+        g_info.set(1.0, **{("id" if k == "self" else k):
+                           ("-" if v is None else str(v))
+                           for k, v in d.items()})
+
+    obs_metrics.DEFAULT.add_collect_hook(collect)
+    return info
 
 
 def _hierarchy_health(hier, controller) -> dict:
@@ -726,6 +797,17 @@ async def amain(args) -> None:
         # rate_limiter_stage_seconds at scrape time (ADR-014).
         tracing.enable(args.flight_recorder_capacity,
                        registry=obs_metrics.DEFAULT)
+    if not args.no_event_journal:
+        # Control-plane event journal (ADR-021): ON by default — events
+        # are rare (never the decide path) and the whole point is
+        # reconstructing incidents nobody predicted. Enabled before any
+        # subsystem that emits (controller, quarantine, membership).
+        from ratelimiter_tpu.observability import events as events_mod
+
+        events_mod.enable(args.event_journal_capacity,
+                          host=(args.fleet_self or
+                                f"{args.host}:{args.port}"),
+                          registry=obs_metrics.DEFAULT)
     http_debug = bool(args.debug_trace or args.debug_token)
 
     cfg = Config(
@@ -1046,6 +1128,34 @@ async def amain(args) -> None:
         return {"fleet": {**fleet_core.status(),
                           **fleet_membership.status()}}
 
+    # Member identity (ADR-021): /healthz "member" block + the
+    # rate_limiter_member_info identity gauge.
+    member_info = _make_member_info(args, fleet_core)
+
+    def _make_tower():
+        """Fleet control tower (ADR-021): rollup/trace/event fan-out
+        over the peers' declared HTTP gateways. None off-fleet or
+        without a local gateway."""
+        if fleet_core is None or args.http_port is None:
+            return None
+        from ratelimiter_tpu.fleet.tower import ControlTower
+
+        me = fleet_core.map.host(args.fleet_self)
+        if me.http != args.http_port:
+            logging.getLogger("ratelimiter_tpu.fleet").warning(
+                "fleet map entry %r declares http=%s but this server "
+                "serves HTTP on %s — peers' fleet rollups/trace "
+                "stitching will miss this member until the map's "
+                "\"http\" field matches", args.fleet_self, me.http,
+                args.http_port)
+        return ControlTower(fleet_core, fleet_membership,
+                            self_health=lambda: _tower_health[0]())
+
+    # Late-bound: the health lambda is built with the door below; the
+    # tower reads it through this cell so construction order stays
+    # simple.
+    _tower_health = [lambda: {}]
+
     http_reset = bool(args.http_reset or args.http_reset_token)
     http_policy = bool(args.http_policy or args.http_policy_token)
     dcn_peers = []
@@ -1149,25 +1259,35 @@ async def amain(args) -> None:
             # decide/reset route through the server's shard router, so a
             # key's quota lives on ONE shard no matter which surface
             # (binary or HTTP) served it.
+            def health_fn() -> dict:
+                return {"serving": True,
+                        **{k: v for k, v in server.stats().items()
+                           if k == "decisions_total"},
+                        "policy_overrides":
+                            server.shard_limiters[0].override_count(),
+                        "member": member_info(),
+                        **_envelope_health(server.shard_limiters),
+                        **_debt_slab_health(server.shard_limiters),
+                        **_consumers_health(server.shard_limiters),
+                        **_audit_health(),
+                        **_slo_health(slo_tracker),
+                        **_hierarchy_health(hier, controller),
+                        **_fleet_health(),
+                        **_events_health(),
+                        **({"quarantine": qmgr.status()}
+                           if qmgr is not None else {}),
+                        **(persist.status() if persist else {})}
+
+            _tower_health[0] = health_fn
+            tower = _make_tower()
             gateway = HttpGateway(
                 server.decide_one, server.reset_one,
                 host=args.host, port=args.http_port,
                 metrics_render=obs_metrics.DEFAULT.render,
-                health=lambda: {"serving": True,
-                                **{k: v for k, v in server.stats().items()
-                                   if k == "decisions_total"},
-                                "policy_overrides":
-                                    server.shard_limiters[0].override_count(),
-                                **_envelope_health(server.shard_limiters),
-                                **_debt_slab_health(server.shard_limiters),
-                                **_consumers_health(server.shard_limiters),
-                                **_audit_health(),
-                                **_slo_health(slo_tracker),
-                                **_hierarchy_health(hier, controller),
-                                **_fleet_health(),
-                                **({"quarantine": qmgr.status()}
-                                   if qmgr is not None else {}),
-                                **(persist.status() if persist else {})},
+                health=health_fn,
+                fleet_status=(tower.fleet_status if tower else None),
+                fleet_trace=(tower.fleet_trace if tower else None),
+                fleet_events=(tower.fleet_events if tower else None),
                 enable_reset=http_reset,
                 reset_token=args.http_reset_token,
                 # Overrides apply on every shard (keys hash-route).
@@ -1334,23 +1454,33 @@ async def amain(args) -> None:
     if args.http_port is not None:
         from ratelimiter_tpu.serving.http_gateway import HttpGateway
 
+        def health_fn() -> dict:
+            return {"serving": True,
+                    "decisions_total": server.batcher.decisions_total,
+                    "policy_overrides": limiter.override_count(),
+                    "member": member_info(),
+                    **_envelope_health([limiter]),
+                    **_debt_slab_health([limiter]),
+                    **_consumers_health([limiter]),
+                    **_audit_health(),
+                    **_slo_health(slo_tracker),
+                    **_hierarchy_health(hier, controller),
+                    **_fleet_health(),
+                    **_events_health(),
+                    **({"quarantine": qmgr.status()}
+                       if qmgr is not None else {}),
+                    **(persist.status() if persist else {})}
+
+        _tower_health[0] = health_fn
+        tower = _make_tower()
         gateway = HttpGateway(
             threadsafe_decide, limiter.reset,
             host=args.host, port=args.http_port,
             metrics_render=obs_metrics.DEFAULT.render,
-            health=lambda: {"serving": True,
-                            "decisions_total": server.batcher.decisions_total,
-                            "policy_overrides": limiter.override_count(),
-                            **_envelope_health([limiter]),
-                            **_debt_slab_health([limiter]),
-                            **_consumers_health([limiter]),
-                            **_audit_health(),
-                            **_slo_health(slo_tracker),
-                            **_hierarchy_health(hier, controller),
-                            **_fleet_health(),
-                            **({"quarantine": qmgr.status()}
-                               if qmgr is not None else {}),
-                            **(persist.status() if persist else {})},
+            health=health_fn,
+            fleet_status=(tower.fleet_status if tower else None),
+            fleet_trace=(tower.fleet_trace if tower else None),
+            fleet_events=(tower.fleet_events if tower else None),
             enable_reset=http_reset,
             reset_token=args.http_reset_token,
             policy_set=limiter.set_override,

@@ -485,11 +485,20 @@ class MicroBatcher:
         rec = tracing.RECORDER
         tq0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
+        if rec is not None:
+            # Current-trace context for layers below without a trace-id
+            # parameter (the fleet forwarder links forwarded fragments
+            # to this id, ADR-021). Recorder-on only — off stays
+            # byte-identical.
+            tracing.set_current(trace_id)
         try:
             ticket = self.limiter.launch_ids(ids, ns, wire=True)
         except BaseException:
             self._window.release()
             raise
+        finally:
+            if rec is not None:
+                tracing.set_current(0)
         self._launch_hist.observe(time.perf_counter() - t0)
         if rec is not None:
             # "queue" = waiting for the FIFO launch executor + window
@@ -508,8 +517,14 @@ class MicroBatcher:
         covers the whole synchronous dispatch."""
         rec = tracing.RECORDER
         t0 = tracing.now() if rec is not None else 0
-        out = (self.limiter.allow_ids(keys, ns) if hashed
-               else self.limiter.allow_batch(keys, ns))
+        if rec is not None:
+            tracing.set_current(trace_id)
+        try:
+            out = (self.limiter.allow_ids(keys, ns) if hashed
+                   else self.limiter.allow_batch(keys, ns))
+        finally:
+            if rec is not None:
+                tracing.set_current(0)
         if rec is not None:
             rec.record("device", t0, tracing.now(), trace_id=trace_id,
                        batch=len(out),
@@ -750,11 +765,17 @@ class MicroBatcher:
         rec = tracing.RECORDER
         tq0 = tracing.now() if rec is not None else 0
         t0 = time.perf_counter()
+        if rec is not None:
+            # See _launch_hashed_work: forwarded-fragment linkage.
+            tracing.set_current(trace_id)
         try:
             ticket = self.limiter.launch_batch(keys, ns)
         except BaseException:
             self._window.release()
             raise
+        finally:
+            if rec is not None:
+                tracing.set_current(0)
         self._launch_hist.observe(time.perf_counter() - t0)
         if rec is not None:
             if t_q:

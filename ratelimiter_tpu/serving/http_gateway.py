@@ -77,7 +77,17 @@ from ratelimiter_tpu.core.errors import (
     StorageUnavailableError,
 )
 from ratelimiter_tpu.core.types import Result
+from ratelimiter_tpu.observability import events as _events
 from ratelimiter_tpu.observability import tracing
+
+
+def _key_token(key: str) -> str:
+    """Irreversible key token for journal payloads (the PII boundary,
+    OPERATIONS §6) — the shared ops/hashing.key_token rule, so journal
+    key_hash fields join against redacted log lines."""
+    from ratelimiter_tpu.ops.hashing import key_token
+
+    return key_token(key)
 
 log = logging.getLogger("ratelimiter_tpu.serving.http")
 
@@ -131,7 +141,10 @@ class HttpGateway:
                  enable_tenants: bool = False,
                  tenants_token: Optional[str] = None,
                  fleet_migrate: Optional[Callable] = None,
-                 migrate_token: Optional[str] = None):
+                 migrate_token: Optional[str] = None,
+                 fleet_status: Optional[Callable[[], dict]] = None,
+                 fleet_trace: Optional[Callable] = None,
+                 fleet_events: Optional[Callable] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -191,11 +204,20 @@ class HttpGateway:
                     limit = int(raw) if raw is not None else None
                     scale = float(q.get("window_scale", ["1.0"])[0])
                     ov = gateway.policy_set(key, limit, window_scale=scale)
+                    _events.emit("policy", "set-override", actor="http",
+                                 payload={"key_hash": _key_token(key),
+                                          "limit": int(ov.limit),
+                                          "window_scale":
+                                              float(ov.window_scale)})
                     self._send(200, {"ok": True, "key": key,
                                      "limit": int(ov.limit),
                                      "window_scale": float(ov.window_scale)})
                 elif self.command == "DELETE":
                     deleted = bool(gateway.policy_delete(key))
+                    _events.emit("policy", "delete-override",
+                                 actor="http",
+                                 payload={"key_hash": _key_token(key),
+                                          "deleted": deleted})
                     self._send(200, {"ok": True, "key": key,
                                      "deleted": deleted})
                 else:
@@ -227,9 +249,12 @@ class HttpGateway:
                     if not name:
                         self._send(400, {"error": "missing name"})
                         return
+                    deleted = bool(hier.delete_tenant(name))
+                    _events.emit("tenant", "delete", actor="http",
+                                 payload={"name": name,
+                                          "deleted": deleted})
                     self._send(200, {"ok": True, "name": name,
-                                     "deleted": bool(
-                                         hier.delete_tenant(name))})
+                                     "deleted": deleted})
                     return
                 if self.command not in ("POST", "PUT"):
                     self._send(405, {"error": f"method {self.command} not "
@@ -242,17 +267,26 @@ class HttpGateway:
                         self._send(400, {"error": "assign needs tenant"})
                         return
                     hier.assign_tenant(key, tenant)
+                    _events.emit("tenant", "assign", actor="http",
+                                 payload={"key_hash": _key_token(key),
+                                          "tenant": tenant})
                     self._send(200, {"ok": True, "key": key,
                                      "tenant": tenant})
                 elif "unassign" in q:
                     key = q["unassign"][0]
+                    unassigned = bool(hier.unassign_tenant(key))
+                    _events.emit("tenant", "unassign", actor="http",
+                                 payload={"key_hash": _key_token(key),
+                                          "unassigned": unassigned})
                     self._send(200, {"ok": True, "key": key,
-                                     "unassigned": bool(
-                                         hier.unassign_tenant(key))})
+                                     "unassigned": unassigned})
                 elif "global_limit" in q:
                     raw = q["global_limit"][0]
                     lim = int(raw) if raw else None
                     hier.set_global_limit(lim or None)
+                    _events.emit("tenant", "set-global-limit",
+                                 actor="http",
+                                 payload={"global_limit": lim or 0})
                     self._send(200, {"ok": True, "global_limit": lim or 0})
                 elif "effective" in q:
                     scope = q["effective"][0]
@@ -261,6 +295,9 @@ class HttpGateway:
                         self._send(400, {"error": "effective needs limit"})
                         return
                     new = hier.set_effective(scope, int(raw))
+                    _events.emit("tenant", "set-effective", actor="http",
+                                 payload={"scope": scope,
+                                          "effective": int(new)})
                     self._send(200, {"ok": True, "scope": scope,
                                      "effective": int(new)})
                 else:
@@ -277,6 +314,11 @@ class HttpGateway:
                     floor = int(rawf) if rawf is not None else None
                     t = hier.set_tenant(name, limit, weight=weight,
                                         floor=floor)
+                    _events.emit("tenant", "set", actor="http",
+                                 payload={"name": name,
+                                          "limit": int(t.limit),
+                                          "weight": int(t.weight),
+                                          "floor": int(t.floor)})
                     self._send(200, {"ok": True, "name": name,
                                      "tid": int(t.tid),
                                      "limit": int(t.limit),
@@ -322,18 +364,40 @@ class HttpGateway:
                 out = gateway.fleet_migrate(ranges, to, wait)
                 self._send(200 if out.get("ok") else 504, out)
 
-            def _handle_debug_trace(self) -> None:
+            def _bearer_value(self) -> Optional[str]:
+                """The caller's bearer token (pass-through credential
+                for fleet fan-outs — debug tokens are assumed
+                fleet-uniform, so the tower forwards the SAME header to
+                peers and never stores one)."""
+                auth = self.headers.get("Authorization", "")
+                return auth[7:] if auth.startswith("Bearer ") else None
+
+            def _handle_debug_trace(self, q) -> None:
                 """Flight-recorder dump as Perfetto/Chrome-trace JSON
                 (ADR-014). A trace exposes keys' traffic timing and
                 thread structure, so the trust boundary is the same as
                 /v1/policy: disabled unless the embedding opted in,
-                bearer token in the header only."""
+                bearer token in the header only. ``?fleet=1`` on a
+                fleet member answers ONE offset-aligned timeline over
+                every member's span rings (ADR-021), the caller's
+                bearer passed through to the peers."""
                 if not gateway.enable_debug:
                     self._send(403, {"error": "debug endpoints are "
                                      "disabled on this gateway"})
                     return
                 if not self._bearer_ok(gateway.debug_token):
                     self._send(403, {"error": "bad debug token"})
+                    return
+                if q.get("fleet", ["0"])[0] not in ("", "0", "false"):
+                    if gateway.fleet_trace is None:
+                        self._send(400, {"error": "fleet trace "
+                                         "stitching needs a fleet "
+                                         "member (--fleet-config) with "
+                                         "http ports in the map"})
+                        return
+                    payload = gateway.fleet_trace(self._bearer_value())
+                    payload["enabled"] = True
+                    self._send(200, payload)
                     return
                 rec = tracing.RECORDER
                 if rec is None:
@@ -345,6 +409,54 @@ class HttpGateway:
                 payload = rec.chrome_trace()
                 payload["enabled"] = True
                 self._send(200, payload)
+
+            def _handle_debug_events(self, q) -> None:
+                """Control-plane event journal (ADR-021): cursor-
+                paginated (``?after=SEQ&limit=N[&category=C]``), tail
+                form (``?tail=N``), and the fleet merge (``?fleet=1``,
+                aligned on the membership clock offsets). Same trust
+                boundary as /debug/trace: events name tenants, ranges,
+                and controller decisions."""
+                if not gateway.enable_debug:
+                    self._send(403, {"error": "debug endpoints are "
+                                     "disabled on this gateway"})
+                    return
+                if not self._bearer_ok(gateway.debug_token):
+                    self._send(403, {"error": "bad debug token"})
+                    return
+                from ratelimiter_tpu.observability import events as ev
+
+                category = q.get("category", [None])[0] or None
+                try:
+                    limit = int(q.get("limit", ["256"])[0])
+                    after = int(q.get("after", ["0"])[0])
+                    tail = int(q.get("tail", ["0"])[0])
+                except ValueError:
+                    self._send(400, {"error": "after/limit/tail must "
+                                     "be integers"})
+                    return
+                if q.get("fleet", ["0"])[0] not in ("", "0", "false"):
+                    if gateway.fleet_events is None:
+                        self._send(400, {"error": "fleet event merge "
+                                         "needs a fleet member "
+                                         "(--fleet-config) with http "
+                                         "ports in the map"})
+                        return
+                    self._send(200, gateway.fleet_events(
+                        limit=(tail or limit), category=category,
+                        bearer=self._bearer_value()))
+                    return
+                j = ev.JOURNAL
+                if j is None:
+                    self._send(200, {"enabled": False, "events": [],
+                                     "hint": "the event journal is "
+                                     "disabled (--no-event-journal?)"})
+                    return
+                if tail:
+                    self._send(200, j.tail(tail, category=category))
+                else:
+                    self._send(200, j.read(after=after, limit=limit,
+                                           category=category))
 
             def _handle_debug_profile(self, q) -> None:
                 """On-demand ``jax.profiler`` capture
@@ -519,6 +631,9 @@ class HttpGateway:
                             self._send(400, {"error": "missing key"})
                             return
                         gateway.reset(key)
+                        _events.emit("policy", "reset", actor="http",
+                                     payload={"key_hash":
+                                              _key_token(key)})
                         self._send(200, {"ok": True})
                     elif url.path == "/v1/policy":
                         self._handle_policy(q)
@@ -546,11 +661,25 @@ class HttpGateway:
                             "duration_s": float(entry.get("duration_s",
                                                           0.0))})
                     elif url.path == "/debug/trace":
-                        self._handle_debug_trace()
+                        self._handle_debug_trace(q)
                     elif url.path == "/debug/profile":
                         self._handle_debug_profile(q)
                     elif url.path == "/debug/audit":
                         self._handle_debug_audit()
+                    elif url.path == "/debug/events":
+                        self._handle_debug_events(q)
+                    elif url.path == "/v1/fleet/status":
+                        # Read-only fleet rollup (ADR-021): merged
+                        # audit/consumer/SLO/hierarchy blocks over every
+                        # member's /healthz — same exposure class as
+                        # /healthz itself (no mutation lever).
+                        if gateway.fleet_status is None:
+                            self._send(404, {"error": "not a fleet "
+                                             "member (--fleet-config "
+                                             "with http ports in the "
+                                             "map)"})
+                        else:
+                            self._send(200, gateway.fleet_status())
                     elif url.path == "/healthz":
                         self._send(200, gateway.health())
                     elif url.path == "/metrics":
@@ -625,6 +754,11 @@ class HttpGateway:
         # both required — _handle_migrate refuses otherwise.
         self.fleet_migrate = fleet_migrate
         self.migrate_token = migrate_token
+        # Fleet control tower (ADR-021): rollup / trace-stitch / event
+        # fan-out callables, wired only on fleet members.
+        self.fleet_status = fleet_status
+        self.fleet_trace = fleet_trace
+        self.fleet_events = fleet_events
         self._profile_lock = threading.Lock()
         self._decide_trace = _accepts_trace(decide)
         self._decide_deadline = _accepts_kw(decide, "deadline")

@@ -27,6 +27,7 @@ from functools import partial
 from typing import Optional
 
 from ratelimiter_tpu.algorithms.base import RateLimiter
+from ratelimiter_tpu.observability import events
 from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
@@ -336,6 +337,8 @@ class RateLimitServer:
         override, GET reads it, DEL returns the key to the default tier.
         All answer T_POLICY_R. Rare control-plane frames — off the event
         loop like reset (the mutation takes the limiter lock)."""
+        from ratelimiter_tpu.ops.hashing import key_token as _key_token
+
         loop = asyncio.get_running_loop()
         try:
             if type_ == p.T_POLICY_SET:
@@ -343,6 +346,11 @@ class RateLimitServer:
                 ov = await loop.run_in_executor(
                     None, lambda: self.limiter.set_override(
                         key, limit, window_scale=scale))
+                events.emit("policy", "set-override", actor="binary",
+                            payload={"key_hash": _key_token(key),
+                                     "limit": int(ov.limit),
+                                     "window_scale":
+                                         float(ov.window_scale)})
                 return p.encode_policy_r(req_id, True, ov.limit,
                                          ov.window_scale)
             if type_ == p.T_POLICY_GET:
@@ -356,6 +364,9 @@ class RateLimitServer:
             key = p.parse_reset(body)
             existed = await loop.run_in_executor(
                 None, self.limiter.delete_override, key)
+            events.emit("policy", "delete-override", actor="binary",
+                        payload={"key_hash": _key_token(key),
+                                 "deleted": bool(existed)})
             return p.encode_policy_r(req_id, bool(existed),
                                      self.limiter.config.limit, 1.0)
         except Exception as exc:
@@ -371,6 +382,10 @@ class RateLimitServer:
                     # Off the event loop: reset takes the limiter lock.
                     await asyncio.get_running_loop().run_in_executor(
                         None, self.limiter.reset, key)
+                    from ratelimiter_tpu.ops.hashing import key_token
+
+                    events.emit("policy", "reset", actor="binary",
+                                payload={"key_hash": key_token(key)})
                     out = p.encode_ok(req_id)
                 except Exception as exc:
                     out = p.encode_error(req_id, p.code_for(exc), str(exc))

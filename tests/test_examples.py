@@ -11,10 +11,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: Examples that spawn server subprocesses (each pays a full JAX boot
 #: per process) — slow lane to respect the 870 s tier-1 budget; their
 #: CI lanes run them explicitly (ci.yml: 11/12 ride the mesh lane, 15
-#: the fleet lane, 16 the resharding lane).
+#: the fleet lane, 16 the resharding lane, 18 the fleet-observability
+#: lane).
 SLOW_EXAMPLES = {"11_mesh_serving.py", "12_mixed_traffic.py",
                  "13_tracing.py", "14_accuracy_observatory.py",
-                 "15_fleet.py", "16_elastic.py"}
+                 "15_fleet.py", "16_elastic.py",
+                 "18_control_tower.py"}
 EXAMPLES = sorted(
     f for f in os.listdir(os.path.join(REPO, "examples"))
     if f.endswith(".py"))
