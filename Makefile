@@ -8,9 +8,10 @@ export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
         test-audit test-fleet test-fleet-forward test-fleet-obs \
-        test-reshard test-hierarchy lint check native bench bench-quick \
-        bench-audit bench-chaos bench-fleet bench-fleet-obs \
-        bench-reshard bench-hierarchy bench-matrix serve verify clean
+        test-reshard test-hierarchy test-leases lint check native \
+        bench bench-quick bench-audit bench-chaos bench-fleet \
+        bench-fleet-obs bench-reshard bench-hierarchy bench-leases \
+        bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -57,6 +58,9 @@ test-hierarchy:  ## hierarchical cascades + AIMD (ADR-020): oracle pinning, fair
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) -m pytest tests/test_hierarchy.py tests/test_hierarchy_serving.py -q
 
+test-leases:     ## client-embedded quota leases (ADR-022): protocol, debit-upfront oracle, revocation chaos, kill -9, both doors, fleet
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_leases.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
@@ -74,6 +78,9 @@ bench-chaos:     ## degraded-serving numbers (retention/entry/recovery JSON)
 
 bench-hierarchy: ## cascade overhead ratio + abuse-scenario numbers (tighten/recover timeline JSON, ADR-020)
 	JAX_PLATFORMS=cpu $(PY) bench.py --hierarchy
+
+bench-leases:    ## client-embedded lease numbers (leased vs wire rate, storm bound, Wilson delta, LEASE_r01 JSON, ADR-022)
+	JAX_PLATFORMS=cpu $(PY) bench.py --leases
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -1087,6 +1087,17 @@ def main() -> None:
                          "scraped mid-run vs everything off), best "
                          "paired retention ratio; bar >= 0.97 "
                          "(published as OBS_r01.json)")
+    ap.add_argument("--leases", action="store_true",
+                    help="run ONLY the client-embedded lease bench "
+                         "(ADR-022) and emit the leases JSON block: "
+                         "client-observed decision rate on hot-key "
+                         "traffic leased vs wire against one real "
+                         "server (bar >= 5x), the never-over-admit "
+                         "oracle through a seeded revocation storm "
+                         "(bit-exact), the observatory's Wilson-"
+                         "bounded false-deny delta leases on vs off, "
+                         "and the leases-off byte-identical pin "
+                         "(published as LEASE_r01.json)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "
@@ -1126,6 +1137,18 @@ def main() -> None:
             "fleet_obs": run_fleet_obs(
                 seconds=float(os.environ.get("BENCH_SECONDS", "4")),
                 pairs=int(os.environ.get("BENCH_OBS_PAIRS", "3")),
+                log=lambda *a: print(*a, file=sys.stderr)),
+        }))
+        return
+
+    if args.leases:
+        from benchmarks.leases import run_leases
+
+        print(json.dumps({
+            "metric": "leases",
+            "platform": jax.devices()[0].platform,
+            "leases": run_leases(
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
                 log=lambda *a: print(*a, file=sys.stderr)),
         }))
         return

@@ -78,6 +78,76 @@ def _spawn_server(backend: str, *, platform: Optional[str] = None,
     return proc, port
 
 
+async def _drive_scalar(port: int, *, seconds: float, conns: int,
+                        inflight: int, n_keys: int, warmup: float = 2.0,
+                        leased: bool = False,
+                        lease_kw: Optional[Dict] = None) -> Dict:
+    """The loadgen's ``leased`` mode (ADR-022) and its wire control.
+
+    Closed-loop SCALAR ``allow()`` on a small zipf-hot keyset — the
+    traffic shape leases exist for (per-key decisions, maximally
+    repeated). ``leased=True`` enables the lease tier on every
+    connection first, so decisions for hot keys are answered by the
+    in-process cache under real concurrency (the maintenance loop
+    renewing budgets while workers spend them); ``leased=False`` is
+    the honest control: same client, same keys, every decision a
+    pipelined wire RTT. The reported rate is CLIENT-OBSERVED either
+    way — what an app embedding the client actually gets."""
+    rng = np.random.default_rng(2)
+    clients = [await AsyncClient.connect(port=port) for _ in range(conns)]
+    caches = []
+    if leased:
+        from ratelimiter_tpu.observability import Registry
+
+        kw = dict(hot_after=2, hot_window=60.0, low_water=0.5)
+        kw.update(lease_kw or {})
+        interval = kw.pop("interval", 0.02)
+        for c in clients:
+            # Own registry per cache: the local-answer counter is
+            # registered by NAME, so DEFAULT-registry caches in one
+            # process would all read the same (summed) series.
+            caches.append(await c.enable_leases(
+                interval=interval, registry=Registry(), **kw))
+    t_measure = time.perf_counter() + warmup
+    stop_at = t_measure + seconds
+    counted = 0
+    total = 0
+
+    async def worker(c: AsyncClient, wid: int):
+        nonlocal counted, total
+        ids = rng.zipf(1.1, size=8192) % n_keys
+        i = wid * 1291
+        while time.perf_counter() < stop_at:
+            for _ in range(256):
+                await c.allow(f"hot:{ids[i % 8192]}")
+                i += 1
+            total += 256
+            if time.perf_counter() >= t_measure:
+                counted += 256
+            # A fully-local burst never yields; give the lease
+            # maintenance loop (and the other workers) the floor.
+            await asyncio.sleep(0)
+
+    await asyncio.gather(*(worker(c, w * conns + k)
+                           for k, c in enumerate(clients)
+                           for w in range(max(1, inflight))))
+    end = time.perf_counter()
+    local = sum(int(lc.status()["local_answers"]) for lc in caches)
+    for c in clients:
+        await c.close()
+    span = max(end - t_measure, 1e-9)
+    return {
+        "mode": "leased" if leased else "wire",
+        "decisions_per_sec": round(counted / span, 1),
+        "completed": counted,
+        "local_answers": local,
+        "local_fraction": round(local / total, 4) if total else None,
+        "connections": conns,
+        "workers_per_conn": max(1, inflight),
+        "hot_keys": n_keys,
+    }
+
+
 async def _drive(port: int, *, seconds: float, conns: int, window: int,
                  n_keys: int, warmup: float = 2.0,
                  trace_sample: int = 0) -> Dict:

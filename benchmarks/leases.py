@@ -1,0 +1,366 @@
+"""Client-embedded quota lease bench (ADR-022) — LEASE_r01.json.
+
+Four phases, each answering one acceptance question:
+
+1. **rate** — client-observed decision rate on lease-eligible hot-key
+   traffic, leased vs wire, against ONE real server process through
+   the real asyncio door (the loadgen's ``leased`` mode,
+   ``benchmarks.e2e._drive_scalar``). The wire side is the honest
+   control: same client, same keys, pipelined scalar RTTs. Bar: ≥ 5×.
+2. **storm** — the never-over-admit oracle through a seeded revocation
+   storm: local spends, wire decisions, revocations with lost pushes,
+   kill -9-flavoured abandons, TTL expiries — then every key is
+   exhausted and client-observed admissions are checked against the
+   frozen-window limit BIT-EXACTLY. This is the structural claim
+   (debit-upfront) measured, not argued.
+3. **accuracy** — the ADR-016 observatory prices the lease tier: the
+   same zipf workload through an undersized sketch with the shadow
+   oracle auditing 1/1, leases off vs on (leased spend reaches the
+   oracle through the manager's renew/return mirror). Reported as
+   false-deny rates with Wilson 95% bounds and their delta.
+4. **off_pin** — leases disabled = byte-identical decision stream
+   (an idle LeaseManager attached vs a plain limiter, full Result
+   equality on a seeded workload).
+
+Published via ``bench.py --leases``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from benchmarks.e2e import _drive_scalar, _spawn_server
+
+#: Phase-1 server shape: exact backend (bit-exact ledger), a window
+#: too big to refill mid-run, budgets sized so renew top-ups keep the
+#: local counters full under a multi-worker spend rate.
+_RATE_SERVER_ARGS = [
+    "--limit", "2000000000", "--window", "600",
+    "--leases", "--lease-ttl", "5",
+    "--lease-budget", "2000000", "--lease-max", "4096",
+]
+
+
+class _FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+class _Res:
+    """Scalar Result shim for the audit tap (one decision per offer)."""
+
+    __slots__ = ("allowed", "fail_open", "fail_open_slices")
+
+    def __init__(self, allowed: bool):
+        self.allowed = np.asarray([bool(allowed)])
+        self.fail_open = False
+        self.fail_open_slices = None
+
+    def __len__(self) -> int:
+        return 1
+
+
+def _mk_limiter(limit: int, *, backend: str = "exact",
+                sketch_width: Optional[int] = None):
+    from ratelimiter_tpu import (
+        Algorithm,
+        Config,
+        ManualClock,
+        SketchParams,
+        create_limiter,
+    )
+
+    kw = {}
+    if sketch_width is not None:
+        kw["sketch"] = SketchParams(depth=2, width=sketch_width,
+                                    sub_windows=6,
+                                    conservative_update=True)
+    cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=limit,
+                 window=60.0, key_prefix="", **kw)
+    return create_limiter(cfg, backend=backend,
+                          clock=ManualClock(1_700_000_000.0)), cfg
+
+
+# ------------------------------------------------------------- phase 1
+
+def _run_rate(*, seconds: float, warmup: float, conns: int,
+              inflight: int, hot_keys: int, log) -> Dict:
+    proc, port = _spawn_server("exact", platform="cpu",
+                               extra_args=_RATE_SERVER_ARGS)
+    try:
+        wire = asyncio.run(_drive_scalar(
+            port, seconds=seconds, conns=conns, inflight=inflight,
+            n_keys=hot_keys, warmup=warmup, leased=False))
+        log(f"leases rate: wire {wire['decisions_per_sec']:,.0f}/s")
+        leased = asyncio.run(_drive_scalar(
+            port, seconds=seconds, conns=conns, inflight=inflight,
+            n_keys=hot_keys, warmup=warmup, leased=True,
+            lease_kw={"want": 2_000_000}))
+        log(f"leases rate: leased {leased['decisions_per_sec']:,.0f}/s "
+            f"(local fraction {leased['local_fraction']})")
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+    speedup = (leased["decisions_per_sec"] / wire["decisions_per_sec"]
+               if wire["decisions_per_sec"] else None)
+    return {
+        "harness": ("one exact-backend serving process, asyncio door; "
+                    "closed-loop scalar allow() on a zipf-hot keyset, "
+                    f"{conns} conns x {max(1, inflight)} workers; "
+                    "wire control vs enable_leases() on the same "
+                    "client (loadgen leased mode)"),
+        "wire": wire,
+        "leased": leased,
+        "speedup": round(speedup, 2) if speedup else None,
+        "bar": 5.0,
+        "pass": bool(speedup and speedup >= 5.0),
+    }
+
+
+# ------------------------------------------------------------- phase 2
+
+def _run_storm(*, steps: int, log) -> Dict:
+    """Seeded storm; the client-observed admission count per key must
+    never exceed the frozen-window limit, bit-exactly."""
+    import random
+
+    from ratelimiter_tpu.leases import LeaseCache, LeaseManager
+    from ratelimiter_tpu.observability import Registry
+    from ratelimiter_tpu.serving import protocol as p
+
+    LIMIT, BUDGET = 500, 48
+    lim, _cfg = _mk_limiter(LIMIT)
+    clk = _FakeClock()
+    mgr = LeaseManager(lim, ttl=2.0, default_budget=BUDGET,
+                       registry=Registry(), clock=clk)
+    cache = LeaseCache(hot_after=2, hot_window=1e9, low_water=0.25,
+                       registry=Registry(), clock=clk)
+    rng = random.Random(1234)
+    keys = [f"storm:{i}" for i in range(3)]
+    admitted = {k: 0 for k in keys}
+    lost_pushes = delivered_pushes = revocations = abandons = 0
+
+    def push(frame: bytes) -> None:
+        nonlocal lost_pushes, delivered_pushes
+        if rng.random() < 0.5:           # chaos: the push never lands
+            lost_pushes += 1
+            return
+        delivered_pushes += 1
+        _reason, _epoch, ids = p.parse_lease_revoke(
+            frame[p.HEADER_SIZE:])
+        cache.invalidate_ids(ids)
+
+    def pump() -> None:
+        for act in cache.actions():
+            if act[0] == "grant":
+                _, key, want = act
+                cache.on_grant(key, *mgr.grant(cache.client_id, key,
+                                               want, push=push))
+            else:
+                _, key, lease_id, delta, top_up = act
+                granted, _lid, top, ttl_s, limit, epoch = mgr.renew(
+                    cache.client_id, lease_id, key, delta, top_up)
+                cache.on_renew(lease_id, granted, top, ttl_s, limit,
+                               epoch)
+
+    for step in range(steps):
+        key = rng.choice(keys)
+        r = cache.try_acquire(key, 1)
+        if r is not None:
+            admitted[key] += 1           # local, memory-speed
+        else:
+            res = lim.allow_n(key, 1)    # wire path
+            cache.note_wire(key)
+            if res.allowed:
+                admitted[key] += 1
+        if step % 5 == 4:
+            pump()
+        if rng.random() < 0.01:          # revocation storm tick
+            revocations += 1
+            mgr.revoke_key(rng.choice(keys), p.LEASE_REV_MANUAL)
+        if rng.random() < 0.005:         # kill -9-flavoured abandon:
+            abandons += 1                # local leases dropped, no
+            cache.invalidate_ids([])     # return frames ever sent
+        if rng.random() < 0.02:
+            clk.advance(rng.uniform(0.1, 1.5))
+
+    # Exhaust every key on the wire: the TOTAL a client observed can
+    # never pass the limit — and must end exactly exhausted.
+    for key in keys:
+        guard = 0
+        while lim.allow_n(key, 1).allowed:
+            admitted[key] += 1
+            guard += 1
+            assert guard <= LIMIT, "runaway exhaust loop"
+        assert not lim.allow_n(key, 1).allowed
+    worst = max(admitted.values())
+    holds = all(v <= LIMIT for v in admitted.values())
+    log(f"leases storm: worst admitted {worst}/{LIMIT}, "
+        f"{revocations} revocations ({lost_pushes} pushes lost), "
+        f"{abandons} abandons -> bound_holds={holds}")
+    mgr.close()
+    lim.close()
+    return {
+        "harness": (f"{steps}-step seeded storm, 3 keys, frozen "
+                    "window: local spends + wire decisions + "
+                    "revocations with 50% lost pushes + abandoned "
+                    "holders + TTL expiry, then full wire exhaust"),
+        "limit": LIMIT,
+        "admitted_per_key": admitted,
+        "worst_admitted": worst,
+        "revocations": revocations,
+        "pushes_lost": lost_pushes,
+        "pushes_delivered": delivered_pushes,
+        "abandons": abandons,
+        "never_over_admit": holds,
+        "pass": holds,
+    }
+
+
+# ------------------------------------------------------------- phase 3
+
+def _run_accuracy(*, n_requests: int, n_keys: int, log) -> Dict:
+    """The observatory prices leasing: same seeded zipf workload, same
+    undersized sketch geometry, audit sample 1/1 — leases off vs on."""
+    from ratelimiter_tpu.leases import LeaseCache, LeaseManager
+    from ratelimiter_tpu.observability import Registry, audit
+
+    LIMIT = 60
+    rng = np.random.default_rng(7)
+    ids = rng.zipf(1.2, size=n_requests) % n_keys
+
+    def run_side(leased: bool) -> Dict:
+        lim, cfg = _mk_limiter(LIMIT, backend="sketch",
+                               sketch_width=256)
+        aud = audit.enable(cfg, sample=1, start=False,
+                           registry=Registry())
+        mgr = cache = None
+        clk = _FakeClock()
+        if leased:
+            mgr = LeaseManager(lim, ttl=1e6, default_budget=LIMIT // 3,
+                               registry=Registry(), clock=clk)
+            cache = LeaseCache(hot_after=4, hot_window=1e9,
+                               low_water=0.25, registry=Registry(),
+                               clock=clk)
+        try:
+            for step, i in enumerate(ids):
+                key = f"acc:{i}"
+                if cache is not None:
+                    r = cache.try_acquire(key, 1)
+                    if r is not None:
+                        continue        # mirrored at renew/return
+                res = lim.allow_n(key, 1)
+                aud.offer_keys([key], np.asarray([1], dtype=np.int64),
+                               clk(), _Res(res.allowed))
+                if step % 256 == 255:
+                    # Keep the tap's bounded queue drained (no worker
+                    # thread in this harness) so the sample is the
+                    # workload, not the queue capacity.
+                    aud.process_pending()
+                if cache is not None:
+                    cache.note_wire(key)
+                    if step % 16 == 15:
+                        for act in cache.actions():
+                            if act[0] == "grant":
+                                _, k, want = act
+                                cache.on_grant(k, *mgr.grant(
+                                    cache.client_id, k, want))
+                            else:
+                                _, k, lid, delta, top = act
+                                ok, _l, tu, ts, lm, ep = mgr.renew(
+                                    cache.client_id, lid, k, delta,
+                                    top)
+                                cache.on_renew(lid, ok, tu, ts, lm,
+                                               ep)
+            if cache is not None:
+                for _, k, lid, delta in cache.drain():
+                    mgr.release(cache.client_id, lid, k, delta)
+            aud.process_pending()
+            st = aud.status()
+            return {
+                "samples": st["samples"],
+                "false_deny_rate": st["false_deny_rate"],
+                "false_deny_wilson95": st["false_deny_wilson95"],
+                "false_allow_rate": st["false_allow_rate"],
+            }
+        finally:
+            audit.disable()
+            if mgr is not None:
+                mgr.close()
+            lim.close()
+
+    off = run_side(False)
+    on = run_side(True)
+    delta = round(on["false_deny_rate"] - off["false_deny_rate"], 8)
+    log(f"leases accuracy: false-deny off={off['false_deny_rate']} "
+        f"on={on['false_deny_rate']} delta={delta}")
+    return {
+        "harness": (f"{n_requests} zipf(1.2) decisions over {n_keys} "
+                    "keys, undersized d=2 w=256 sketch, shadow oracle "
+                    "auditing 1/1; leased side mirrors spend through "
+                    "the manager's renew/return reconcile"),
+        "leases_off": off,
+        "leases_on": on,
+        "false_deny_delta": delta,
+    }
+
+
+# ------------------------------------------------------------- phase 4
+
+def _run_off_pin(*, n_requests: int, log) -> Dict:
+    """Leases not enabled == byte-identical decisions."""
+    from ratelimiter_tpu.leases import LeaseManager
+    from ratelimiter_tpu.observability import Registry
+
+    rng = np.random.default_rng(11)
+    ops: List[tuple] = [(f"pin:{rng.integers(0, 40)}",
+                         int(rng.integers(1, 4)))
+                        for _ in range(n_requests)]
+    lim_plain, _ = _mk_limiter(200)
+    lim_mgr, _ = _mk_limiter(200)
+    mgr = LeaseManager(lim_mgr, registry=Registry())  # attached, idle
+    identical = True
+    for key, n in ops:
+        a = lim_plain.allow_n(key, n)
+        b = lim_mgr.allow_n(key, n)
+        if (a.allowed != b.allowed or a.remaining != b.remaining
+                or a.limit != b.limit):
+            identical = False
+            break
+    mgr.close()
+    lim_plain.close()
+    lim_mgr.close()
+    log(f"leases off-pin: identical={identical} over "
+        f"{n_requests} ops")
+    return {"requests": n_requests, "identical": identical,
+            "pass": identical}
+
+
+def run_leases(*, seconds: float = 4.0, warmup: float = 1.5,
+               conns: int = 4, inflight: int = 8, hot_keys: int = 16,
+               storm_steps: int = 4000, log=print) -> Dict:
+    """The LEASE_r01 block."""
+    out: Dict = {
+        "rate": _run_rate(seconds=seconds, warmup=warmup, conns=conns,
+                          inflight=inflight, hot_keys=hot_keys,
+                          log=log),
+        "storm": _run_storm(steps=storm_steps, log=log),
+        "accuracy": _run_accuracy(n_requests=12_000, n_keys=600,
+                                  log=log),
+        "off_pin": _run_off_pin(n_requests=600, log=log),
+    }
+    out["pass"] = bool(out["rate"]["pass"] and out["storm"]["pass"]
+                       and out["off_pin"]["pass"])
+    return out

@@ -1,0 +1,127 @@
+"""Client-embedded quota leases: hot-key decisions at memory speed
+(ADR-022).
+
+Every decision the serving tier makes normally costs a wire RTT. The
+lease tier moves the hottest keys off the wire entirely: the server
+debits a bounded token budget from the limiter UPFRONT and hands it to
+the client, whose ``allow``/``allow_n`` then answer leased keys from an
+in-process counter — nanoseconds, no socket. Safety is structural:
+because the whole budget was charged through the real decide path
+before the first local answer, no client behaviour (crash, partition,
+lost revocation) can push global admissions past the limit; the worst
+case is unused budget reading as consumed. This example shows the full
+loop on one asyncio-door server:
+
+1. a hot key crosses the client's hotness threshold and gets leased;
+2. local answers vs wire answers, timed side by side;
+3. a policy override tightens the key → the server pushes a
+   revocation and the cache drops the lease mid-flight;
+4. the server-side lease metric families on the registry.
+
+Run on any host:
+
+    JAX_PLATFORMS=cpu python examples/19_leases.py
+
+The served form (the flags live on the real binary too):
+
+    python -m ratelimiter_tpu.serving --backend sketch --leases \
+        --lease-ttl 2 --lease-budget 256
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import asyncio
+import time
+
+from ratelimiter_tpu import Algorithm, Config, ManualClock, create_limiter
+from ratelimiter_tpu.leases import LeaseManager
+from ratelimiter_tpu.observability import Registry
+from ratelimiter_tpu.serving import AsyncClient, RateLimitServer
+
+T0 = 1_700_000_000.0
+
+
+async def main() -> None:
+    # Exact backend, frozen window: admissions are bit-exact, so the
+    # debit-upfront arithmetic below is visible in the numbers.
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=500_000,
+                 window=60.0, key_prefix="")
+    lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+    reg = Registry()
+    mgr = LeaseManager(lim, ttl=2.0, default_budget=50_000, registry=reg)
+    server = RateLimitServer(lim, "127.0.0.1", 0, leases=mgr)
+    await server.start()
+
+    client = await AsyncClient.connect(server.host, server.port)
+    cache = await client.enable_leases(interval=0.02, hot_after=4,
+                                       hot_window=5.0, low_water=0.5)
+
+    # --- 1. heat the key: a few wire decisions trip the hotness
+    # detector, the background maintenance grants a lease.
+    for _ in range(6):
+        await client.allow("user:hot")
+    for _ in range(200):
+        if cache.status()["leased_keys"]:
+            break
+        await asyncio.sleep(0.02)
+    assert cache.status()["leased_keys"] == 1, cache.status()
+    print("== lease granted ==")
+    print(f"  server: {mgr.status()['active']} active, "
+          f"{int(mgr.status()['granted_total'])} granted")
+
+    # --- 2. memory-speed vs wire, same client, same key.
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        await client.allow("user:hot")          # local: lease cache
+    t_local = time.perf_counter() - t0
+    # Rotate over 1000 cold keys: 2 visits each stays under hot_after,
+    # so this loop never trips a lease — every decision is a real RTT.
+    for i in range(1000):
+        await client.allow(f"cold:{i}")         # warm the key table
+    t0 = time.perf_counter()
+    for i in range(n):
+        await client.allow(f"cold:{i % 1000}")  # wire: full RTT
+    t_wire = time.perf_counter() - t0
+    st = cache.status()
+    print("== decision cost, same client ==")
+    print(f"  leased  : {n / t_local:,.0f}/s "
+          f"({t_local / n * 1e6:.2f} us/decision)")
+    print(f"  wire    : {n / t_wire:,.0f}/s "
+          f"({t_wire / n * 1e6:.2f} us/decision)")
+    print(f"  local answers so far: {st['local_answers']}")
+    assert st["local_answers"] >= n
+
+    # --- 3. a policy change must not leave stale budgets answering:
+    # the override handler revokes the key's leases with a push frame.
+    await client.set_override("user:hot", limit=10)
+    for _ in range(200):
+        if not cache.status()["leased_keys"]:
+            break
+        await asyncio.sleep(0.02)
+    assert cache.status()["leased_keys"] == 0, cache.status()
+    r = await client.allow("user:hot")          # back on the wire
+    print("== revocation push (policy override limit=10) ==")
+    print(f"  cache leases after push: {cache.status()['leased_keys']}")
+    print(f"  wire decision under new limit: allowed={r.allowed}")
+
+    # --- 4. the observable trail.
+    print("== server lease families (/metrics) ==")
+    for line in reg.render().splitlines():
+        if line.startswith("rate_limiter_lease") and " " in line \
+                and not line.startswith("# HELP"):
+            print(" ", line)
+
+    await client.close()
+    await server.shutdown()
+    lim.close()
+    print("OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -105,6 +105,7 @@ class AIMDController:
                  interval: float = 1.0,
                  gains: Optional[AIMDGains] = None,
                  publish: Optional[Callable[[dict], None]] = None,
+                 on_tighten: Optional[Callable[[str], None]] = None,
                  registry=None):
         from ratelimiter_tpu.observability import metrics as m
 
@@ -114,6 +115,11 @@ class AIMDController:
         self.interval = float(interval)
         self.gains = gains or AIMDGains()
         self.publish = publish
+        #: Called with the scope name after each successful tighten —
+        #: the lease-revocation seam (ADR-022): leased budget granted
+        #: under the old effective limit must not keep spending at the
+        #: old rate once the controller squeezes the scope.
+        self.on_tighten = on_tighten
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_tighten: Dict[str, float] = {}
@@ -239,6 +245,12 @@ class AIMDController:
                                  tenants[scope]["in_window"]
                                  if scope in tenants else g_mass),
                              **snapshot})
+                if self.on_tighten is not None:
+                    try:
+                        self.on_tighten(scope)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        log.exception(
+                            "controller: on_tighten hook failed")
 
         if (pressure or (saturated and hot)) and fd_hi > g.false_deny_veto:
             # Vetoed tighten: the limiter is already over-denying with

@@ -1,0 +1,25 @@
+"""Client-embedded quota leases (ADR-022).
+
+A lease moves a bounded token budget for ONE hot key into a client
+process, so that client answers ``allow``/``allow_n`` for the key from
+an in-process counter — no wire round trip — while the server keeps the
+global bound by debiting the whole budget from the key's live window
+UPFRONT. The tier spans:
+
+* :class:`LeaseManager` — server side: nominates hot keys from the hh
+  side table, grants/renews/revokes, mirrors leased consumption into
+  the ADR-016 audit tap, journals lease events (ADR-021), and snapshots
+  its grant table so it rides checkpoints.
+* :class:`LeaseCache` — client side: per-key token counters, local
+  hot-key detection, and a background maintenance channel that grants,
+  renews and returns asynchronously (never on the decision path).
+* :class:`LeaseListener` — a small asyncio sidecar listener serving
+  only the lease control frames, for the native C++ front door (whose
+  decision fast path knows nothing of leases).
+"""
+
+from ratelimiter_tpu.leases.cache import LeaseCache, LeasedKey
+from ratelimiter_tpu.leases.listener import LeaseListener
+from ratelimiter_tpu.leases.manager import LeaseManager
+
+__all__ = ["LeaseCache", "LeasedKey", "LeaseListener", "LeaseManager"]

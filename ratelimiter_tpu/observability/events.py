@@ -60,6 +60,7 @@ CATEGORIES = (
     "membership",   # peer liveness transitions
     "policy",       # per-key override + reset mutations
     "tenant",       # tenant registry / assignment / effective-limit moves
+    "lease",        # client-embedded quota leases: grant/return/revoke/expire
 )
 
 

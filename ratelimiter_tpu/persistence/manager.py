@@ -166,6 +166,33 @@ class PersistenceManager:
         assert self.snapshotter is not None, "attach() first"
         self.snapshotter.remove_aux(origin)
 
+    def add_sidecar(self, name: str, obj) -> None:
+        """Ride a non-limiter object on the snapshot cycle (ADR-022:
+        the lease grant table). ``obj`` duck-types ``snapshot_arrays()
+        -> (arrays, meta)`` / ``restore_arrays(arrays, meta)``."""
+        assert self.snapshotter is not None, "attach() first"
+        self.snapshotter.add_sidecar(name, obj)
+
+    def restore_sidecar(self, name: str, obj) -> bool:
+        """Restore ``obj`` from the newest manifest entry carrying a
+        sidecar of this name; True iff one was found and applied. Run
+        AFTER recover() — the sidecar is consistent with (not ahead of)
+        the snapshot the shards restored from."""
+        from ratelimiter_tpu.persistence.snapshotter import (
+            load_sidecar,
+            read_manifest,
+        )
+
+        manifest = read_manifest(self.dir)
+        if not manifest:
+            return False
+        for entry in reversed(manifest["snapshots"]):
+            got = load_sidecar(self.dir, entry, name)
+            if got is not None:
+                obj.restore_arrays(got[0], got[1])
+                return True
+        return False
+
     def status(self) -> dict:
         out = self.snapshotter.status() if self.snapshotter else {
             "persistence": True, "wal_seq": self.wal.last_seq}

@@ -50,6 +50,27 @@ def _aux_name(snap_id: int, origin: str) -> str:
     return f"aux-{snap_id:08d}-{origin}.npz"
 
 
+def _side_name(snap_id: int, name: str) -> str:
+    return f"side-{snap_id:08d}-{name}.npz"
+
+
+def load_sidecar(dir_: str, entry: dict, name: str):
+    """Read one sidecar's ``(arrays, meta)`` from a manifest entry, or
+    None when the entry has no sidecar of that name (older snapshot, or
+    the subsystem was off when it was taken)."""
+    import numpy as np
+
+    for sc in entry.get("sidecars", []):
+        if sc.get("name") != name:
+            continue
+        with np.load(os.path.join(dir_, sc["file"])) as z:
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+            meta = (json.loads(bytes(z["__meta__"]).decode("utf-8"))
+                    if "__meta__" in z.files else {})
+        return arrays, meta
+    return None
+
+
 def read_manifest(dir_: str) -> Optional[dict]:
     """The snapshot manifest, or None when the directory has none yet.
     Unparseable content raises CheckpointError: the manifest is written
@@ -126,6 +147,13 @@ class Snapshotter:
         #: recorded in the manifest under ``aux`` so recovery of THIS
         #: host's successor can restore them too.
         self._aux: dict = {}
+        #: Lightweight sidecar objects riding the snapshot cycle
+        #: (ADR-022: the lease grant table). Unlike aux units these are
+        #: NOT limiters — anything exposing ``snapshot_arrays() ->
+        #: (arrays, meta)`` / ``restore_arrays(arrays, meta)`` rides
+        #: along as one ``side-*.npz`` per cycle, recorded in the
+        #: manifest entry under ``sidecars``.
+        self._sidecars: dict = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -177,6 +205,20 @@ class Snapshotter:
     def remove_aux(self, origin: str) -> None:
         with self._lock:
             self._aux.pop(str(origin), None)
+
+    def add_sidecar(self, name: str, obj) -> None:
+        """Register a sidecar (``snapshot_arrays``/``restore_arrays``
+        duck type) so every later cycle captures it alongside the
+        shards. The name lands in filenames — keep it short and
+        path-safe."""
+        if "/" in name or name != name.strip() or not name:
+            raise ValueError(f"bad sidecar name {name!r}")
+        with self._lock:
+            self._sidecars[name] = obj
+
+    def remove_sidecar(self, name: str) -> None:
+        with self._lock:
+            self._sidecars.pop(name, None)
 
     def notify_mutation(self) -> None:
         """Called per WAL append; trips the mutation-count trigger."""
@@ -236,6 +278,13 @@ class Snapshotter:
                 unit_caps[key] = (lim.capture_state(), lim.config,
                                   origin)
             aux_captures.append((origin, entry["ranges"], key))
+        side_captures = []
+        for name, obj in self._sidecars.items():
+            try:
+                side_captures.append((name, obj.snapshot_arrays()))
+            except Exception:  # noqa: BLE001 — a sidecar must never
+                # block the shards' durability
+                log.exception("sidecar %r capture failed; skipping", name)
         capture_s = time.perf_counter() - t0
         # Off-lock from here: serialization + fsync happen while decisions
         # keep flowing.
@@ -257,6 +306,19 @@ class Snapshotter:
         aux_entries = [{"origin": origin, "file": aux_files[key],
                         "ranges": ranges}
                        for origin, ranges, key in aux_captures]
+        side_entries = []
+        for name, (arrays, meta) in side_captures:
+            import io
+
+            import numpy as np
+
+            fname = _side_name(snap_id, name)
+            buf = io.BytesIO()
+            np.savez(buf, __meta__=np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+                **arrays)
+            write_atomic(os.path.join(self.dir, fname), buf.getvalue())
+            side_entries.append({"name": name, "file": fname})
         from ratelimiter_tpu.checkpoint import config_fingerprint
 
         cfg = self.limiters[0].config
@@ -275,6 +337,8 @@ class Snapshotter:
         }
         if aux_entries:
             entry["aux"] = aux_entries
+        if side_entries:
+            entry["sidecars"] = side_entries
         manifest = read_manifest(self.dir) or {
             "format_version": MANIFEST_VERSION, "snapshots": []}
         manifest["snapshots"].append(entry)
@@ -301,9 +365,11 @@ class Snapshotter:
         keep = {name for e in manifest["snapshots"] for name in e["files"]}
         keep |= {a["file"] for e in manifest["snapshots"]
                  for a in e.get("aux", [])}
+        keep |= {s["file"] for e in manifest["snapshots"]
+                 for s in e.get("sidecars", [])}
         try:
             for name in os.listdir(self.dir):
-                if (name.startswith(("snap-", "aux-"))
+                if (name.startswith(("snap-", "aux-", "side-"))
                         and name.endswith(".npz")
                         and name not in keep):
                     try:

@@ -138,6 +138,39 @@ def build_parser() -> argparse.ArgumentParser:
                          "false-deny tighten veto)")
     ap.add_argument("--controller-interval", type=float, default=1.0,
                     help="seconds between AIMD controller ticks")
+    # Client-embedded quota leases (ADR-022).
+    ap.add_argument("--leases", action="store_true",
+                    help="grant client-embedded quota leases (ADR-022): "
+                         "clients holding a lease answer allow/allow_n "
+                         "for that key from a local token budget at "
+                         "memory speed; the budget is debited upfront "
+                         "through the normal decide path, so the global "
+                         "bound fails toward false-denies, never "
+                         "over-admission. Revocations push over the "
+                         "granting connection (and gossip to DCN peers); "
+                         "the lease TTL bounds a holder that lost the "
+                         "push")
+    ap.add_argument("--lease-ttl", type=float, default=2.0,
+                    help="lease lifetime seconds (renewals extend it); "
+                         "ALSO the staleness bound on a partitioned "
+                         "holder that missed its revocation push")
+    ap.add_argument("--lease-budget", type=int, default=256,
+                    help="tokens per grant when the client does not ask "
+                         "for a specific amount")
+    ap.add_argument("--lease-max", type=int, default=4096,
+                    help="active-grant capacity; grants beyond it are "
+                         "refused and clients stay on the wire path")
+    ap.add_argument("--lease-require-hot", action="store_true",
+                    help="only lease keys currently in the heavy-hitter "
+                         "side table's top-k (needs --hh-slots): the "
+                         "hot-key nomination posture — cold keys stay "
+                         "on the wire")
+    ap.add_argument("--lease-port", type=int, default=None,
+                    help="--native only: serve lease frames on this "
+                         "sidecar port (0 = ephemeral, printed in the "
+                         "banner). The C++ front door has no lease "
+                         "lane; the asyncio door serves lease frames "
+                         "on its main port and ignores this flag")
     ap.add_argument("--http-tenants", action="store_true",
                     help="expose tenant management (GET/POST/PUT/DELETE "
                          "/v1/tenants) on the HTTP gateway (OFF by "
@@ -722,6 +755,104 @@ def make_threadsafe_decide_many(batcher, loop):
     return decide_many
 
 
+def _setup_leases(args, *, limiter, decide, fleet_core, pushers, persist):
+    """Lease authority (ADR-022): grants/renewals/returns plus the
+    revocation fan-out. Debits ride ``decide`` — the door's shared
+    dispatch path, so a lease budget is charged exactly like a wire
+    decision (and lands on the owning shard/peer). Revocations gossip
+    over the DCN pushers when the deployment runs them, and the grant
+    table rides the snapshot cycle as a checkpoint sidecar."""
+    if not args.leases:
+        return None
+    from ratelimiter_tpu.leases import LeaseManager
+    from ratelimiter_tpu.observability.decorators import undecorated
+
+    epoch_fn = None
+    owns_fn = None
+    if fleet_core is not None:
+        epoch_fn = lambda: int(fleet_core.map.epoch)  # noqa: E731
+
+        def owns_fn(key: str) -> bool:
+            h = fleet_core.hash_keys([key])
+            return bool(fleet_core.all_local(
+                fleet_core.owners_of_hash(h)))
+
+    mgr = LeaseManager(
+        undecorated(limiter), decide=decide,
+        ttl=args.lease_ttl, default_budget=args.lease_budget,
+        max_leases=args.lease_max,
+        require_hot=args.lease_require_hot,
+        epoch_fn=epoch_fn, owns_fn=owns_fn,
+        gossip=(pushers[0].push_lease if pushers else None),
+        registry=obs_metrics.DEFAULT)
+    if persist is not None:
+        persist.add_sidecar("leases", mgr)
+        if persist.restore_sidecar("leases", mgr):
+            logging.getLogger("ratelimiter_tpu.leases").info(
+                "lease table restored from snapshot sidecar "
+                "(restored grants are tombstone-only: their mass "
+                "stays charged, holders re-grant)")
+    return mgr
+
+
+def _lease_guarded_policy(lease_mgr, set_fn, delete_fn):
+    """Wrap a door's policy callables so an override mutation revokes
+    the key's outstanding leases — a holder must not keep answering
+    locally under the limit the operator just changed. The wrappers
+    preserve the wrapped callables' signatures (gateway and gRPC both
+    call them)."""
+    if lease_mgr is None:
+        return set_fn, delete_fn
+    from ratelimiter_tpu.serving import protocol as p
+
+    def set_(key, limit=None, **kw):
+        ov = set_fn(key, limit, **kw)
+        lease_mgr.revoke_key(key, p.LEASE_REV_POLICY)
+        return ov
+
+    def delete_(key):
+        existed = delete_fn(key)
+        if existed:
+            lease_mgr.revoke_key(key, p.LEASE_REV_POLICY)
+        return existed
+
+    return set_, delete_
+
+
+def _lease_guarded_reset(lease_mgr, reset_fn):
+    """Reset erases the window counter holding a grant's debited mass,
+    so leased tokens spent afterwards would be invisible to the bound —
+    revoke the key's leases alongside (same rule as the binary door's
+    T_RESET path)."""
+    if lease_mgr is None:
+        return reset_fn
+    from ratelimiter_tpu.serving import protocol as p
+
+    def reset_(key):
+        out = reset_fn(key)
+        lease_mgr.revoke_key(key, p.LEASE_REV_MANUAL)
+        return out
+
+    return reset_
+
+
+def _lease_controller_hook(lease_mgr):
+    """AIMD tighten → lease revocation (ADR-022): any tightened scope
+    invalidates outstanding budgets sized under the old effective
+    limits. Scope→keys is not tracked, so the hook revokes ALL grants —
+    coarse, but in the safe direction (lease churn, never
+    over-admission)."""
+    if lease_mgr is None:
+        return None
+    from ratelimiter_tpu.serving import protocol as p
+
+    return lambda _scope: lease_mgr.revoke_all(p.LEASE_REV_CONTROLLER)
+
+
+def _lease_health(lease_mgr) -> dict:
+    return {"leases": lease_mgr.status()} if lease_mgr is not None else {}
+
+
 def _prewarm(limiter, max_batch: int) -> None:
     """Compile every batch pad shape the serving tier can produce BEFORE
     accepting traffic, so no client request ever pays a jit compile: the
@@ -845,6 +976,16 @@ async def amain(args) -> None:
         raise SystemExit("--tenant/--assign need --tenants > 0")
     if args.mesh_devices is not None and args.backend != "mesh":
         raise SystemExit("--mesh-devices needs --backend mesh")
+    if args.lease_require_hot and not args.leases:
+        raise SystemExit("--lease-require-hot needs --leases")
+    if args.lease_require_hot and args.hh_slots <= 0:
+        raise SystemExit("--lease-require-hot needs --hh-slots > 0 "
+                         "(hot-key nomination reads the heavy-hitter "
+                         "side table)")
+    if args.lease_port is not None and not args.native:
+        raise SystemExit("--lease-port is the native door's lease "
+                         "sidecar; the asyncio door serves lease "
+                         "frames on its main port")
     if args.quarantine and args.backend != "mesh":
         raise SystemExit("--quarantine needs --backend mesh (failure "
                          "domains are per device slice)")
@@ -1243,6 +1384,23 @@ async def amain(args) -> None:
                     interval=args.dcn_interval, secret=dcn_secret))
             for pu in pushers:
                 pu.start()
+        # Client-embedded quota leases (ADR-022): the C++ door has no
+        # lease lane, so grants/renewals/returns serve from a sidecar
+        # listener; revocation gossip and epoch checks still ride the
+        # door's DCN receive path (server.leases). Debits route
+        # through decide_one — the shard router — so a lease budget
+        # lands on the key's owning shard.
+        lease_mgr = _setup_leases(
+            args, limiter=limiter, decide=server.decide_one,
+            fleet_core=fleet_core, pushers=pushers, persist=persist)
+        server.leases = lease_mgr
+        lease_listener = None
+        if lease_mgr is not None:
+            from ratelimiter_tpu.leases.listener import LeaseListener
+
+            lease_listener = LeaseListener(lease_mgr, host=args.host,
+                                           port=args.lease_port or 0)
+            lease_listener.start()
         # Hierarchical cascades (ADR-020): management surface over every
         # dispatch shard + the optional AIMD controller. After recovery
         # (hier_* checkpoint columns restore first), before the gateway
@@ -1250,6 +1408,16 @@ async def amain(args) -> None:
         hier, controller = _setup_hierarchy(
             args, cfg, server.shard_limiters, slo_tracker=slo_tracker,
             auditor=auditor, fleet_membership=fleet_membership)
+        if controller is not None:
+            controller.on_tighten = _lease_controller_hook(lease_mgr)
+        # Policy/reset levers revoke the touched key's leases: HTTP and
+        # gRPC get the wrapped callables here; a mutation arriving over
+        # the C++ door's own binary lane is bounded by the lease TTL
+        # instead (the asyncio door revokes inline).
+        lease_set, lease_del = _lease_guarded_policy(
+            lease_mgr, server.set_override_all,
+            server.delete_override_all)
+        lease_reset = _lease_guarded_reset(lease_mgr, server.reset_one)
         fleet_migrate = _make_fleet_migrate(args, fleet_core,
                                             fleet_membership)
         gateway = None
@@ -1272,6 +1440,7 @@ async def amain(args) -> None:
                         **_audit_health(),
                         **_slo_health(slo_tracker),
                         **_hierarchy_health(hier, controller),
+                        **_lease_health(lease_mgr),
                         **_fleet_health(),
                         **_events_health(),
                         **({"quarantine": qmgr.status()}
@@ -1281,7 +1450,7 @@ async def amain(args) -> None:
             _tower_health[0] = health_fn
             tower = _make_tower()
             gateway = HttpGateway(
-                server.decide_one, server.reset_one,
+                server.decide_one, lease_reset,
                 host=args.host, port=args.http_port,
                 metrics_render=obs_metrics.DEFAULT.render,
                 health=health_fn,
@@ -1291,9 +1460,9 @@ async def amain(args) -> None:
                 enable_reset=http_reset,
                 reset_token=args.http_reset_token,
                 # Overrides apply on every shard (keys hash-route).
-                policy_set=server.set_override_all,
+                policy_set=lease_set,
                 policy_get=server.get_override_one,
-                policy_delete=server.delete_override_all,
+                policy_delete=lease_del,
                 enable_policy=http_policy,
                 policy_token=args.http_policy_token,
                 snapshot=(persist.snapshot_now if persist else None),
@@ -1315,13 +1484,12 @@ async def amain(args) -> None:
             from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
 
             grpc_srv = GrpcRateLimitServer(
-                server.decide_one, server.reset_one,
+                server.decide_one, lease_reset,
                 host=args.host, port=args.grpc_port,
                 decisions_total=lambda: server.stats().get(
                     "decisions_total", 0),
                 decide_many=server.decide_many,
-                policy=(server.set_override_all, server.get_override_one,
-                        server.delete_override_all),
+                policy=(lease_set, server.get_override_one, lease_del),
                 default_limit=lambda: limiter.config.limit)
             grpc_srv.start()
         stop = asyncio.Event()
@@ -1332,7 +1500,9 @@ async def amain(args) -> None:
               f"limit={args.limit}/{args.window:g}s on "
               f"{args.host}:{server.port}"
               + (f" http:{gateway.port}" if gateway else "")
-              + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
+              + (f" grpc:{grpc_srv.port}" if grpc_srv else "")
+              + (f" lease:{lease_listener.port}" if lease_listener
+                 else ""), flush=True)
         if fleet_membership is not None:
             fleet_membership.start()
         if controller is not None:
@@ -1362,6 +1532,13 @@ async def amain(args) -> None:
             gateway.shutdown()
         if grpc_srv is not None:
             grpc_srv.shutdown()
+        if lease_mgr is not None:
+            # Revoke-all BEFORE the listener closes: holders get the
+            # shutdown push and stop answering locally right away
+            # instead of riding out their TTL.
+            lease_mgr.close()
+        if lease_listener is not None:
+            lease_listener.close()
         if persist is not None:
             # Stop the C++ door FIRST (answers in-flight work), then the
             # final snapshot: every acknowledged decision is captured —
@@ -1432,15 +1609,24 @@ async def amain(args) -> None:
         fleet=fleet_core,
         fleet_announce=(fleet_membership.handle_announce
                         if fleet_membership is not None else None))
-    await server.start()
-
-    gateway = None
-    grpc_srv = None
     loop = asyncio.get_running_loop()
 
     # Gateway/gRPC worker threads funnel into the SAME micro-batcher as
     # the binary protocol: all surfaces share device dispatches.
     threadsafe_decide = make_threadsafe_decide(server.batcher, loop)
+
+    # Client-embedded quota leases (ADR-022): the asyncio door serves
+    # lease frames on its main port (no sidecar). Debits ride the
+    # shared micro-batcher — the lease handler runs on an executor
+    # thread, so the threadsafe bridge is the right decide path.
+    lease_mgr = _setup_leases(
+        args, limiter=limiter, decide=threadsafe_decide,
+        fleet_core=fleet_core, pushers=pushers, persist=persist)
+    server.leases = lease_mgr
+    await server.start()
+
+    gateway = None
+    grpc_srv = None
 
     # Hierarchical cascades (ADR-020) on the asyncio door: ONE dispatch
     # unit (a SlicedMeshLimiter already spans its slices write-all, and
@@ -1449,6 +1635,13 @@ async def amain(args) -> None:
     hier, controller = _setup_hierarchy(
         args, cfg, [limiter], slo_tracker=slo_tracker, auditor=auditor,
         fleet_membership=fleet_membership)
+    if controller is not None:
+        controller.on_tighten = _lease_controller_hook(lease_mgr)
+    # HTTP/gRPC policy + reset levers revoke the touched key's leases
+    # (the binary door's T_POLICY/T_RESET handlers revoke inline).
+    lease_set, lease_del = _lease_guarded_policy(
+        lease_mgr, limiter.set_override, limiter.delete_override)
+    lease_reset = _lease_guarded_reset(lease_mgr, limiter.reset)
     fleet_migrate = _make_fleet_migrate(args, fleet_core, fleet_membership)
 
     if args.http_port is not None:
@@ -1465,6 +1658,7 @@ async def amain(args) -> None:
                     **_audit_health(),
                     **_slo_health(slo_tracker),
                     **_hierarchy_health(hier, controller),
+                    **_lease_health(lease_mgr),
                     **_fleet_health(),
                     **_events_health(),
                     **({"quarantine": qmgr.status()}
@@ -1474,7 +1668,7 @@ async def amain(args) -> None:
         _tower_health[0] = health_fn
         tower = _make_tower()
         gateway = HttpGateway(
-            threadsafe_decide, limiter.reset,
+            threadsafe_decide, lease_reset,
             host=args.host, port=args.http_port,
             metrics_render=obs_metrics.DEFAULT.render,
             health=health_fn,
@@ -1483,9 +1677,9 @@ async def amain(args) -> None:
             fleet_events=(tower.fleet_events if tower else None),
             enable_reset=http_reset,
             reset_token=args.http_reset_token,
-            policy_set=limiter.set_override,
+            policy_set=lease_set,
             policy_get=limiter.get_override,
-            policy_delete=limiter.delete_override,
+            policy_delete=lease_del,
             enable_policy=http_policy,
             policy_token=args.http_policy_token,
             snapshot=(persist.snapshot_now if persist else None),
@@ -1506,12 +1700,11 @@ async def amain(args) -> None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
 
         grpc_srv = GrpcRateLimitServer(
-            threadsafe_decide, limiter.reset,
+            threadsafe_decide, lease_reset,
             host=args.host, port=args.grpc_port,
             decisions_total=lambda: server.batcher.decisions_total,
             decide_many=make_threadsafe_decide_many(server.batcher, loop),
-            policy=(limiter.set_override, limiter.get_override,
-                    limiter.delete_override),
+            policy=(lease_set, limiter.get_override, lease_del),
             default_limit=lambda: limiter.config.limit)
         grpc_srv.start()
 

@@ -109,6 +109,8 @@ class Client:
         self._desynced = False
         self._ids = itertools.count(1)
         self._lock = threading.Lock()
+        self._lease_cache = None
+        self._lease_driver = None
         self._connect_locked()
 
     # ------------------------------------------------------------ plumbing
@@ -250,13 +252,21 @@ class Client:
         with a client-side ``tracing.record("client", ...)`` span to get
         the full client → door → device tree in one dump. ``deadline``
         (seconds) bounds the call including retries and propagates to
-        the server (ADR-015)."""
+        the server (ADR-015). With leases enabled (ADR-022), a key
+        holding a live local lease with budget answers WITHOUT the wire."""
+        lc = self._lease_cache
+        if lc is not None:
+            res = lc.try_acquire(key, n)
+            if res is not None:
+                return res
         req_id = next(self._ids)
         type_, body = self._roundtrip(p.encode_allow_n(req_id, key, n),
                                       req_id, trace_id=trace_id,
                                       deadline=deadline)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
+        if lc is not None:
+            lc.note_wire(key)
         return p.parse_result(body)
 
     def allow_batch(self, keys: Sequence[str],
@@ -363,7 +373,45 @@ class Client:
             p.encode_policy_key(p.T_POLICY_DEL, req_id, key), req_id)
         return found
 
+    # -------------------------------------------- quota leases (ADR-022)
+
+    def enable_leases(self, *, lease_port: Optional[int] = None,
+                      interval: float = 0.1, cache=None, **cache_kw):
+        """Turn on the client-embedded lease tier: hot keys get a local
+        token budget and ``allow``/``allow_n`` answer them at memory
+        speed. ``lease_port`` targets the native door's sidecar listener
+        (default: the main port — the asyncio door serves lease frames
+        itself). Remaining kwargs configure the
+        :class:`~ratelimiter_tpu.leases.cache.LeaseCache` (hot_after,
+        want, low_water, ...). Returns the cache."""
+        from ratelimiter_tpu.leases.cache import LeaseCache
+        from ratelimiter_tpu.leases.driver import LeaseDriver
+
+        if self._lease_driver is not None:
+            return self._lease_cache
+        self._lease_cache = (cache if cache is not None
+                             else LeaseCache(**cache_kw))
+        addr = (self._host, lease_port if lease_port is not None
+                else self._port)
+        self._lease_driver = LeaseDriver(self._lease_cache,
+                                         lambda key: addr,
+                                         interval=interval)
+        self._lease_driver.start()
+        return self._lease_cache
+
+    def disable_leases(self) -> None:
+        """Hand every lease back and return to pure wire decisions."""
+        drv, self._lease_driver = self._lease_driver, None
+        self._lease_cache = None
+        if drv is not None:
+            drv.close()
+
+    @property
+    def lease_cache(self):
+        return self._lease_cache
+
     def close(self) -> None:
+        self.disable_leases()
         try:
             if self._sock is not None:
                 self._sock.close()
@@ -400,6 +448,8 @@ class AsyncClient:
         self._backoff = 0.05
         self._backoff_max = 2.0
         self._conn_lock: Optional[asyncio.Lock] = None
+        self._lease_cache = None
+        self._lease_task: Optional[asyncio.Task] = None
 
     @classmethod
     async def connect(cls, host: str = "127.0.0.1", port: int = 0, *,
@@ -450,6 +500,19 @@ class AsyncClient:
                 hdr = await self._reader.readexactly(p.HEADER_SIZE)
                 length, type_, rid = p.parse_header(hdr)
                 body = await self._reader.readexactly(length - 9)
+                if rid == 0 and type_ == p.T_LEASE_REVOKE:
+                    # Unsolicited server push (ADR-022): the leases it
+                    # names stop answering locally NOW.
+                    lc = self._lease_cache
+                    if lc is not None:
+                        try:
+                            reason, _, ids = p.parse_lease_revoke(body)
+                            lc.invalidate_ids(
+                                ids,
+                                p.LEASE_REASONS.get(reason, "revoked"))
+                        except Exception:  # noqa: BLE001 — keep reading
+                            pass
+                    continue
                 fut = self._waiting.pop(rid, None)
                 if fut is not None and not fut.done():
                     fut.set_result((type_, body))
@@ -525,12 +588,19 @@ class AsyncClient:
 
     async def allow_n(self, key: str, n: int, *, trace_id: int = 0,
                       deadline: Optional[float] = None) -> Result:
+        lc = self._lease_cache
+        if lc is not None:
+            res = lc.try_acquire(key, n)
+            if res is not None:
+                return res
         req_id = next(self._ids)
         type_, body = await self._request(
             p.encode_allow_n(req_id, key, n), req_id, trace_id=trace_id,
             deadline=deadline)
         if type_ != p.T_RESULT:
             raise p.ProtocolError(f"unexpected response type {type_}")
+        if lc is not None:
+            lc.note_wire(key)
         return p.parse_result(body)
 
     async def allow_many(self, keys: Sequence[str],
@@ -641,7 +711,98 @@ class AsyncClient:
             p.encode_policy_key(p.T_POLICY_DEL, req_id, key), req_id)
         return found
 
+    # -------------------------------------------- quota leases (ADR-022)
+
+    async def enable_leases(self, *, interval: float = 0.1, cache=None,
+                            **cache_kw):
+        """Turn on the lease tier: maintenance (grant/renew/return)
+        pipelines on THIS connection like any other request, and
+        revocation pushes are consumed by the read loop. Returns the
+        :class:`~ratelimiter_tpu.leases.cache.LeaseCache`. Asyncio-door
+        servers only (the native door's lease sidecar speaks to the
+        blocking clients' driver)."""
+        from ratelimiter_tpu.leases.cache import LeaseCache
+
+        if self._lease_task is not None:
+            return self._lease_cache
+        self._lease_cache = (cache if cache is not None
+                             else LeaseCache(**cache_kw))
+        self._lease_task = asyncio.ensure_future(
+            self._lease_loop(float(interval)))
+        return self._lease_cache
+
+    async def disable_leases(self) -> None:
+        task, self._lease_task = self._lease_task, None
+        cache, self._lease_cache = self._lease_cache, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        if cache is not None:
+            for act in cache.drain():
+                _, key, lease_id, delta = act
+                try:
+                    req_id = next(self._ids)
+                    await self._request(
+                        p.encode_lease_return(req_id, cache.client_id,
+                                              lease_id, key, delta),
+                        req_id)
+                except Exception:  # noqa: BLE001 — TTL reaps it anyway
+                    pass
+
+    @property
+    def lease_cache(self):
+        return self._lease_cache
+
+    async def _lease_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            cache = self._lease_cache
+            if cache is None:
+                return
+            for act in cache.actions():
+                await self._lease_action(cache, act)
+
+    async def _lease_action(self, cache, act: tuple) -> None:
+        kind = act[0]
+        if kind == "grant":
+            _, key, want = act
+            try:
+                req_id = next(self._ids)
+                type_, body = await self._request(
+                    p.encode_lease_grant(req_id, cache.client_id, key,
+                                         want), req_id)
+                if type_ != p.T_LEASE_R:
+                    raise p.ProtocolError(
+                        f"unexpected lease response type {type_}")
+                granted, lease_id, budget, ttl, limit, epoch = \
+                    p.parse_lease_r(body)
+                cache.on_grant(key, granted, lease_id, budget, ttl,
+                               limit, epoch)
+            except Exception:  # noqa: BLE001 — wire path covers
+                cache.grant_failed(key)
+        elif kind == "renew":
+            _, key, lease_id, delta, want = act
+            try:
+                req_id = next(self._ids)
+                type_, body = await self._request(
+                    p.encode_lease_renew(req_id, cache.client_id,
+                                         lease_id, key, delta, want),
+                    req_id)
+                if type_ != p.T_LEASE_R:
+                    raise p.ProtocolError(
+                        f"unexpected lease response type {type_}")
+                granted, lease_id, top_up, ttl, limit, epoch = \
+                    p.parse_lease_r(body)
+                cache.on_renew(lease_id, granted, top_up, ttl, limit,
+                               epoch)
+            except Exception:  # noqa: BLE001
+                cache.renew_failed(lease_id, delta)
+
     async def close(self) -> None:
+        await self.disable_leases()
         if self._reader_task is not None:
             self._reader_task.cancel()
             try:
@@ -738,6 +899,8 @@ class FleetClient:
         self._clients: Dict[int, Client] = {}
         self._lock = threading.Lock()
         self._pool = None
+        self._lease_cache = None
+        self._lease_driver = None
 
     # ------------------------------------------------------------ plumbing
 
@@ -782,6 +945,11 @@ class FleetClient:
             if m.epoch > self.map.epoch:
                 with self._lock:
                     self.map = m
+                if self._lease_cache is not None:
+                    # Ownership moved (ADR-022): leases granted under
+                    # the old epoch may name ranges their grantor no
+                    # longer owns — stop answering from them.
+                    self._lease_cache.on_epoch(m.epoch)
                 return True
             return False
         return False
@@ -812,16 +980,24 @@ class FleetClient:
 
     def allow_n(self, key: str, n: int = 1, *,
                 deadline: Optional[float] = None) -> Result:
+        lc = self._lease_cache
+        if lc is not None:
+            res = lc.try_acquire(key, n)
+            if res is not None:
+                return res
         self._maybe_refresh()
         dl = deadline if deadline is not None else self.deadline
         owner = int(self.map.owner_of_hash(self._hash([key]))[0])
         try:
-            return self._client(owner).allow_n(key, n, deadline=dl)
+            res = self._client(owner).allow_n(key, n, deadline=dl)
         except Exception as exc:
             if not self._refresh_from_error(exc):
                 raise
             owner = int(self.map.owner_of_hash(self._hash([key]))[0])
-            return self._client(owner).allow_n(key, n, deadline=dl)
+            res = self._client(owner).allow_n(key, n, deadline=dl)
+        if lc is not None:
+            lc.note_wire(key)
+        return res
 
     # ------------------------------------------------------------- frames
 
@@ -944,7 +1120,44 @@ class FleetClient:
             existed = self._client(o).delete_override(key) or existed
         return existed
 
+    # -------------------------------------------- quota leases (ADR-022)
+
+    def enable_leases(self, *, interval: float = 0.1, cache=None,
+                      **cache_kw):
+        """Lease tier over the fleet: grants route to the key's OWNER
+        (the driver resolves per key on the current map), and an epoch
+        bump from refresh_map retires leases granted under old
+        ownership. Returns the LeaseCache."""
+        from ratelimiter_tpu.leases.cache import LeaseCache
+        from ratelimiter_tpu.leases.driver import LeaseDriver
+
+        if self._lease_driver is not None:
+            return self._lease_cache
+        self._lease_cache = (cache if cache is not None
+                             else LeaseCache(**cache_kw))
+
+        def resolve(key: str):
+            owner = int(self.map.owner_of_hash(self._hash([key]))[0])
+            host = self.map.hosts[owner]
+            return host.host, host.port
+
+        self._lease_driver = LeaseDriver(self._lease_cache, resolve,
+                                         interval=interval)
+        self._lease_driver.start()
+        return self._lease_cache
+
+    def disable_leases(self) -> None:
+        drv, self._lease_driver = self._lease_driver, None
+        self._lease_cache = None
+        if drv is not None:
+            drv.close()
+
+    @property
+    def lease_cache(self):
+        return self._lease_cache
+
     def close(self) -> None:
+        self.disable_leases()
         with self._lock:
             clients = list(self._clients.values())
             self._clients.clear()
@@ -975,6 +1188,8 @@ class AsyncFleetClient:
         self._map_fetched_at = time.monotonic()
         self._clients: Dict[int, AsyncClient] = {}
         self._client_kw: dict = {}
+        self._lease_cache = None
+        self._lease_task: Optional[asyncio.Task] = None
 
     @classmethod
     async def connect(cls, fleet_map=None, *,
@@ -1010,6 +1225,11 @@ class AsyncFleetClient:
                 await c.close()
             c = await AsyncClient.connect(host.host, host.port,
                                           **self._client_kw)
+            # Sub-clients share the fleet cache so a revocation push on
+            # ANY member connection invalidates locally (ADR-022); the
+            # fleet client owns the maintenance task, so the sub-client
+            # never starts its own.
+            c._lease_cache = self._lease_cache
             self._clients[ordinal] = c
         return c
 
@@ -1029,6 +1249,10 @@ class AsyncFleetClient:
             m = _fleet_map_of(d)
             if m.epoch > self.map.epoch:
                 self.map = m
+                if self._lease_cache is not None:
+                    # Ownership moved: retire leases granted under the
+                    # old epoch (ADR-022).
+                    self._lease_cache.on_epoch(m.epoch)
                 return True
             return False
         return False
@@ -1191,7 +1415,80 @@ class AsyncFleetClient:
         ride :meth:`refresh_map`)."""
         return self.map.to_dict()
 
+    # -------------------------------------------- quota leases (ADR-022)
+
+    async def enable_leases(self, *, interval: float = 0.1, cache=None,
+                            **cache_kw):
+        """Lease tier over the async fleet: ONE cache shared by every
+        member connection (any member's revocation push invalidates),
+        with this client's maintenance task routing grants/renews to
+        each key's owner. Returns the LeaseCache."""
+        from ratelimiter_tpu.leases.cache import LeaseCache
+
+        if self._lease_task is not None:
+            return self._lease_cache
+        self._lease_cache = (cache if cache is not None
+                             else LeaseCache(**cache_kw))
+        for c in self._clients.values():
+            c._lease_cache = self._lease_cache
+        self._lease_task = asyncio.ensure_future(
+            self._lease_loop(float(interval)))
+        return self._lease_cache
+
+    async def disable_leases(self) -> None:
+        task, self._lease_task = self._lease_task, None
+        cache, self._lease_cache = self._lease_cache, None
+        for c in self._clients.values():
+            c._lease_cache = None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        if cache is not None:
+            for act in cache.drain():
+                _, key, lease_id, delta = act
+                try:
+                    owner = int(self.map.owner_of_hash(
+                        self._hash([key]))[0])
+                    c = await self._client(owner)
+                    req_id = next(c._ids)
+                    await c._request(
+                        p.encode_lease_return(req_id, cache.client_id,
+                                              lease_id, key, delta),
+                        req_id)
+                except Exception:  # noqa: BLE001 — TTL reaps it anyway
+                    pass
+
+    @property
+    def lease_cache(self):
+        return self._lease_cache
+
+    async def _lease_loop(self, interval: float) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            cache = self._lease_cache
+            if cache is None:
+                return
+            for act in cache.actions():
+                # Route each action to the key's owner; the sub-client's
+                # action handler applies results to the SHARED cache.
+                try:
+                    key = act[1]
+                    owner = int(self.map.owner_of_hash(
+                        self._hash([key]))[0])
+                    c = await self._client(owner)
+                except Exception:  # noqa: BLE001 — degrade to wire
+                    if act[0] == "grant":
+                        cache.grant_failed(act[1])
+                    elif act[0] == "renew":
+                        cache.renew_failed(act[2], act[3])
+                    continue
+                await c._lease_action(cache, act)
+
     async def close(self) -> None:
+        await self.disable_leases()
         clients = list(self._clients.values())
         self._clients.clear()
         for c in clients:

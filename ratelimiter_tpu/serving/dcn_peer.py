@@ -49,7 +49,7 @@ log = logging.getLogger("ratelimiter_tpu.serving.dcn")
 def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
                        secret: Optional[str] = None,
                        guard: Optional[p.DcnReplayGuard] = None,
-                       on_fleet=None) -> None:
+                       on_fleet=None, on_lease=None) -> None:
     """Parse one T_DCN_PUSH body and merge it into every given limiter —
     the single receive path shared by the asyncio server (its one
     limiter) and the native front door (every shard limiter).
@@ -66,6 +66,14 @@ def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
     callback (the fleet membership) instead of the merge path. Without
     a callback, fleet frames answer E_INVALID_CONFIG — a non-fleet
     server must not silently swallow ownership gossip.
+
+    ``on_lease`` (ADR-022): lease revocation gossip (DCN_KIND_LEASE)
+    rides the same authenticated channel — a forged revocation is a
+    targeted denial lever, so it gets the envelope too. Handed the
+    parsed JSON payload (LeaseManager.on_gossip); without a callback
+    the frame is acknowledged and dropped — a member without leases
+    enabled has nothing to revoke, and the gossip is best-effort by
+    design (holder TTLs bound staleness).
 
     With dispatch shards, the full foreign payload merges into EVERY
     shard: a key is only ever read on its owner shard, where the foreign
@@ -86,6 +94,10 @@ def merge_push_payload(limiters: Sequence[SketchLimiter], body: bytes,
                 "fleet announce received but this server is not a fleet "
                 "member (--fleet-config)")
         on_fleet(p.parse_dcn_fleet(body[1:]))
+        return
+    if body[:1] and body[0] == p.DCN_KIND_LEASE:
+        if on_lease is not None:
+            on_lease(p.parse_dcn_lease(body[1:]))
         return
     lims = [undecorated(lim) for lim in limiters]
     lim0 = lims[0]
@@ -347,6 +359,30 @@ class DcnPusher:
                 delivered += 1
                 sent_up_to = last - 1
             self._watermarks[i] = max(self._watermarks[i], sent_up_to)
+        return delivered
+
+    # ------------------------------------------------------- lease gossip
+
+    def push_lease(self, payload: dict) -> int:
+        """Fan a lease-revocation payload (ADR-022) to every peer NOW —
+        revocations cannot wait for the next export cycle. Best-effort:
+        per-peer failures are counted and logged, never raised (the
+        holder-side TTL bounds what a lost revocation can cost).
+        Returns peers reached."""
+        req_id = next(self._ids)
+        frame = p.encode_dcn_lease(
+            req_id, payload, secret=self.secret, sender=self._sender,
+            seq=(self._next_seq() if self.secret is not None else None))
+        delivered = 0
+        for peer in self.peers:
+            try:
+                peer.push(frame, req_id)
+                delivered += 1
+                self.pushes_ok += 1
+            except Exception as exc:
+                self.pushes_failed += 1
+                log.warning("lease gossip to %s:%d failed: %s",
+                            peer.host, peer.port, exc)
         return delivered
 
     # ---------------------------------------------------------- lifecycle

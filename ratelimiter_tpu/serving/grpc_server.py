@@ -41,7 +41,8 @@ from ratelimiter_tpu.core.errors import (
     StorageUnavailableError,
 )
 from ratelimiter_tpu.core.types import Result
-from ratelimiter_tpu.observability import tracing
+from ratelimiter_tpu.observability import events, tracing
+from ratelimiter_tpu.ops.hashing import key_token as _key_token
 
 log = logging.getLogger("ratelimiter_tpu.serving.grpc")
 
@@ -238,6 +239,12 @@ class GrpcRateLimitServer:
 
         def do_reset(req):
             self.reset(req.key)
+            # Control-plane journal (ADR-021): the gRPC door records
+            # the same mutation events as the HTTP/binary doors, so an
+            # incident reconstruction never depends on WHICH surface
+            # the operator used. Hashed key tokens only (OPERATIONS §6).
+            events.emit("policy", "reset", actor="grpc",
+                        payload={"key_hash": _key_token(req.key)})
             return pb2.ResetResponse()
 
         def health(_req):
@@ -261,6 +268,11 @@ class GrpcRateLimitServer:
                            int(req.limit) if req.limit else None,
                            window_scale=(req.window_scale
                                          if req.window_scale else 1.0))
+                events.emit("policy", "set-override", actor="grpc",
+                            payload={"key_hash": _key_token(req.key),
+                                     "limit": int(ov.limit),
+                                     "window_scale":
+                                         float(ov.window_scale)})
                 return pb2.OverrideResponse(
                     found=True, key=req.key, limit=int(ov.limit),
                     window_scale=float(ov.window_scale))
@@ -278,8 +290,11 @@ class GrpcRateLimitServer:
                     window_scale=float(ov.window_scale))
 
             def delete_override(req):
-                return pb2.DeleteOverrideResponse(
-                    deleted=bool(p_del(req.key)))
+                deleted = bool(p_del(req.key))
+                events.emit("policy", "delete-override", actor="grpc",
+                            payload={"key_hash": _key_token(req.key),
+                                     "deleted": deleted})
+                return pb2.DeleteOverrideResponse(deleted=deleted)
 
             rpcs.update({
                 "SetOverride": (set_override, pb2.SetOverrideRequest),

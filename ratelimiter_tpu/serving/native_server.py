@@ -152,7 +152,7 @@ class NativeRateLimitServer:
                  max_dcn_conns: int = 4,
                  shard_decorate=None,
                  shard_limiters: Optional[list] = None,
-                 fleet=None, fleet_announce=None):
+                 fleet=None, fleet_announce=None, leases=None):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -257,6 +257,11 @@ class NativeRateLimitServer:
         # shards). None = byte-identical hot path.
         self._fleet = fleet
         self._fleet_announce = fleet_announce
+        #: LeaseManager (ADR-022). The compiled fast path knows nothing
+        #: of lease frames — lease TRAFFIC enters via the LeaseListener
+        #: sidecar port — but the DCN receive path here still applies
+        #: revocation gossip and epoch checks against it.
+        self.leases = leases
 
         # Fast path: C++ prepends the prefix while building the blob, so
         # the decide callback hashes ready-made bytes (the numpy re-pack
@@ -711,9 +716,14 @@ class NativeRateLimitServer:
         from ratelimiter_tpu.serving.dcn_peer import merge_push_payload
 
         try:
-            merge_push_payload(self._shard_limiters, payload,
-                               self.dcn_secret, self._dcn_guard,
-                               self._fleet_announce)
+            merge_push_payload(
+                self._shard_limiters, payload, self.dcn_secret,
+                self._dcn_guard, self._fleet_announce,
+                self.leases.on_gossip if self.leases is not None else None)
+            if self.leases is not None:
+                # An announce may have moved ownership: revoke grants
+                # over ranges this member no longer owns (ADR-022).
+                self.leases.check_epoch()
         except Exception as exc:
             raise _BridgeError(p.code_for(exc), str(exc)) from exc
 

@@ -122,6 +122,15 @@ T_ALLOW_HASHED = 11
 #: native C++ door answers unknown-type — fetch the map from an asyncio
 #: member, the fleet config file, or the HTTP /healthz fleet block).
 T_FLEET_MAP = 12
+#: Client-embedded quota leases (ADR-022): a client asks for a bounded
+#: token budget on one hot key (GRANT), tops it up / reports local
+#: consumption (RENEW), and hands the remainder back (RETURN). All
+#: three answer T_LEASE_R. 13..15 are the LAST base-type slots below
+#: FORWARD_FLAG (0x10) — any later request family needs a sub-typed
+#: frame, not a new type byte.
+T_LEASE_GRANT = 13
+T_LEASE_RENEW = 14
+T_LEASE_RETURN = 15
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
@@ -133,6 +142,12 @@ DCN_KIND_DEBT = 2    # token bucket: accumulated debt delta
 #: unauthenticated announce on a secret-bearing server is rejected
 #: before it can move ownership.
 DCN_KIND_FLEET = 3
+#: Lease revocation gossip (ADR-022): u32 len + JSON payload naming the
+#: revoked scope (one hashed key token or "all"), the reason and the
+#: sender's epoch. Rides T_DCN_PUSH so member→member revocations
+#: inherit the RLA2 HMAC + replay-guard envelope — an unauthenticated
+#: push on a secret-bearing server cannot revoke (or suppress) leases.
+DCN_KIND_LEASE = 4
 # Response types
 T_RESULT = 129
 T_OK = 130
@@ -143,6 +158,13 @@ T_POLICY_R = 134
 T_SNAPSHOT_R = 135
 T_RESULT_HASHED = 136
 T_FLEET_MAP_R = 137
+#: Answer to every T_LEASE_* request (ADR-022).
+T_LEASE_R = 138
+#: Unsolicited server→client lease revocation push (ADR-022): sent with
+#: req_id=0 on the connection that granted, so clients must tolerate
+#: rid-0 frames on a lease-bearing connection (both client read loops
+#: consume them before request/response correlation).
+T_LEASE_REVOKE = 139
 T_ERROR = 255
 
 # --------------------------------------------- trace context (ADR-014)
@@ -414,6 +436,35 @@ def parse_dcn_fleet(payload: bytes) -> dict:
     return json.loads(payload[4:4 + n].decode("utf-8"))
 
 
+def encode_dcn_lease(req_id: int, payload: dict, secret=None, *,
+                     sender=None, seq=None) -> bytes:
+    """Member→member lease revocation gossip (ADR-022): T_DCN_PUSH
+    kind=DCN_KIND_LEASE with a JSON body ({"scope": "key"|"all",
+    "key_hash": 16-hex token, "reason": str, "epoch": int}), wrapped in
+    the RLA2 envelope when a secret is held — same auth + replay
+    contract as fleet announces."""
+    import json
+
+    jb = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    body = _DCN_HEAD.pack(DCN_KIND_LEASE) + _U32.pack(len(jb)) + jb
+    frame = _HDR.pack(1 + 8 + len(body), T_DCN_PUSH, req_id) + body
+    return (wrap_dcn_auth(frame, secret, sender=sender, seq=seq)
+            if secret is not None else frame)
+
+
+def parse_dcn_lease(payload: bytes) -> dict:
+    """JSON revocation payload from an (auth-stripped) DCN_KIND_LEASE
+    body (the bytes AFTER the kind byte)."""
+    import json
+
+    if len(payload) < 4:
+        raise ProtocolError("short lease revocation body")
+    (n,) = _U32.unpack_from(payload)
+    if len(payload) != 4 + n:
+        raise ProtocolError("bad lease revocation body")
+    return json.loads(payload[4:4 + n].decode("utf-8"))
+
+
 _HDR = struct.Struct("<IBQ")          # length, type, request_id
 _ALLOW_BODY = struct.Struct("<IH")    # n, key_len
 _KEYLEN = struct.Struct("<H")
@@ -526,6 +577,122 @@ def parse_snapshot_r(body: bytes) -> Tuple[int, int, float]:
     """-> (snapshot_id, wal_seq, duration_s)."""
     snapshot_id, wal_seq, duration = _SNAPSHOT_R_BODY.unpack(body)
     return snapshot_id, wal_seq, duration
+
+
+# ------------------------------------------- quota leases (ADR-022)
+#
+# GRANT debits the requested budget from the key's live window UPFRONT
+# (through the server's normal decide path), so the global bound holds
+# no matter what the client does with the tokens afterwards. RENEW
+# reports local consumption (for the audit mirror) and asks for a
+# top-up; RETURN reports the final count and releases the grant —
+# WITHOUT re-crediting unused budget (the window already charged it;
+# failing toward false-denies is the documented side).
+
+_LEASE_GRANT_HEAD = struct.Struct("<QIdH")   # client, want, ttl_want, key_len
+_LEASE_RENEW_HEAD = struct.Struct("<QQQIH")  # client, lease, consumed, want, key_len
+_LEASE_RETURN_HEAD = struct.Struct("<QQQH")  # client, lease, consumed, key_len
+_LEASE_R_BODY = struct.Struct("<BQqdqQ")     # flags, lease, budget, ttl, limit, epoch
+_LEASE_REVOKE_HEAD = struct.Struct("<BQI")   # reason, epoch, count (then count u64)
+
+#: Revocation reasons (wire u8 + journal/metrics label).
+LEASE_REV_POLICY = 1      # per-key override set/deleted
+LEASE_REV_LIMIT = 2       # update_limit / update_window
+LEASE_REV_CONTROLLER = 3  # AIMD tighten on the key's scope (ADR-020)
+LEASE_REV_EPOCH = 4       # fleet ownership moved (ADR-017/PR 11 handoff)
+LEASE_REV_SHUTDOWN = 5    # graceful server shutdown
+LEASE_REV_MANUAL = 6      # operator drill
+LEASE_REASONS = {LEASE_REV_POLICY: "policy", LEASE_REV_LIMIT: "limit",
+                 LEASE_REV_CONTROLLER: "controller",
+                 LEASE_REV_EPOCH: "epoch", LEASE_REV_SHUTDOWN: "shutdown",
+                 LEASE_REV_MANUAL: "manual"}
+
+
+def encode_lease_grant(req_id: int, client_id: int, key: str, want: int,
+                       ttl_want: float = 0.0) -> bytes:
+    kb = key.encode("utf-8")
+    body = _LEASE_GRANT_HEAD.pack(client_id, want, float(ttl_want),
+                                  len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_LEASE_GRANT, req_id) + body
+
+
+def parse_lease_grant(body: bytes):
+    """-> (client_id, key, want, ttl_want)."""
+    client, want, ttl_want, key_len = _LEASE_GRANT_HEAD.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _LEASE_GRANT_HEAD.size + key_len:
+        raise ProtocolError("bad LEASE_GRANT body")
+    return client, body[_LEASE_GRANT_HEAD.size:].decode("utf-8"), want, ttl_want
+
+
+def encode_lease_renew(req_id: int, client_id: int, lease_id: int, key: str,
+                       consumed: int, want: int) -> bytes:
+    kb = key.encode("utf-8")
+    body = _LEASE_RENEW_HEAD.pack(client_id, lease_id, consumed, want,
+                                  len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_LEASE_RENEW, req_id) + body
+
+
+def parse_lease_renew(body: bytes):
+    """-> (client_id, lease_id, key, consumed, want)."""
+    client, lease, consumed, want, key_len = _LEASE_RENEW_HEAD.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _LEASE_RENEW_HEAD.size + key_len:
+        raise ProtocolError("bad LEASE_RENEW body")
+    return (client, lease, body[_LEASE_RENEW_HEAD.size:].decode("utf-8"),
+            consumed, want)
+
+
+def encode_lease_return(req_id: int, client_id: int, lease_id: int, key: str,
+                        consumed: int) -> bytes:
+    kb = key.encode("utf-8")
+    body = _LEASE_RETURN_HEAD.pack(client_id, lease_id, consumed, len(kb)) + kb
+    return _HDR.pack(1 + 8 + len(body), T_LEASE_RETURN, req_id) + body
+
+
+def parse_lease_return(body: bytes):
+    """-> (client_id, lease_id, key, consumed)."""
+    client, lease, consumed, key_len = _LEASE_RETURN_HEAD.unpack_from(body)
+    if key_len > MAX_KEY_LEN or len(body) != _LEASE_RETURN_HEAD.size + key_len:
+        raise ProtocolError("bad LEASE_RETURN body")
+    return (client, lease, body[_LEASE_RETURN_HEAD.size:].decode("utf-8"),
+            consumed)
+
+
+def encode_lease_r(req_id: int, granted: bool, lease_id: int, budget: int,
+                   ttl_s: float, limit: int, epoch: int = 0) -> bytes:
+    """``budget`` is the number of tokens ADDED by this answer (initial
+    grant or renew top-up) — the client adds it to its local counter.
+    ``granted`` False means lease refused / released; the client serves
+    the key from the wire path."""
+    body = _LEASE_R_BODY.pack(1 if granted else 0, lease_id, budget,
+                              float(ttl_s), limit, epoch)
+    return _HDR.pack(1 + 8 + len(body), T_LEASE_R, req_id) + body
+
+
+def parse_lease_r(body: bytes):
+    """-> (granted, lease_id, budget, ttl_s, limit, epoch)."""
+    flags, lease, budget, ttl_s, limit, epoch = _LEASE_R_BODY.unpack(body)
+    return bool(flags & 1), lease, budget, ttl_s, limit, epoch
+
+
+def encode_lease_revoke(reason: int, epoch: int, lease_ids) -> bytes:
+    """Unsolicited push (req_id=0). An EMPTY id list revokes every lease
+    the receiving client holds from this server (the revoke-all form —
+    update_limit, shutdown, epoch bumps)."""
+    ids = list(lease_ids)
+    body = _LEASE_REVOKE_HEAD.pack(reason, epoch, len(ids))
+    body += b"".join(_TRACE_ID.pack(i) for i in ids)
+    return _HDR.pack(1 + 8 + len(body), T_LEASE_REVOKE, 0) + body
+
+
+def parse_lease_revoke(body: bytes):
+    """-> (reason, epoch, [lease_id, ...])."""
+    reason, epoch, count = _LEASE_REVOKE_HEAD.unpack_from(body)
+    need = _LEASE_REVOKE_HEAD.size + 8 * count
+    if len(body) != need:
+        raise ProtocolError("bad LEASE_REVOKE body")
+    ids = [_TRACE_ID.unpack_from(body, _LEASE_REVOKE_HEAD.size + 8 * i)[0]
+           for i in range(count)]
+    return reason, epoch, ids
 
 
 _BATCH_ITEM = struct.Struct("<IH")       # n, key_len (per request)

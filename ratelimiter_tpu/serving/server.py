@@ -50,8 +50,14 @@ class RateLimitServer:
                  registry: Optional[m.Registry] = None,
                  dcn: bool = False, dcn_secret: Optional[str] = None,
                  snapshot: Optional[callable] = None,
-                 fleet=None, fleet_announce: Optional[callable] = None):
+                 fleet=None, fleet_announce: Optional[callable] = None,
+                 leases=None):
         self.limiter = limiter
+        #: LeaseManager (ADR-022); None answers the T_LEASE_* frames
+        #: with E_INVALID_CONFIG. When set, policy mutations through
+        #: this door revoke the key's leases, DCN lease gossip is
+        #: applied, and revocation pushes ride the granting connection.
+        self.leases = leases
         self.host = host
         self.port = port
         #: Fleet routing core (ADR-017); answers T_FLEET_MAP and, in
@@ -104,6 +110,12 @@ class RateLimitServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self.leases is not None:
+            # Push revoke-all while the granting connections are still
+            # open — holders stop answering locally instead of spending
+            # leased budget against a server that is gone.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.leases.revoke_all, p.LEASE_REV_SHUTDOWN)
         await self.batcher.drain()
         for t in list(self._conn_tasks):
             t.cancel()
@@ -326,9 +338,19 @@ class RateLimitServer:
         # device slice (keys hash-route across slices; dcn_peer explains
         # why the per-shard merge is double-count-free).
         lims = undecorated(self.limiter).sub_limiters()
-        await asyncio.get_running_loop().run_in_executor(
-            None, merge_push_payload, lims, body, self.dcn_secret,
-            self._dcn_guard, self.fleet_announce)
+        on_lease = self.leases.on_gossip if self.leases is not None else None
+
+        def _merge() -> None:
+            merge_push_payload(lims, body, self.dcn_secret,
+                               self._dcn_guard, self.fleet_announce,
+                               on_lease)
+            if self.leases is not None:
+                # A fleet announce may have installed a newer ownership
+                # epoch: revoke grants over ranges this member no longer
+                # owns before the next local answer spends them.
+                self.leases.check_epoch()
+
+        await asyncio.get_running_loop().run_in_executor(None, _merge)
         return p.encode_ok(req_id)
 
     async def _handle_policy(self, type_: int, req_id: int,
@@ -351,6 +373,12 @@ class RateLimitServer:
                                      "limit": int(ov.limit),
                                      "window_scale":
                                          float(ov.window_scale)})
+                if self.leases is not None:
+                    # Outstanding grants were budgeted under the old
+                    # limit — revoke so holders re-lease under the new.
+                    await loop.run_in_executor(
+                        None, self.leases.revoke_key, key,
+                        p.LEASE_REV_POLICY)
                 return p.encode_policy_r(req_id, True, ov.limit,
                                          ov.window_scale)
             if type_ == p.T_POLICY_GET:
@@ -367,6 +395,9 @@ class RateLimitServer:
             events.emit("policy", "delete-override", actor="binary",
                         payload={"key_hash": _key_token(key),
                                  "deleted": bool(existed)})
+            if existed and self.leases is not None:
+                await loop.run_in_executor(
+                    None, self.leases.revoke_key, key, p.LEASE_REV_POLICY)
             return p.encode_policy_r(req_id, bool(existed),
                                      self.limiter.config.limit, 1.0)
         except Exception as exc:
@@ -386,6 +417,13 @@ class RateLimitServer:
 
                     events.emit("policy", "reset", actor="binary",
                                 payload={"key_hash": key_token(key)})
+                    if self.leases is not None:
+                        # Reset zeroes the counter the grant mass lives
+                        # in; leased tokens spent after it would not be
+                        # reflected there — revoke instead.
+                        await asyncio.get_running_loop().run_in_executor(
+                            None, self.leases.revoke_key, key,
+                            p.LEASE_REV_MANUAL)
                     out = p.encode_ok(req_id)
                 except Exception as exc:
                     out = p.encode_error(req_id, p.code_for(exc), str(exc))
@@ -433,6 +471,38 @@ class RateLimitServer:
                 else:
                     try:
                         out = await self._handle_dcn(req_id, body)
+                    except Exception as exc:
+                        out = p.encode_error(req_id, p.code_for(exc),
+                                             str(exc))
+            elif type_ in (p.T_LEASE_GRANT, p.T_LEASE_RENEW,
+                           p.T_LEASE_RETURN):
+                if self.leases is None:
+                    out = p.encode_error(
+                        req_id, p.E_INVALID_CONFIG,
+                        "leases not enabled on this server (--leases)")
+                else:
+                    from ratelimiter_tpu.leases.listener import (
+                        serve_lease_frame,
+                    )
+
+                    loop = asyncio.get_running_loop()
+
+                    def push(frame: bytes, _loop=loop,
+                             _writer=writer) -> None:
+                        # Revocation push, called from arbitrary
+                        # threads: marshal onto the connection's loop.
+                        # A closed conn/loop raises here and the
+                        # manager counts the failed push (the holder's
+                        # TTL still bounds the stale window).
+                        if _writer.is_closing():
+                            raise ConnectionError(
+                                "lease push: connection closed")
+                        _loop.call_soon_threadsafe(_writer.write, frame)
+
+                    try:
+                        out = await loop.run_in_executor(
+                            None, serve_lease_frame, self.leases, type_,
+                            req_id, body, push)
                     except Exception as exc:
                         out = p.encode_error(req_id, p.code_for(exc),
                                              str(exc))

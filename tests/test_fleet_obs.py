@@ -460,6 +460,129 @@ class TestMergeTraces:
         assert dev["args"]["trace_parents"] == sorted([t1, t2])
 
 
+class TestOfflineStitchParityPin:
+    """tools/fleet_trace.py --offline must reproduce the server-side
+    fan-out byte-for-byte given the same dumps and clock offsets: both
+    paths call tower.merge_traces, so any divergence is assembly
+    plumbing (ref choice, offset lookup, unreachable handling) — the
+    exact class of bug this pin exists to catch. Covers the
+    single-parent rewrite and the multi-parent window in one timeline."""
+
+    def _tool(self):
+        import importlib.util
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "fleet_trace_tool", os.path.join(repo, "tools",
+                                             "fleet_trace.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _payloads(self):
+        t1, t2, w_single, w_multi = "11" * 8, "33" * 8, "22" * 8, "44" * 8
+        a = {"traceEvents": [
+                {"name": "io", "cat": "ratelimiter", "ph": "X",
+                 "ts": 100.0, "dur": 2.0, "pid": 1, "tid": 7,
+                 "args": {"trace_id": t1}},
+                {"name": "forward", "cat": "ratelimiter", "ph": "X",
+                 "ts": 101.0, "dur": 5.0, "pid": 1, "tid": 7,
+                 "args": {"trace_id": w_single}}],
+             "otherData": {"links": [
+                 {"parent": t1, "child": w_single, "t_ns": 0},
+                 {"parent": t1, "child": w_multi, "t_ns": 0},
+                 {"parent": t2, "child": w_multi, "t_ns": 1}],
+                 "threads": {"7": "io-worker"}}}
+        b = {"traceEvents": [
+                {"name": "device", "cat": "ratelimiter", "ph": "X",
+                 "ts": 5000.0, "dur": 3.0, "pid": 1, "tid": 9,
+                 "args": {"trace_id": w_single}},
+                {"name": "device", "cat": "ratelimiter", "ph": "X",
+                 "ts": 5010.0, "dur": 3.0, "pid": 1, "tid": 9,
+                 "args": {"trace_id": w_multi}}],
+             "otherData": {"links": [], "threads": {"9": "dispatch"}}}
+        return a, b
+
+    def test_offline_equals_server_side_merge(self, monkeypatch):
+        import copy
+
+        a, b = self._payloads()
+        off_b = -4_000_000_000
+        health = {"fleet": {
+            "self": "a",
+            "peers": {"b": {"mono_offset_ns": off_b}},
+            "hosts": {
+                "a": {"addr": "127.0.0.1:9001", "http": 8434},
+                "b": {"addr": "127.0.0.1:9002", "http": 8435},
+            }}}
+        urls = []
+
+        def fake_fetch(url, bearer=None, timeout=10.0):
+            urls.append(url)
+            if url.endswith("/healthz"):
+                return copy.deepcopy(health)
+            if "8434" in url:
+                return copy.deepcopy(a)
+            if "8435" in url:
+                return copy.deepcopy(b)
+            raise AssertionError(f"unexpected fetch {url}")
+
+        monkeypatch.setattr(tower, "fetch_json", fake_fetch)
+        tool = self._tool()
+        offline = tool.stitched_offline("http://127.0.0.1:8434", "tok",
+                                        10.0)
+        # The server-side stitch on the SAME inputs: exactly what
+        # ControlTower.fleet_trace hands to merge_traces.
+        server_side = tower.merge_traces(
+            {"a": copy.deepcopy(a), "b": copy.deepcopy(b)},
+            {"a": 0, "b": off_b}, "a")
+        assert json.dumps(offline, sort_keys=True) == json.dumps(
+            server_side, sort_keys=True)
+        # The pin is only meaningful if the hard cases are present:
+        spans = [e for e in offline["traceEvents"] if e["ph"] == "X"]
+        dev_single = next(e for e in spans if e["name"] == "device"
+                          and "trace_parents" not in e["args"])
+        assert dev_single["args"]["trace_id"] == "11" * 8   # rewritten
+        assert dev_single["args"]["window_id"] == "22" * 8
+        assert dev_single["ts"] == pytest.approx(5000.0 + off_b / 1e3)
+        dev_multi = next(e for e in spans if e["name"] == "device"
+                         and "trace_parents" in e["args"])
+        assert dev_multi["args"]["trace_id"] == "44" * 8    # kept
+        assert dev_multi["args"]["trace_parents"] == sorted(
+            ["11" * 8, "33" * 8])
+
+    def test_offline_unreachable_peer_is_a_named_gap_in_both(
+            self, monkeypatch):
+        import copy
+
+        a, _ = self._payloads()
+        health = {"fleet": {
+            "self": "a",
+            "peers": {},
+            "hosts": {
+                "a": {"addr": "127.0.0.1:9001", "http": 8434},
+                "b": {"addr": "127.0.0.1:9002", "http": 8435},
+            }}}
+
+        def fake_fetch(url, bearer=None, timeout=10.0):
+            if url.endswith("/healthz"):
+                return copy.deepcopy(health)
+            if "8434" in url:
+                return copy.deepcopy(a)
+            raise urllib.error.URLError("connection refused")
+
+        monkeypatch.setattr(tower, "fetch_json", fake_fetch)
+        tool = self._tool()
+        offline = tool.stitched_offline("http://127.0.0.1:8434", None,
+                                        10.0)
+        server_side = tower.merge_traces(
+            {"a": copy.deepcopy(a), "b": None}, {"a": 0, "b": None}, "a")
+        assert json.dumps(offline, sort_keys=True) == json.dumps(
+            server_side, sort_keys=True)
+        hb = offline["otherData"]["hosts"]["b"]
+        assert hb["reachable"] is False and hb["aligned"] is False
+
+
 class TestMergeEvents:
     def test_host_tag_alignment_and_sort(self):
         pages = {

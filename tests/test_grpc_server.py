@@ -235,6 +235,50 @@ class TestGrpcServer:
             srv.shutdown()
             lim.close()
 
+    def test_policy_mutations_journaled(self, pb2):
+        """The gRPC door records the same control-plane journal events
+        as the HTTP/binary doors (ADR-021): set-override /
+        delete-override / reset, actor="grpc", hashed key tokens only."""
+        import json
+
+        from ratelimiter_tpu.observability import events
+
+        clock = ManualClock(T0)
+        cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=3,
+                     window=60.0)
+        lim = create_limiter(cfg, backend="exact", clock=clock)
+        srv = grpc_server_for_limiter(lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        events.enable(capacity=64)
+        try:
+            stub.SetOverride(pb2.SetOverrideRequest(key="vip", limit=7))
+            stub.DeleteOverride(pb2.DeleteOverrideRequest(key="vip"))
+            stub.DeleteOverride(pb2.DeleteOverrideRequest(key="vip"))
+            stub.Reset(pb2.ResetRequest(key="vip"))
+            evs = events.get().tail(category="policy")["events"]
+            assert [(e["action"], e["actor"]) for e in evs] == [
+                ("set-override", "grpc"),
+                ("delete-override", "grpc"),
+                ("delete-override", "grpc"),
+                ("reset", "grpc"),
+            ]
+            set_ev = evs[0]
+            assert set_ev["payload"]["limit"] == 7
+            assert set_ev["payload"]["window_scale"] == 1.0
+            assert evs[1]["payload"]["deleted"] is True
+            assert evs[2]["payload"]["deleted"] is False
+            # Same hashed token at every mutation site; raw key absent.
+            tokens = {e["payload"]["key_hash"] for e in evs}
+            assert len(tokens) == 1
+            assert "vip" not in json.dumps(evs)
+        finally:
+            events.disable()
+            channel.close()
+            srv.shutdown()
+            lim.close()
+
     def test_closed_limiter_failed_precondition(self, pb2):
         cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=3, window=60.0)
         lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))

@@ -1,0 +1,940 @@
+"""Client-embedded quota leases (ADR-022): protocol, manager, cache,
+safety oracles, chaos drills, and both-door integration.
+
+The safety headline is debit-upfront: a grant admits the WHOLE budget
+through the limiter's decide path before a token reaches the client, so
+no client behaviour — spends, crashes, lost revocations, kill -9 — can
+push global admissions past the limit. The oracle tests here pin that
+bit-exactly; the documented failure side (unused budget reads as
+consumed) is asserted too, in the mass-retention checks.
+
+Deliberately grpc-free: the CI lease lane runs this module with zero
+skips on a plain CPU box (the native-door class compiles the C++ door,
+which the image carries).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.leases import LeaseCache, LeaseListener, LeaseManager
+from ratelimiter_tpu.observability import Registry, events
+from ratelimiter_tpu.serving import AsyncClient, Client, RateLimitServer
+from ratelimiter_tpu.serving import protocol as p
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _mk_limiter(limit=1000, window=60.0, algo=Algorithm.TPU_SKETCH,
+                backend="exact", **kw):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=algo, limit=limit, window=window, **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+class FakeClock:
+    """Mutable monotonic stand-in for the lease manager/cache clocks."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _mk_manager(limit=1000, ttl=2.0, budget=64, **kw):
+    lim, _ = _mk_limiter(limit=limit)
+    clk = FakeClock()
+    reg = Registry()
+    mgr = LeaseManager(lim, ttl=ttl, default_budget=budget,
+                       registry=reg, clock=clk, **kw)
+    return mgr, lim, clk, reg
+
+
+@contextmanager
+def running_server(limiter, **kw):
+    """A live asyncio-door server on a background loop."""
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    server = RateLimitServer(limiter, "127.0.0.1", 0, **kw)
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=10)
+    try:
+        yield server, server.port, loop
+    finally:
+        asyncio.run_coroutine_threadsafe(server.shutdown(),
+                                         loop).result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# --------------------------------------------------------------- protocol
+
+class TestLeaseProtocol:
+    def test_grant_roundtrip(self):
+        frame = p.encode_lease_grant(7, 0xABCD, "user:1", 128, 1.5)
+        length, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert (type_, rid) == (p.T_LEASE_GRANT, 7)
+        assert length == len(frame) - 4
+        client, key, want, ttl_want = p.parse_lease_grant(
+            frame[p.HEADER_SIZE:])
+        assert (client, key, want, ttl_want) == (0xABCD, "user:1", 128, 1.5)
+
+    def test_renew_roundtrip(self):
+        frame = p.encode_lease_renew(8, 3, 99, "ключ:héllo", 17, 32)
+        _, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert (type_, rid) == (p.T_LEASE_RENEW, 8)
+        out = p.parse_lease_renew(frame[p.HEADER_SIZE:])
+        assert out == (3, 99, "ключ:héllo", 17, 32)
+
+    def test_return_roundtrip(self):
+        frame = p.encode_lease_return(9, 4, 100, "k", 63)
+        _, type_, _ = p.parse_header(frame[:p.HEADER_SIZE])
+        assert type_ == p.T_LEASE_RETURN
+        assert p.parse_lease_return(frame[p.HEADER_SIZE:]) == (4, 100,
+                                                               "k", 63)
+
+    def test_lease_r_roundtrip(self):
+        frame = p.encode_lease_r(5, True, 42, 256, 2.0, 1000, epoch=3)
+        _, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert (type_, rid) == (p.T_LEASE_R, 5)
+        out = p.parse_lease_r(frame[p.HEADER_SIZE:])
+        assert out == (True, 42, 256, 2.0, 1000, 3)
+        refuse = p.encode_lease_r(6, False, 0, 0, 0.0, 0)
+        assert p.parse_lease_r(refuse[p.HEADER_SIZE:])[0] is False
+
+    def test_revoke_push_roundtrip(self):
+        frame = p.encode_lease_revoke(p.LEASE_REV_POLICY, 2, [1, 5, 9])
+        _, type_, rid = p.parse_header(frame[:p.HEADER_SIZE])
+        assert (type_, rid) == (p.T_LEASE_REVOKE, 0)  # unsolicited push
+        reason, epoch, ids = p.parse_lease_revoke(frame[p.HEADER_SIZE:])
+        assert (reason, epoch, ids) == (p.LEASE_REV_POLICY, 2, [1, 5, 9])
+        # Empty id list = revoke-all form.
+        allf = p.encode_lease_revoke(p.LEASE_REV_SHUTDOWN, 0, [])
+        assert p.parse_lease_revoke(allf[p.HEADER_SIZE:]) == (
+            p.LEASE_REV_SHUTDOWN, 0, [])
+
+    def test_revoke_truncated_rejected(self):
+        frame = p.encode_lease_revoke(p.LEASE_REV_LIMIT, 1, [1, 2])
+        with pytest.raises(p.ProtocolError):
+            p.parse_lease_revoke(frame[p.HEADER_SIZE:-3])
+
+    def test_dcn_lease_envelope_roundtrip(self):
+        payload = {"scope": "key", "key_hash": "ab" * 8,
+                   "reason": "policy", "epoch": 4}
+        frame = p.encode_dcn_lease(1, payload)
+        _, type_, _ = p.parse_header(frame[:p.HEADER_SIZE])
+        assert type_ == p.T_DCN_PUSH
+        body = frame[p.HEADER_SIZE:]
+        assert body[0] == p.DCN_KIND_LEASE
+        assert p.parse_dcn_lease(body[1:]) == payload
+
+    def test_dcn_lease_auth_tamper_rejected(self):
+        from ratelimiter_tpu.core.errors import InvalidConfigError
+
+        payload = {"scope": "all", "reason": "limit", "epoch": 1}
+        frame = p.encode_dcn_lease(2, payload, "s3cret", sender=7, seq=1)
+        body = frame[p.HEADER_SIZE:]
+        inner = p.unwrap_dcn_auth(body, "s3cret")
+        assert inner[0] == p.DCN_KIND_LEASE
+        assert p.parse_dcn_lease(inner[1:]) == payload
+        # One flipped payload byte must fail the HMAC.
+        bad = bytearray(body)
+        bad[-1] ^= 0x01
+        with pytest.raises(InvalidConfigError):
+            p.unwrap_dcn_auth(bytes(bad), "s3cret")
+        # Unauthenticated frame at a secret-requiring receiver: rejected.
+        plain = p.encode_dcn_lease(3, payload)
+        with pytest.raises(InvalidConfigError):
+            p.unwrap_dcn_auth(plain[p.HEADER_SIZE:], "s3cret")
+
+
+# ---------------------------------------------------------------- manager
+
+class TestLeaseManager:
+    def test_grant_debits_budget_upfront(self):
+        mgr, lim, _, _ = _mk_manager(limit=1000, budget=256)
+        ok, lease_id, budget, ttl, limit, _ = mgr.grant(1, "k", 256)
+        assert ok and lease_id == 1 and budget == 256 and limit == 1000
+        assert ttl == pytest.approx(2.0)
+        # The window has already been charged the WHOLE budget.
+        assert lim.allow_n("k", 744).allowed
+        assert not lim.allow_n("k", 1).allowed
+
+    def test_grant_refused_when_window_cannot_cover(self):
+        mgr, lim, _, _ = _mk_manager(limit=100, budget=64)
+        assert lim.allow_n("k", 80).allowed
+        ok, _, _, _, _, _ = mgr.grant(1, "k", 64)
+        assert not ok
+        # A refused grant consumed nothing.
+        assert lim.allow_n("k", 20).allowed
+
+    def test_want_clamped_to_max_budget(self):
+        mgr, _, _, _ = _mk_manager(limit=100000, max_budget=512)
+        ok, _, budget, _, _, _ = mgr.grant(1, "k", 10**9)
+        assert ok and budget == 512
+
+    def test_max_leases_capacity(self):
+        mgr, _, _, _ = _mk_manager(max_leases=1)
+        assert mgr.grant(1, "a")[0]
+        assert not mgr.grant(2, "b")[0]
+
+    def test_renew_extends_and_tops_up(self):
+        mgr, _, clk, _ = _mk_manager(ttl=2.0, budget=64)
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k", 64)
+        clk.advance(1.5)
+        ok, _, top_up, ttl, limit, _ = mgr.renew(1, lease_id, "k", 10, 32)
+        assert ok and top_up == 32 and ttl == pytest.approx(2.0)
+        assert limit == 1000
+        # The renew pushed the deadline out: 1.5s later it's still live.
+        clk.advance(1.5)
+        assert mgr.renew(1, lease_id, "k", 0, 0)[0]
+
+    def test_renew_refused_wrong_client_or_unknown(self):
+        mgr, _, _, _ = _mk_manager()
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k")
+        assert not mgr.renew(2, lease_id, "k", 0, 0)[0]   # not the holder
+        assert not mgr.renew(1, lease_id + 7, "k", 0, 0)[0]  # unknown
+
+    def test_release_counts_returned_not_recredited(self):
+        mgr, lim, _, reg = _mk_manager(limit=1000, budget=100)
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k", 100)
+        ok, *_ = mgr.release(1, lease_id, "k", 40)
+        assert not ok  # RETURN always answers granted=False
+        c = reg.get("rate_limiter_lease_tokens_total")
+        assert c.value(flow="returned") == 60.0
+        assert c.value(flow="consumed") == 40.0
+        # Returned budget stays charged: only 900 tokens remain.
+        assert lim.allow_n("k", 900).allowed
+        assert not lim.allow_n("k", 1).allowed
+
+    def test_ttl_sweep_expires_silent_holder(self):
+        mgr, _, clk, reg = _mk_manager(ttl=2.0)
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k")
+        clk.advance(2.5)
+        mgr.grant(2, "other")  # any entry point sweeps first
+        assert reg.get("rate_limiter_lease_expired_total").value() == 1.0
+        assert not mgr.renew(1, lease_id, "k", 0, 0)[0]
+
+    def test_revoke_key_tombstones_until_ttl(self):
+        mgr, _, _, _ = _mk_manager()
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k")
+        assert mgr.revoke_key("k", p.LEASE_REV_POLICY) == 1
+        # A raced renew gets a clean refusal, not unknown-lease noise.
+        assert not mgr.renew(1, lease_id, "k", 5, 0)[0]
+        # The key itself stays leasable (fresh debit, fresh grant).
+        assert mgr.grant(1, "k")[0]
+
+    def test_revoke_pushes_frame_through_grant_connection(self):
+        mgr, _, _, _ = _mk_manager()
+        frames = []
+        _, lease_id, _, _, _, _ = mgr.grant(1, "k", push=frames.append)
+        assert mgr.revoke_key("k", p.LEASE_REV_CONTROLLER) == 1
+        assert len(frames) == 1
+        reason, _, ids = p.parse_lease_revoke(frames[0][p.HEADER_SIZE:])
+        assert reason == p.LEASE_REV_CONTROLLER and ids == [lease_id]
+
+    def test_push_error_counts_failure_ttl_bounds_holder(self):
+        mgr, _, _, reg = _mk_manager()
+
+        def broken(_frame):
+            raise ConnectionError("holder is gone")
+
+        mgr.grant(1, "k", push=broken)
+        assert mgr.revoke_all(p.LEASE_REV_MANUAL) == 1
+        assert reg.get(
+            "rate_limiter_lease_push_failures_total").value() == 1.0
+
+    def test_epoch_bump_revokes_moved_keys(self):
+        epoch = [1]
+        lim, _ = _mk_limiter()
+        clk = FakeClock()
+        reg = Registry()
+        mgr = LeaseManager(lim, registry=reg, clock=clk,
+                           epoch_fn=lambda: epoch[0],
+                           owns_fn=lambda key: key == "stays")
+        frames = []
+        mgr.grant(1, "stays", push=frames.append)
+        _, moved_id, _, _, _, _ = mgr.grant(1, "moves", push=frames.append)
+        epoch[0] = 2
+        assert mgr.check_epoch() == 1
+        assert mgr.status()["epoch"] == 2
+        reason, ep, ids = p.parse_lease_revoke(frames[-1][p.HEADER_SIZE:])
+        assert (reason, ep, ids) == (p.LEASE_REV_EPOCH, 2, [moved_id])
+        assert mgr.renew(1, moved_id, "moves", 0, 0)[0] is False
+
+    def test_gossip_emitted_and_applied_by_peer(self):
+        sent = []
+        mgr_a, _, _, _ = _mk_manager()
+        mgr_a.gossip = sent.append
+        mgr_b, _, _, _ = _mk_manager()
+        mgr_b.grant(9, "k")
+        mgr_a.grant(1, "k")
+        mgr_a.revoke_key("k", p.LEASE_REV_POLICY)
+        assert sent and sent[0]["scope"] == "key"
+        assert sent[0]["reason"] == "policy"
+        # Same config => same consumer-token hashing on the peer.
+        assert mgr_b.on_gossip(sent[0]) == 1
+        sent.clear()
+        mgr_a.grant(1, "k2")
+        mgr_a.revoke_all(p.LEASE_REV_LIMIT)
+        assert sent and sent[0]["scope"] == "all"
+        mgr_b.grant(9, "k3")
+        assert mgr_b.on_gossip(sent[0]) == 1
+        # Peer-origin revocations must NOT re-gossip (no storms).
+        captured = []
+        mgr_b.gossip = captured.append
+        mgr_b.grant(9, "k4")
+        mgr_b.on_gossip({"scope": "all", "reason": "limit", "epoch": 0})
+        assert captured == []
+
+    def test_require_hot_nominates_from_hh_table(self):
+        lim, _ = _mk_limiter()
+        clk = FakeClock()
+        mgr = LeaseManager(lim, require_hot=True, hot_k=4,
+                           registry=Registry(), clock=clk)
+        hot_token = mgr._consumer_token("hot")
+
+        class HotStats:
+            def consumer_stats(self, k):
+                return {"top": [{"consumer": hot_token}]}
+
+        # No hh side table at all -> nothing is eligible.
+        assert not mgr.grant(1, "hot")[0]
+        lim.consumer_stats = HotStats().consumer_stats
+        assert mgr.eligible("hot")
+        assert not mgr.eligible("cold")
+        assert mgr.grant(1, "hot")[0]
+        assert not mgr.grant(1, "cold")[0]
+
+    def test_snapshot_restore_roundtrip(self):
+        mgr, _, clk, _ = _mk_manager(ttl=4.0)
+        _, id_a, _, _, _, _ = mgr.grant(11, "a", 32)
+        _, id_b, _, _, _, _ = mgr.grant(22, "b", 64)
+        mgr.revoke_key("b")
+        arrays, meta = mgr.snapshot_arrays()
+        assert len(arrays["lease_id"]) == 2
+        lim2, _ = _mk_limiter()
+        mgr2 = LeaseManager(lim2, ttl=4.0, registry=Registry(), clock=clk)
+        assert mgr2.restore_arrays(arrays, meta) == 2
+        st = mgr2.status()
+        assert st["active"] == 1 and st["tombstoned"] == 1
+        # The restored limiter was NOT touched: restore neither re-debits
+        # nor re-credits — the mass rides the LIMITER's own snapshot.
+        assert lim2.allow_n("probe", 1000).allowed
+        # A surviving holder renews by id (the frame re-carries the key).
+        assert mgr2.renew(11, id_a, "a", 3, 0)[0]
+        assert not mgr2.renew(22, id_b, "b", 0, 0)[0]  # tombstone held
+        # New ids never collide with restored ones.
+        _, id_c, _, _, _, _ = mgr2.grant(33, "c")
+        assert id_c > max(id_a, id_b)
+
+    def test_journal_events_on_grant_and_revoke(self):
+        events.enable(capacity=64)
+        try:
+            mgr, _, _, _ = _mk_manager()
+            raw_key = "user:super-secret-raw-key"
+            mgr.grant(1, raw_key)
+            mgr.revoke_key(raw_key, p.LEASE_REV_POLICY)
+            evs = events.get().tail(category="lease")["events"]
+            actions = [e["action"] for e in evs]
+            assert "grant" in actions and "revoke" in actions
+            rev = next(e for e in evs if e["action"] == "revoke")
+            assert rev["payload"]["reason"] == "policy"
+            assert rev["severity"] == "warning"
+            # PII boundary: hashed key tokens only, never raw keys.
+            assert raw_key not in json.dumps(evs)
+        finally:
+            events.disable()
+
+
+# ------------------------------------------------------------ lease cache
+
+class TestLeaseCache:
+    def _cache(self, **kw):
+        clk = FakeClock()
+        kw.setdefault("registry", Registry())
+        kw.setdefault("client_id", 7)
+        return LeaseCache(clock=clk, **kw), clk
+
+    def test_local_answer_decrements_budget(self):
+        cache, clk = self._cache()
+        cache.on_grant("k", True, 1, 10, 2.0, 100, 0)
+        res = cache.try_acquire("k", 3)
+        assert res.allowed and res.remaining == 7 and res.limit == 100
+        assert cache.status()["local_answers"] == 1
+
+    def test_exhausted_falls_back_to_wire(self):
+        cache, _ = self._cache()
+        cache.on_grant("k", True, 1, 2, 2.0, 100, 0)
+        assert cache.try_acquire("k") is not None
+        assert cache.try_acquire("k") is not None
+        assert cache.try_acquire("k") is None  # budget gone -> wire
+
+    def test_expired_lease_dies_client_side(self):
+        cache, clk = self._cache()
+        cache.on_grant("k", True, 1, 10, 2.0, 100, 0)
+        clk.advance(2.5)
+        assert cache.try_acquire("k") is None
+        assert cache.status()["leased_keys"] == 0
+
+    def test_hot_detection_requests_grant(self):
+        cache, _ = self._cache(hot_after=3, hot_window=1.0)
+        for _ in range(3):
+            cache.note_wire("k")
+        acts = cache.actions()
+        assert ("grant", "k", 0) in acts
+        # Pending: no duplicate request on the next tick.
+        assert cache.actions() == []
+
+    def test_consumed_delta_exactly_once(self):
+        cache, _ = self._cache()
+        cache.on_grant("k", True, 1, 10, 2.0, 100, 0)
+        for _ in range(4):
+            cache.try_acquire("k")
+        acts = cache.actions()
+        renews = [a for a in acts if a[0] == "renew"]
+        assert len(renews) == 1 and renews[0][3] == 4
+        # Send failed -> the delta is re-credited for the NEXT renew.
+        cache.renew_failed(1, renews[0][3])
+        acts2 = cache.actions()
+        assert [a for a in acts2 if a[0] == "renew"][0][3] == 4
+        # Send succeeded but REFUSED -> lease dies, delta NOT re-credited
+        # (the server already reconciled it).
+        cache.on_renew(1, False, 0, 0.0, 0, 0)
+        assert cache.status()["leased_keys"] == 0
+
+    def test_invalidate_ids_and_epoch(self):
+        cache, _ = self._cache()
+        cache.on_grant("a", True, 1, 10, 2.0, 100, 1)
+        cache.on_grant("b", True, 2, 10, 2.0, 100, 1)
+        assert cache.invalidate_ids([2]) == 1
+        assert cache.try_acquire("b") is None
+        assert cache.try_acquire("a") is not None
+        # Empty list drops EVERYTHING (revoke-all push form).
+        assert cache.invalidate_ids([]) == 1
+        cache.on_grant("c", True, 3, 10, 2.0, 100, 1)
+        assert cache.on_epoch(2) == 1  # older-epoch lease retired
+        assert cache.status()["leased_keys"] == 0
+
+    def test_drain_returns_all(self):
+        cache, _ = self._cache()
+        cache.on_grant("a", True, 1, 10, 2.0, 100, 0)
+        cache.try_acquire("a")
+        rows = cache.drain()
+        assert rows == [("return", "a", 1, 1)]
+        assert cache.try_acquire("a") is None
+
+
+# --------------------------------------------------- never-over-admit oracle
+
+class TestNeverOverAdmitOracle:
+    def test_storm_never_exceeds_limit(self):
+        """Seeded storm of grants, local spends, renews, revocations,
+        lost pushes, and abandons: client-observed admissions per key
+        can NEVER exceed the limit — bit-exactly, because every local
+        token was debited through the window upfront."""
+        LIMIT = 500
+        lim, _ = _mk_limiter(limit=LIMIT)
+        clk = FakeClock()
+        mgr = LeaseManager(lim, ttl=3.0, default_budget=16,
+                           registry=Registry(), clock=clk)
+        cache = LeaseCache(client_id=7, hot_after=2, hot_window=10.0,
+                           registry=Registry(), clock=clk)
+        rng = random.Random(42)
+        keys = ["alpha", "beta", "gamma"]
+        admitted = {k: 0 for k in keys}
+
+        def drive():
+            for act in cache.actions():
+                if act[0] == "grant":
+                    _, key, want = act
+                    out = mgr.grant(cache.client_id, key, want,
+                                    push=None)
+                    cache.on_grant(key, out[0], out[1], out[2], out[3],
+                                   out[4], out[5])
+                else:
+                    _, key, lease_id, delta, top_up = act
+                    out = mgr.renew(cache.client_id, lease_id, key,
+                                    delta, top_up)
+                    cache.on_renew(lease_id, out[0], out[2], out[3],
+                                   out[4], out[5])
+
+        for step in range(4000):
+            key = rng.choice(keys)
+            res = cache.try_acquire(key)
+            if res is not None:
+                admitted[key] += 1
+            else:
+                r = lim.allow_n(key, 1)
+                if r.allowed:
+                    admitted[key] += 1
+                cache.note_wire(key)
+            if step % 7 == 0:
+                drive()
+            roll = rng.random()
+            if roll < 0.01:
+                # Revocation storm tick; half the pushes get "lost"
+                # (the cache never hears — TTL bounds it instead).
+                victim = rng.choice(keys)
+                ids = [i for i, k in list(cache._by_id.items())
+                       if k == victim]
+                mgr.revoke_key(victim, p.LEASE_REV_POLICY)
+                if rng.random() < 0.5:
+                    cache.invalidate_ids(ids)
+            elif roll < 0.02:
+                # kill -9 flavored abandon: local state vanishes,
+                # server-side grant expires by TTL.
+                cache.invalidate_ids([])
+            elif roll < 0.1:
+                clk.advance(rng.random())
+        # The manual limiter clock never advanced: one frozen window.
+        for k in keys:
+            assert admitted[k] <= LIMIT, (k, admitted[k])
+        # Exhaust each key: once the window is spent, neither path
+        # admits — and the totals pin AT the limit, not past it.
+        for k in keys:
+            for _ in range(3 * LIMIT):
+                res = cache.try_acquire(k)
+                if res is None:
+                    res = lim.allow_n(k, 1)
+                if res.allowed:
+                    admitted[k] += 1
+            assert admitted[k] <= LIMIT, (k, admitted[k])
+            assert not lim.allow_n(k, 1).allowed
+
+    def test_budget_grants_plus_wire_bounded_by_limit(self):
+        """Token-flow ledger: granted budgets + direct wire admissions
+        never exceed the window, even when every grant is abandoned."""
+        LIMIT = 300
+        lim, _ = _mk_limiter(limit=LIMIT)
+        clk = FakeClock()
+        reg = Registry()
+        mgr = LeaseManager(lim, ttl=1.0, default_budget=50,
+                           registry=reg, clock=clk)
+        rng = random.Random(7)
+        wire = 0
+        for i in range(40):
+            if rng.random() < 0.5:
+                mgr.grant(i, "k", 50)     # may be refused when spent
+                clk.advance(1.1)          # holder dies; budget lost
+            else:
+                if lim.allow_n("k", 5).allowed:
+                    wire += 5
+        granted = int(reg.get("rate_limiter_lease_tokens_total")
+                      .value(flow="granted"))
+        assert granted + wire <= LIMIT
+        assert not lim.allow_n("k", LIMIT).allowed
+
+
+# ------------------------------------------------------- revocation chaos
+
+class TestRevocationChaos:
+    def test_lost_push_is_counted_journaled_and_ttl_bounded(self):
+        """Full DCN partition during a revocation storm: pushes drop,
+        the failure is counted and journaled, and the holder's cache
+        keeps answering ONLY until the TTL — never past it."""
+        from ratelimiter_tpu import chaos
+
+        inj = chaos.install(seed=11)
+        inj.partition_dcn(1.0)
+        events.enable(capacity=64)
+        try:
+            lim, _ = _mk_limiter()
+            clk = FakeClock()
+            reg = Registry()
+            mgr = LeaseManager(lim, ttl=2.0, default_budget=32,
+                               registry=reg, clock=clk)
+            cache = LeaseCache(client_id=3, registry=Registry(),
+                               clock=clk)
+            delivered = []
+            out = mgr.grant(3, "k", 32, push=delivered.append)
+            cache.on_grant("k", out[0], out[1], out[2], out[3], out[4],
+                           out[5])
+            assert mgr.revoke_key("k", p.LEASE_REV_POLICY) == 1
+            # The push was chaos-dropped, counted, and journaled.
+            assert delivered == []
+            assert inj.dcn_dropped == 1
+            assert reg.get(
+                "rate_limiter_lease_push_failures_total").value() == 1.0
+            evs = events.get().tail(category="lease")["events"]
+            assert any(e["action"] == "revoke" for e in evs)
+            # The holder never heard: it keeps answering locally...
+            assert cache.try_acquire("k") is not None
+            # ...but ONLY until the TTL, the pinned staleness bound.
+            clk.advance(2.1)
+            assert cache.try_acquire("k") is None
+            # And the server refuses the holder's next renew cleanly.
+            assert not mgr.renew(3, out[1], "k", 1, 0)[0]
+        finally:
+            events.disable()
+            chaos.uninstall()
+
+    def test_corrupted_push_parses_as_garbage_not_over_admission(self):
+        """Bit-flip corruption on the push frame: whatever the client
+        does with the garbage (drop it, revoke a wrong id), admissions
+        stay bounded — the budget was debited long before."""
+        from ratelimiter_tpu import chaos
+
+        inj = chaos.install(seed=13)
+        inj.corrupt_dcn(1.0)
+        try:
+            mgr, _, clk, _ = _mk_manager(ttl=2.0)
+            got = []
+            out = mgr.grant(5, "k", push=got.append)
+            mgr.revoke_key("k", p.LEASE_REV_MANUAL)
+            assert inj.dcn_corrupted == 1 and len(got) == 1
+            clean = p.encode_lease_revoke(p.LEASE_REV_MANUAL, 0,
+                                          [out[1]])
+            assert got[0] != clean  # the wire really was corrupted
+            # Server state is already revoked regardless of delivery.
+            assert not mgr.renew(5, out[1], "k", 0, 0)[0]
+        finally:
+            chaos.uninstall()
+
+
+# ----------------------------------------------------- asyncio door (e2e)
+
+class TestAsyncioDoorLeases:
+    def test_client_lease_lifecycle_and_policy_revocation(self):
+        lim, _ = _mk_limiter(limit=100000)
+        mgr = LeaseManager(lim, ttl=2.0, default_budget=64,
+                           registry=Registry())
+        with running_server(lim, leases=mgr) as (_, port, _loop):
+            with Client(port=port) as c:
+                cache = c.enable_leases(interval=0.02, hot_after=3,
+                                        hot_window=5.0)
+                _wait_until(
+                    lambda: (c.allow("hot").allowed
+                             and cache.status()["leased_keys"] > 0),
+                    what="lease grant")
+                before = cache.status()["local_answers"]
+                for _ in range(64 // 2):
+                    assert c.allow("hot").allowed
+                assert cache.status()["local_answers"] > before
+                assert mgr.status()["active"] >= 1
+                # A policy mutation through the door revokes; the push
+                # rides the granting connection back to THIS client.
+                c.set_override("hot", 50000)
+                _wait_until(
+                    lambda: cache.status()["leased_keys"] == 0,
+                    what="revocation push to reach the cache")
+                # Wire path still serves the key afterwards.
+                assert c.allow("hot").allowed
+
+    def test_shutdown_revokes_all(self):
+        lim, _ = _mk_limiter(limit=100000)
+        mgr = LeaseManager(lim, ttl=30.0, default_budget=16,
+                           registry=Registry())
+        with running_server(lim, leases=mgr) as (_, port, _loop):
+            with Client(port=port) as c:
+                cache = c.enable_leases(interval=0.02, hot_after=2,
+                                        hot_window=5.0)
+                _wait_until(
+                    lambda: (c.allow("k").allowed
+                             and cache.status()["leased_keys"] > 0),
+                    what="lease grant")
+        # Server shutdown pushed revoke-all before closing.
+        assert mgr.status()["active"] == 0
+
+    def test_async_client_leases(self):
+        lim, _ = _mk_limiter(limit=100000)
+        mgr = LeaseManager(lim, ttl=2.0, default_budget=64,
+                           registry=Registry())
+
+        async def go():
+            server = RateLimitServer(lim, "127.0.0.1", 0, leases=mgr)
+            await server.start()
+            c = await AsyncClient.connect(port=server.port)
+            try:
+                cache = await c.enable_leases(interval=0.02, hot_after=3,
+                                              hot_window=5.0)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    assert (await c.allow("hot")).allowed
+                    if cache.status()["leased_keys"]:
+                        break
+                    await asyncio.sleep(0.005)
+                assert cache.status()["leased_keys"] == 1
+                before = cache.status()["local_answers"]
+                for _ in range(20):
+                    assert (await c.allow("hot")).allowed
+                assert cache.status()["local_answers"] > before
+            finally:
+                await c.close()
+                await server.shutdown()
+
+        asyncio.run(go())
+        assert mgr.status()["active"] == 0  # close() returned the lease
+
+
+# ------------------------------------------------------ native door (e2e)
+
+class TestNativeDoorLeases:
+    pytestmark = pytest.mark.skipif(
+        not __import__(
+            "ratelimiter_tpu.serving.native_server",
+            fromlist=["native_server_available"],
+        ).native_server_available(),
+        reason="needs g++ for the native server")
+
+    def test_lease_sidecar_next_to_native_door(self):
+        from ratelimiter_tpu.serving.native_server import (
+            NativeRateLimitServer,
+        )
+
+        lim, _ = _mk_limiter(limit=100000)
+        mgr = LeaseManager(lim, ttl=2.0, default_budget=64,
+                           registry=Registry())
+        listener = LeaseListener(mgr, "127.0.0.1", 0)
+        listener.start()
+        srv = NativeRateLimitServer(lim, "127.0.0.1", 0)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                cache = c.enable_leases(lease_port=listener.port,
+                                        interval=0.02, hot_after=3,
+                                        hot_window=5.0)
+                _wait_until(
+                    lambda: (c.allow("hot").allowed
+                             and cache.status()["leased_keys"] > 0),
+                    what="lease grant via the sidecar listener")
+                before = cache.status()["local_answers"]
+                for _ in range(20):
+                    assert c.allow("hot").allowed
+                assert cache.status()["local_answers"] > before
+                # Revocation pushes ride the sidecar connection too.
+                mgr.revoke_all(p.LEASE_REV_MANUAL)
+                _wait_until(
+                    lambda: cache.status()["leased_keys"] == 0,
+                    what="revocation push via the sidecar")
+        finally:
+            srv.shutdown()
+            listener.close()
+
+
+# ------------------------------------------------- kill -9 mass retention
+
+_HOLDER_SCRIPT = """
+import sys, time
+from ratelimiter_tpu.serving import Client
+
+port = int(sys.argv[1])
+c = Client(port=port)
+cache = c.enable_leases(interval=0.02, hot_after=1, hot_window=60.0,
+                        low_water=0.0)
+# Exactly ONE wire decision seeds the hot detector; the grant follows
+# on a driver tick without further wire debits.
+assert c.allow("hh").allowed
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if cache.status()["leased_keys"]:
+        break
+    time.sleep(0.01)
+else:
+    sys.exit(3)
+for _ in range(5):
+    assert c.allow("hh").allowed  # local answers, no wire debit
+print("LEASED", flush=True)
+time.sleep(600)  # hold the lease until kill -9
+"""
+
+
+class TestKillNineHolder:
+    def test_killed_holder_budget_expires_and_mass_stays(self, tmp_path):
+        """kill -9 a lease-holding client process: the grant expires
+        server-side, its unused budget reads as consumed (bit-exact
+        mass retention), and a checkpoint restore does not resurrect
+        the mass."""
+        LIMIT, BUDGET = 200, 64
+        lim, _ = _mk_limiter(limit=LIMIT)
+        mgr = LeaseManager(lim, ttl=1.0, default_budget=BUDGET,
+                           registry=Registry())
+        script = tmp_path / "holder.py"
+        script.write_text(_HOLDER_SCRIPT, encoding="utf-8")
+        env = dict(os.environ,
+                   PYTHONPATH=str(REPO_ROOT),
+                   PYTHONUNBUFFERED="1",
+                   JAX_PLATFORMS="cpu")
+        with running_server(lim, leases=mgr) as (_, port, _loop):
+            proc = subprocess.Popen(
+                [sys.executable, str(script), str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                env=env, text=True)
+            try:
+                # jax/absl may chat on the merged stream before the
+                # holder's own banner — scan for it.
+                lines = []
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    line = proc.stdout.readline()
+                    if not line:
+                        break
+                    lines.append(line)
+                    if "LEASED" in line:
+                        break
+                assert any("LEASED" in ln for ln in lines), (
+                    f"holder never leased: {lines!r}")
+                assert mgr.status()["active"] == 1
+                # Snapshot the grant table while the holder is alive.
+                arrays, meta = mgr.snapshot_arrays()
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=30)
+                # No renewals arrive; the TTL sweep expires the grant.
+                _wait_until(
+                    lambda: (mgr.grant(99, "sweep-probe")[0] or True)
+                    and mgr.status()["expired_total"] >= 1,
+                    what="server-side lease expiry")
+                # The holder's grant is gone; its key holds no leases.
+                assert "hh" not in mgr._by_key
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait(timeout=10)
+        # Mass retention, bit-exact: the holder debited 1 wire decision
+        # + a 64-token grant; the probe key is separate. Kill -9 does
+        # NOT refund the 59 unspent tokens.
+        assert lim.allow_n("hh", LIMIT - BUDGET - 1).allowed
+        assert not lim.allow_n("hh", 1).allowed
+        # Restore the sidecar into a FRESH process: the grant table
+        # comes back, the limiter is untouched (no resurrection, no
+        # double debit — mass rides the limiter's own snapshot).
+        lim2, _ = _mk_limiter(limit=LIMIT)
+        mgr2 = LeaseManager(lim2, ttl=1.0, registry=Registry())
+        assert mgr2.restore_arrays(arrays, meta) == 1
+        assert lim2.allow_n("probe", LIMIT).allowed
+        lim2.close()
+        lim.close()
+
+
+# ----------------------------------------------- leases-off identity pin
+
+class TestLeasesOffPin:
+    def test_manager_attachment_is_decision_invisible(self):
+        """Leases off (manager constructed, zero grants): the decision
+        stream is byte-identical to a limiter that never heard of
+        leases — the pinned no-regression contract."""
+        rng = random.Random(1234)
+        workload = [(f"k{rng.randrange(8)}", rng.randrange(1, 4))
+                    for _ in range(600)]
+        lim_plain, _ = _mk_limiter(limit=100)
+        lim_leased, _ = _mk_limiter(limit=100)
+        LeaseManager(lim_leased, registry=Registry())  # attached, idle
+        got_plain = [lim_plain.allow_n(k, n).allowed for k, n in workload]
+        got_leased = [lim_leased.allow_n(k, n) for k, n in workload]
+        assert got_plain == [r.allowed for r in got_leased]
+        # Full-result equality, not just the bitmap.
+        lim_a, _ = _mk_limiter(limit=100)
+        lim_b, _ = _mk_limiter(limit=100)
+        LeaseManager(lim_b, registry=Registry())
+        for k, n in workload[:100]:
+            assert lim_a.allow_n(k, n) == lim_b.allow_n(k, n)
+
+
+# ----------------------------------------------------------- audit mirror
+
+class TestAuditMirror:
+    def test_reconcile_offers_leased_admissions_to_auditor(self):
+        from ratelimiter_tpu.observability import audit
+
+        cfg = Config(algorithm=Algorithm.TPU_SKETCH, limit=1000,
+                     window=60.0)
+        aud = audit.enable(cfg, sample=1, start=False,
+                           registry=Registry())
+        try:
+            lim, _ = _mk_limiter(limit=1000)
+            mgr = LeaseManager(lim, registry=Registry(),
+                               clock=FakeClock())
+            _, lease_id, _, _, _, _ = mgr.grant(1, "k", 64)
+            mgr.renew(1, lease_id, "k", 10, 0)
+            aud.process_pending()
+            st = aud.status()
+            assert st["samples"] >= 1
+        finally:
+            audit.disable()
+
+
+# ------------------------------------------------------------ fleet client
+
+class TestFleetClientLeases:
+    def test_fleet_client_leases_route_to_owner(self):
+        """FleetClient over two live members, each with its own lease
+        manager: hot keys lease from their OWNER, answer locally, and an
+        epoch bump retires stale leases client-side."""
+        lim_a, _ = _mk_limiter(limit=100000)
+        lim_b, _ = _mk_limiter(limit=100000)
+        mgr_a = LeaseManager(lim_a, ttl=2.0, default_budget=64,
+                             registry=Registry())
+        mgr_b = LeaseManager(lim_b, ttl=2.0, default_budget=64,
+                             registry=Registry())
+        from ratelimiter_tpu.serving.client import FleetClient
+
+        with running_server(lim_a, leases=mgr_a) as (_, pa, _l1), \
+                running_server(lim_b, leases=mgr_b) as (_, pb, _l2):
+            d = {"buckets": 32, "epoch": 1, "hosts": [
+                {"id": "a", "host": "127.0.0.1", "port": pa,
+                 "ranges": [[0, 16]], "successor": "b"},
+                {"id": "b", "host": "127.0.0.1", "port": pb,
+                 "ranges": [[16, 32]], "successor": "a"},
+            ]}
+            fc = FleetClient(d, map_max_age=None)
+            try:
+                cache = fc.enable_leases(interval=0.02, hot_after=3,
+                                         hot_window=5.0)
+                # One key per owner, so BOTH members grant.
+                owner_of = (lambda k: int(
+                    fc.map.owner_of_hash(fc._hash([k]))[0]))
+                key_a = next(f"k:{i}" for i in range(99)
+                             if owner_of(f"k:{i}") == 0)
+                key_b = next(f"k:{i}" for i in range(99)
+                             if owner_of(f"k:{i}") == 1)
+                _wait_until(
+                    lambda: (fc.allow(key_a).allowed
+                             and fc.allow(key_b).allowed
+                             and cache.status()["leased_keys"] == 2),
+                    what="leases from both owners")
+                assert mgr_a.status()["active"] == 1
+                assert mgr_b.status()["active"] == 1
+                before = cache.status()["local_answers"]
+                for _ in range(20):
+                    assert fc.allow(key_a).allowed
+                    assert fc.allow(key_b).allowed
+                assert cache.status()["local_answers"] >= before + 30
+                # Fleet epoch bump: stale-epoch leases retire locally.
+                assert cache.on_epoch(2) == 2
+                assert cache.status()["leased_keys"] == 0
+            finally:
+                fc.close()
+        lim_a.close()
+        lim_b.close()

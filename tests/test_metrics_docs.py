@@ -6,8 +6,9 @@ Three real server binaries (spawned concurrently) cover the
 backend-conditional families:
 
 * a fully-featured windowed-sketch member (fleet + audit + hh +
-  flight recorder + breaker + tenants + controller + persistence) —
-  the bulk of the families, incl. the sketch accuracy envelope;
+  flight recorder + breaker + tenants + controller + persistence +
+  leases) — the bulk of the families, incl. the sketch accuracy
+  envelope and the ADR-022 lease families;
 * a mesh member with quarantine — the per-slice failure-domain
   families;
 * a token-bucket server — the debt-slab families.
@@ -90,6 +91,7 @@ class TestMetricNameDrift:
                     "--hh-slots", "16", "--circuit-breaker",
                     "--tenants", "4", "--global-limit", "1000",
                     "--controller", "--snapshot-dir", snap,
+                    "--leases",
                     "--http-policy-token", "ptok"]),
             # 2: mesh + quarantine (per-slice failure domains).
             _spawn(["--backend", "mesh", "--mesh-devices", "2",
