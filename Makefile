@@ -8,10 +8,10 @@ export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
         test-audit test-fleet test-fleet-forward test-fleet-obs \
-        test-reshard test-hierarchy test-leases lint check native \
-        bench bench-quick bench-audit bench-chaos bench-fleet \
+        test-reshard test-hierarchy test-leases test-placement lint check \
+        native bench bench-quick bench-audit bench-chaos bench-fleet \
         bench-fleet-obs bench-reshard bench-hierarchy bench-leases \
-        bench-matrix serve verify clean
+        bench-rebalance bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -61,6 +61,9 @@ test-hierarchy:  ## hierarchical cascades + AIMD (ADR-020): oracle pinning, fair
 test-leases:     ## client-embedded quota leases (ADR-022): protocol, debit-upfront oracle, revocation chaos, kill -9, both doors, fleet
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_leases.py -q
 
+test-placement:  ## load-aware placement (ADR-023): planner determinism, chaos rebalance oracle, journal spill, real-process operator flow (slow lane unfiltered)
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_placement.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
@@ -81,6 +84,9 @@ bench-hierarchy: ## cascade overhead ratio + abuse-scenario numbers (tighten/rec
 
 bench-leases:    ## client-embedded lease numbers (leased vs wire rate, storm bound, Wilson delta, LEASE_r01 JSON, ADR-022)
 	JAX_PLATFORMS=cpu $(PY) bench.py --leases
+
+bench-rebalance: ## load-aware placement numbers (skewed fleet convergence, moved-range oracle, off-pin, REBALANCE_r01 JSON, ADR-023)
+	JAX_PLATFORMS=cpu $(PY) bench.py --rebalance
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -1098,6 +1098,19 @@ def main() -> None:
                          "bounded false-deny delta leases on vs off, "
                          "and the leases-off byte-identical pin "
                          "(published as LEASE_r01.json)")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="run ONLY the load-aware placement bench "
+                         "(ADR-023) over a 3-member fleet and emit the "
+                         "rebalance JSON block: skewed-hotspot "
+                         "imbalance before/after an operator "
+                         "dry-run -> apply through the bearer door "
+                         "(bar: >= 2.0x converging to <= 1.3x), the "
+                         "per-key admission oracle across the wire "
+                         "handoff (zero over-admission, zero client "
+                         "errors), the one-correlation-id journal "
+                         "reconstruction, and the rebalance-off "
+                         "byte-identical pin (published as "
+                         "REBALANCE_r01.json)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "
@@ -1108,6 +1121,18 @@ def main() -> None:
                          "time, and offline tools/rebucket.py resize "
                          "timings (published as RESHARD_r01.json)")
     args = ap.parse_args()
+
+    if args.rebalance:
+        from benchmarks.rebalance import run_rebalance
+
+        print(json.dumps({
+            "metric": "rebalance",
+            "platform": jax.devices()[0].platform,
+            "rebalance": run_rebalance(
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                log=lambda *a: print(*a, file=sys.stderr)),
+        }))
+        return
 
     if args.reshard:
         # Before the first jax.devices() call initializes the backend:

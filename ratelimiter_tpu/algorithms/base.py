@@ -355,6 +355,11 @@ class RateLimiter(abc.ABC):
         self._check_open()
         return self._hier().tenant_of(key)
 
+    def get_tenant(self, name: str):
+        """The registered Tenant (tid/limit/weight/floor), or None."""
+        self._check_open()
+        return self._hier().get_tenant(name)
+
     def list_tenants(self):
         """Sorted (name, Tenant) pairs."""
         self._check_open()

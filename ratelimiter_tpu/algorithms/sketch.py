@@ -355,6 +355,7 @@ class SketchLimiter(RateLimiter):
                     # — tenant ids derive on device, same dispatch.
                     args = args + (self._hier_device(),)
                 self._state, outs = step(*args)
+                self._fence_dispatch(outs)
                 # Inside the lock: a concurrent set/delete_override
                 # rebuilds the table's sorted views, and a torn read
                 # would mis-index. Raw-id launches finalize host-side
@@ -398,6 +399,16 @@ class SketchLimiter(RateLimiter):
         t.slot = slot
         t.padded = padded
         return t
+
+    def _fence_dispatch(self, outs) -> None:
+        """Complete a just-launched step before the dispatch lock drops.
+
+        No-op on the single-chip path, where in-flight executions are
+        independent and the async dispatch stream is the pipelining win.
+        Mesh backends override: their step embeds a per-chip collective,
+        and on the CPU host platform concurrent in-flight rendezvous
+        starve the shared device pool into a permanent deadlock (see
+        _MeshPlacement._fence_dispatch)."""
 
     def _launch_finish(self, outs, now_us: int):
         """Queue the device-side result-assembly kernel behind the step

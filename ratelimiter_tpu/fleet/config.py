@@ -284,9 +284,14 @@ class FleetMap:
                     to_id: str) -> "FleetMap":
         """New map with the given ``[lo, hi)`` ranges moved from
         ``from_id`` to ``to_id`` and the epoch bumped — the live
-        migration / rejoin / departure transition (ADR-018). Ranges move
-        as whole units and must be ranges ``from_id`` currently owns;
-        everything else (successors, snapshot dirs) is unchanged."""
+        migration / rejoin / departure transition (ADR-018). A moving
+        range may be a whole owned range OR a sub-range of one (the
+        placement planner carves slices out of affine units, ADR-023);
+        the remainder stays with ``from_id`` as split pieces. Each
+        moving range must lie entirely inside ONE owned range — a
+        handoff ships one standby unit, so a move that straddles units
+        is two moves. Everything else (successors, snapshot dirs) is
+        unchanged."""
         src = self.host(from_id)
         self.host(to_id)  # validates existence
         moving = {(int(lo), int(hi)) for lo, hi in ranges}
@@ -294,15 +299,38 @@ class FleetMap:
         if not moving:
             return self
         if not moving <= owned:
-            raise InvalidConfigError(
-                f"fleet host {from_id!r} does not own ranges "
-                f"{sorted(moving - owned)} (owns {sorted(owned)}); "
-                f"ranges move as whole units")
+            # Sub-range path: split each containing owned range into
+            # (left, moved, right) and keep the leftovers. Whole-unit
+            # moves above stay byte-identical to the pre-split code
+            # (no coalescing of existing tuples).
+            new_owned = set(owned)
+            for lo, hi in sorted(moving):
+                if not (0 <= lo < hi <= self.buckets):
+                    raise InvalidConfigError(
+                        f"range [{lo}, {hi}) is outside "
+                        f"[0, {self.buckets})")
+                parent = next((r for r in new_owned
+                               if r[0] <= lo and hi <= r[1]), None)
+                if parent is None:
+                    raise InvalidConfigError(
+                        f"fleet host {from_id!r} does not own range "
+                        f"[{lo}, {hi}) as a whole unit or sub-range "
+                        f"of one owned range (owns "
+                        f"{sorted(new_owned)}); a straddling move "
+                        f"must be issued per owned range")
+                new_owned.discard(parent)
+                if parent[0] < lo:
+                    new_owned.add((parent[0], lo))
+                if hi < parent[1]:
+                    new_owned.add((hi, parent[1]))
+            owned_after = new_owned
+        else:
+            owned_after = owned - moving
         hosts: List[FleetHost] = []
         for h in self.hosts:
             if h.id == from_id:
                 hosts.append(replace(h, ranges=tuple(
-                    sorted(owned - moving))))
+                    sorted(owned_after))))
             elif h.id == to_id:
                 hosts.append(replace(h, ranges=tuple(
                     sorted(set(h.ranges) | moving))))

@@ -159,6 +159,10 @@ class FleetCore:
         #: Failure sink (wired to FleetMembership.note_peer_failure):
         #: classified forward failures count toward peer-death detection.
         self.on_peer_failure = None
+        #: Placement load slab (ADR-023): attached by the server binary
+        #: for fleet members. owners_of_hash notes every routed row's
+        #: bucket into it — observation only, decisions untouched.
+        self.load_slab = None
         reg = registry if registry is not None else m.DEFAULT
         self._lane_metrics = LaneMetrics(reg)
         self._g_epoch = reg.gauge(
@@ -356,7 +360,19 @@ class FleetCore:
         return hash_prefixed_u64(list(keys), self.prefix)
 
     def owners_of_hash(self, h64: np.ndarray) -> np.ndarray:
-        return self.map.owner_of_hash(h64)
+        mp = self.map
+        slab = self.load_slab
+        if slab is None:
+            return mp.owner_of_hash(h64)
+        # Placement load accounting (ADR-023) rides the routing lookup:
+        # the bucket index is computed here ANYWAY — note it into the
+        # slab (two bincount adds) and gather owners from the same
+        # vector. Decisions are untouched; with the slab detached this
+        # path is byte-identical to owner_of_hash.
+        b = mp.bucket_of_hash(h64)
+        owners = mp.owner_table[b]
+        slab.note(b, owners == self.self_ordinal)
+        return owners
 
     def owners_of_ids(self, ids: np.ndarray) -> np.ndarray:
         return self.owners_of_hash(splitmix64(np.asarray(ids, np.uint64)))

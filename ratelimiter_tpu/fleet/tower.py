@@ -280,6 +280,17 @@ def merged_status(members: Dict[str, Optional[dict]]) -> dict:
             if b.get("hierarchy")}
     if hier:
         out["hierarchy"] = merge_hierarchy(hier)
+    plc = {h: b["placement"] for h, b in reach.items()
+           if b.get("placement")}
+    if plc:
+        from ratelimiter_tpu.placement.accounting import merge_placement
+
+        out["placement"] = merge_placement(plc)
+        rebal = {h: b["placement"]["rebalance"] for h, b in reach.items()
+                 if isinstance(b.get("placement"), dict)
+                 and b["placement"].get("rebalance")}
+        if rebal:
+            out["placement"]["rebalance"] = rebal
     return out
 
 

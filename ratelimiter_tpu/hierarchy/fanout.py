@@ -67,6 +67,9 @@ class HierarchyFanout:
     def tenant_of(self, key: str) -> str:
         return self.units[0].tenant_of(key)
 
+    def get_tenant(self, name: str):
+        return self.units[0].get_tenant(name)
+
     def list_tenants(self):
         return self.units[0].list_tenants()
 

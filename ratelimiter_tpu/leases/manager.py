@@ -134,6 +134,14 @@ class LeaseManager:
         self._by_key: Dict[str, Set[int]] = {}
         self._next_id = 1
         self._last_epoch = epoch_fn() if epoch_fn is not None else 0
+        #: Hotness ledger: consumer token -> grants issued. A key that
+        #: EARNED a lease stays grant-worthy across a restart, when the
+        #: hh side table is cold and ``require_hot`` would otherwise
+        #: refuse every grant until the sketch re-warms. Bounded;
+        #: checkpointed with the grant table (hot_token/hot_count
+        #: columns — tokens only, the OPERATIONS §6 PII boundary).
+        self._hot_counts: Dict[str, int] = {}
+        self._hot_cap = max(64, 4 * self.hot_k)
 
         reg = registry if registry is not None else m.DEFAULT
         self._g_active = reg.gauge(
@@ -211,13 +219,27 @@ class LeaseManager:
     def eligible(self, key: str) -> bool:
         """Hot-key nomination: with ``require_hot`` the key must sit in
         the hh side table's current top-k (the sketch already tracks
-        exactly the keys worth leasing); otherwise any key qualifies."""
+        exactly the keys worth leasing), or in the persisted hotness
+        ledger — a key that already earned a lease stays eligible after
+        a restart while the restored sketch's side table re-warms;
+        otherwise any key qualifies."""
         if not self.require_hot:
             return True
+        token = self._consumer_token(key)
         hot = self._hot_tokens()
-        if not hot:
-            return False
-        return self._consumer_token(key) in hot
+        if hot and token in hot:
+            return True
+        with self._lock:
+            return token in self._hot_counts
+
+    def _note_hot_locked(self, token: str) -> None:
+        self._hot_counts[token] = self._hot_counts.get(token, 0) + 1
+        if len(self._hot_counts) > self._hot_cap:
+            # Evict the coldest entry (ties: lowest token) — the ledger
+            # is a warm-start hint, not an exact ranking.
+            victim = min(self._hot_counts.items(),
+                         key=lambda kv: (kv[1], kv[0]))[0]
+            del self._hot_counts[victim]
 
     # ------------------------------------------------------------ grants
 
@@ -257,6 +279,7 @@ class LeaseManager:
                       expires=now + ttl, epoch=epoch, push=push)
             self._grants[lease_id] = g
             self._by_key.setdefault(key, set()).add(lease_id)
+            self._note_hot_locked(token)
             active = sum(1 for gg in self._grants.values()
                          if not gg.revoked)
         self._g_active.set(active)
@@ -542,6 +565,13 @@ class LeaseManager:
                 "epoch": np.asarray([g.epoch for g in gs],
                                     dtype=np.uint64),
             }
+            # Hotness ledger rides the same sidecar so restart keeps
+            # hot-key eligibility warm (tokens only, never raw keys).
+            hot = sorted(self._hot_counts.items())
+            arrays["hot_token"] = np.asarray(
+                [int(t, 16) for t, _ in hot], dtype=np.uint64)
+            arrays["hot_count"] = np.asarray(
+                [c for _, c in hot], dtype=np.int64)
             meta = {"next_id": self._next_id,
                     "last_epoch": self._last_epoch}
         return arrays, meta
@@ -573,6 +603,13 @@ class LeaseManager:
                     epoch=int(arrays["epoch"][i]),
                     revoked=bool(arrays["revoked"][i]))
                 self._grants[g.lease_id] = g
+            if "hot_token" in arrays:
+                # Older sidecars predate the ledger: keep it empty and
+                # let grants rebuild it.
+                self._hot_counts = {
+                    f"{int(t):016x}": int(c)
+                    for t, c in zip(arrays["hot_token"],
+                                    arrays["hot_count"])}
             self._next_id = max(int(meta.get("next_id", 1)),
                                 (max(self._grants) + 1
                                  if self._grants else 1))
@@ -599,6 +636,7 @@ class LeaseManager:
                 "default_budget": self.default_budget,
                 "max_leases": self.max_leases,
                 "require_hot": self.require_hot,
+                "hot_ledger": len(self._hot_counts),
                 "epoch": self._last_epoch,
             }
         out["granted_total"] = int(

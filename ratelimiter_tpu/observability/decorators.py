@@ -235,6 +235,9 @@ class LimiterDecorator(RateLimiter):
     def tenant_of(self, key: str) -> str:
         return self.inner.tenant_of(key)
 
+    def get_tenant(self, name: str):
+        return self.inner.get_tenant(name)
+
     def list_tenants(self):
         return self.inner.list_tenants()
 

@@ -43,6 +43,9 @@ dropped events the cursor never saw — the caller's history has a hole).
 
 from __future__ import annotations
 
+import json
+import os
+import re
 import threading
 import time
 from collections import deque
@@ -61,6 +64,7 @@ CATEGORIES = (
     "policy",       # per-key override + reset mutations
     "tenant",       # tenant registry / assignment / effective-limit moves
     "lease",        # client-embedded quota leases: grant/return/revoke/expire
+    "placement",    # load-aware rebalancing: plan/move/abort/veto (ADR-023)
 )
 
 
@@ -68,7 +72,9 @@ class EventJournal:
     """Bounded in-memory ring of structured control-plane events."""
 
     def __init__(self, capacity: int = 4096, *, host: str = "",
-                 registry=None):
+                 registry=None, spill_dir: Optional[str] = None,
+                 spill_segment_bytes: int = 1 << 20,
+                 spill_segments: int = 8):
         if capacity < 16:
             raise ValueError(f"capacity must be >= 16, got {capacity}")
         self.capacity = int(capacity)
@@ -82,6 +88,116 @@ class EventJournal:
                 "rate_limiter_events_total",
                 "Control-plane events recorded in the event journal "
                 "(ADR-021), by category")
+        # Optional append-only file spill: a restart replays the tail
+        # of the on-disk segments back into the ring, so pre-restart
+        # events survive (`--event-journal-dir`). Bounded: segments
+        # rotate at spill_segment_bytes and the oldest is deleted past
+        # spill_segments. Spill failures NEVER break serving — they are
+        # counted and surfaced in status().
+        self._spill_dir = spill_dir
+        self._spill_segment_bytes = max(4096, int(spill_segment_bytes))
+        self._spill_segments = max(1, int(spill_segments))
+        self._spill_file = None
+        self._spill_path = ""
+        self._spill_index = 0
+        self._spill_written = 0
+        self._spill_errors = 0
+        self._replayed = 0
+        if spill_dir:
+            self._spill_open(spill_dir)
+
+    # ------------------------------------------------------------ spill
+
+    _SEG_RE = re.compile(r"^events-(\d{8})\.jsonl$")
+
+    def _segments(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self._spill_dir)
+                           if self._SEG_RE.match(n))
+        except OSError:
+            return []
+        return names
+
+    def _spill_open(self, spill_dir: str) -> None:
+        try:
+            os.makedirs(spill_dir, exist_ok=True)
+            segs = self._segments()
+            # Replay the on-disk tail (oldest segment first) into the
+            # ring, re-sequencing: seqs are per-process-generation, the
+            # ring's contract is only "monotonic within this journal".
+            replay: deque = deque(maxlen=self.capacity)
+            for name in segs:
+                try:
+                    with open(os.path.join(self._spill_dir, name),
+                              encoding="utf-8") as f:
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            try:
+                                e = json.loads(line)
+                            except ValueError:
+                                continue  # torn tail write (kill -9)
+                            if isinstance(e, dict) and "category" in e:
+                                replay.append(e)
+                except OSError:
+                    continue
+            for e in replay:
+                self._seq += 1
+                e["seq"] = self._seq
+                e.setdefault("replayed", True)
+                self._events.append(e)
+            self._replayed = len(replay)
+            if segs:
+                self._spill_index = int(
+                    self._SEG_RE.match(segs[-1]).group(1)) + 1
+            self._spill_rotate_locked()
+        except OSError:
+            self._spill_errors += 1
+            self._spill_file = None
+
+    def _spill_rotate_locked(self) -> None:
+        if self._spill_file is not None:
+            try:
+                self._spill_file.close()
+            except OSError:
+                pass
+        self._spill_path = os.path.join(
+            self._spill_dir, f"events-{self._spill_index:08d}.jsonl")
+        self._spill_file = open(self._spill_path, "a",
+                                encoding="utf-8")
+        self._spill_index += 1
+        self._spill_written = 0
+        # Enforce the segment bound (oldest deleted first).
+        segs = self._segments()
+        while len(segs) > self._spill_segments:
+            try:
+                os.unlink(os.path.join(self._spill_dir, segs.pop(0)))
+            except OSError:
+                break
+
+    def _spill_locked(self, event: dict) -> None:
+        if self._spill_file is None:
+            return
+        try:
+            line = json.dumps(event, sort_keys=True,
+                              default=str) + "\n"
+            self._spill_file.write(line)
+            self._spill_file.flush()
+            self._spill_written += len(line)
+            if self._spill_written >= self._spill_segment_bytes:
+                self._spill_rotate_locked()
+        except (OSError, ValueError):
+            self._spill_errors += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._spill_file is not None:
+                try:
+                    self._spill_file.close()
+                except OSError:
+                    pass
+                self._spill_file = None
 
     # ----------------------------------------------------------- record
 
@@ -96,7 +212,7 @@ class EventJournal:
         with self._lock:
             self._seq += 1
             seq = self._seq
-            self._events.append({
+            event = {
                 "seq": seq,
                 "ts": round(now_wall, 6),
                 "mono_ns": now_mono,
@@ -107,7 +223,9 @@ class EventJournal:
                          else ""),
                 "severity": str(severity),
                 "payload": dict(payload) if payload else {},
-            })
+            }
+            self._events.append(event)
+            self._spill_locked(event)
         c = self._counter
         if c is not None:
             c.inc(category=str(category))
@@ -163,8 +281,16 @@ class EventJournal:
 
     def status(self) -> dict:
         with self._lock:
-            return {"capacity": self.capacity, "held": len(self._events),
-                    "seq": self._seq}
+            out = {"capacity": self.capacity,
+                   "held": len(self._events), "seq": self._seq}
+            if self._spill_dir:
+                out["spill"] = {
+                    "dir": self._spill_dir,
+                    "segments": len(self._segments()),
+                    "replayed": self._replayed,
+                    "errors": self._spill_errors,
+                }
+            return out
 
 
 #: Process-wide journal; None = journaling off. Library emit sites pay
@@ -176,16 +302,26 @@ JOURNAL: Optional[EventJournal] = None
 
 
 def enable(capacity: int = 4096, *, host: str = "",
-           registry=None) -> EventJournal:
+           registry=None, spill_dir: Optional[str] = None,
+           spill_segment_bytes: int = 1 << 20,
+           spill_segments: int = 8) -> EventJournal:
     """Install (and return) the process-wide journal, replacing any
-    previous one."""
+    previous one. With ``spill_dir`` the journal keeps an append-only
+    on-disk mirror (bounded rotating segments) and replays its tail
+    into the ring on startup — a restart no longer loses the events
+    that explain WHY it restarted."""
     global JOURNAL
-    JOURNAL = EventJournal(capacity, host=host, registry=registry)
+    JOURNAL = EventJournal(capacity, host=host, registry=registry,
+                           spill_dir=spill_dir,
+                           spill_segment_bytes=spill_segment_bytes,
+                           spill_segments=spill_segments)
     return JOURNAL
 
 
 def disable() -> None:
     global JOURNAL
+    if JOURNAL is not None:
+        JOURNAL.close()
     JOURNAL = None
 
 

@@ -78,6 +78,23 @@ class _MeshPlacement:
         the n_chips devices holds a complete copy."""
         return super().memory_bytes() * self.n_chips
 
+    def _fence_dispatch(self, outs) -> None:
+        # At most ONE in-flight collective execution on the host platform:
+        # xla:cpu's all_gather/psum rendezvous parks device-pool threads
+        # until every participant arrives, so two concurrent n_chips-wide
+        # executions can each hold a subset of the pool and starve the
+        # other forever (observed under the 150-thread contract storm:
+        # a dozen in-flight steps, every rank logging "waiting for all
+        # participants to arrive", zero progress). Completing the step
+        # while the dispatch lock is still held caps the stream at one
+        # rendezvous, which a starved pool always drains. Real devices
+        # serialize executions in the hardware queue — the fence there
+        # would only re-order the wait, so it stays CPU-only.
+        if self.mesh.devices.flat[0].platform == "cpu":
+            import jax
+
+            jax.block_until_ready((self._state, outs))
+
 
 class MeshSketchLimiter(_MeshPlacement, SketchLimiter):
     """Sketch limiter whose dispatch spans every chip of a mesh.
@@ -700,6 +717,9 @@ class SlicedMeshLimiter(RateLimiter):
 
     def tenant_of(self, key: str) -> str:
         return self._hier().tenant_of(key)
+
+    def get_tenant(self, name: str):
+        return self._hier().get_tenant(name)
 
     def list_tenants(self):
         return self._hier().list_tenants()

@@ -1,0 +1,31 @@
+"""Load-aware placement (ADR-023): the fleet rebalancing brain.
+
+ADR-018 built the complete live-migration *mechanism* (capture →
+WAL-suffix replay → epoch flip, zero client errors); this package is
+the *policy* that was the ROADMAP residual — it decides which ranges
+move, when, and how fast:
+
+* :mod:`accounting` — per-bucket decision/forward mass on the hot path
+  at counter-increment cost (the bucket index is already computed for
+  routing), drained at scrape cadence into EWMA rates.
+* :mod:`planner` — a deterministic, seeded greedy planner that turns
+  the merged fleet load view into a bounded migration plan under
+  hysteresis bands and a min-residency cooldown.
+* :mod:`executor` — the RebalanceController: plans execute through the
+  existing ``migrate_ranges`` handoff one move at a time, with AIMD
+  pacing vetoed by the ADR-016 observatory (SLO burn, false-deny
+  Wilson bounds), journaled under one correlation id per plan.
+"""
+
+from ratelimiter_tpu.placement.accounting import LoadSlab, merge_placement
+from ratelimiter_tpu.placement.planner import Plan, PlannerKnobs, plan_moves
+from ratelimiter_tpu.placement.executor import RebalanceController
+
+__all__ = [
+    "LoadSlab",
+    "merge_placement",
+    "Plan",
+    "PlannerKnobs",
+    "plan_moves",
+    "RebalanceController",
+]

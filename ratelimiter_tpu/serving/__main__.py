@@ -184,6 +184,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "migration, ADR-018) on the HTTP gateway, gated "
                          "by this bearer token. No token, no endpoint — "
                          "an ownership-move lever is never open")
+    ap.add_argument("--http-rebalance-token", default=None,
+                    help="enable GET/POST /v1/fleet/rebalance (placement "
+                         "brain operator surface, ADR-023: status / "
+                         "dry-run / apply / abort) on the HTTP gateway, "
+                         "gated by this bearer token. No token, no "
+                         "endpoint — same posture as /v1/fleet/migrate")
     ap.add_argument("--max-batch", type=int, default=4096,
                     help="micro-batcher flush size")
     ap.add_argument("--max-delay-us", type=float, default=200.0,
@@ -257,6 +263,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--event-journal-capacity", type=int, default=4096,
                     help="events held in the journal ring (oldest "
                          "evicted; ~300 B/event)")
+    ap.add_argument("--event-journal-dir", default=None, metavar="DIR",
+                    help="also spill journal events to append-only "
+                         "JSONL segments in DIR (bounded rotation) and "
+                         "replay the on-disk tail into the ring at "
+                         "startup — a restart keeps the events that "
+                         "explain WHY it restarted")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the MetricsDecorator (on by default)")
     # Live accuracy observatory (ADR-016).
@@ -372,6 +384,41 @@ def build_parser() -> argparse.ArgumentParser:
                          "wire frame (ADR-019; capped at 32768 — the "
                          "coalesced REPLY costs ~24 B/row against the "
                          "1 MiB wire bound)")
+    # Load-aware placement (ADR-023): the fleet rebalancing brain.
+    ap.add_argument("--rebalance", action="store_true",
+                    help="run the placement rebalancer (ADR-023): a "
+                         "background loop that merges every member's "
+                         "per-bucket decision load, plans bounded "
+                         "range moves toward max/mean balance "
+                         "(hysteresis + min-residency cooldown, so "
+                         "ranges never flap), and executes its OWN "
+                         "donated moves through the ADR-018 handoff — "
+                         "paced AIMD-style and vetoed by SLO burn / "
+                         "false-deny bounds. Needs --fleet-config; "
+                         "every member should run it (each executes "
+                         "only the moves it donates)")
+    ap.add_argument("--rebalance-interval", type=float, default=10.0,
+                    help="seconds between rebalance planning cycles "
+                         "(vetoes and failed moves back the effective "
+                         "interval off multiplicatively)")
+    ap.add_argument("--rebalance-max-moves", type=int, default=2,
+                    help="range moves budgeted per planning cycle")
+    ap.add_argument("--rebalance-trigger", type=float, default=1.4,
+                    help="plan only when fleet max/mean decision-load "
+                         "imbalance reaches this ratio (hysteresis "
+                         "upper band)")
+    ap.add_argument("--rebalance-target", type=float, default=1.15,
+                    help="plan down toward this imbalance ratio "
+                         "(hysteresis lower band; must be below the "
+                         "trigger or the fleet flaps)")
+    ap.add_argument("--rebalance-min-residency", type=float,
+                    default=60.0,
+                    help="seconds a moved bucket is frozen before it "
+                         "may move again (flap prevention)")
+    ap.add_argument("--rebalance-seed", type=int, default=0,
+                    help="planner seed, salted into every plan id "
+                         "(plans are deterministic: same load view -> "
+                         "same plan)")
     ap.add_argument("--dcn-secret", default=None,
                     help="shared secret HMAC-gating T_DCN_PUSH frames "
                          "(both sides must set it; prefer the "
@@ -938,7 +985,8 @@ async def amain(args) -> None:
         events_mod.enable(args.event_journal_capacity,
                           host=(args.fleet_self or
                                 f"{args.host}:{args.port}"),
-                          registry=obs_metrics.DEFAULT)
+                          registry=obs_metrics.DEFAULT,
+                          spill_dir=args.event_journal_dir)
     http_debug = bool(args.debug_trace or args.debug_token)
 
     cfg = Config(
@@ -976,6 +1024,13 @@ async def amain(args) -> None:
         raise SystemExit("--tenant/--assign need --tenants > 0")
     if args.mesh_devices is not None and args.backend != "mesh":
         raise SystemExit("--mesh-devices needs --backend mesh")
+    if args.rebalance and not args.fleet_config:
+        raise SystemExit("--rebalance needs --fleet-config (the "
+                         "placement brain moves fleet ranges)")
+    if args.rebalance and args.rebalance_target >= args.rebalance_trigger:
+        raise SystemExit("--rebalance-target must be below "
+                         "--rebalance-trigger (the hysteresis band "
+                         "prevents flapping)")
     if args.lease_require_hot and not args.leases:
         raise SystemExit("--lease-require-hot needs --leases")
     if args.lease_require_hot and args.hh_slots <= 0:
@@ -1165,6 +1220,15 @@ async def amain(args) -> None:
             forward_conns=args.fleet_forward_conns,
             forward_coalesce=args.fleet_forward_coalesce,
             registry=obs_metrics.DEFAULT)
+        # Placement load accounting (ADR-023): attached for EVERY fleet
+        # member, not just --rebalance ones — any planning peer needs to
+        # see this member's per-bucket load, and the /healthz placement
+        # block + rate_limiter_placement_* families export either way.
+        # Observation only: decisions and wire bytes are untouched.
+        from ratelimiter_tpu.placement import LoadSlab
+
+        fleet_core.load_slab = LoadSlab(fleet_map.buckets,
+                                        registry=obs_metrics.DEFAULT)
 
         def _fleet_adopt(dead):
             """Failover standby unit: a fresh single-device sketch
@@ -1268,6 +1332,72 @@ async def amain(args) -> None:
             return {}
         return {"fleet": {**fleet_core.status(),
                           **fleet_membership.status()}}
+
+    # Placement (ADR-023): per-member load slab block (+ controller
+    # status when the rebalancer runs here). Late-bound cell like the
+    # tower's health: the controller is built with the door below.
+    _rebalance_ctl = [None]
+
+    def _placement_health() -> dict:
+        if fleet_core is None or fleet_core.load_slab is None:
+            return {}
+        blk = fleet_core.load_slab.snapshot()
+        if _rebalance_ctl[0] is not None:
+            blk["rebalance"] = _rebalance_ctl[0].status()
+        return {"placement": blk}
+
+    def _make_rebalance(tower):
+        """(controller, gateway hook) for the placement brain. The
+        controller exists when this is a fleet member AND the operator
+        asked for it (--rebalance background loop, or just
+        --http-rebalance-token for a manual dry-run/apply surface)."""
+        if fleet_core is None or fleet_core.load_slab is None:
+            return None, None
+        if not (args.rebalance or args.http_rebalance_token):
+            return None, None
+        from ratelimiter_tpu.placement import (
+            PlannerKnobs,
+            RebalanceController,
+        )
+
+        if tower is None and len(fleet_core.map.hosts) > 1:
+            logging.getLogger("ratelimiter_tpu.placement").warning(
+                "rebalance on a multi-member fleet without --http-port: "
+                "peers' load blocks are unreachable, so every cycle "
+                "skips on load-gap (wire an HTTP gateway and declare "
+                "\"http\" ports in the fleet map)")
+        ctl = RebalanceController(
+            fleet_core, fleet_membership, fleet_core.load_slab,
+            interval=args.rebalance_interval,
+            knobs=PlannerKnobs(
+                max_moves=args.rebalance_max_moves,
+                trigger_ratio=args.rebalance_trigger,
+                target_ratio=args.rebalance_target,
+                min_residency_s=args.rebalance_min_residency),
+            seed=args.rebalance_seed,
+            fetch_peer_health=(
+                (lambda: tower._fetch_all("/healthz", None))
+                if tower is not None else None),
+            slo_status=(slo_tracker.status if slo_tracker is not None
+                        else None),
+            audit_status=(auditor.status if auditor is not None
+                          else None),
+            registry=obs_metrics.DEFAULT)
+        _rebalance_ctl[0] = ctl
+
+        def hook(action: str) -> dict:
+            if action == "status":
+                return {"ok": True, "auto": bool(args.rebalance),
+                        **ctl.status()}
+            if action == "dry-run":
+                return ctl.dry_run()
+            if action == "apply":
+                return ctl.apply()
+            if action == "abort":
+                return ctl.abort()
+            return {"ok": False, "error": f"unknown action {action!r}"}
+
+        return ctl, hook
 
     # Member identity (ADR-021): /healthz "member" block + the
     # rate_limiter_member_info identity gauge.
@@ -1442,6 +1572,7 @@ async def amain(args) -> None:
                         **_hierarchy_health(hier, controller),
                         **_lease_health(lease_mgr),
                         **_fleet_health(),
+                        **_placement_health(),
                         **_events_health(),
                         **({"quarantine": qmgr.status()}
                            if qmgr is not None else {}),
@@ -1449,6 +1580,7 @@ async def amain(args) -> None:
 
             _tower_health[0] = health_fn
             tower = _make_tower()
+            rebal_ctl, fleet_rebalance = _make_rebalance(tower)
             gateway = HttpGateway(
                 server.decide_one, lease_reset,
                 host=args.host, port=args.http_port,
@@ -1477,8 +1609,12 @@ async def amain(args) -> None:
                                     or args.http_tenants_token),
                 tenants_token=args.http_tenants_token,
                 fleet_migrate=fleet_migrate,
-                migrate_token=args.http_migrate_token)
+                migrate_token=args.http_migrate_token,
+                fleet_rebalance=fleet_rebalance,
+                rebalance_token=args.http_rebalance_token)
             gateway.start()
+        else:
+            rebal_ctl = None
         grpc_srv = None
         if args.grpc_port is not None:
             from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
@@ -1490,7 +1626,8 @@ async def amain(args) -> None:
                     "decisions_total", 0),
                 decide_many=server.decide_many,
                 policy=(lease_set, server.get_override_one, lease_del),
-                default_limit=lambda: limiter.config.limit)
+                default_limit=lambda: limiter.config.limit,
+                tenants=hier)
             grpc_srv.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -1507,9 +1644,15 @@ async def amain(args) -> None:
             fleet_membership.start()
         if controller is not None:
             controller.start()
+        if rebal_ctl is not None and args.rebalance:
+            rebal_ctl.start()
         if start_chaos is not None:
             start_chaos()
         await stop.wait()
+        if rebal_ctl is not None:
+            # Before departure: a mid-shutdown plan must not race the
+            # departure handoff for the same ranges.
+            rebal_ctl.stop()
         if controller is not None:
             # Before the doors drain: a controller tick against a
             # closing limiter would race teardown.
@@ -1660,6 +1803,7 @@ async def amain(args) -> None:
                     **_hierarchy_health(hier, controller),
                     **_lease_health(lease_mgr),
                     **_fleet_health(),
+                    **_placement_health(),
                     **_events_health(),
                     **({"quarantine": qmgr.status()}
                        if qmgr is not None else {}),
@@ -1667,6 +1811,7 @@ async def amain(args) -> None:
 
         _tower_health[0] = health_fn
         tower = _make_tower()
+        rebal_ctl, fleet_rebalance = _make_rebalance(tower)
         gateway = HttpGateway(
             threadsafe_decide, lease_reset,
             host=args.host, port=args.http_port,
@@ -1694,8 +1839,12 @@ async def amain(args) -> None:
                                 or args.http_tenants_token),
             tenants_token=args.http_tenants_token,
             fleet_migrate=fleet_migrate,
-            migrate_token=args.http_migrate_token)
+            migrate_token=args.http_migrate_token,
+            fleet_rebalance=fleet_rebalance,
+            rebalance_token=args.http_rebalance_token)
         gateway.start()
+    else:
+        rebal_ctl = None
     if args.grpc_port is not None:
         from ratelimiter_tpu.serving.grpc_server import GrpcRateLimitServer
 
@@ -1705,7 +1854,8 @@ async def amain(args) -> None:
             decisions_total=lambda: server.batcher.decisions_total,
             decide_many=make_threadsafe_decide_many(server.batcher, loop),
             policy=(lease_set, limiter.get_override, lease_del),
-            default_limit=lambda: limiter.config.limit)
+            default_limit=lambda: limiter.config.limit,
+            tenants=hier)
         grpc_srv.start()
 
     stop = asyncio.Event()
@@ -1720,9 +1870,15 @@ async def amain(args) -> None:
         fleet_membership.start()
     if controller is not None:
         controller.start()
+    if rebal_ctl is not None and args.rebalance:
+        rebal_ctl.start()
     if start_chaos is not None:
         start_chaos()
     await stop.wait()
+    if rebal_ctl is not None:
+        # Before departure: a mid-shutdown plan must not race the
+        # departure handoff for the same ranges.
+        rebal_ctl.stop()
     if controller is not None:
         # Before the door drains: a controller tick against a closing
         # limiter would race teardown.

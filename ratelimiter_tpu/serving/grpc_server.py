@@ -125,7 +125,8 @@ class GrpcRateLimitServer:
                  max_workers: int = 8,
                  decide_many: Optional[Callable] = None,
                  policy: Optional[tuple] = None,
-                 default_limit: Optional[Callable[[], int]] = None):
+                 default_limit: Optional[Callable[[], int]] = None,
+                 tenants: Optional[object] = None):
         """``decide_many``: optional bulk callable ``[(key, n), ...] ->
         [Result, ...]`` (request order). When wired, AllowBatch submits
         the WHOLE frame to the micro-batcher before waiting, so an
@@ -133,7 +134,10 @@ class GrpcRateLimitServer:
         sequential submit-wait round-trips. ``policy``: optional
         ``(set_override, get_override, delete_override)`` triple
         enabling the override RPCs; ``default_limit`` supplies the
-        default-tier limit GetOverride reports on a miss."""
+        default-tier limit GetOverride reports on a miss. ``tenants``:
+        optional hierarchy surface (TenantTable / HierarchyFanout)
+        enabling the tenant CRUD RPCs — mutations are journaled with
+        ``actor="grpc"`` mirroring the HTTP twin's /v1/tenants."""
         import grpc
         from concurrent import futures
 
@@ -301,6 +305,64 @@ class GrpcRateLimitServer:
                 "GetOverride": (get_override, pb2.GetOverrideRequest),
                 "DeleteOverride": (delete_override,
                                    pb2.DeleteOverrideRequest),
+            })
+
+        if tenants is not None:
+            hier = tenants
+
+            def set_tenant(req):
+                t = hier.set_tenant(
+                    req.name,
+                    int(req.limit) if req.limit else None,
+                    weight=int(req.weight) if req.weight else 1,
+                    floor=int(req.floor) if req.floor else None)
+                events.emit("tenant", "set", actor="grpc",
+                            payload={"name": req.name,
+                                     "limit": int(t.limit),
+                                     "weight": int(t.weight),
+                                     "floor": int(t.floor)})
+                return pb2.TenantResponse(
+                    found=True, name=req.name, tid=int(t.tid),
+                    limit=int(t.limit), weight=int(t.weight),
+                    floor=int(t.floor))
+
+            def get_tenant(req):
+                t = hier.get_tenant(req.name)
+                if t is None:
+                    return pb2.TenantResponse(found=False, name=req.name)
+                return pb2.TenantResponse(
+                    found=True, name=req.name, tid=int(t.tid),
+                    limit=int(t.limit), weight=int(t.weight),
+                    floor=int(t.floor))
+
+            def delete_tenant(req):
+                deleted = bool(hier.delete_tenant(req.name))
+                events.emit("tenant", "delete", actor="grpc",
+                            payload={"name": req.name,
+                                     "deleted": deleted})
+                return pb2.DeleteTenantResponse(deleted=deleted)
+
+            def assign_tenant(req):
+                hier.assign_tenant(req.key, req.tenant)
+                events.emit("tenant", "assign", actor="grpc",
+                            payload={"key_hash": _key_token(req.key),
+                                     "tenant": req.tenant})
+                return pb2.AssignTenantResponse()
+
+            def unassign_tenant(req):
+                unassigned = bool(hier.unassign_tenant(req.key))
+                events.emit("tenant", "unassign", actor="grpc",
+                            payload={"key_hash": _key_token(req.key),
+                                     "unassigned": unassigned})
+                return pb2.UnassignTenantResponse(unassigned=unassigned)
+
+            rpcs.update({
+                "SetTenant": (set_tenant, pb2.SetTenantRequest),
+                "GetTenant": (get_tenant, pb2.GetTenantRequest),
+                "DeleteTenant": (delete_tenant, pb2.DeleteTenantRequest),
+                "AssignTenant": (assign_tenant, pb2.AssignTenantRequest),
+                "UnassignTenant": (unassign_tenant,
+                                   pb2.UnassignTenantRequest),
             })
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(

@@ -144,7 +144,9 @@ class HttpGateway:
                  migrate_token: Optional[str] = None,
                  fleet_status: Optional[Callable[[], dict]] = None,
                  fleet_trace: Optional[Callable] = None,
-                 fleet_events: Optional[Callable] = None):
+                 fleet_events: Optional[Callable] = None,
+                 fleet_rebalance: Optional[Callable] = None,
+                 rebalance_token: Optional[str] = None):
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -363,6 +365,37 @@ class HttpGateway:
                 wait = float(q.get("wait", ["10.0"])[0])
                 out = gateway.fleet_migrate(ranges, to, wait)
                 self._send(200 if out.get("ok") else 504, out)
+
+            def _handle_rebalance(self, q) -> None:
+                """Operator surface for the placement brain (ADR-023):
+                GET /v1/fleet/rebalance (status) and POST
+                /v1/fleet/rebalance?action=dry-run|apply|abort. An
+                ownership-move lever like /v1/fleet/migrate, so it only
+                exists when the embedding wired BOTH the controller
+                hook AND a bearer token (--http-rebalance-token)."""
+                if gateway.fleet_rebalance is None or \
+                        gateway.rebalance_token is None:
+                    self._send(403, {"error": "rebalancing is not "
+                                     "exposed on this gateway (needs "
+                                     "--http-rebalance-token on a "
+                                     "fleet member)"})
+                    return
+                if not self._bearer_ok(gateway.rebalance_token):
+                    self._send(403, {"error": "bad rebalance token"})
+                    return
+                if self.command == "GET":
+                    self._send(200, gateway.fleet_rebalance("status"))
+                    return
+                if self.command != "POST":
+                    self._send(405, {"error": "GET or POST only"})
+                    return
+                action = q.get("action", [None])[0]
+                if action not in ("dry-run", "apply", "abort"):
+                    self._send(400, {"error": "action must be one of "
+                                     "dry-run|apply|abort"})
+                    return
+                out = gateway.fleet_rebalance(action)
+                self._send(200 if out.get("ok") else 409, out)
 
             def _bearer_value(self) -> Optional[str]:
                 """The caller's bearer token (pass-through credential
@@ -641,6 +674,8 @@ class HttpGateway:
                         self._handle_tenants(q)
                     elif url.path == "/v1/fleet/migrate":
                         self._handle_migrate(q)
+                    elif url.path == "/v1/fleet/rebalance":
+                        self._handle_rebalance(q)
                     elif (url.path == "/v1/snapshot"
                           and self.command == "POST"):
                         # Durability trigger: bearer-gated like reset
@@ -759,6 +794,10 @@ class HttpGateway:
         self.fleet_status = fleet_status
         self.fleet_trace = fleet_trace
         self.fleet_events = fleet_events
+        # Placement rebalancer (ADR-023 operator surface): hook AND
+        # token both required — _handle_rebalance refuses otherwise.
+        self.fleet_rebalance = fleet_rebalance
+        self.rebalance_token = rebalance_token
         self._profile_lock = threading.Lock()
         self._decide_trace = _accepts_trace(decide)
         self._decide_deadline = _accepts_kw(decide, "deadline")

@@ -72,6 +72,16 @@ def _stub(channel, pb2):
                              pb2.OverrideResponse)
         DeleteOverride = method("DeleteOverride", pb2.DeleteOverrideRequest,
                                 pb2.DeleteOverrideResponse)
+        SetTenant = method("SetTenant", pb2.SetTenantRequest,
+                           pb2.TenantResponse)
+        GetTenant = method("GetTenant", pb2.GetTenantRequest,
+                           pb2.TenantResponse)
+        DeleteTenant = method("DeleteTenant", pb2.DeleteTenantRequest,
+                              pb2.DeleteTenantResponse)
+        AssignTenant = method("AssignTenant", pb2.AssignTenantRequest,
+                              pb2.AssignTenantResponse)
+        UnassignTenant = method("UnassignTenant", pb2.UnassignTenantRequest,
+                                pb2.UnassignTenantResponse)
 
     return Stub
 
@@ -278,6 +288,87 @@ class TestGrpcServer:
             channel.close()
             srv.shutdown()
             lim.close()
+
+    def test_tenant_crud_and_journal(self, pb2):
+        """Tenant CRUD over gRPC: the registry mutations work and land
+        in the control-plane journal with actor="grpc" — the same
+        vocabulary as the HTTP twin's /v1/tenants (ADR-021), so an
+        incident reconstruction never depends on WHICH surface the
+        operator used."""
+        import json
+
+        from ratelimiter_tpu import HierarchySpec, SketchParams
+        from ratelimiter_tpu.observability import events
+
+        clock = ManualClock(T0)
+        cfg = Config(
+            algorithm=Algorithm.SLIDING_WINDOW, limit=50, window=60.0,
+            sketch=SketchParams(depth=2, width=512, sub_windows=4),
+            hierarchy=HierarchySpec(tenants=4))
+        lim = create_limiter(cfg, backend="sketch", clock=clock)
+        srv = GrpcRateLimitServer(
+            lambda key, n: lim.allow_n(key, n), lim.reset,
+            tenants=lim)
+        srv.start()
+        channel = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+        stub = _stub(channel, pb2)
+        events.enable(capacity=64)
+        try:
+            out = stub.SetTenant(pb2.SetTenantRequest(
+                name="gold", limit=30, weight=3))
+            assert out.found and out.limit == 30 and out.weight == 3
+            assert out.floor == 3  # default: ceiling / 10
+            got = stub.GetTenant(pb2.GetTenantRequest(name="gold"))
+            assert got.found and got.tid == out.tid
+            miss = stub.GetTenant(pb2.GetTenantRequest(name="nope"))
+            assert not miss.found
+            stub.AssignTenant(pb2.AssignTenantRequest(
+                key="acct:1", tenant="gold"))
+            assert lim.tenant_of("acct:1") == "gold"
+            un = stub.UnassignTenant(pb2.UnassignTenantRequest(
+                key="acct:1"))
+            assert un.unassigned
+            dl = stub.DeleteTenant(pb2.DeleteTenantRequest(name="gold"))
+            assert dl.deleted
+            assert not stub.DeleteTenant(
+                pb2.DeleteTenantRequest(name="gold")).deleted
+            # Unknown tenant on assign -> INVALID_ARGUMENT (core error
+            # taxonomy, same as every other surface).
+            with pytest.raises(grpc.RpcError) as ei:
+                stub.AssignTenant(pb2.AssignTenantRequest(
+                    key="k", tenant="nope"))
+            assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+            evs = events.get().tail(category="tenant")["events"]
+            assert [(e["action"], e["actor"]) for e in evs] == [
+                ("set", "grpc"),
+                ("assign", "grpc"),
+                ("unassign", "grpc"),
+                ("delete", "grpc"),
+                ("delete", "grpc"),
+            ]
+            assert evs[0]["payload"] == {"name": "gold", "limit": 30,
+                                         "weight": 3, "floor": 3}
+            assert evs[3]["payload"]["deleted"] is True
+            assert evs[4]["payload"]["deleted"] is False
+            # Keys ride as hashed tokens only (OPERATIONS §6).
+            assert "acct:1" not in json.dumps(evs)
+            assert evs[1]["payload"]["key_hash"] == \
+                evs[2]["payload"]["key_hash"]
+        finally:
+            events.disable()
+            channel.close()
+            srv.shutdown()
+            lim.close()
+
+    def test_tenantless_server_unimplemented(self, served, pb2):
+        """Without a hierarchy surface the tenant RPCs are absent —
+        UNIMPLEMENTED, exactly like any unregistered method."""
+        channel, _lim, _clock = served
+        stub = _stub(channel, pb2)
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.SetTenant(pb2.SetTenantRequest(name="gold", limit=1))
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
 
     def test_closed_limiter_failed_precondition(self, pb2):
         cfg = Config(algorithm=Algorithm.FIXED_WINDOW, limit=3, window=60.0)

@@ -92,6 +92,7 @@ class TestMetricNameDrift:
                     "--tenants", "4", "--global-limit", "1000",
                     "--controller", "--snapshot-dir", snap,
                     "--leases",
+                    "--http-rebalance-token", "rtok",
                     "--http-policy-token", "ptok"]),
             # 2: mesh + quarantine (per-slice failure domains).
             _spawn(["--backend", "mesh", "--mesh-devices", "2",

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Operator CLI for the placement brain (ADR-023): a thin wrapper over
+the bearer-gated gateway endpoint
+
+    GET  /v1/fleet/rebalance            -> controller status
+    POST /v1/fleet/rebalance?action=dry-run | apply | abort
+
+    python tools/fleet_rebalance.py http://member:8433 status \
+        --token $REBALANCE_TOKEN
+    python tools/fleet_rebalance.py http://member:8433 dry-run \
+        --token $REBALANCE_TOKEN
+    python tools/fleet_rebalance.py http://member:8433 apply \
+        --token $REBALANCE_TOKEN
+
+The gateway must have been started with ``--http-rebalance-token`` on a
+fleet member (there is no tokenless rebalance surface). ``dry-run``
+returns the plan the member would execute right now without moving
+anything; ``apply`` clears any operator hold and runs one full cycle
+synchronously; ``abort`` stops the in-flight plan between moves and
+holds the background loop until the next ``apply``.
+
+Pure stdlib (urllib); no client library import, so it runs from any
+operator box that can reach the gateway port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+ACTIONS = ("status", "dry-run", "apply", "abort")
+
+
+def rebalance(gateway: str, action: str, *, token: str,
+              timeout: float) -> dict:
+    base = f"{gateway.rstrip('/')}/v1/fleet/rebalance"
+    if action == "status":
+        url, method = base, "GET"
+    else:
+        q = urllib.parse.urlencode({"action": action})
+        url, method = f"{base}?{q}", "POST"
+    req = urllib.request.Request(
+        url, method=method,
+        headers={"Authorization": f"Bearer {token}"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        # The gateway answers errors as JSON too (403/400/409); surface
+        # its body, not a bare traceback.
+        try:
+            body = json.loads(exc.read().decode())
+        except Exception:  # noqa: BLE001 — non-JSON error page
+            body = {"error": str(exc)}
+        body.setdefault("ok", False)
+        body["http_status"] = exc.code
+        return body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fleet rebalance control via a member's HTTP "
+                    "gateway (/v1/fleet/rebalance).")
+    ap.add_argument("gateway",
+                    help="member's gateway base URL, e.g. http://host:8433")
+    ap.add_argument("action", choices=ACTIONS,
+                    help="status: controller state; dry-run: plan without "
+                         "moving; apply: run one cycle now (clears a hold); "
+                         "abort: stop between moves and hold the loop")
+    ap.add_argument("--token", required=True,
+                    help="bearer token (the server's "
+                         "--http-rebalance-token)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="HTTP timeout; apply blocks for the full cycle "
+                         "(default 120)")
+    args = ap.parse_args(argv)
+
+    out = rebalance(args.gateway, args.action, token=args.token,
+                    timeout=args.timeout)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
