@@ -6,7 +6,7 @@ PY ?= python
 REPO := $(abspath $(dir $(lastword $(MAKEFILE_LIST))))
 export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
-.PHONY: help test test-all test-serving test-mesh test-tracing test-chaos \
+.PHONY: help test test-all test-serving test-mesh test-collective test-tracing test-chaos \
         test-audit test-fleet test-fleet-forward test-fleet-obs \
         test-reshard test-hierarchy test-leases test-placement lint check \
         native bench bench-quick bench-audit bench-chaos bench-fleet \
@@ -28,6 +28,9 @@ test-serving:    ## serving tier only
 test-mesh:       ## mesh contract + multichip + slice-parallel serving tests
 	$(PY) -m pytest tests/test_contract_mesh.py tests/test_multichip.py \
 	    tests/test_mesh_serving.py tests/test_scatter_gather.py -q
+
+test-collective: ## collective router parity + overflow fallback (ADR-024)
+	$(PY) -m pytest tests/test_collective_router.py -q
 
 test-tracing:    ## flight-recorder span trees, both doors (ADR-014)
 	$(PY) -m pytest tests/test_tracing.py -q

@@ -247,6 +247,7 @@ def measure_mesh_step_rate(n_devices: int, *, seconds: float = 2.0,
 
 def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
                          e2e_seconds: float = 0.0, batch: int = 16384,
+                         routers=("host",),
                          log=lambda *a: None) -> dict:
     """The multichip_scaling curve (ISSUE-5/ISSUE-6): device-step and e2e
     serving rates of the sliced mesh backend at each device count. e2e
@@ -258,7 +259,15 @@ def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
     row carries both rates plus mixed p50/p99, so the affine/mixed gap
     is visible per n, not just at the max count. Per-row
     ``e2e_device_gap`` = device step rate over the affine e2e served
-    rate at the SAME device count."""
+    rate at the SAME device count.
+
+    ``routers`` (ADR-024): including "collective" adds, per row, the
+    SAME affine + mixed measurements served through the collective mesh
+    router (``e2e_collective_*`` keys) plus the per-row
+    ``e2e_collective_vs_host_mixed`` ratio — the host-partition-vs-
+    device-all_to_all comparison the matrix renders. Identical traffic
+    (same loadgen invocation, same owner rule), only the server's
+    --router differs."""
     rows = []
     loadgen = None
     td = None
@@ -316,18 +325,62 @@ def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
                         row["e2e_decisions_per_sec"])
                     row["e2e_mixed_frame_p50_ms"] = row["e2e_frame_p50_ms"]
                     row["e2e_mixed_frame_p99_ms"] = row["e2e_frame_p99_ms"]
+                if "collective" in routers:
+                    # Collective-router rows (ADR-024): the same affine
+                    # and mixed traffic served through --router
+                    # collective — one shard_map dispatch per frame, the
+                    # host never partitions.
+                    try:
+                        ca = run_mesh_loadgen(n, seconds=e2e_seconds,
+                                              spread=1, loadgen=loadgen,
+                                              router="collective")
+                        if "error" in ca:
+                            raise RuntimeError(ca["error"])
+                        row["e2e_collective_decisions_per_sec"] = (
+                            ca["decisions_per_sec"])
+                        row["e2e_collective_frame_p50_ms"] = (
+                            ca["frame_p50_ms"])
+                        row["e2e_collective_frame_p99_ms"] = (
+                            ca["frame_p99_ms"])
+                        if int(n) > 1:
+                            cm = run_mesh_loadgen(n, seconds=e2e_seconds,
+                                                  spread=int(n),
+                                                  loadgen=loadgen,
+                                                  router="collective")
+                            if "error" in cm:
+                                raise RuntimeError(cm["error"])
+                        else:
+                            cm = ca
+                        row["e2e_collective_mixed_decisions_per_sec"] = (
+                            cm["decisions_per_sec"])
+                        row["e2e_collective_mixed_frame_p50_ms"] = (
+                            cm["frame_p50_ms"])
+                        row["e2e_collective_mixed_frame_p99_ms"] = (
+                            cm["frame_p99_ms"])
+                        host_mixed = row.get("e2e_mixed_decisions_per_sec")
+                        if host_mixed:
+                            row["e2e_collective_vs_host_mixed"] = round(
+                                float(cm["decisions_per_sec"])
+                                / float(host_mixed), 3)
+                    except Exception as exc:
+                        row["e2e_collective_error"] = str(exc)[:200]
             rows.append(row)
             log(f"mesh n={n}: device_step "
                 f"{row['device_step_decisions_per_sec']:.0f}/s"
                 + (f" e2e {row['e2e_decisions_per_sec']:.0f}/s"
                    if "e2e_decisions_per_sec" in row else "")
                 + (f" mixed {row['e2e_mixed_decisions_per_sec']:.0f}/s"
-                   if "e2e_mixed_decisions_per_sec" in row else ""))
+                   if "e2e_mixed_decisions_per_sec" in row else "")
+                + (f" collective-mixed "
+                   f"{row['e2e_collective_mixed_decisions_per_sec']:.0f}/s"
+                   if "e2e_collective_mixed_decisions_per_sec" in row
+                   else ""))
         out = {
             "backend": "mesh (slice-parallel serving tier, ADR-012: "
                        "device-pinned slices, hash-routed keys, "
                        "collective-free decide path)",
             "device_batch": batch,
+            "routers": list(routers),
             "rows": rows,
         }
         first, last = rows[0], rows[-1]
@@ -355,6 +408,11 @@ def measure_mesh_scaling(device_counts, *, seconds: float = 2.0,
             # Kept alongside the per-row mixed columns for r06-schema
             # readers.
             out["e2e_mixed_decisions_per_sec_at_max"] = last_mixed
+            cm_max = rows[-1].get("e2e_collective_mixed_decisions_per_sec")
+            if cm_max is not None:
+                out["e2e_collective_mixed_decisions_per_sec_at_max"] = cm_max
+                out["e2e_collective_vs_host_mixed_at_max"] = round(
+                    float(cm_max) / max(float(last_mixed), 1.0), 3)
             out["e2e_mixed_note"] = (
                 "mixed frames are split once per frame (ragged "
                 "sub-framing), coalesced per device per window by the "
@@ -502,6 +560,226 @@ def measure_host_phases(B: int = INGEST_BATCH, reps: int = 30) -> dict:
            if hashed_phases["total_us"] else float("inf"))
     return {"frame_keys": B, "string": string_phases,
             "hashed": hashed_phases, "host_cut_factor": round(cut, 1)}
+
+
+def measure_route_phases(B: int = INGEST_BATCH, n: int = 8,
+                         reps: int = 30) -> dict:
+    """Per-frame host-phase breakdown of MIXED-frame routing (ADR-024):
+    microseconds the host CPU spends getting a B-key frame to and from n
+    device slices, for both routers. Host router (ADR-013): partition
+    (stable argsort over owners + searchsorted bounds + per-slice
+    gathers — the work _launch_split does before any sub-launch) and
+    scatter (per-slice fancy-indexed assignment of the four result
+    columns back to frame order). Collective router: the owner mod, the
+    binning, the all_to_all, and the return route all run INSIDE the
+    jitted step, so the host's only per-frame array work is padding the
+    frame to the mesh's shard shape — partition_us and scatter_us are
+    structurally zero, not merely small. Device work is excluded by
+    construction (no limiter is dispatched), making this the honest
+    "host partitioning eliminated" evidence for MULTICHIP r08."""
+    import time as _time
+
+    rng = np.random.default_rng(0)
+    h64 = rng.integers(1, 1 << 63, size=B).astype(np.uint64)
+    ns = np.ones(B, np.int64)
+    owners = (h64 % np.uint64(n)).astype(np.int64)
+    L = -(-B // n)  # per-device shard rows (pre-pow2-pad; copy cost ~B)
+    h64p = np.zeros(L * n, np.uint64)
+    nsp = np.zeros(L * n, np.int32)
+
+    def t_us(fn, reps=reps):
+        fn()  # warm
+        t0 = _time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (_time.perf_counter() - t0) / reps * 1e6
+
+    def host_partition():
+        order = np.argsort(owners, kind="stable")
+        so = owners[order]
+        bounds = np.searchsorted(so, np.arange(n + 1))
+        parts = []
+        for s in range(n):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            if lo != hi:
+                pos = order[lo:hi]
+                parts.append((pos, h64[pos], ns[pos]))
+        return parts
+
+    parts = host_partition()
+    allowed = np.empty(B, bool)
+    remaining = np.empty(B, np.int64)
+    retry = np.empty(B)
+    reset = np.empty(B)
+    sub_cols = [(pos, np.ones(len(pos), bool), np.full(len(pos), 5,
+                                                       np.int64),
+                 np.zeros(len(pos)), np.full(len(pos), 123.0))
+                for pos, _, _ in parts]
+
+    def host_scatter():
+        for pos, a, r, ry, rs in sub_cols:
+            allowed[pos] = a
+            remaining[pos] = r
+            retry[pos] = ry
+            reset[pos] = rs
+
+    def collective_pad():
+        h64p[:B] = h64
+        nsp[:B] = ns
+
+    host = {"partition_us": t_us(host_partition),
+            "scatter_us": t_us(host_scatter)}
+    coll = {"partition_us": 0.0, "pad_us": t_us(collective_pad),
+            "scatter_us": 0.0}
+    for d in (host, coll):
+        for k in d:
+            d[k] = round(d[k], 1)
+        d["total_us"] = round(sum(d.values()), 1)
+    cut = (host["total_us"] / coll["total_us"]
+           if coll["total_us"] else float("inf"))
+    return {"frame_keys": B, "n_devices": n,
+            "host": host, "collective": coll,
+            "host_route_cut_factor": (round(cut, 1)
+                                      if cut != float("inf") else None),
+            "note": "host CPU array work per mixed frame only; the "
+                    "collective router's owner mod, binning, all_to_all "
+                    "and return route run in-step on device (ADR-024)"}
+
+
+def measure_kernels_ab(*, seconds: float = 2.0, batch: int = 16384,
+                       depth: int = 4, width: int = 1 << 16) -> dict:
+    """``--accel`` block: pallas-vs-jnp dispatch rate on the serving hot
+    path (ADR-011) — the same pipelined launch/resolve loop for each
+    forced kernel choice. On non-TPU backends the pallas row reports the
+    failure instead of silently falling back (resolve_kernels only
+    auto-selects pallas on TPU; forcing it elsewhere is the honest
+    probe of whether the lowering exists there)."""
+    from ratelimiter_tpu import create_limiter
+
+    rng = np.random.default_rng(0)
+    frames = [np.asarray(rng.integers(1, 1 << 40, size=batch), np.uint64)
+              for _ in range(4)]
+    out: dict = {}
+    for choice in ("jnp", "pallas"):
+        cfg = Config(
+            algorithm=Algorithm.SLIDING_WINDOW, limit=100, window=60.0,
+            max_batch_admission_iters=1,
+            sketch=SketchParams(depth=depth, width=width, sub_windows=60,
+                                conservative_update=True, kernels=choice))
+        try:
+            lim = create_limiter(cfg, backend="sketch")
+            lim.allow_hashed(frames[0])  # compile outside timed window
+            K = 4
+            tickets = [lim.launch_hashed(frames[j % 4]) for j in range(K)]
+            done = 0
+            k = 0
+            stop = time.perf_counter() + seconds
+            t0 = time.perf_counter()
+            while time.perf_counter() < stop:
+                lim.resolve(tickets.pop(0))
+                done += batch
+                tickets.append(lim.launch_hashed(frames[k % 4]))
+                k += 1
+            for t in tickets:
+                lim.resolve(t)
+                done += batch
+            elapsed = time.perf_counter() - t0
+            lim.close()
+            out[choice] = {
+                "decisions_per_sec": round(done / elapsed, 1)}
+        except Exception as exc:
+            out[choice] = {"error": str(exc)[:200]}
+    if ("decisions_per_sec" in out.get("pallas", {})
+            and "decisions_per_sec" in out.get("jnp", {})):
+        out["pallas_speedup"] = round(
+            out["pallas"]["decisions_per_sec"]
+            / max(out["jnp"]["decisions_per_sec"], 1.0), 2)
+    return out
+
+
+def measure_inflight_sweep(windows=(1, 2, 4, 8), *, seconds: float = 3.0,
+                           log=lambda *a: None) -> list:
+    """``--accel`` block: the pipelined-dispatch depth sweep (ADR-010)
+    against one real ``--native`` sketch server per point, driven by the
+    C++ loadgen's hashed lane — the served-rate-vs-window curve ROADMAP
+    item 5 wants measured on a real chip (on CPU the jitted step runs
+    synchronously inside launch, so the curve is expected flat)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return [{"error": "no g++"}]
+    from benchmarks.e2e import _build_loadgen, _spawn_server
+
+    rows = []
+    with tempfile.TemporaryDirectory() as td:
+        binary = _build_loadgen(td)
+        for w in windows:
+            row: dict = {"inflight": int(w)}
+            try:
+                proc, port = _spawn_server(
+                    "sketch", native=True, max_batch=16384,
+                    max_delay_us=1000.0, inflight=int(w))
+                try:
+                    lg = [binary, "127.0.0.1", str(port), str(seconds),
+                          "16", "8", "2048", "1000000", "hashed", "1", "1"]
+                    out = subprocess.run(lg, capture_output=True,
+                                         text=True, timeout=seconds + 120)
+                    got = json.loads(out.stdout.strip())
+                    row["decisions_per_sec"] = got["decisions_per_sec"]
+                    row["frame_p50_ms"] = got["frame_p50_ms"]
+                    row["frame_p99_ms"] = got["frame_p99_ms"]
+                finally:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+            except Exception as exc:
+                row["error"] = str(exc)[:200]
+            log(f"accel inflight={w}: "
+                + (f"{row['decisions_per_sec']:.0f}/s"
+                   if "decisions_per_sec" in row else row.get("error", "")))
+            rows.append(row)
+    return rows
+
+
+def run_accel_preset(device_counts, *, seconds: float = 2.0,
+                     e2e_seconds: float = 4.0,
+                     log=lambda *a: None) -> dict:
+    """``--accel`` (ROADMAP item 5): the whole real-accelerator proof
+    sweep as ONE command — kernels=pallas vs jnp on the serving hot
+    path, the ``--inflight`` pipelining sweep, the mesh scaling curve
+    (affine AND mixed) through BOTH routers (host ADR-013, collective
+    ADR-024), and the route-phase host breakdown. Platform is
+    auto-detected; run it on a TPU/GPU box and publish the JSON as
+    BENCH_tpu_r01.json (same block names as the BENCH_r0x series)."""
+    platform = jax.devices()[0].platform
+    out: dict = {
+        "platform": platform,
+        "on_accelerator": platform != "cpu",
+        "n_devices_visible": len(jax.devices()),
+        "device_counts": [int(n) for n in device_counts],
+    }
+    log("accel: kernels A/B (pallas vs jnp)")
+    out["kernels_ab"] = measure_kernels_ab(
+        seconds=seconds, batch=(1 << 16) if platform != "cpu" else 16384)
+    log("accel: --inflight sweep")
+    out["inflight_sweep"] = measure_inflight_sweep(
+        seconds=e2e_seconds, log=log)
+    log("accel: mesh scaling, both routers")
+    out["multichip_scaling"] = measure_mesh_scaling(
+        device_counts, seconds=seconds, e2e_seconds=e2e_seconds,
+        routers=("host", "collective"), log=log)
+    out["route_phase_us"] = measure_route_phases(
+        n=int(device_counts[-1]))
+    out["harness"] = (
+        "bench.py --accel: kernels A/B via pipelined launch/resolve on "
+        "one sketch limiter; inflight sweep + mesh rows via real "
+        "--native servers driven by the C++ loadgen hashed lane; "
+        "collective rows are --router collective (ADR-024)")
+    return out
 
 
 def measure_live_accuracy(*, n_keys: int = 20_000, n_requests: int = 120_000,
@@ -1067,6 +1345,27 @@ def main() -> None:
                          "multichip_scaling curve (device step rate + e2e "
                          "serving rate per count). On CPU this forces N "
                          "virtual host devices")
+    ap.add_argument("--router", default="host",
+                    choices=["host", "collective"],
+                    help="--mesh-devices: 'collective' ALSO serves every "
+                         "e2e row through the collective mesh router "
+                         "(ADR-024, --router collective servers — one "
+                         "shard_map dispatch per frame, zero host "
+                         "partitioning) and adds the e2e_collective_* "
+                         "columns plus the route_phase_us host-phase "
+                         "breakdown; host rows are always measured (the "
+                         "comparison is the point)")
+    ap.add_argument("--accel", action="store_true",
+                    help="run ONLY the real-accelerator proof preset "
+                         "(ROADMAP item 5) and emit one JSON: kernels="
+                         "pallas vs jnp A/B, the --inflight pipelining "
+                         "sweep, the mesh scaling curve (affine AND "
+                         "mixed) through BOTH routers, and the "
+                         "route-phase breakdown. Auto-detects the "
+                         "platform; also writes the JSON to "
+                         "BENCH_<platform>_r01.json (override with "
+                         "BENCH_ACCEL_OUT=path; devices via "
+                         "--mesh-devices, default 8)")
     ap.add_argument("--fleet-hosts", type=int, default=None, metavar="N",
                     help="run ONLY the fleet scale-out bench (ADR-017, "
                          "forward lanes ADR-019) and emit the "
@@ -1241,7 +1540,7 @@ def main() -> None:
         }))
         return
 
-    if args.mesh_devices:
+    if args.mesh_devices or args.accel:
         # Must land before the first jax.devices() call initializes the
         # backend; on real accelerators the flag only affects the (then
         # unused) host platform. Spawned e2e servers inherit it via env.
@@ -1249,7 +1548,29 @@ def main() -> None:
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count="
-                f"{args.mesh_devices}").strip()
+                f"{args.mesh_devices or 8}").strip()
+
+    if args.accel:
+        avail = len(jax.devices())
+        counts = [1]
+        while counts[-1] * 2 <= min(args.mesh_devices or 8, avail):
+            counts.append(counts[-1] * 2)
+        payload = {
+            "metric": "accel_preset",
+            **run_accel_preset(
+                counts,
+                seconds=float(os.environ.get("BENCH_MESH_SECONDS", "3")),
+                e2e_seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                log=lambda msg: print(msg, file=sys.stderr, flush=True)),
+        }
+        out_path = os.environ.get(
+            "BENCH_ACCEL_OUT",
+            f"BENCH_{payload['platform']}_r01.json")
+        with open(out_path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(payload))
+        return
 
     platform = jax.devices()[0].platform
     on_accel = platform != "cpu"
@@ -1544,10 +1865,17 @@ def main() -> None:
         counts = [1]
         while counts[-1] * 2 <= min(args.mesh_devices, avail):
             counts.append(counts[-1] * 2)
+        routers = (("host", "collective") if args.router == "collective"
+                   else ("host",))
         mesh_block = {"multichip_scaling": measure_mesh_scaling(
             counts, seconds=float(os.environ.get("BENCH_MESH_SECONDS", "3")),
-            e2e_seconds=4.0,
+            e2e_seconds=4.0, routers=routers,
             log=lambda msg: print(msg, file=sys.stderr, flush=True))}
+        if args.router == "collective":
+            # The "host partitioning eliminated" evidence (ADR-024):
+            # per-frame host-phase microseconds for both routers.
+            mesh_block["route_phase_us"] = measure_route_phases(
+                n=counts[-1])
 
     # --------------------------------------- phase G: stage attribution
     # (opt-in, --trace): per-stage latency breakdown from the flight

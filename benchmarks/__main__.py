@@ -31,6 +31,52 @@ if _cache:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def _render_multichip(ms: dict, route_phases: dict | None = None) -> list:
+    """The multichip_scaling curve as a markdown table, with the
+    collective-vs-host router comparison columns (ADR-024). n/a-safe by
+    the e2e_mixed_* convention (ADR-013): a column whose key is absent —
+    host-router-only runs, single-device JSONs, rows whose e2e leg
+    errored — renders as ``n/a``, never as a silent 0."""
+    def _rate(r: dict, k: str) -> str:
+        v = r.get(k)
+        return f"{v:,.0f}" if isinstance(v, (int, float)) else "n/a"
+
+    lines = [
+        "## Multichip scaling (mesh serving)", "",
+        "Rows are decisions/s through the real native door. affine = "
+        "shard-affine traffic (ADR-012), mixed = uniform per-frame "
+        "fan-out (scatter-gather, ADR-013); collective columns are the "
+        "same traffic served by `--router collective` (ADR-024, one "
+        "shard_map all_to_all dispatch per frame). n/a = not measured "
+        "in this run, never a silent zero.", "",
+        "| n | device step/s | e2e affine/s | e2e mixed/s "
+        "| collective affine/s | collective mixed/s | coll/host mixed |",
+        "|---:|---:|---:|---:|---:|---:|---:|",
+    ]
+    for r in ms.get("rows", []):
+        ratio = r.get("e2e_collective_vs_host_mixed")
+        lines.append(
+            f"| {r.get('n_devices', '?')} "
+            f"| {_rate(r, 'device_step_decisions_per_sec')} "
+            f"| {_rate(r, 'e2e_decisions_per_sec')} "
+            f"| {_rate(r, 'e2e_mixed_decisions_per_sec')} "
+            f"| {_rate(r, 'e2e_collective_decisions_per_sec')} "
+            f"| {_rate(r, 'e2e_collective_mixed_decisions_per_sec')} "
+            f"| {ratio if ratio is not None else 'n/a'} |")
+    lines.append("")
+    if route_phases:
+        host = route_phases.get("host", {})
+        coll = route_phases.get("collective", {})
+        lines += [
+            f"Route host phases (per {route_phases.get('frame_keys', '?')}"
+            f"-key mixed frame, n={route_phases.get('n_devices', '?')}): "
+            f"host router partition {host.get('partition_us', 'n/a')} µs "
+            f"+ scatter {host.get('scatter_us', 'n/a')} µs vs collective "
+            f"pad {coll.get('pad_us', 'n/a')} µs (partition/scatter "
+            "eliminated on device, ADR-024).", ""]
+    return lines
+
+
 def _render_md(doc: dict) -> str:
     lines = [
         "# Benchmark results",
@@ -72,6 +118,9 @@ def _render_md(doc: dict) -> str:
                 if k != "config":
                     lines.append(f"- {k}: {v}")
             lines.append("")
+    if "multichip_scaling" in doc:
+        lines += _render_multichip(doc["multichip_scaling"],
+                                   doc.get("route_phase_us"))
     if "e2e" in doc:
         lines += ["## End-to-end serving (string keys over the wire)", "",
                   "| variant | decisions/s | scalar p50 ms | scalar p99 ms "
@@ -102,7 +151,21 @@ def main() -> None:
                     help="e2e loadgen: sample every Nth frame per "
                          "connection with a wire trace id and record "
                          "client spans (ADR-014; 0 = off)")
+    ap.add_argument("--multichip", default=None, metavar="PATH",
+                    help="render an existing MULTICHIP_rXX.json (or any "
+                         "JSON with a multichip_scaling block) as the "
+                         "markdown scaling table to stdout — including "
+                         "the collective-vs-host router columns "
+                         "(ADR-024; n/a-safe for runs without them) — "
+                         "and exit without measuring anything")
     args = ap.parse_args()
+
+    if args.multichip:
+        with open(args.multichip) as f:
+            blob = json.load(f)
+        ms = blob.get("multichip_scaling", blob)
+        print("\n".join(_render_multichip(ms, blob.get("route_phase_us"))))
+        return
 
     import jax
 

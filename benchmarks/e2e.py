@@ -327,6 +327,7 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
                      affine: bool = True, spread: Optional[int] = None,
                      loadgen: Optional[str] = None,
                      platform: Optional[str] = None,
+                     router: str = "host",
                      chaos: Optional[str] = None,
                      chaos_slice: int = 1,
                      chaos_after: float = 1.0) -> Dict:
@@ -347,13 +348,26 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
     the jitted step executes synchronously inside launch, so pipelining
     only fragments coalesced batches across window slots; each device's
     dispatcher thread blocking in its own decide IS the parallelism
-    (the GIL is released while the device computes)."""
+    (the GIL is released while the device computes).
+
+    ``router="collective"`` (ADR-024) serves the same traffic through
+    the collective mesh router: the composite limiter mounts as ONE
+    dispatch shard and every frame is one shard_map'd all_to_all step —
+    the id generation (and therefore the affine/mixed traffic shape,
+    which both routers define by the same ``h64 % n`` owner rule) is
+    unchanged, so host and collective rows are directly comparable."""
     import json
     import shutil
     import tempfile
 
     if shutil.which("g++") is None:
         return {"error": "no g++"}
+    if chaos and router == "collective":
+        # The slice chaos scenarios need --quarantine, which the
+        # collective router refuses (whole-mesh blast radius, ADR-024).
+        raise ValueError("chaos scenarios need the host router "
+                         "(--quarantine is incompatible with "
+                         "router='collective')")
     if spread is None:
         spread = 1 if affine else n_devices
     spread = max(1, min(int(spread), n_devices))
@@ -369,6 +383,8 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
                           "--chaos-scenario", chaos,
                           "--chaos-slice", str(chaos_slice),
                           "--chaos-after", str(chaos_after)]
+        if router != "host":
+            chaos_args = chaos_args + ["--router", router]
         proc, port = _spawn_server(
             "mesh", platform=platform, native=True, max_batch=16384,
             max_delay_us=1000.0, inflight=1, mesh_devices=n_devices,
@@ -391,6 +407,7 @@ def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
             except subprocess.TimeoutExpired:
                 proc.kill()
     row["n_devices"] = n_devices
+    row["router"] = router
     if chaos:
         row["chaos"] = {"scenario": chaos, "victim_slice": chaos_slice,
                         "armed_after_s": chaos_after}

@@ -54,6 +54,14 @@ def create_limiter(
 
         return SketchLimiter(config, clock, **kwargs)
     if backend == "mesh":
+        if config.mesh.router == "collective":
+            # Collective mesh router (ADR-024): same slices, same owner
+            # rule, but every frame is ONE shard_map'd SPMD dispatch.
+            from ratelimiter_tpu.parallel.collective import (
+                CollectiveMeshLimiter,
+            )
+
+            return CollectiveMeshLimiter(config, clock, **kwargs)
         from ratelimiter_tpu.parallel.limiter import SlicedMeshLimiter
 
         return SlicedMeshLimiter(config, clock, **kwargs)

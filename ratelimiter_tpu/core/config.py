@@ -393,6 +393,22 @@ class MeshSpec:
 
     #: Devices to span (None = every visible device; must be >= 1).
     devices: Optional[int] = None
+    #: Frame routing mode (ADR-024). "host" = the ADR-013 scatter-gather
+    #: scheduler (host argsort partition, per-slice sub-launches, barrier
+    #: + index-map scatter). "collective" = one shard_map'd SPMD dispatch
+    #: per frame: owners computed on device, rows all-to-all'd to their
+    #: slices, verdicts all-to-all'd back — the host never partitions.
+    #: Decisions are bit-identical either way (same ``h64 % n`` owner
+    #: rule, same kernels); "collective" targets real accelerator meshes
+    #: where ICI beats host phases, and falls back to the host router on
+    #: bin overflow (so admission is never dropped) and under the strict
+    #: overload policy.
+    router: str = "host"
+    #: Collective-router bin headroom: per-(source, destination) bin
+    #: capacity is ``ceil(bin_headroom * shard_len / devices)``. Uniform
+    #: mixed traffic fills bins to ~1/headroom; skewed frames that
+    #: overflow a bin fall back to the host router (ADR-024 trade-off).
+    bin_headroom: float = 2.0
     #: Failure-domain isolation (ADR-015): wrap every slice in a
     #: quarantine guard — per-slice dispatch deadline + failure
     #: classifier, degraded per-range answers per ``fail_open``, and
@@ -430,6 +446,21 @@ class MeshSpec:
             raise InvalidConfigError(
                 f"mesh failure_threshold must be an integer >= 1, "
                 f"got {self.failure_threshold!r}")
+        if self.router not in ("host", "collective"):
+            raise InvalidConfigError(
+                f"mesh router must be 'host' or 'collective', "
+                f"got {self.router!r}")
+        if self.router == "collective" and self.quarantine:
+            raise InvalidConfigError(
+                "router='collective' is incompatible with quarantine: a "
+                "collective dispatch is ONE mesh-wide execution, so a "
+                "single slice's fault has whole-mesh blast radius and "
+                "per-slice failure domains cannot contain it (ADR-024). "
+                "Use router='host' for quarantined deployments.")
+        if not (self.bin_headroom > 0):
+            raise InvalidConfigError(
+                f"mesh bin_headroom must be positive, "
+                f"got {self.bin_headroom!r}")
 
 
 @dataclass(frozen=True)

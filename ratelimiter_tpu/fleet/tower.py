@@ -297,6 +297,20 @@ def merged_status(members: Dict[str, Optional[dict]]) -> dict:
 # ------------------------------------------------------------- tracing
 
 
+def synthetic_parent_id(parents) -> str:
+    """Deterministic synthetic trace id for a forward window that
+    coalesced SEVERAL sampled client frames: the truncated digest of the
+    sorted parent ids, in the same 16-hex shape as real trace ids. A
+    pure function of the parent set — the same coalition always maps to
+    the same id regardless of which window carried it or which host
+    runs the stitch (the server-side/offline parity pin depends on
+    that)."""
+    import hashlib
+
+    return hashlib.sha256(
+        ",".join(sorted(parents)).encode()).hexdigest()[:16]
+
+
 def merge_traces(payloads: Dict[str, Optional[dict]],
                  offsets: Dict[str, Optional[int]],
                  ref: str) -> dict:
@@ -307,9 +321,10 @@ def merge_traces(payloads: Dict[str, Optional[dict]],
     (t_ref = t_host + offset; ns), and forward-window spans REWRITTEN
     to their client frame's trace id wherever the sender's
     (fragment -> window) links name exactly one parent — the cross-hop
-    stitch. Hosts with a None payload (unreachable) or a None offset
-    (no announce heard yet; merged unshifted) are reported in
-    ``otherData``."""
+    stitch. Multi-parent windows rewrite to ``synthetic_parent_id`` of
+    their parent set (window id + parents preserved in args). Hosts
+    with a None payload (unreachable) or a None offset (no announce
+    heard yet; merged unshifted) are reported in ``otherData``."""
     events: List[dict] = []
     links: List[dict] = []
     meta: List[dict] = []
@@ -346,7 +361,14 @@ def merge_traces(payloads: Dict[str, Optional[dict]],
     # fragments into it (sender-side links). A single-parent window's
     # spans rename to the client id — ONE trace id across the hop; a
     # multi-parent window (several sampled frames coalesced into one
-    # wire window) keeps its window id with the parents listed.
+    # wire window) renames to a SYNTHETIC parent id derived from the
+    # full parent set (the PR-14 residual: keeping the window id left
+    # the receiver's spans grouped apart from every client frame, so a
+    # trace viewer's by-id filter found neither side). The synthetic id
+    # is a pure function of the sorted parents, so every window that
+    # coalesced the same client frames lands under the same id, the
+    # server-side and offline stitches agree byte-for-byte, and the
+    # original window id + the parent list stay in args.
     parents: Dict[str, set] = {}
     for ln in links:
         parents.setdefault(ln["child"], set()).add(ln["parent"])
@@ -359,6 +381,7 @@ def merge_traces(payloads: Dict[str, Optional[dict]],
         if len(ps) == 1:
             e["args"]["trace_id"] = next(iter(ps))
         else:
+            e["args"]["trace_id"] = synthetic_parent_id(ps)
             e["args"]["trace_parents"] = sorted(ps)
     events.sort(key=lambda e: e.get("ts", 0.0))
     return {

@@ -1,0 +1,332 @@
+"""Device-side all-to-all frame routing for the sliced mesh (ADR-024).
+
+The host router (ADR-013) partitions every mixed frame on the host — a
+stable argsort over the owner vector, per-slice sub-launches, a barrier,
+and an index-map scatter of results. This module is the SPMD answer the
+ADR deferred: one shard_map'd step over the slice mesh in which each
+device
+
+1. receives an even 1/n shard of the frame's (h64, ns) columns,
+2. computes ``owner = h64 % n`` on device (premix lanes splitmix64
+   first — the same finalize-then-mod rule as
+   ``SlicedMeshLimiter.owner_of_id``),
+3. bins its rows into fixed-capacity per-destination bins and routes
+   them with ONE ``jax.lax.all_to_all``,
+4. runs the UNCHANGED fused decision kernel
+   (sketch_kernels._sketch_step / bucket_kernels._bucket_step) on the
+   rows it owns, against its own slice state (sharded, not replicated —
+   each device's shard IS that slice's counters), and
+5. all-to-all's the verdicts back to source order and assembles the
+   finish_window/finish_bucket result columns in frame order.
+
+The host never argsorts, never builds index maps, never fans out
+sub-launches; resolve blocks on one ticket.
+
+Bit-identity with the host-routed oracle holds because the destination
+device runs the exact same step body on the exact same rows in the exact
+same order: a source shard is a contiguous chunk of the frame, bins fill
+in shard order, and the tiled all_to_all concatenates source-major — so
+an owner's received rows are in global frame order, which is precisely
+the order the host router's stable argsort feeds that slice. Pad rows
+(key 0, n = 0) are decision-inert in both paths (no mass, no counter
+write), so differing pad counts cannot diverge state.
+
+Bins are fixed capacity C per (source, destination) pair — shapes must
+be static under jit. A source with more than C rows for one destination
+sets a device-computed overflow flag (pmax'd to every device); the step
+then keeps ALL state leaves untouched (``jnp.where(ovf, old, new)``)
+and the host re-dispatches the frame through the host router, so
+admission is never silently dropped OR double-counted. Capacity is
+``ceil(bin_headroom * L / n)`` (MeshSpec.bin_headroom): uniform mixed
+traffic expects L/n rows per bin, affine single-owner frames need up to
+L and deliberately overflow to the host router's single-owner
+passthrough instead of paying n× bin memory (the trade-off recorded in
+docs/ADR/024-collective-mesh-router.md).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ratelimiter_tpu.core.config import Config
+from ratelimiter_tpu.ops import ensure_x64
+from ratelimiter_tpu.parallel.mesh import AXIS
+
+#: Empty bin slots travel with this ns sentinel so the destination can
+#: tell a routed row from bin padding without shipping an index column.
+_EMPTY = -1
+
+
+def bin_capacity(L: int, n: int, headroom: float) -> int:
+    """Static per-(source, destination) bin capacity for an L-row shard
+    on an n-device mesh. Clamped to [1, L]: a source can send at most
+    its whole shard to one destination, and zero-capacity bins would
+    overflow every non-empty frame. Two lower bounds apply on top of
+    the headroom multiplier when headroom >= 1 (headroom < 1 skips
+    both so tests can force capacity-1 bins to exercise the fallback):
+
+    * a flat floor of 8 rows (the _MIN_PAD instinct) so SMALL mixed
+      frames — where binomial noise dwarfs the L/n mean — do not
+      overflow constantly, and
+    * a binomial tail bound ``mean + 4*sqrt(mean) + 8``: each of the
+      n^2 (source, destination) pairs receives Bin(L, 1/n) rows, and a
+      plain 2x-mean headroom still overflows ~10-20% of uniform frames
+      at mid sizes (L=32, n=8 puts C at 8 against a mean of 4 —
+      measured maxbin 9-10). Four sigmas plus slack pushes per-frame
+      overflow below ~1e-4 while the bin memory stays O(L) per device.
+    """
+    c = int(-(-int(headroom * L) // n)) if headroom > 0 else 1
+    if headroom >= 1.0:
+        mean = L / n
+        tail = int(mean + 4.0 * mean ** 0.5 + 8)
+        c = max(c, 8, tail)
+    return max(1, min(L, c))
+
+
+def _route(h64, ns, b, n: int, L: int, C: int, premix: bool):
+    """Per-device routing prologue: owner mod, per-destination ranks,
+    bin scatter, one all_to_all each for the key and count columns.
+    Returns (h_own, ns_own, order, binpos, keep, ovf_local) where
+    h_own/ns_own are the owned rows compacted to the front in global
+    frame order and padded with decision-inert (0, 0) rows."""
+    from ratelimiter_tpu.ops.hashing import splitmix64_dev
+
+    me = jax.lax.axis_index(AXIS)
+    gidx = me.astype(jnp.int64) * L + jnp.arange(L, dtype=jnp.int64)
+    valid_src = gidx < b
+    hfin = splitmix64_dev(h64) if premix else h64
+    owner = (hfin % jnp.uint64(n)).astype(jnp.int32)
+    # Exclusive per-destination rank among this shard's valid rows: a
+    # one-hot cumsum (L x n) — no sort on the routing path.
+    oh = ((owner[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :])
+          & valid_src[:, None]).astype(jnp.int32)
+    rank = jnp.take_along_axis(jnp.cumsum(oh, axis=0) - oh,
+                               owner[:, None].astype(jnp.int32),
+                               axis=1)[:, 0]
+    keep = valid_src & (rank < C)
+    ovf_local = jnp.any(valid_src & (rank >= C))
+    binpos = owner * C + rank
+    # Out-of-range scatter index drops the row (bin padding keeps the
+    # _EMPTY sentinel) — no host-side compaction, no dynamic shapes.
+    pos = jnp.where(keep, binpos, n * C)
+    send_h = jnp.zeros(n * C, jnp.uint64).at[pos].set(h64, mode="drop")
+    send_ns = jnp.full(n * C, _EMPTY, jnp.int32).at[pos].set(
+        ns, mode="drop")
+    recv_h = jax.lax.all_to_all(send_h, AXIS, 0, 0, tiled=True)
+    recv_ns = jax.lax.all_to_all(send_ns, AXIS, 0, 0, tiled=True)
+    valid_r = recv_ns != _EMPTY
+    # Compact owned rows to the front. Source shards are contiguous
+    # frame chunks and the tiled all_to_all concatenates source-major,
+    # so a STABLE sort on validity preserves global frame order — the
+    # order the host router's stable argsort would feed this slice
+    # (the bit-identity linchpin: in-batch same-key sequencing).
+    order = jnp.argsort(~valid_r, stable=True)
+    vr = valid_r[order]
+    h_own = jnp.where(vr, recv_h[order], jnp.uint64(0))
+    ns_own = jnp.where(vr, recv_ns[order], 0)
+    return h_own, ns_own, order, binpos, keep, ovf_local
+
+
+def _return_route(cols, order, binpos, keep):
+    """Inverse-scatter per-row result columns into the bin layout and
+    all_to_all them back to their source devices; gather into source row
+    order. Rows the source never shipped (overflow) read slot 0 garbage
+    — the frame is re-dispatched host-side in that case, so the values
+    never reach a client."""
+    out = []
+    safe = jnp.where(keep, binpos, 0)
+    for c in cols:
+        back = jnp.zeros(c.shape, c.dtype).at[order].set(c)
+        ret = jax.lax.all_to_all(back, AXIS, 0, 0, tiled=True)
+        out.append(ret[safe])
+    return out
+
+
+def state_layout(cfg: Config) -> Tuple[str, Tuple[str, ...],
+                                       Tuple[str, ...]]:
+    """(kind, mutated leaves, read-only leaves) of the per-slice state
+    under one routed step. Read-only leaves (the slab ring and its
+    period bookkeeping — only the host-driven rollover writes them) ride
+    as a second operand group that is never an output, so the step
+    neither copies nor donates them."""
+    from ratelimiter_tpu.core.types import Algorithm
+
+    if cfg.algorithm is Algorithm.TOKEN_BUCKET:
+        mut = ["debt", "acc", "rem", "last"]
+        if cfg.hierarchy.tenants:
+            mut += ["tn_counts", "tn_period"]
+        return "bucket", tuple(mut), ()
+    from ratelimiter_tpu.ops import sketch_kernels
+
+    mut = ["cur", "totals"]
+    ro = ["slabs", "slab_period", "last_period"]
+    if cfg.hierarchy.tenants:
+        mut += ["tn_cur", "tn_totals"]
+        ro += ["tn_slabs"]
+    hh, _ = sketch_kernels._hh_params(cfg)
+    if hh:
+        mut += ["hh_owner", "hh_owner2", "hh_cur", "hh_totals", "hh_last"]
+        ro += ["hh_slabs"]
+    return "sketch", tuple(mut), tuple(ro)
+
+
+#: Per-slice state leaves that are scalars on a slice (assembled as an
+#: (n,) global, local (1,) — the body unwraps/rewraps them).
+_SCALAR_LEAVES = frozenset(["last_period", "rem", "last", "tn_period"])
+
+_ROUTED_CACHE: Dict[tuple, Callable] = {}
+
+
+def build_routed_step(cfg: Config, mesh, *, premix: bool, L: int,
+                      capacity: int) -> Callable:
+    """Jitted collective ``step(mut, ro, h64, ns, b, now_us, policy[,
+    hier])`` over the slice mesh.
+
+    ``mut``/``ro`` are the sharded per-slice state groups
+    (state_layout), ``h64``/``ns`` the (n*L,)-padded frame columns
+    sharded over AXIS, ``b`` the true row count and ``now_us`` the
+    decision timestamp (both replicated scalars; traced, so varying b
+    never recompiles — only a new L bucket does). Policy (and cascade)
+    tables ride replicated, exactly as on the single-slice step.
+
+    Returns ``(new_mut, (allowed, remaining, retry, reset, mass), ovf)``
+    — the four finish columns in global frame order, the per-slice
+    admitted mass (n,), and the replicated overflow flag. On overflow
+    every state leaf is returned UNCHANGED."""
+    from ratelimiter_tpu.parallel.mesh_kernels import _HIER_SPEC, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    ensure_x64()
+    n = mesh.devices.size
+    kind, mut_keys, ro_keys = state_layout(cfg)
+    seed = cfg.sketch.seed
+    tenants = cfg.hierarchy.tenants
+    mesh_key = (tuple(mesh.devices.flat), mesh.axis_names)
+    if kind == "sketch":
+        from ratelimiter_tpu.core.types import Algorithm
+        from ratelimiter_tpu.ops import sketch_kernels
+
+        W, sub_us, SW, S, limit = sketch_kernels.sketch_geometry(cfg)
+        d, w = cfg.sketch.depth, cfg.sketch.width
+        weighted = cfg.algorithm is not Algorithm.FIXED_WINDOW
+        cu = cfg.sketch.conservative_update
+        hh, hh_thresh = sketch_kernels._hh_params(cfg)
+        use_pallas = sketch_kernels._resolve_pallas(cfg)
+        statics = (limit, W, SW, d, w, cfg.max_batch_admission_iters,
+                   weighted, cu, hh, hh_thresh, tenants, use_pallas)
+        step_kw = dict(limit=limit, sub_us=sub_us, SW=SW, S=S, d=d, w=w,
+                       iters=cfg.max_batch_admission_iters,
+                       weighted=weighted, conservative=cu, hh=hh,
+                       hh_thresh=hh_thresh, tenants=tenants,
+                       use_pallas=use_pallas)
+    else:
+        from ratelimiter_tpu.ops import bucket_kernels
+
+        limit, num, den, d, w, iters = bucket_kernels._params(cfg)
+        tenants_, wus = bucket_kernels._hier_params(cfg)
+        from ratelimiter_tpu.ops.sketch_kernels import _resolve_pallas
+
+        use_pallas = _resolve_pallas(cfg, bucket=True)
+        statics = (limit, num, den, d, w, iters, tenants_, wus, use_pallas)
+        step_kw = dict(limit=limit, rate_num=num, rate_den=den, d=d, w=w,
+                       iters=iters, tenants=tenants_, window_us=wus,
+                       use_pallas=use_pallas)
+        window_us = wus
+    key = (kind, mesh_key, statics, seed, premix, L, capacity)
+    cached = _ROUTED_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    C = capacity
+
+    def _unwrap(mut, ro):
+        state = {}
+        for k in mut_keys:
+            state[k] = mut[k][0] if k in _SCALAR_LEAVES else mut[k]
+        for k in ro_keys:
+            state[k] = ro[k][0] if k in _SCALAR_LEAVES else ro[k]
+        return state
+
+    def _rewrap_mut(new_state, old_mut, ovf):
+        out = {}
+        for k in mut_keys:
+            v = new_state[k]
+            if k in _SCALAR_LEAVES:
+                v = v.reshape(1)
+            # Overflow leaves the frame to the host router: EVERY state
+            # write is suppressed so the re-dispatch admits each row
+            # exactly once (no lost, no duplicated admission mass).
+            out[k] = jnp.where(ovf, old_mut[k], v)
+        return out
+
+    def body(mut, ro, h64, ns, b, now_us, policy, hier=None):
+        from ratelimiter_tpu.ops.hashing import split_hash_dev, \
+            splitmix64_dev
+
+        h_own, ns_own, order, binpos, keep, ovf_l = _route(
+            h64, ns, b, n, L, C, premix)
+        ovf = jax.lax.pmax(ovf_l.astype(jnp.int32), AXIS) > 0
+        state = _unwrap(mut, ro)
+        h = splitmix64_dev(h_own) if premix else h_own
+        h1, h2 = split_hash_dev(h, seed)
+        if kind == "sketch":
+            from ratelimiter_tpu.ops import sketch_kernels
+
+            new_state, (allowed, remaining, _est) = \
+                sketch_kernels._sketch_step(
+                    state, h1, h2, ns_own, now_us, policy, hier, **step_kw)
+            retry_col = None
+        else:
+            from ratelimiter_tpu.ops import bucket_kernels
+
+            new_state, (allowed, remaining, retry_us) = \
+                bucket_kernels._bucket_step(
+                    state, h1, h2, ns_own, now_us, policy, hier, **step_kw)
+            retry_col = retry_us
+        mass = jnp.sum(jnp.where(allowed, ns_own, 0)
+                       .astype(jnp.int64)).reshape(1)
+        cols = [allowed.astype(jnp.uint8), remaining]
+        if retry_col is not None:
+            cols.append(retry_col)
+        rets = _return_route(cols, order, binpos, keep)
+        allowed_s = rets[0].astype(jnp.bool_)
+        remaining_s = rets[1]
+        if kind == "sketch":
+            from ratelimiter_tpu.ops import sketch_kernels
+
+            fin = sketch_kernels.finish_window(
+                allowed_s, remaining_s, now_us, jnp.int64(W))
+        else:
+            from ratelimiter_tpu.ops import bucket_kernels
+
+            fin = bucket_kernels.finish_bucket(
+                allowed_s, remaining_s, rets[2], now_us,
+                jnp.int64(window_us))
+        return (_rewrap_mut(new_state, mut, ovf), fin + (mass,),
+                ovf.astype(jnp.int32))
+
+    mut_spec = {k: P(AXIS) for k in mut_keys}
+    ro_spec = {k: P(AXIS) for k in ro_keys}
+    policy_spec = {"key": P(), "limit": P()}
+    in_specs = [mut_spec, ro_spec, P(AXIS), P(AXIS), P(), P(), policy_spec]
+    if tenants:
+        in_specs.append(_HIER_SPEC)
+    out_specs = (mut_spec,
+                 (P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                 P())
+    # check_vma=False for the same reason as mesh_kernels: ovf IS
+    # replicated (a pmax result) but the checker cannot prove it, and
+    # the sharded state outputs flow through sort/cumsum chains.
+    mapped = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=out_specs, check_vma=False)
+    # No donation: the assembled global state aliases the slices' own
+    # pinned buffers (jax.make_array_from_single_device_arrays is
+    # zero-copy), and donating would invalidate them mid-writeback. The
+    # RO group (the big slab ring) is never an output, so the copy cost
+    # is bounded by the small mutated leaves.
+    step = jax.jit(mapped)
+    _ROUTED_CACHE[key] = step
+    return step

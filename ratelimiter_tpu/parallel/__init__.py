@@ -38,6 +38,7 @@ from ratelimiter_tpu.parallel.limiter import (
     SlicedMeshLimiter,
     build_slices,
 )
+from ratelimiter_tpu.parallel.collective import CollectiveMeshLimiter
 from ratelimiter_tpu.parallel.dcn import (
     DcnMirrorGroup,
     export_completed,
@@ -47,6 +48,7 @@ from ratelimiter_tpu.parallel.dcn import (
 )
 
 __all__ = [
+    "CollectiveMeshLimiter",
     "DcnMirrorGroup",
     "MeshSketchLimiter",
     "MeshTokenBucketLimiter",

@@ -43,6 +43,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--backend mesh: devices to span (default: all "
                          "visible; on CPU set XLA_FLAGS="
                          "--xla_force_host_platform_device_count=N)")
+    ap.add_argument("--router", default="host",
+                    choices=["host", "collective"],
+                    help="--backend mesh: how a mixed frame reaches its "
+                         "owning slices. 'host' (ADR-013) argsorts and "
+                         "fans out per-slice sub-launches on the host; "
+                         "'collective' (ADR-024) makes the whole frame "
+                         "ONE shard_map dispatch — owners computed on "
+                         "device, rows routed with all_to_all, the host "
+                         "never partitions. Incompatible with "
+                         "--quarantine (whole-mesh blast radius)")
+    ap.add_argument("--bin-headroom", type=float, default=2.0,
+                    help="--router collective: per-(source,destination) "
+                         "bin capacity multiplier over the L/n mean; a "
+                         "frame overflowing a bin falls back to the host "
+                         "router (never silently dropped)")
     ap.add_argument("--quarantine", action="store_true",
                     help="--backend mesh: per-slice failure domains "
                          "(ADR-015) — slice dispatches get a deadline + "
@@ -936,6 +951,13 @@ def _prewarm(limiter, max_batch: int) -> None:
             if size >= top:
                 break
             size *= 2
+    und = undecorated(limiter)
+    if hasattr(und, "prewarm_routed"):
+        # Collective router (ADR-024): the shard_map'd all_to_all step is
+        # its own compilation per pad shape, distinct from the per-slice
+        # kernels warmed above (those stay warm for the overflow/strict
+        # fallback path).
+        und.prewarm_routed(max_batch)
     logging.getLogger("ratelimiter_tpu.serving").info(
         "prewarmed pad shapes up to %d (%d dispatch target%s) in %.1fs",
         top, len(targets), "s" if len(targets) != 1 else "",
@@ -1005,6 +1027,8 @@ async def amain(args) -> None:
             retain=args.snapshot_retain,
             wal_fsync=args.wal_fsync),
         mesh=MeshSpec(devices=args.mesh_devices,
+                      router=args.router,
+                      bin_headroom=args.bin_headroom,
                       quarantine=args.quarantine,
                       slice_deadline=args.slice_deadline_ms * 1e-3,
                       probe_interval=args.probe_interval,
@@ -1044,6 +1068,16 @@ async def amain(args) -> None:
     if args.quarantine and args.backend != "mesh":
         raise SystemExit("--quarantine needs --backend mesh (failure "
                          "domains are per device slice)")
+    if args.router != "host" and args.backend != "mesh":
+        raise SystemExit("--router needs --backend mesh (it selects how "
+                         "mixed frames reach the device slices)")
+    if args.router == "collective" and args.quarantine:
+        raise SystemExit(
+            "--router collective is incompatible with --quarantine: a "
+            "collective dispatch is ONE mesh-wide shard_map execution, "
+            "so a single slice's fault has whole-mesh blast radius and "
+            "per-slice failure domains cannot contain it (ADR-024). "
+            "Use --router host for quarantined deployments.")
     start_chaos = None
     if args.chaos_scenario:
         slice_scen = args.chaos_scenario in ("kill-slice", "slow-slice",
@@ -1102,8 +1136,12 @@ async def amain(args) -> None:
     # launch/resolve chain, collective-free (ADR-012). The asyncio door
     # serves the composite SlicedMeshLimiter instead — the micro-batcher
     # pipelines whole frames and the limiter fans each frame out to its
-    # owning devices.
-    mesh_native = bool(args.backend == "mesh" and args.native)
+    # owning devices. --router collective (ADR-024) keeps the composite
+    # shape under BOTH doors: the whole mesh is one dispatch shard and
+    # each frame is one shard_map'd SPMD step, so mounting per-device
+    # shards would defeat the point.
+    mesh_native = bool(args.backend == "mesh" and args.native
+                       and args.router != "collective")
     slices = None
     qmgr = None
     if mesh_native:

@@ -446,7 +446,7 @@ class TestMergeTraces:
         dev = next(e for e in spans if e["name"] == "device")
         assert dev["args"]["window_id"] == W
 
-    def test_multi_parent_window_keeps_window_id(self):
+    def test_multi_parent_window_gets_synthetic_parent(self):
         t1, t2, w = "11" * 8, "33" * 8, "22" * 8
         a = self._payload([], links=[
             {"parent": t1, "child": w, "t_ns": 0},
@@ -456,8 +456,25 @@ class TestMergeTraces:
         merged = tower.merge_traces({"h0": a, "h1": b},
                                     {"h0": 0, "h1": 0}, "h0")
         dev = [e for e in merged["traceEvents"] if e["ph"] == "X"][0]
-        assert dev["args"]["trace_id"] == w
+        # PR-14 residual closed: the window's spans rename to a
+        # SYNTHETIC parent id derived from the full parent set (a
+        # by-id filter now groups the receiver's spans under one id
+        # instead of leaving them stranded on the window id), while
+        # the window id and the parent list stay queryable in args.
+        assert dev["args"]["trace_id"] == tower.synthetic_parent_id(
+            [t1, t2])
+        assert dev["args"]["trace_id"] != w
+        assert dev["args"]["window_id"] == w
         assert dev["args"]["trace_parents"] == sorted([t1, t2])
+
+    def test_synthetic_parent_id_is_order_invariant_and_16_hex(self):
+        t1, t2 = "11" * 8, "33" * 8
+        sid = tower.synthetic_parent_id([t2, t1])
+        assert sid == tower.synthetic_parent_id([t1, t2])
+        assert len(sid) == 16
+        int(sid, 16)  # well-formed hex, same shape as real trace ids
+        # Different coalitions -> different synthetic ids.
+        assert sid != tower.synthetic_parent_id([t1, "55" * 8])
 
 
 class TestOfflineStitchParityPin:
@@ -547,7 +564,9 @@ class TestOfflineStitchParityPin:
         assert dev_single["ts"] == pytest.approx(5000.0 + off_b / 1e3)
         dev_multi = next(e for e in spans if e["name"] == "device"
                          and "trace_parents" in e["args"])
-        assert dev_multi["args"]["trace_id"] == "44" * 8    # kept
+        assert dev_multi["args"]["trace_id"] == tower.synthetic_parent_id(
+            ["11" * 8, "33" * 8])
+        assert dev_multi["args"]["window_id"] == "44" * 8
         assert dev_multi["args"]["trace_parents"] == sorted(
             ["11" * 8, "33" * 8])
 
