@@ -8,10 +8,11 @@ export PYTHONPATH := $(REPO):$(PYTHONPATH)
 
 .PHONY: help test test-all test-serving test-mesh test-collective test-tracing test-chaos \
         test-audit test-fleet test-fleet-forward test-fleet-obs \
-        test-reshard test-hierarchy test-leases test-placement lint check \
+        test-reshard test-hierarchy test-leases test-placement test-shm \
+        lint check \
         native bench bench-quick bench-audit bench-chaos bench-fleet \
         bench-fleet-obs bench-reshard bench-hierarchy bench-leases \
-        bench-rebalance bench-matrix serve verify clean
+        bench-rebalance bench-shm bench-matrix serve verify clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -67,6 +68,9 @@ test-leases:     ## client-embedded quota leases (ADR-022): protocol, debit-upfr
 test-placement:  ## load-aware placement (ADR-023): planner determinism, chaos rebalance oracle, journal spill, real-process operator flow (slow lane unfiltered)
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_placement.py -q
 
+test-shm:        ## shared-memory wire lane (ADR-025): uds/shm both doors, bit-identical pins, kill -9, ring fuzz, revocation-over-shm
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shm_transport.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
@@ -90,6 +94,9 @@ bench-leases:    ## client-embedded lease numbers (leased vs wire rate, storm bo
 
 bench-rebalance: ## load-aware placement numbers (skewed fleet convergence, moved-range oracle, off-pin, REBALANCE_r01 JSON, ADR-023)
 	JAX_PLATFORMS=cpu $(PY) bench.py --rebalance
+
+bench-shm:       ## transport ladder A/B (interleaved tcp/uds/shm paired rounds, wire-phase breakdown, SHM_r01 JSON, ADR-025)
+	$(PY) bench.py --shm
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -772,6 +772,11 @@ def run_accel_preset(device_counts, *, seconds: float = 2.0,
     out["multichip_scaling"] = measure_mesh_scaling(
         device_counts, seconds=seconds, e2e_seconds=e2e_seconds,
         routers=("host", "collective"), log=log)
+    log("accel: shm transport A/B (ADR-025)")
+    from benchmarks.e2e import run_shm_ab
+
+    out["shm_transport"] = run_shm_ab(
+        seconds=e2e_seconds, pairs=2, log=log)
     out["route_phase_us"] = measure_route_phases(
         n=int(device_counts[-1]))
     out["harness"] = (
@@ -1410,6 +1415,17 @@ def main() -> None:
                          "reconstruction, and the rebalance-off "
                          "byte-identical pin (published as "
                          "REBALANCE_r01.json)")
+    ap.add_argument("--shm", action="store_true",
+                    help="run ONLY the shared-memory wire-lane A/B "
+                         "(ADR-025) and emit the shm_transport JSON "
+                         "block: interleaved paired tcp-loopback / uds "
+                         "/ shm rounds through the C++ loadgen's "
+                         "hashed lane against real --native --shm "
+                         "servers, best paired ratios + per-frame "
+                         "serialize/wire-write phase breakdown, plus "
+                         "the single-device step rate so the "
+                         "device-vs-e2e gap is tracked per transport "
+                         "(published as SHM_r01.json)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "
@@ -1420,6 +1436,41 @@ def main() -> None:
                          "time, and offline tools/rebucket.py resize "
                          "timings (published as RESHARD_r01.json)")
     args = ap.parse_args()
+
+    if args.shm:
+        from benchmarks.e2e import run_shm_ab
+
+        platform = jax.devices()[0].platform
+        payload = {
+            "metric": "shm_transport",
+            "platform": platform,
+            "shm_transport": run_shm_ab(
+                seconds=float(os.environ.get("BENCH_SECONDS", "4")),
+                pairs=int(os.environ.get("BENCH_SHM_PAIRS", "3")),
+                log=lambda *a: print(*a, file=sys.stderr, flush=True)),
+        }
+        st = payload["shm_transport"]
+        if "error" not in st:
+            # The device-vs-e2e gap per transport (BENCH_r05 anchor:
+            # 14.4M device vs 869K e2e on this harness): the shm lane's
+            # claim is a smaller wire tax between those two numbers.
+            dev = measure_mesh_step_rate(
+                1, seconds=float(os.environ.get("BENCH_MESH_SECONDS",
+                                                "2")))
+            st["device_step_decisions_per_sec"] = round(dev, 1)
+            for t in ("shm", "uds"):
+                e2e = float(st["paired_best"][t]["decisions_per_sec"])
+                st["paired_best"][t]["device_gap"] = round(
+                    dev / max(e2e, 1.0), 2)
+            tcp_e2e = float(
+                st["paired_best"]["shm"]["tcp_decisions_per_sec"])
+            st["tcp_device_gap"] = round(dev / max(tcp_e2e, 1.0), 2)
+        out_path = os.environ.get("BENCH_SHM_OUT", "SHM_r01.json")
+        with open(out_path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(payload))
+        return
 
     if args.rebalance:
         from benchmarks.rebalance import run_rebalance

@@ -313,6 +313,117 @@ def _run_native_loadgen(*, seconds: float, log=print,
     return row
 
 
+def run_shm_ab(*, seconds: float = 4.0, pairs: int = 3,
+               threads: int = 4, inflight: int = 8,
+               frame_keys: int = 256, loadgen: Optional[str] = None,
+               log=print) -> Dict:
+    """Transport A/B for the zero-syscall shm wire lane (ADR-025):
+    INTERLEAVED paired rounds of tcp-loopback / uds / shm through the
+    C++ loadgen's hashed lane against real ``--native --shm`` servers —
+    back-to-back rounds see the same box state, so the best paired
+    ratio measures the transport's marginal cost, not machine drift
+    (the same honesty pattern as the audit overhead A/B). Every row
+    carries the loadgen's serialize/wire-write phase means, so the
+    JSON shows WHERE the per-frame time went: encoding is
+    transport-invariant, the write phase is the lane under test.
+
+    Two servers, both shm-enabled: one TCP (serves the tcp and shm
+    rounds — the shm lane upgrades over it) and one UDS (``--listen
+    unix:...``). ``frame_keys`` is deliberately smaller than the
+    saturation benches' 1024-2048: per-frame wire cost is the
+    numerator here, and jumbo frames would hide it behind the device
+    decide."""
+    import json
+    import shutil
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return {"error": "no g++"}
+    td = None
+    try:
+        if loadgen is None:
+            td = tempfile.mkdtemp()
+            loadgen = _build_loadgen(td)
+        upath = os.path.join(td or tempfile.gettempdir(),
+                             f"rltpu-bench-{os.getpid()}.sock")
+        tcp_proc, tcp_port = _spawn_server(
+            "sketch", platform="cpu", native=True, max_batch=16384,
+            inflight=inflight, extra_args=["--shm", "--limit", "1000000"])
+        uds_proc = None
+        try:
+            uds_proc, _ = _spawn_server(
+                "sketch", platform="cpu", native=True, max_batch=16384,
+                inflight=inflight,
+                extra_args=["--shm", "--limit", "1000000",
+                            "--listen", f"unix:{upath}"])
+
+            def run(transport: str) -> Dict:
+                host = upath if transport == "uds" else "127.0.0.1"
+                args = [loadgen, host, str(tcp_port), str(seconds),
+                        str(threads), str(inflight), str(frame_keys),
+                        "100000", "hashed", "--transport", transport]
+                out = subprocess.run(args, capture_output=True, text=True,
+                                     timeout=seconds + 90)
+                return json.loads(out.stdout.strip())
+
+            rounds = []
+            for i in range(max(1, pairs)):
+                rd = {t: run(t) for t in ("tcp", "uds", "shm")}
+                rounds.append(rd)
+                log(f"shm A/B round {i + 1}: "
+                    + " ".join(f"{t}={rd[t]['decisions_per_sec']:.0f}/s"
+                               f"(wr {rd[t]['wire_write_us_per_frame']:.2f}"
+                               "us)" for t in ("tcp", "uds", "shm")))
+        finally:
+            for proc in (tcp_proc, uds_proc):
+                if proc is None:
+                    continue
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    finally:
+        if td is not None:
+            import shutil as _sh
+
+            _sh.rmtree(td, ignore_errors=True)
+
+    def best_pair(t: str) -> Dict:
+        rd = max(rounds, key=lambda r: (r[t]["decisions_per_sec"]
+                                        / max(r["tcp"]["decisions_per_sec"],
+                                              1e-9)))
+        return {
+            "decisions_per_sec": rd[t]["decisions_per_sec"],
+            "tcp_decisions_per_sec": rd["tcp"]["decisions_per_sec"],
+            "vs_tcp": round(rd[t]["decisions_per_sec"]
+                            / max(rd["tcp"]["decisions_per_sec"], 1e-9), 3),
+            "frame_p50_ms": rd[t]["frame_p50_ms"],
+            "frame_p99_ms": rd[t]["frame_p99_ms"],
+        }
+
+    wire = {t: round(min(r[t]["wire_write_us_per_frame"] for r in rounds),
+                     3)
+            for t in ("tcp", "uds", "shm")}
+    return {
+        "rounds": rounds,
+        "paired_best": {"uds": best_pair("uds"), "shm": best_pair("shm")},
+        "wire_write_us_per_frame_best": {
+            **wire,
+            "tcp_over_shm": round(wire["tcp"] / max(wire["shm"], 1e-9), 2),
+        },
+        "harness": (
+            f"cpp_loadgen hashed lane, {threads} conns x {inflight} "
+            f"pipelined {frame_keys}-id frames, interleaved "
+            "tcp/uds/shm rounds against two --native --shm sketch-on-cpu "
+            "servers (one tcp, one --listen unix:); paired_best is the "
+            "round with the best transport/tcp ratio (drift cancels "
+            "in-pair); wire_write_us is the loadgen's measured "
+            "send-syscall (tcp/uds) or ring-push+doorbell (shm) phase "
+            "per frame"),
+    }
+
+
 def _build_loadgen(td: str) -> str:
     binary = os.path.join(td, "rltpu_loadgen")
     subprocess.run(

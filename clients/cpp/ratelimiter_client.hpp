@@ -25,6 +25,7 @@
 #include <netdb.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -80,7 +81,24 @@ class ProtocolError : public std::runtime_error {
 
 class Client {
  public:
+  // `host` may be a unix socket path — "unix:/run/rl.sock" or any
+  // leading-slash path (port ignored) — for the same-host UDS listener
+  // (ADR-025); otherwise it resolves as an IPv4 host.
   Client(const std::string& host, uint16_t port) : req_id_(0) {
+    if (host.rfind("unix:", 0) == 0 || (!host.empty() && host[0] == '/')) {
+      std::string path = host.rfind("unix:", 0) == 0 ? host.substr(5) : host;
+      fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+      struct sockaddr_un a {};
+      a.sun_family = AF_UNIX;
+      if (fd_ < 0 || path.size() >= sizeof(a.sun_path))
+        throw ProtocolError("bad unix socket path " + path);
+      std::memcpy(a.sun_path, path.c_str(), path.size());
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&a), sizeof(a)) != 0) {
+        ::close(fd_);
+        throw ProtocolError("connect failed to " + path);
+      }
+      return;
+    }
     struct addrinfo hints{}, *res = nullptr;
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;

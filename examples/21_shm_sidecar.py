@@ -1,0 +1,117 @@
+"""Zero-syscall shared-memory wire lane: co-located frames at device
+speed (ADR-025).
+
+A rate-limit sidecar usually shares the host with its callers, yet every
+decision still pays the full socket toll: two syscalls plus a kernel
+copy per frame, each way. The shm lane removes all of it for same-host
+traffic. A client connects normally (tcp or uds), then sends one
+T_SHM_HELLO; the server maps a pair of single-producer/single-consumer
+rings in /dev/shm and from then on frames — the EXISTING wire framing,
+byte for byte — move as memory writes with a bounded-spin-then-eventfd
+doorbell. The socket stays open but silent: it is the liveness channel
+(peer death = socket close) and the auth boundary (the hello runs under
+whatever the connection already negotiated).
+
+This example shows the ladder end-to-end on one asyncio-door server:
+
+1. plain tcp client and shm-upgraded client answering the same keys;
+2. per-call latency, tcp vs shm, same loop, same limiter;
+3. the transport observability block: per-transport connection counts,
+   ring occupancy/high-water, doorbell-vs-spin counters;
+4. the off-by-default pin: a server without ``shm=True`` answers the
+   hello with a typed error and nothing else changes.
+
+Run on any host:
+
+    JAX_PLATFORMS=cpu python examples/21_shm_sidecar.py
+
+The served form (same flags on the real binary, both doors):
+
+    python -m ratelimiter_tpu.serving --backend sketch --native --shm
+    python -m ratelimiter_tpu.serving --listen unix:/run/rl.sock --shm
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import asyncio
+import time
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    create_limiter,
+)
+from ratelimiter_tpu.serving import AsyncClient, RateLimitServer
+
+T0 = 1_700_000_000.0
+
+
+async def timed_calls(client, key: str, n: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(n):
+        res = await client.allow(key)
+        assert res.allowed
+    return (time.perf_counter() - t0) / n * 1e6  # µs/call
+
+
+async def main() -> None:
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=10_000_000,
+                 window=60.0, key_prefix="")
+    lim = create_limiter(cfg, backend="exact", clock=ManualClock(T0))
+    server = RateLimitServer(lim, "127.0.0.1", 0, shm=True)
+    await server.start()
+
+    # -- 1. the same lanes over both rungs -----------------------------
+    tcp = await AsyncClient.connect(server.host, server.port)
+    shm = await AsyncClient.connect(server.host, server.port,
+                                    transport="shm")
+    for c in (tcp, shm):
+        assert (await c.allow("api:GET /v1/users")).allowed
+        batch = await c.allow_batch(["t:1", "t:2", "t:1"])
+        assert [r.allowed for r in batch] == [True, True, True]
+
+    # -- 2. per-call latency, same loop, same limiter ------------------
+    n = 2000
+    us_tcp = await timed_calls(tcp, "bench:tcp", n)
+    us_shm = await timed_calls(shm, "bench:shm", n)
+    print(f"per-call latency over {n} calls: "
+          f"tcp {us_tcp:.1f} us  shm {us_shm:.1f} us")
+
+    # -- 3. transport observability ------------------------------------
+    st = server.transport_stats()
+    print("connections by transport:", st["connections"])
+    sh = st["shm"]
+    print(f"shm lanes active={sh['lanes_active']} "
+          f"records in/out={sh['records_in']}/{sh['records_out']} "
+          f"spin-hits={sh['spin_hits']} "
+          f"doorbell-wakes={sh['doorbell_wakes']} "
+          f"req-ring high-water={sh['req_ring_highwater_bytes']}B")
+    assert st["connections"]["shm"] == 1
+    assert sh["records_in"] >= n
+
+    await tcp.close()
+    await shm.close()
+    await server.shutdown()
+
+    # -- 4. off by default: the hello is a typed refusal ---------------
+    plain = RateLimitServer(lim, "127.0.0.1", 0)  # no shm=True
+    await plain.start()
+    try:
+        await AsyncClient.connect(plain.host, plain.port, transport="shm")
+        raise AssertionError("hello should have been refused")
+    except InvalidConfigError as exc:
+        print(f"shm off => typed refusal: {exc}")
+    await plain.shutdown()
+    lim.close()
+    print("OK")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

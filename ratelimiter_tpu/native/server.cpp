@@ -73,12 +73,18 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
 #include <unistd.h>
+
+#include "shm_ring.h"
 
 #include <atomic>
 #include <condition_variable>
@@ -99,6 +105,11 @@ constexpr uint8_t T_ALLOW_N = 1, T_RESET = 2, T_HEALTH = 3, T_METRICS = 4,
 constexpr uint8_t T_RESULT = 129, T_OK = 130, T_HEALTH_R = 131,
                   T_METRICS_R = 132, T_RESULT_BATCH = 133,
                   T_RESULT_HASHED = 136, T_ERROR = 255;
+// Shm lane upgrade (ADR-025): 16 aliases FORWARD_FLAG | 0 on the type
+// byte, so the hello is matched EXACTLY on the raw byte before any flag
+// stripping (base type 0 is invalid, making the exact match unambiguous;
+// the hello never composes with the trace/deadline/forward extensions).
+constexpr uint8_t T_SHM_HELLO = 16, T_SHM_HELLO_R = 141;
 
 // splitmix64 finalizer — BIT-IDENTICAL to ops/hashing.splitmix64 (and
 // its device twin): the hashed wire lane's raw u64 ids are finalized
@@ -203,17 +214,50 @@ std::string make_error(uint64_t req_id, uint16_t code, const std::string& msg) {
   return out;
 }
 
+// Shm lane state for one upgraded connection (ADR-025; io thread only
+// except the ring ctrl words, which the client process shares). The
+// socket stays open as the liveness channel: its EOF/HUP reclaims the
+// mapping deterministically, so a kill -9'd client can never wedge the
+// server. Spin budget before re-arming the doorbell: cheap C++
+// iterations, so a deeper spin than the Python mirror's.
+constexpr int SHM_SPIN_ITERS = 4096;
+
+struct ShmLane {
+  uint8_t* base = nullptr;
+  size_t map_len = 0;
+  rlshm::LaneView lane;
+  int efd_server = -1;   // server reads (request doorbell)
+  int efd_client = -1;   // client reads (reply doorbell)
+  int ctrl_listen_fd = -1;
+  std::string shm_path, ctrl_path;
+  bool handshaken = false;   // eventfds delivered; replies ride the ring
+  bool unlinked = false;
+  ~ShmLane() {
+    if (ctrl_listen_fd >= 0) close(ctrl_listen_fd);
+    if (efd_server >= 0) close(efd_server);
+    if (efd_client >= 0) close(efd_client);
+    if (base != nullptr) munmap(base, map_len);
+    if (!unlinked) {
+      unlink(ctrl_path.c_str());
+      unlink(shm_path.c_str());
+    }
+  }
+};
+
 struct Conn {
   int fd = -1;
   std::string rbuf;                 // partial frames (io thread only)
   std::deque<std::string> wq;       // outgoing frames
   size_t woff = 0;                  // offset into wq.front()
+  size_t wq_bytes = 0;              // guarded by wmx (shm slow-reader cut)
   std::mutex wmx;
   std::atomic<bool> closed{false};
   bool want_write = false;          // io thread only
   // This connection currently holds a DCN-sized receive-buffer grant
   // (io thread only; counted in Server::dcn_conns).
   bool dcn_big = false;
+  // Shm lane after a T_SHM_HELLO upgrade (null = plain socket conn).
+  std::unique_ptr<ShmLane> shm;
 };
 
 using ConnPtr = std::shared_ptr<Conn>;
@@ -290,6 +334,27 @@ struct InFlight {
 struct Server {
   int listen_fd = -1, epoll_fd = -1, event_fd = -1;
   uint16_t port = 0;
+  // UDS listener (--listen unix:/path): host strings beginning "unix:".
+  bool uds = false;
+  std::string uds_path;
+  // Shm wire lane (ADR-025). Off by default: T_SHM_HELLO answers
+  // E_INVALID_CONFIG and every other wire byte is identical to a server
+  // built before the lane existed.
+  bool shm_enabled = false;
+  std::string shm_dir = "/dev/shm";
+  uint32_t shm_ring_bytes = 0;
+  uint32_t lane_ctr = 0;                  // io thread only
+  std::map<int, ConnPtr> shm_fds;         // ctrl/efd fd -> conn (io thread)
+  // Transport observability (scrape-time, mirrors the asyncio door's
+  // transport_stats()): cumulative accepts + live/cumulative lane and
+  // ring counters.
+  std::atomic<uint64_t> conns_tcp{0}, conns_uds{0}, conns_shm{0};
+  std::atomic<uint64_t> shm_lanes_active{0};
+  std::atomic<uint64_t> shm_doorbell_wakes{0};
+  std::atomic<uint64_t> shm_spin_hits{0};
+  std::atomic<uint64_t> shm_records_in{0}, shm_records_out{0};
+  std::atomic<uint64_t> shm_ring_full_stalls{0};
+  std::atomic<uint64_t> shm_req_highwater{0}, shm_rep_highwater{0};
   uint32_t max_batch = 4096;
   uint32_t max_delay_us = 200;
   // Dispatch SLO (0 = disabled): when one batched decide exceeds this,
@@ -527,6 +592,7 @@ void conn_send(Server* s, const ConnPtr& c, std::string frame) {
   if (c->closed.load()) return;
   {
     std::lock_guard<std::mutex> g(c->wmx);
+    c->wq_bytes += frame.size();
     c->wq.push_back(std::move(frame));
   }
   uint64_t one = 1;  // wake the io thread to flush
@@ -1509,12 +1575,83 @@ void close_conn(Server* s, const ConnPtr& c) {
     c->dcn_big = false;
     s->dcn_conns.fetch_sub(1);
   }
+  if (c->shm) {
+    // Deterministic reclaim (ADR-025): drop the doorbell/control fds
+    // from epoll, then let the lane destructor unmap + unlink. Records
+    // the client pushed but we never drained are abandoned with the
+    // mapping — exactly the TCP contract for bytes in a dead socket.
+    ShmLane* L = c->shm.get();
+    for (int fd : {L->ctrl_listen_fd, L->efd_server}) {
+      if (fd >= 0) {
+        epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
+        s->shm_fds.erase(fd);
+      }
+    }
+    if (L->handshaken) s->shm_lanes_active.fetch_sub(1);
+    c->shm.reset();
+  }
   epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
   s->conns.erase(c->fd);
 }
 
+void ding_efd(int fd) {
+  uint64_t one = 1;
+  ssize_t r = write(fd, &one, 8);
+  (void)r;
+}
+
+// Reply producer for an upgraded conn: push queued frames into the
+// reply ring (every reply funnels through conn_send -> wq, so ALL
+// encodings — results, errors, metrics, health — ride unchanged).
+// Ring full leaves the residue in wq with producer_waiting raised; the
+// client's consumer dings efd_server after freeing space and the drain
+// path re-flushes. A peer further behind than the slow-reader cut
+// (mirrors the asyncio door's WRITE_BUFFER_LIMIT) is disconnected.
+void flush_shm_writes(Server* s, const ConnPtr& c) {
+  ShmLane* L = c->shm.get();
+  rlshm::Ring& ring = L->lane.outbound;
+  bool pushed = false, cut = false;
+  {
+    std::lock_guard<std::mutex> g(c->wmx);
+    while (!c->wq.empty()) {
+      const std::string& f = c->wq.front();
+      if (8 + rlshm::align8((uint32_t)f.size()) >= ring.capacity) {
+        cut = true;  // frame can never fit: fatal for this lane
+        break;
+      }
+      if (!ring.try_push((const uint8_t*)f.data(), (uint32_t)f.size())) {
+        ring.set_producer_waiting();
+        // Re-check after the SeqCst store: the consumer may have freed
+        // space between the failed push and the flag store.
+        if (!ring.try_push((const uint8_t*)f.data(), (uint32_t)f.size())) {
+          s->shm_ring_full_stalls.fetch_add(1);
+          break;
+        }
+        ring.clear_producer_waiting();
+      }
+      pushed = true;
+      s->shm_records_out.fetch_add(1);
+      c->wq_bytes -= f.size();
+      c->wq.pop_front();
+    }
+    if (c->wq_bytes > 8ul * 1024 * 1024) cut = true;
+    uint64_t used = ring.used();
+    uint64_t hw = s->shm_rep_highwater.load();
+    while (used > hw && !s->shm_rep_highwater.compare_exchange_weak(hw, used)) {
+    }
+  }
+  if (pushed && ring.consumer_sleeping()) ding_efd(L->efd_client);
+  if (cut) close_conn(s, c);
+}
+
 void flush_writes(Server* s, const ConnPtr& c) {
+  if (c->shm && c->shm->handshaken) {
+    // Upgraded conn: replies ride the reply ring, not the socket (the
+    // socket is the liveness channel only past this point).
+    flush_shm_writes(s, c);
+    return;
+  }
   std::lock_guard<std::mutex> g(c->wmx);
   while (!c->wq.empty()) {
     const std::string& front = c->wq.front();
@@ -1527,6 +1664,7 @@ void flush_writes(Server* s, const ConnPtr& c) {
     }
     c->woff += (size_t)w;
     if (c->woff == front.size()) {
+      c->wq_bytes -= front.size();
       c->wq.pop_front();
       c->woff = 0;
     }
@@ -1539,6 +1677,241 @@ void flush_writes(Server* s, const ConnPtr& c) {
     ev.data.fd = c->fd;
     epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
   }
+}
+
+bool process_rbuf(Server* s, const ConnPtr& c);
+
+uint32_t clamp_ring_bytes(uint32_t n) {
+  // Mirrors serving/shm.py clamp_ring_bytes: 0 -> default 2 MiB, else a
+  // power of two in [MIN_RING, MAX_RING].
+  if (n == 0) return 1u << 21;
+  if (n < rlshm::MIN_RING) n = rlshm::MIN_RING;
+  if (n > rlshm::MAX_RING) n = rlshm::MAX_RING;
+  uint32_t p = rlshm::MIN_RING;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+// T_SHM_HELLO on the io thread (ADR-025): create the per-connection
+// mapping + eventfds + one-shot control listener, answer T_SHM_HELLO_R
+// over the socket. Returns false on a malformed body (protocol error:
+// the caller closes the connection, matching parse_shm_hello's raise).
+bool handle_shm_hello(Server* s, const ConnPtr& c, uint64_t req_id,
+                      const char* body, uint32_t blen) {
+  if (blen != 12) return false;
+  if (!s->shm_enabled) {
+    conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
+                               "shm lane not enabled on this server "
+                               "(--shm)"));
+    return true;
+  }
+  if (c->shm) {
+    conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
+                               "shm lane already active on this "
+                               "connection"));
+    return true;
+  }
+  uint32_t version, req_b, rep_b;
+  memcpy(&version, body, 4);
+  memcpy(&req_b, body + 4, 4);
+  memcpy(&rep_b, body + 8, 4);
+  if (version != rlshm::VERSION) {
+    conn_send(s, c, make_error(req_id, E_INVALID_CONFIG,
+                               "unsupported shm lane version"));
+    return true;
+  }
+  uint32_t req_cap = clamp_ring_bytes(req_b ? req_b : s->shm_ring_bytes);
+  uint32_t rep_cap = clamp_ring_bytes(rep_b ? rep_b : s->shm_ring_bytes);
+  auto L = std::make_unique<ShmLane>();
+  int sfd = -1;
+  char path[512];
+  for (int attempt = 0; attempt < 64 && sfd < 0; ++attempt) {
+    snprintf(path, sizeof(path), "%s/rltpu-shm-%d-n%u-%d",
+             s->shm_dir.c_str(), (int)getpid(), ++s->lane_ctr, attempt);
+    sfd = open(path, O_CREAT | O_EXCL | O_RDWR, 0600);
+  }
+  if (sfd < 0) {
+    conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                               "could not allocate shm lane file"));
+    return true;
+  }
+  L->shm_path = path;
+  L->ctrl_path = L->shm_path + ".ctrl";
+  L->map_len = (size_t)rlshm::total_bytes(req_cap, rep_cap);
+  if (ftruncate(sfd, (off_t)L->map_len) != 0 ||
+      (L->base = (uint8_t*)mmap(nullptr, L->map_len,
+                                PROT_READ | PROT_WRITE, MAP_SHARED, sfd,
+                                0)) == MAP_FAILED) {
+    L->base = nullptr;
+    close(sfd);
+    unlink(path);
+    L->unlinked = true;
+    conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                               "could not map shm lane file"));
+    return true;
+  }
+  close(sfd);
+  rlshm::init_file(L->base, req_cap, rep_cap);
+  rlshm::attach(L->base, /*server=*/true, &L->lane);
+  // Armed from birth: the client's very first push must ding the
+  // doorbell (the drain path re-arms after each empty spin).
+  L->lane.inbound.set_sleeping();
+  L->efd_server = eventfd(0, EFD_NONBLOCK);
+  L->efd_client = eventfd(0, EFD_NONBLOCK);
+  L->ctrl_listen_fd =
+      socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  struct sockaddr_un sun{};
+  sun.sun_family = AF_UNIX;
+  if (L->efd_server < 0 || L->efd_client < 0 || L->ctrl_listen_fd < 0 ||
+      L->ctrl_path.size() >= sizeof(sun.sun_path)) {
+    conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                               "could not set up shm lane doorbells"));
+    return true;  // ~ShmLane cleans up
+  }
+  memcpy(sun.sun_path, L->ctrl_path.c_str(), L->ctrl_path.size() + 1);
+  unlink(L->ctrl_path.c_str());
+  if (bind(L->ctrl_listen_fd, (struct sockaddr*)&sun, sizeof(sun)) != 0 ||
+      chmod(L->ctrl_path.c_str(), 0600) != 0 ||
+      listen(L->ctrl_listen_fd, 1) != 0) {
+    conn_send(s, c, make_error(req_id, E_STORAGE_UNAVAILABLE,
+                               "could not bind shm control socket"));
+    return true;
+  }
+  struct epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = L->ctrl_listen_fd;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, L->ctrl_listen_fd, &ev);
+  s->shm_fds[L->ctrl_listen_fd] = c;
+  std::string sp = L->shm_path, cp = L->ctrl_path;
+  c->shm = std::move(L);
+  s->conns_shm.fetch_add(1);
+  std::string out;
+  frame_header(out, T_SHM_HELLO_R, req_id,
+               9 + 2 + (uint32_t)sp.size() + 2 + (uint32_t)cp.size());
+  out.push_back((char)1);  // ok
+  put_u32(out, req_cap);
+  put_u32(out, rep_cap);
+  put_u16(out, (uint16_t)sp.size());
+  out += sp;
+  put_u16(out, (uint16_t)cp.size());
+  out += cp;
+  conn_send(s, c, std::move(out));  // lane not handshaken: rides the socket
+  return true;
+}
+
+// Control-socket accept: ship the eventfd pair via SCM_RIGHTS, then
+// unlink both filesystem artifacts (the peer holds them open) and start
+// watching the request doorbell.
+void shm_ctrl_accept(Server* s, const ConnPtr& c) {
+  ShmLane* L = c->shm.get();
+  int cfd = accept4(L->ctrl_listen_fd, nullptr, nullptr, 0);
+  if (cfd < 0) return;
+  char data = 'x';
+  struct iovec iov {
+    &data, 1
+  };
+  char cbuf[CMSG_SPACE(2 * sizeof(int))];
+  memset(cbuf, 0, sizeof(cbuf));
+  struct msghdr msg{};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  struct cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+  cm->cmsg_level = SOL_SOCKET;
+  cm->cmsg_type = SCM_RIGHTS;
+  cm->cmsg_len = CMSG_LEN(2 * sizeof(int));
+  int fds[2] = {L->efd_server, L->efd_client};
+  memcpy(CMSG_DATA(cm), fds, sizeof(fds));
+  msg.msg_controllen = cm->cmsg_len;
+  ssize_t w = sendmsg(cfd, &msg, 0);
+  close(cfd);
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, L->ctrl_listen_fd, nullptr);
+  s->shm_fds.erase(L->ctrl_listen_fd);
+  close(L->ctrl_listen_fd);
+  L->ctrl_listen_fd = -1;
+  unlink(L->ctrl_path.c_str());
+  unlink(L->shm_path.c_str());
+  L->unlinked = true;
+  if (w < 0) {
+    close_conn(s, c);
+    return;
+  }
+  L->handshaken = true;
+  s->shm_lanes_active.fetch_add(1);
+  struct epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = L->efd_server;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, L->efd_server, &ev);
+  s->shm_fds[L->efd_server] = c;
+  // Replies queued during the handshake window move to the ring now.
+  flush_shm_writes(s, c);
+}
+
+// Request-doorbell wake: drain every committed record into rbuf (records
+// ARE wire frames, so the normal parser consumes them unchanged), with
+// the same cleared-while-draining / re-arm / missed-wake-recheck
+// protocol as the Python ServerLane. A torn record poisons the lane —
+// reclaim through the liveness socket, never spin on corrupt memory.
+void shm_drain(Server* s, const ConnPtr& c) {
+  ShmLane* L = c->shm.get();
+  uint64_t junk;
+  ssize_t r = read(L->efd_server, &junk, 8);
+  (void)r;
+  s->shm_doorbell_wakes.fetch_add(1);
+  rlshm::Ring& ring = L->lane.inbound;
+  uint64_t used = ring.used();
+  uint64_t hw = s->shm_req_highwater.load();
+  while (used > hw && !s->shm_req_highwater.compare_exchange_weak(hw, used)) {
+  }
+  ring.clear_sleeping();
+  bool dead = false;
+  for (;;) {
+    const uint8_t* payload;
+    uint32_t len;
+    rlshm::Ring::PopResult pr = ring.pop(&payload, &len);
+    if (pr == rlshm::Ring::POP_EMPTY) {
+      // Dispatch what is buffered BEFORE burning the spin budget — the
+      // spin exists to catch back-to-back pushes cheaply, not to delay
+      // work already in hand.
+      if (!c->rbuf.empty() && !process_rbuf(s, c)) {
+        dead = true;
+        break;
+      }
+      for (int i = 0; i < SHM_SPIN_ITERS; ++i) {
+        pr = ring.pop(&payload, &len);
+        if (pr != rlshm::Ring::POP_EMPTY) {
+          s->shm_spin_hits.fetch_add(1);
+          break;
+        }
+      }
+      if (pr == rlshm::Ring::POP_EMPTY) {
+        ring.set_sleeping();
+        pr = ring.pop(&payload, &len);  // missed-wake recheck
+        if (pr == rlshm::Ring::POP_EMPTY) break;
+        ring.clear_sleeping();
+      }
+    }
+    if (pr == rlshm::Ring::POP_TORN) {
+      dead = true;
+      break;
+    }
+    c->rbuf.append((const char*)payload, len);
+    ring.advance(len);
+    s->shm_records_in.fetch_add(1);
+  }
+  if (!dead && !c->rbuf.empty() && !process_rbuf(s, c)) dead = true;
+  if (dead) {
+    close_conn(s, c);
+    return;
+  }
+  if (ring.producer_waiting()) {
+    ring.clear_producer_waiting();
+    ding_efd(L->efd_client);
+  }
+  // Space may have been freed on the reply ring by the client too;
+  // retry any residue the last flush left queued.
+  flush_shm_writes(s, c);
 }
 
 // Parse complete frames out of c->rbuf; enqueue work.
@@ -1554,6 +1927,20 @@ bool process_rbuf(Server* s, const ConnPtr& c) {
     // allow_dcn). The trace-context flag (ADR-014) is stripped first:
     // flagged requests prefix their body with a u64 trace id.
     uint8_t rawtype = (uint8_t)c->rbuf[off + 4];
+    if (rawtype == T_SHM_HELLO) {
+      // Shm lane upgrade (ADR-025): EXACT match on the raw type byte
+      // BEFORE any flag stripping — 16 aliases FORWARD_FLAG | 0, and
+      // base type 0 is invalid, so this cannot shadow a real frame.
+      if (length > MAX_FRAME) return false;
+      if (c->rbuf.size() - off < 4 + length) break;
+      uint64_t rid;
+      memcpy(&rid, c->rbuf.data() + off + 5, 8);
+      const char* hbody = c->rbuf.data() + off + 13;
+      uint32_t hlen = length - 9;
+      off += 4 + length;
+      if (!handle_shm_hello(s, c, rid, hbody, hlen)) return false;
+      continue;
+    }
     bool traced = (rawtype & TRACE_FLAG) != 0 && rawtype < 0x80;
     uint8_t type = traced ? (uint8_t)(rawtype & ~TRACE_FLAG) : rawtype;
     bool deadlined = (type & DEADLINE_FLAG) != 0 && rawtype < 0x80;
@@ -1900,8 +2287,13 @@ void io_main(Server* s) {
         while (true) {
           int cfd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
           if (cfd < 0) break;
-          int one = 1;
-          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          if (s->uds) {
+            s->conns_uds.fetch_add(1);
+          } else {
+            int one = 1;
+            setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            s->conns_tcp.fetch_add(1);
+          }
           auto c = std::make_shared<Conn>();
           c->fd = cfd;
           s->conns[cfd] = c;
@@ -1921,6 +2313,17 @@ void io_main(Server* s) {
           flush_writes(s, c);
         }
       } else {
+        // Shm lane fds first: the one-shot control listener and, after
+        // the handshake, the request doorbell (ADR-025).
+        auto sit = s->shm_fds.find(fd);
+        if (sit != s->shm_fds.end()) {
+          ConnPtr sc = sit->second;
+          if (sc->shm && fd == sc->shm->ctrl_listen_fd)
+            shm_ctrl_accept(s, sc);
+          else if (sc->shm)
+            shm_drain(s, sc);
+          continue;
+        }
         auto it = s->conns.find(fd);
         if (it == s->conns.end()) continue;
         ConnPtr c = it->second;
@@ -1990,21 +2393,43 @@ PyObject* server_start(PyObject* self, PyObject* args) {
   int port;
   if (!PyArg_ParseTuple(args, "si", &host, &port)) return nullptr;
 
-  s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  int one = 1;
-  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
-  struct sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)port);
-  inet_pton(AF_INET, host, &addr.sin_addr);
-  if (bind(s->listen_fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
-      listen(s->listen_fd, 512) != 0) {
-    PyErr_SetFromErrno(PyExc_OSError);
-    return nullptr;
+  if (strncmp(host, "unix:", 5) == 0) {
+    // UDS listener (ADR-025 transport ladder): host is "unix:/path".
+    const char* upath = host + 5;
+    struct sockaddr_un sun{};
+    if (strlen(upath) >= sizeof(sun.sun_path)) {
+      PyErr_SetString(PyExc_ValueError, "unix socket path too long");
+      return nullptr;
+    }
+    s->uds = true;
+    s->uds_path = upath;
+    s->listen_fd = socket(AF_UNIX, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    sun.sun_family = AF_UNIX;
+    memcpy(sun.sun_path, upath, strlen(upath) + 1);
+    unlink(upath);  // stale socket from a previous run
+    if (bind(s->listen_fd, (struct sockaddr*)&sun, sizeof(sun)) != 0 ||
+        listen(s->listen_fd, 512) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return nullptr;
+    }
+    s->port = 0;
+  } else {
+    s->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    int one = 1;
+    setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    if (bind(s->listen_fd, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(s->listen_fd, 512) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return nullptr;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(s->listen_fd, (struct sockaddr*)&addr, &alen);
+    s->port = ntohs(addr.sin_port);
   }
-  socklen_t alen = sizeof(addr);
-  getsockname(s->listen_fd, (struct sockaddr*)&addr, &alen);
-  s->port = ntohs(addr.sin_port);
 
   s->epoll_fd = epoll_create1(0);
   s->event_fd = eventfd(0, EFD_NONBLOCK);
@@ -2107,6 +2532,7 @@ PyObject* server_shutdown(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     close(s->epoll_fd);
     close(s->event_fd);
     s->listen_fd = -1;
+    if (s->uds && !s->uds_path.empty()) unlink(s->uds_path.c_str());
   }
   Py_RETURN_NONE;
 }
@@ -2164,8 +2590,39 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
     Py_DECREF(per_quar);
     return nullptr;
   }
+  // Per-transport accepts + shm lane counters (ADR-025): the same
+  // shape the asyncio door's transport_stats() reports, so the metrics
+  // collect hook and bench tooling read one schema from either door.
+  PyObject* transport = Py_BuildValue(
+      "{s:K,s:K,s:K}",
+      "tcp", (unsigned long long)ps->s->conns_tcp.load(),
+      "uds", (unsigned long long)ps->s->conns_uds.load(),
+      "shm", (unsigned long long)ps->s->conns_shm.load());
+  PyObject* shm_stats = Py_BuildValue(
+      "{s:K,s:K,s:K,s:K,s:K,s:K,s:K,s:K}",
+      "lanes_active", (unsigned long long)ps->s->shm_lanes_active.load(),
+      "doorbell_wakes",
+      (unsigned long long)ps->s->shm_doorbell_wakes.load(),
+      "spin_hits", (unsigned long long)ps->s->shm_spin_hits.load(),
+      "ring_full_stalls",
+      (unsigned long long)ps->s->shm_ring_full_stalls.load(),
+      "records_in", (unsigned long long)ps->s->shm_records_in.load(),
+      "records_out", (unsigned long long)ps->s->shm_records_out.load(),
+      "req_ring_highwater_bytes",
+      (unsigned long long)ps->s->shm_req_highwater.load(),
+      "rep_ring_highwater_bytes",
+      (unsigned long long)ps->s->shm_rep_highwater.load());
+  if (transport == nullptr || shm_stats == nullptr) {
+    Py_DECREF(per_shard);
+    Py_DECREF(per_quar);
+    Py_DECREF(stage_ns);
+    Py_XDECREF(transport);
+    Py_XDECREF(shm_stats);
+    return nullptr;
+  }
   PyObject* out = Py_BuildValue(
-      "{s:K,s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O,s:O,s:O}", "decisions_total",
+      "{s:K,s:K,s:K,s:d,s:K,s:I,s:O,s:I,s:O,s:O,s:O,s:O,s:O}",
+      "decisions_total",
       (unsigned long long)ps->s->decisions.load(), "slo_breaches_total",
       (unsigned long long)ps->s->slo_breaches.load(),
       // Deadline shedding (ABI 10, ADR-015).
@@ -2177,10 +2634,13 @@ PyObject* server_stats(PyObject* self, PyObject* Py_UNUSED(ignored)) {
       // Shard routing observability (mesh mode: one shard == one
       // device, so this is the per-device decision balance, ADR-012).
       "num_shards", ps->s->num_shards, "shard_decisions", per_shard,
-      "shard_quarantined", per_quar, "stage_ns", stage_ns);
+      "shard_quarantined", per_quar, "stage_ns", stage_ns,
+      "transport", transport, "shm", shm_stats);
   Py_DECREF(per_shard);  // Py_BuildValue "O" took its own reference
   Py_DECREF(per_quar);
   Py_DECREF(stage_ns);
+  Py_DECREF(transport);
+  Py_DECREF(shm_stats);
   return out;
 }
 
@@ -2292,6 +2752,7 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                                  "dcn_auth_required", "max_dcn_conns",
                                  "decide_hashed", "launch_hashed",
                                  "spans",
+                                 "shm", "shm_dir", "shm_ring_bytes",
                                  nullptr};
   PyObject *decide, *reset, *metrics = Py_None, *dcn = Py_None;
   PyObject *launch = Py_None, *resolve = Py_None;
@@ -2305,7 +2766,10 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   Py_ssize_t key_prefix_len = 0;
   unsigned int num_shards = 1, inflight = 8, max_dcn_conns = 4;
   int dcn_auth_required = 0;
-  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpIOOO",
+  int shm = 0;
+  const char* shm_dir = nullptr;
+  unsigned int shm_ring_bytes = 0;
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO|OIIIpLdy#IOOOIpIOOOpsI",
                                    (char**)kwlist,
                                    &decide, &reset, &metrics, &max_batch,
                                    &max_delay_us, &slo_us, &fail_open, &limit,
@@ -2313,7 +2777,8 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
                                    &num_shards, &dcn, &launch, &resolve,
                                    &inflight, &dcn_auth_required,
                                    &max_dcn_conns, &decide_hashed,
-                                   &launch_hashed, &spans))
+                                   &launch_hashed, &spans, &shm, &shm_dir,
+                                   &shm_ring_bytes))
     return nullptr;
   if (num_shards < 1 || num_shards > 64) {
     PyErr_SetString(PyExc_ValueError, "num_shards must be in [1, 64]");
@@ -2337,6 +2802,9 @@ PyObject* create_server(PyObject* Py_UNUSED(mod), PyObject* args,
   ps->s->inflight_window = inflight < 1 ? 1 : inflight;
   ps->s->dcn_auth_required = dcn_auth_required != 0;
   ps->s->max_dcn_conns = max_dcn_conns;
+  ps->s->shm_enabled = shm != 0;
+  if (shm_dir != nullptr && shm_dir[0] != '\0') ps->s->shm_dir = shm_dir;
+  ps->s->shm_ring_bytes = shm_ring_bytes;
   if (key_prefix != nullptr && key_prefix_len > 0)
     ps->s->key_prefix.assign(key_prefix, (size_t)key_prefix_len);
   Py_INCREF(decide);
@@ -2381,7 +2849,7 @@ struct PyModuleDef server_module = {
 extern "C" {
 
 // C ABI probe so the loader can verify the build (native/__init__ pattern).
-int64_t rl_server_abi_version() { return 11; }
+int64_t rl_server_abi_version() { return 12; }
 
 PyMODINIT_FUNC PyInit__server(void) {
   PyServerType.tp_name = "ratelimiter_tpu.native._server.Server";

@@ -31,6 +31,29 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-backed rate-limit service")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8432)
+    ap.add_argument("--listen", default=None, metavar="ADDR",
+                    help="binary-door bind override (ADR-025): "
+                         "'unix:/path' listens on a unix domain socket "
+                         "instead of TCP (--port ignored for the binary "
+                         "door; HTTP/gRPC/lease sidecars keep --host)")
+    ap.add_argument("--shm", action="store_true",
+                    help="enable the zero-syscall shared-memory wire "
+                         "lane (ADR-025): a connected client may send "
+                         "T_SHM_HELLO to upgrade its connection to "
+                         "per-connection SPSC ring pairs in --shm-dir "
+                         "carrying the SAME wire frames; the socket "
+                         "stays open as the liveness/control channel. "
+                         "Off (the default) = wire bytes byte-identical "
+                         "to a server without this flag")
+    ap.add_argument("--shm-dir", default="/dev/shm", metavar="DIR",
+                    help="--shm: directory for the ring files (0600, "
+                         "unlinked after the handshake; same-uid trust "
+                         "boundary — see OPERATIONS §6)")
+    ap.add_argument("--shm-ring-bytes", type=int, default=0, metavar="B",
+                    help="--shm: per-direction ring capacity (power of "
+                         "two, clamped to [64KiB, 64MiB]; 0 = 2MiB "
+                         "default). A client's hello may request its "
+                         "own size; the server clamps")
     ap.add_argument("--algorithm", default="tpu_sketch",
                     choices=[a.value for a in Algorithm])
     ap.add_argument("--backend", default="sketch",
@@ -1499,7 +1522,9 @@ async def amain(args) -> None:
                     f"rows as strings (ADR-019)")
 
         server = NativeRateLimitServer(
-            limiter, args.host, args.port,
+            limiter, args.listen or args.host, args.port,
+            shm=args.shm, shm_dir=args.shm_dir,
+            shm_ring_bytes=args.shm_ring_bytes,
             max_batch=args.max_batch, max_delay=args.max_delay_us * 1e-6,
             dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
                               if args.dispatch_timeout_ms else None),
@@ -1601,6 +1626,7 @@ async def amain(args) -> None:
                            if k == "decisions_total"},
                         "policy_overrides":
                             server.shard_limiters[0].override_count(),
+                        "transport": server.transport_stats(),
                         "member": member_info(),
                         **_envelope_health(server.shard_limiters),
                         **_debt_slab_health(server.shard_limiters),
@@ -1673,7 +1699,9 @@ async def amain(args) -> None:
             loop.add_signal_handler(sig, stop.set)
         print(f"serving(native) {args.algorithm}/{args.backend} "
               f"limit={args.limit}/{args.window:g}s on "
-              f"{args.host}:{server.port}"
+              + (args.listen if args.listen
+                 else f"{args.host}:{server.port}")
+              + (" shm" if args.shm else "")
               + (f" http:{gateway.port}" if gateway else "")
               + (f" grpc:{grpc_srv.port}" if grpc_srv else "")
               + (f" lease:{lease_listener.port}" if lease_listener
@@ -1778,7 +1806,9 @@ async def amain(args) -> None:
 
         limiter = FleetForwarder(limiter, fleet_core)
     server = RateLimitServer(
-        limiter, args.host, args.port,
+        limiter, args.listen or args.host, args.port,
+        shm=args.shm, shm_dir=args.shm_dir,
+        shm_ring_bytes=args.shm_ring_bytes,
         max_batch=args.max_batch,
         max_delay=args.max_delay_us * 1e-6,
         dispatch_timeout=(args.dispatch_timeout_ms * 1e-3
@@ -1832,6 +1862,7 @@ async def amain(args) -> None:
             return {"serving": True,
                     "decisions_total": server.batcher.decisions_total,
                     "policy_overrides": limiter.override_count(),
+                    "transport": server.transport_stats(),
                     "member": member_info(),
                     **_envelope_health([limiter]),
                     **_debt_slab_health([limiter]),
@@ -1901,7 +1932,9 @@ async def amain(args) -> None:
         loop.add_signal_handler(sig, stop.set)
     print(f"serving {args.algorithm}/{args.backend} "
           f"limit={args.limit}/{args.window:g}s on "
-          f"{args.host}:{server.port}"
+          + (args.listen if args.listen
+             else f"{args.host}:{server.port}")
+          + (" shm" if args.shm else "")
           + (f" http:{gateway.port}" if gateway else "")
           + (f" grpc:{grpc_srv.port}" if grpc_srv else ""), flush=True)
     if fleet_membership is not None:

@@ -53,6 +53,7 @@ from ratelimiter_tpu.core.errors import (
 )
 from ratelimiter_tpu.core.types import Result
 from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving import shm as shm_lane
 
 
 def _jitter_delay(attempt: int, backoff: float, backoff_max: float) -> float:
@@ -95,8 +96,24 @@ class Client:
                  connect_timeout: Optional[float] = None,
                  call_timeout: Optional[float] = None,
                  retries: int = 2, backoff: float = 0.05,
-                 backoff_max: float = 2.0):
+                 backoff_max: float = 2.0,
+                 transport: str = "tcp",
+                 shm_ring_bytes: int = 0):
+        """``transport`` selects the wire (ADR-025 ladder): "tcp"
+        (default), "uds" (``host`` is ``unix:/path``, or pass the bare
+        path), or "shm" — connect normally (tcp or uds per the host
+        string), then upgrade via T_SHM_HELLO to per-connection shared
+        rings; the socket stays open as the liveness channel. A ``host``
+        beginning ``unix:`` implies uds even when transport is "tcp"."""
         self._host, self._port = host, port
+        if transport not in ("tcp", "uds", "shm"):
+            raise ValueError(f"unknown transport {transport!r}")
+        if transport == "uds" and not host.startswith("unix:"):
+            host = "unix:" + host
+            self._host = host
+        self._transport = transport
+        self._shm_ring_bytes = int(shm_ring_bytes)
+        self._lane: Optional[shm_lane.ClientLane] = None
         self._connect_timeout = (connect_timeout if connect_timeout
                                  is not None else timeout)
         self._call_timeout = (call_timeout if call_timeout is not None
@@ -116,16 +133,48 @@ class Client:
     # ------------------------------------------------------------ plumbing
 
     def _connect_locked(self) -> None:
-        self._sock = socket.create_connection(
-            (self._host, self._port), timeout=self._connect_timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._host.startswith("unix:"):
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(self._connect_timeout)
+            self._sock.connect(self._host[len("unix:"):])
+        else:
+            self._sock = socket.create_connection(
+                (self._host, self._port), timeout=self._connect_timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
         # Per-call READ timeout — deliberately not the connect timeout
         # (the pre-PR-8 bug: one knob silently bounded both).
         self._sock.settimeout(self._call_timeout)
         self._buf = b""
         self._desynced = False
+        if self._transport == "shm":
+            self._upgrade_shm_locked()
+
+    def _upgrade_shm_locked(self) -> None:
+        """T_SHM_HELLO on the fresh socket (ADR-025): the reply names a
+        /dev/shm file + control socket; map the file FIRST, then collect
+        the eventfd pair (the server unlinks both paths on accept)."""
+        req_id = next(self._ids)
+        self._sock.sendall(p.encode_shm_hello(
+            req_id, self._shm_ring_bytes, self._shm_ring_bytes))
+        hdr = self._recv_exact(p.HEADER_SIZE, None, req_id,
+                               p.T_SHM_HELLO)
+        length, type_, rid = p.parse_header(hdr)
+        body = self._recv_exact(length - 9, None, req_id, p.T_SHM_HELLO)
+        if type_ == p.T_ERROR:
+            code, msg = p.parse_error(body)
+            raise p.exception_for(code, msg)
+        if type_ != p.T_SHM_HELLO_R or rid != req_id:
+            raise p.ProtocolError(
+                f"unexpected SHM_HELLO response type {type_}")
+        _req_cap, _rep_cap, shm_path, ctrl_path = p.parse_shm_hello_r(
+            body)
+        self._lane = shm_lane.ClientLane(shm_path, ctrl_path)
 
     def _reconnect_locked(self) -> None:
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -168,11 +217,68 @@ class Client:
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
+    def _shm_roundtrip_locked(self, frame: bytes, req_id: int,
+                              req_type: int,
+                              deadline_at: Optional[float]):
+        """One request/response over the shm lane: zero syscalls when
+        both sides keep up (the doorbell only fires out of the bounded
+        spin). rid-0 revocation pushes interleave with replies on the
+        ring — consume them exactly like the socket read loops do."""
+        self._lane.send_frame(frame)
+        while True:
+            if deadline_at is not None:
+                rem = deadline_at - time.monotonic()
+                if rem <= 0:
+                    self._desynced = True
+                    raise RequestTimeoutError(
+                        f"deadline expired awaiting response to request "
+                        f"{req_id} (type {req_type}); connection will "
+                        f"reconnect", request_id=req_id,
+                        request_type=req_type)
+                timeout = (rem if self._call_timeout is None
+                           else min(rem, self._call_timeout))
+            else:
+                timeout = self._call_timeout
+            reply = self._lane.recv_frame(timeout)
+            if reply is None:
+                self._desynced = True
+                raise RequestTimeoutError(
+                    f"timed out awaiting response to request {req_id} "
+                    f"(type {req_type}); connection will reconnect",
+                    request_id=req_id, request_type=req_type)
+            length, type_, rid = p.parse_header(reply)
+            body = reply[p.HEADER_SIZE:]
+            if len(body) != length - 9:
+                self._desynced = True
+                raise p.ProtocolError("shm reply record length mismatch")
+            if rid == 0 and type_ == p.T_LEASE_REVOKE:
+                lc = self._lease_cache
+                if lc is not None:
+                    try:
+                        reason, _, ids = p.parse_lease_revoke(body)
+                        lc.invalidate_ids(
+                            ids, p.LEASE_REASONS.get(reason, "revoked"))
+                    except Exception:  # noqa: BLE001 — keep reading
+                        pass
+                continue
+            if rid != req_id:
+                self._desynced = True
+                raise p.ProtocolError(
+                    f"response id {rid} != request id {req_id}")
+            return type_, body
+
     def _roundtrip_once(self, frame: bytes, req_id: int, req_type: int,
                         deadline_at: Optional[float]):
         with self._lock:
             if self._desynced or self._sock is None:
                 self._reconnect_locked()
+            if self._lane is not None:
+                type_, body = self._shm_roundtrip_locked(
+                    frame, req_id, req_type, deadline_at)
+                if type_ == p.T_ERROR:
+                    code, msg = p.parse_error(body)
+                    raise p.exception_for(code, msg)
+                return type_, body
             self._sock.sendall(frame)
             hdr = self._recv_exact(p.HEADER_SIZE, deadline_at, req_id,
                                    req_type)
@@ -412,6 +518,9 @@ class Client:
 
     def close(self) -> None:
         self.disable_leases()
+        if self._lane is not None:
+            self._lane.close()
+            self._lane = None
         try:
             if self._sock is not None:
                 self._sock.close()
@@ -450,13 +559,28 @@ class AsyncClient:
         self._conn_lock: Optional[asyncio.Lock] = None
         self._lease_cache = None
         self._lease_task: Optional[asyncio.Task] = None
+        self._transport = "tcp"
+        self._shm_ring_bytes = 0
+        self._lane: Optional[shm_lane.ClientLane] = None
 
     @classmethod
     async def connect(cls, host: str = "127.0.0.1", port: int = 0, *,
                       retries: int = 2, backoff: float = 0.05,
-                      backoff_max: float = 2.0) -> "AsyncClient":
+                      backoff_max: float = 2.0,
+                      transport: str = "tcp",
+                      shm_ring_bytes: int = 0) -> "AsyncClient":
+        """``transport``: "tcp", "uds" (``host`` is ``unix:/path``) or
+        "shm" (connect, then upgrade to shared rings via T_SHM_HELLO —
+        ADR-025; replies arrive through the lane's eventfd doorbell on
+        this loop). A ``unix:`` host implies uds regardless."""
         self = cls()
+        if transport not in ("tcp", "uds", "shm"):
+            raise ValueError(f"unknown transport {transport!r}")
+        if transport == "uds" and not host.startswith("unix:"):
+            host = "unix:" + host
         self._host, self._port = host, port
+        self._transport = transport
+        self._shm_ring_bytes = int(shm_ring_bytes)
         self.retries = int(retries)
         self._backoff = float(backoff)
         self._backoff_max = float(backoff_max)
@@ -465,11 +589,101 @@ class AsyncClient:
         return self
 
     async def _open(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(
-            self._host, self._port)
-        self._writer.get_extra_info("socket").setsockopt(
-            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._host.startswith("unix:"):
+            self._reader, self._writer = (
+                await asyncio.open_unix_connection(
+                    self._host[len("unix:"):]))
+        else:
+            self._reader, self._writer = await asyncio.open_connection(
+                self._host, self._port)
+            self._writer.get_extra_info("socket").setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._transport == "shm":
+            # Upgrade BEFORE the read loop exists, so the hello reply
+            # is read inline here rather than raced by _read_loop.
+            await self._upgrade_shm()
         self._reader_task = asyncio.ensure_future(self._read_loop())
+
+    async def _upgrade_shm(self) -> None:
+        req_id = next(self._ids)
+        self._writer.write(p.encode_shm_hello(
+            req_id, self._shm_ring_bytes, self._shm_ring_bytes))
+        await self._writer.drain()
+        hdr = await self._reader.readexactly(p.HEADER_SIZE)
+        length, type_, rid = p.parse_header(hdr)
+        body = await self._reader.readexactly(length - 9)
+        if type_ == p.T_ERROR:
+            code, msg = p.parse_error(body)
+            raise p.exception_for(code, msg)
+        if type_ != p.T_SHM_HELLO_R or rid != req_id:
+            raise p.ProtocolError(
+                f"unexpected SHM_HELLO response type {type_}")
+        _rq, _rp, shm_path, ctrl_path = p.parse_shm_hello_r(body)
+        loop = asyncio.get_running_loop()
+        # The control-socket connect + SCM_RIGHTS receive block briefly;
+        # keep them off the loop.
+        self._lane = await loop.run_in_executor(
+            None, shm_lane.ClientLane, shm_path, ctrl_path)
+        # This client consumes replies via the event loop, not a spin:
+        # keep the consumer-sleeping flag permanently up so the server
+        # dings the doorbell for every reply burst (one eventfd write
+        # per drain, not per frame — the batching still amortizes).
+        self._lane.inbound.set_sleeping(True)
+        loop.add_reader(self._lane.efd_client, self._lane_drain)
+
+    def _lane_drain(self) -> None:
+        """efd_client doorbell: pop every committed reply record and
+        dispatch it exactly as the socket read loop would."""
+        lane = self._lane
+        if lane is None:
+            return
+        shm_lane._drain_eventfd(lane.efd_client)
+        lane.stats.doorbell_wakes += 1
+        try:
+            while True:
+                frame = lane.try_recv()
+                if frame is None:
+                    break
+                _len, type_, rid = p.parse_header(frame)
+                self._dispatch_reply(type_, rid, frame[p.HEADER_SIZE:])
+        except shm_lane.ShmProtocolError as exc:
+            # Poisoned ring: fail the in-flight calls and drop the
+            # connection through the liveness socket.
+            for fut in self._waiting.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError(f"shm lane poisoned: {exc}"))
+            self._waiting.clear()
+            self._teardown_lane()
+            if self._writer is not None:
+                self._writer.close()
+
+    def _teardown_lane(self) -> None:
+        lane, self._lane = self._lane, None
+        if lane is None:
+            return
+        try:
+            asyncio.get_running_loop().remove_reader(lane.efd_client)
+        except (OSError, RuntimeError):
+            pass
+        lane.close()
+
+    def _dispatch_reply(self, type_: int, rid: int, body: bytes) -> None:
+        if rid == 0 and type_ == p.T_LEASE_REVOKE:
+            # Unsolicited server push (ADR-022): the leases it names
+            # stop answering locally NOW.
+            lc = self._lease_cache
+            if lc is not None:
+                try:
+                    reason, _, ids = p.parse_lease_revoke(body)
+                    lc.invalidate_ids(
+                        ids, p.LEASE_REASONS.get(reason, "revoked"))
+                except Exception:  # noqa: BLE001 — keep reading
+                    pass
+            return
+        fut = self._waiting.pop(rid, None)
+        if fut is not None and not fut.done():
+            fut.set_result((type_, body))
 
     async def _ensure_open(self) -> None:
         if self._conn_lock is None:
@@ -484,6 +698,7 @@ class AsyncClient:
                     or self._reader_task is None
                     or self._reader_task.done())
             if dead:
+                self._teardown_lane()
                 if self._reader_task is not None:
                     self._reader_task.cancel()
                     try:
@@ -500,35 +715,29 @@ class AsyncClient:
                 hdr = await self._reader.readexactly(p.HEADER_SIZE)
                 length, type_, rid = p.parse_header(hdr)
                 body = await self._reader.readexactly(length - 9)
-                if rid == 0 and type_ == p.T_LEASE_REVOKE:
-                    # Unsolicited server push (ADR-022): the leases it
-                    # names stop answering locally NOW.
-                    lc = self._lease_cache
-                    if lc is not None:
-                        try:
-                            reason, _, ids = p.parse_lease_revoke(body)
-                            lc.invalidate_ids(
-                                ids,
-                                p.LEASE_REASONS.get(reason, "revoked"))
-                        except Exception:  # noqa: BLE001 — keep reading
-                            pass
-                    continue
-                fut = self._waiting.pop(rid, None)
-                if fut is not None and not fut.done():
-                    fut.set_result((type_, body))
+                self._dispatch_reply(type_, rid, body)
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 asyncio.CancelledError, OSError) as exc:
             for fut in self._waiting.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError(f"connection lost: {exc!r}"))
             self._waiting.clear()
+            # On an shm connection the socket is the liveness channel:
+            # its death invalidates the rings too.
+            self._teardown_lane()
 
     async def _request_once(self, frame: bytes, req_id: int):
         fut = asyncio.get_running_loop().create_future()
         self._waiting[req_id] = fut
         try:
-            self._writer.write(frame)
-            await self._writer.drain()
+            if self._lane is not None:
+                # Ring write: zero syscalls unless the server sleeps
+                # (doorbell) or the ring backs up (typed RingFullError,
+                # a StorageUnavailableError — never a silent drop).
+                self._lane.send_frame(frame)
+            else:
+                self._writer.write(frame)
+                await self._writer.drain()
             type_, body = await fut
         finally:
             self._waiting.pop(req_id, None)
@@ -803,6 +1012,7 @@ class AsyncClient:
 
     async def close(self) -> None:
         await self.disable_leases()
+        self._teardown_lane()
         if self._reader_task is not None:
             self._reader_task.cancel()
             try:

@@ -40,7 +40,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 11
+_ABI = 12
 
 
 def _load_extension():
@@ -152,7 +152,9 @@ class NativeRateLimitServer:
                  max_dcn_conns: int = 4,
                  shard_decorate=None,
                  shard_limiters: Optional[list] = None,
-                 fleet=None, fleet_announce=None, leases=None):
+                 fleet=None, fleet_announce=None, leases=None,
+                 shm: bool = False, shm_dir: str = "/dev/shm",
+                 shm_ring_bytes: int = 0):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -310,7 +312,16 @@ class NativeRateLimitServer:
             # in-flight push needs a grant; the default covers small
             # meshes, a refused peer gets a typed error and retries next
             # cycle (watermarks re-send slabs; dcn_peer.py).
-            max_dcn_conns=max(1, int(max_dcn_conns)))
+            max_dcn_conns=max(1, int(max_dcn_conns)),
+            # Zero-syscall shared-memory lane (ADR-025): off by default;
+            # when on, T_SHM_HELLO upgrades a connection to SPSC ring
+            # pairs in /dev/shm carrying the SAME wire frames.
+            shm=bool(shm), shm_dir=str(shm_dir),
+            shm_ring_bytes=int(shm_ring_bytes))
+        self.shm = bool(shm)
+        self.shm_dir = str(shm_dir)
+        self.shm_ring_bytes = int(shm_ring_bytes)
+        self.registry.add_collect_hook(self._collect_transport_metrics)
 
     # ------------------------------------------------------------ callbacks
 
@@ -994,6 +1005,7 @@ class NativeRateLimitServer:
         — the durability subsystem's final snapshot (serving/__main__.py)
         captures AFTER the last decision is answered, so a graceful
         shutdown loses nothing; call close_shards() afterwards."""
+        self.registry.remove_collect_hook(self._collect_transport_metrics)
         self._server.shutdown()
         if close_limiters:
             self.close_shards()
@@ -1005,5 +1017,55 @@ class NativeRateLimitServer:
 
     def stats(self) -> dict:
         return self._server.stats()
+
+    def transport_stats(self) -> dict:
+        """Same envelope as RateLimitServer.transport_stats (ADR-025):
+        the C++ io thread owns the counters; this is a snapshot read."""
+        st = self._server.stats()
+        sh = dict(st.get("shm", {}))
+        # The native door does not sample live ring occupancy (the io
+        # thread owns the rings); report 0 so the gauge set is uniform.
+        sh.setdefault("req_ring_used_bytes", 0)
+        sh.setdefault("rep_ring_used_bytes", 0)
+        return {"connections": dict(st.get("transport", {})), "shm": sh}
+
+    def _collect_transport_metrics(self) -> None:
+        st = self.transport_stats()
+        g = self.registry.gauge(
+            "rate_limiter_transport_connections",
+            "Connections accepted per transport (cumulative)")
+        for k, v in st["connections"].items():
+            g.set(v, transport=k)
+        sh = st["shm"]
+        self.registry.gauge(
+            "rate_limiter_shm_lanes_active",
+            "Live shared-memory lanes (ADR-025)").set(sh["lanes_active"])
+        self.registry.gauge(
+            "rate_limiter_shm_doorbell_wakes",
+            "eventfd wakeups taken by shm ring consumers").set(
+                sh["doorbell_wakes"])
+        self.registry.gauge(
+            "rate_limiter_shm_spin_hits",
+            "shm records claimed during the bounded spin (no syscall)"
+        ).set(sh["spin_hits"])
+        self.registry.gauge(
+            "rate_limiter_shm_ring_full_stalls",
+            "shm ring-full backpressure stalls").set(
+                sh["ring_full_stalls"])
+        rg = self.registry.gauge(
+            "rate_limiter_shm_records",
+            "Frames carried over shm rings, by direction")
+        rg.set(sh["records_in"], direction="in")
+        rg.set(sh["records_out"], direction="out")
+        ug = self.registry.gauge(
+            "rate_limiter_shm_ring_used_bytes",
+            "Current shm ring occupancy, summed over lanes")
+        ug.set(sh["req_ring_used_bytes"], ring="req")
+        ug.set(sh["rep_ring_used_bytes"], ring="rep")
+        hg = self.registry.gauge(
+            "rate_limiter_shm_ring_highwater_bytes",
+            "High-water shm ring occupancy across lanes")
+        hg.set(sh["req_ring_highwater_bytes"], ring="req")
+        hg.set(sh["rep_ring_highwater_bytes"], ring="rep")
 
 

@@ -131,6 +131,17 @@ T_FLEET_MAP = 12
 T_LEASE_GRANT = 13
 T_LEASE_RENEW = 14
 T_LEASE_RETURN = 15
+#: Shared-memory lane negotiation (ADR-025). Type byte 16 is the one
+#: deliberate exception to the "13..15 are the last base slots" rule:
+#: 16 == FORWARD_FLAG with base type 0, and base type 0 is not a valid
+#: request, so an EXACT match on the raw (unstripped) type byte is
+#: unambiguous. Both doors and split_forward() special-case the exact
+#: value BEFORE any flag stripping; T_SHM_HELLO never composes with the
+#: trace/deadline/forward extensions. Body: u32 version | u32
+#: req_ring_bytes | u32 rep_ring_bytes (0 = server default). Servers
+#: with --shm off answer T_ERROR E_INVALID_CONFIG, keeping the off-path
+#: wire byte-identical for clients that never send the hello.
+T_SHM_HELLO = 16
 
 # DCN payload kinds (parallel/dcn.py exchange families)
 DCN_KIND_SLABS = 1   # windowed: completed sub-window slabs
@@ -165,6 +176,11 @@ T_LEASE_R = 138
 #: rid-0 frames on a lease-bearing connection (both client read loops
 #: consume them before request/response correlation).
 T_LEASE_REVOKE = 139
+#: Answer to T_SHM_HELLO (ADR-025): u8 ok | u32 req_cap | u32 rep_cap |
+#: u16 path_len + shm path | u16 path_len + control-socket path. 140 is
+#: left free to keep the lease family (138/139) contiguous with any
+#: future lease response.
+T_SHM_HELLO_R = 141
 T_ERROR = 255
 
 # --------------------------------------------- trace context (ADR-014)
@@ -230,8 +246,10 @@ def with_forward(frame: bytes) -> bytes:
 def split_forward(type_: int):
     """(base_type, is_forward) — strip the forward hint bit. Call AFTER
     split_request (the hint is a bare bit, the other extensions carry
-    body prefixes)."""
-    if type_ < 128 and type_ & FORWARD_FLAG:
+    body prefixes). T_SHM_HELLO (16 == FORWARD_FLAG | 0) is exempt —
+    the doors intercept it on the raw byte before any stripping, and
+    this guard keeps late callers from mangling it into base type 0."""
+    if type_ != T_SHM_HELLO and type_ < 128 and type_ & FORWARD_FLAG:
         return type_ & ~FORWARD_FLAG, True
     return type_, False
 
@@ -517,6 +535,58 @@ def encode_error(req_id: int, code: int, msg: str) -> bytes:
     mb = msg.encode("utf-8")[:65535]
     body = _ERROR_HEAD.pack(code, len(mb)) + mb
     return _HDR.pack(1 + 8 + len(body), T_ERROR, req_id) + body
+
+
+# ------------------------------------------- shm lane hello (ADR-025)
+
+_SHM_HELLO_BODY = struct.Struct("<III")   # version, req_ring, rep_ring
+_SHM_HELLO_R_HEAD = struct.Struct("<BII")  # ok, req_cap, rep_cap
+_U16 = struct.Struct("<H")
+
+
+def encode_shm_hello(req_id: int, req_ring_bytes: int = 0,
+                     rep_ring_bytes: int = 0) -> bytes:
+    """Request the shared-memory lane upgrade (0 = server default ring
+    size; the server clamps to a power of two in its configured range).
+    Sent on the normal socket AFTER auth, like any other request."""
+    body = _SHM_HELLO_BODY.pack(1, req_ring_bytes, rep_ring_bytes)
+    return _HDR.pack(1 + 8 + len(body), T_SHM_HELLO, req_id) + body
+
+
+def parse_shm_hello(body: bytes):
+    """-> (version, req_ring_bytes, rep_ring_bytes)."""
+    if len(body) != _SHM_HELLO_BODY.size:
+        raise ProtocolError("bad SHM_HELLO body")
+    return _SHM_HELLO_BODY.unpack_from(body)
+
+
+def encode_shm_hello_r(req_id: int, req_cap: int, rep_cap: int,
+                       shm_path: str, ctrl_path: str) -> bytes:
+    sp = shm_path.encode("utf-8")
+    cp = ctrl_path.encode("utf-8")
+    body = (_SHM_HELLO_R_HEAD.pack(1, req_cap, rep_cap)
+            + _U16.pack(len(sp)) + sp + _U16.pack(len(cp)) + cp)
+    return _HDR.pack(1 + 8 + len(body), T_SHM_HELLO_R, req_id) + body
+
+
+def parse_shm_hello_r(body: bytes):
+    """-> (req_cap, rep_cap, shm_path, ctrl_path)."""
+    if len(body) < _SHM_HELLO_R_HEAD.size + 4:
+        raise ProtocolError("short SHM_HELLO_R body")
+    ok, req_cap, rep_cap = _SHM_HELLO_R_HEAD.unpack_from(body)
+    if not ok:
+        raise ProtocolError("server rejected SHM_HELLO")
+    off = _SHM_HELLO_R_HEAD.size
+    (sp_len,) = _U16.unpack_from(body, off)
+    off += 2
+    shm_path = body[off:off + sp_len].decode("utf-8")
+    off += sp_len
+    (cp_len,) = _U16.unpack_from(body, off)
+    off += 2
+    ctrl_path = body[off:off + cp_len].decode("utf-8")
+    if off + cp_len != len(body):
+        raise ProtocolError("bad SHM_HELLO_R body")
+    return req_cap, rep_cap, shm_path, ctrl_path
 
 
 # ----------------------------------------------------- policy overrides

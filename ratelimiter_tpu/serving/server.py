@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
+import socket
 import time
 from functools import partial
 from typing import Optional
@@ -31,6 +33,7 @@ from ratelimiter_tpu.observability import events
 from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.observability import tracing
 from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving import shm as shm_lane
 from ratelimiter_tpu.serving.batcher import MicroBatcher
 
 log = logging.getLogger("ratelimiter_tpu.serving")
@@ -51,8 +54,27 @@ class RateLimitServer:
                  dcn: bool = False, dcn_secret: Optional[str] = None,
                  snapshot: Optional[callable] = None,
                  fleet=None, fleet_announce: Optional[callable] = None,
-                 leases=None):
+                 leases=None, shm: bool = False,
+                 shm_dir: str = "/dev/shm", shm_ring_bytes: int = 0):
         self.limiter = limiter
+        #: Shared-memory wire lane (ADR-025). Off by default: with shm
+        #: False a T_SHM_HELLO answers E_INVALID_CONFIG and every other
+        #: wire byte is identical to a server built before the lane
+        #: existed. ``host`` may be ``unix:/path`` for a UDS listener
+        #: (the middle rung of the transport ladder) on either setting.
+        self.shm = shm
+        self.shm_dir = shm_dir
+        self.shm_ring_bytes = shm_ring_bytes
+        self._shm_lanes: set = set()
+        self._lane_ctr = 0
+        self._uds_path: Optional[str] = None
+        #: Cumulative per-transport accept counts (scrape-time gauges).
+        self._transport_conns = {"tcp": 0, "uds": 0, "shm": 0}
+        #: Counters carried over from retired lanes so scrapes stay
+        #: monotonic across disconnects.
+        self._shm_totals = {"doorbell_wakes": 0, "spin_hits": 0,
+                            "ring_full_stalls": 0, "records_in": 0,
+                            "records_out": 0}
         #: LeaseManager (ADR-022); None answers the T_LEASE_* frames
         #: with E_INVALID_CONFIG. When set, policy mutations through
         #: this door revoke the key's leases, DCN lease gossip is
@@ -96,12 +118,26 @@ class RateLimitServer:
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port)
-        self.port = self._server.sockets[0].getsockname()[1]
+        if self.host.startswith("unix:"):
+            path = self.host[len("unix:"):]
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._server = await asyncio.start_unix_server(
+                self._handle_conn, path)
+            self._uds_path = path
+            self.port = 0
+            log.info("rate-limit server listening on %s", self.host)
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            log.info("rate-limit server listening on %s:%d",
+                     self.host, self.port)
         self._started_at = time.time()
         self._serving = True
-        log.info("rate-limit server listening on %s:%d", self.host, self.port)
+        self.registry.add_collect_hook(self._collect_transport_metrics)
 
     async def shutdown(self) -> None:
         """Graceful: stop accepting, answer what is in flight, drain the
@@ -121,11 +157,112 @@ class RateLimitServer:
             t.cancel()
         await asyncio.gather(*list(self._conn_tasks), return_exceptions=True)
         self.batcher.close()
+        self.registry.remove_collect_hook(self._collect_transport_metrics)
+        for lane in list(self._shm_lanes):
+            lane.close()
+        self._shm_lanes.clear()
+        if self._uds_path is not None:
+            try:
+                os.unlink(self._uds_path)
+            except OSError:
+                pass
         log.info("rate-limit server stopped")
 
     async def serve_forever(self) -> None:
         assert self._server is not None, "call start() first"
         await self._server.serve_forever()
+
+    # ------------------------------------------------------ transport obs
+
+    def transport_stats(self) -> dict:
+        """Per-transport counters + shm lane gauges (ADR-025). Snapshot
+        reads only — never called from the decide path (the registry
+        collect hook and the /healthz envelope are the two consumers)."""
+        agg = dict(self._shm_totals)
+        active = req_used = rep_used = req_hw = rep_hw = 0
+        for lane in list(self._shm_lanes):
+            st = lane.stats
+            agg["doorbell_wakes"] += st.doorbell_wakes
+            agg["spin_hits"] += st.spin_hits
+            agg["ring_full_stalls"] += st.ring_full_stalls
+            agg["records_in"] += st.records_in
+            agg["records_out"] += st.records_out
+            if lane.closed:
+                continue
+            active += 1
+            try:
+                req_used += lane.inbound.used()
+                rep_used += lane.outbound.used()
+                req_hw = max(req_hw, lane.req_highwater)
+                rep_hw = max(rep_hw, lane.outbound.highwater)
+            except ValueError:
+                pass
+        return {
+            "connections": dict(self._transport_conns),
+            "shm": {"lanes_active": active,
+                    "req_ring_used_bytes": int(req_used),
+                    "rep_ring_used_bytes": int(rep_used),
+                    "req_ring_highwater_bytes": int(req_hw),
+                    "rep_ring_highwater_bytes": int(rep_hw),
+                    **agg},
+        }
+
+    def _collect_transport_metrics(self) -> None:
+        st = self.transport_stats()
+        g = self.registry.gauge(
+            "rate_limiter_transport_connections",
+            "Connections accepted per transport (cumulative)")
+        for k, v in st["connections"].items():
+            g.set(v, transport=k)
+        sh = st["shm"]
+        self.registry.gauge(
+            "rate_limiter_shm_lanes_active",
+            "Live shared-memory lanes (ADR-025)").set(sh["lanes_active"])
+        self.registry.gauge(
+            "rate_limiter_shm_doorbell_wakes",
+            "eventfd wakeups taken by shm ring consumers").set(
+                sh["doorbell_wakes"])
+        self.registry.gauge(
+            "rate_limiter_shm_spin_hits",
+            "shm records claimed during the bounded spin (no syscall)"
+        ).set(sh["spin_hits"])
+        self.registry.gauge(
+            "rate_limiter_shm_ring_full_stalls",
+            "shm ring-full backpressure stalls").set(
+                sh["ring_full_stalls"])
+        rg = self.registry.gauge(
+            "rate_limiter_shm_records",
+            "Frames carried over shm rings, by direction")
+        rg.set(sh["records_in"], direction="in")
+        rg.set(sh["records_out"], direction="out")
+        ug = self.registry.gauge(
+            "rate_limiter_shm_ring_used_bytes",
+            "Current shm ring occupancy, summed over lanes")
+        ug.set(sh["req_ring_used_bytes"], ring="req")
+        ug.set(sh["rep_ring_used_bytes"], ring="rep")
+        hg = self.registry.gauge(
+            "rate_limiter_shm_ring_highwater_bytes",
+            "High-water shm ring occupancy across lanes")
+        hg.set(sh["req_ring_highwater_bytes"], ring="req")
+        hg.set(sh["rep_ring_highwater_bytes"], ring="rep")
+
+    async def _shm_accept(self, lane, writer: asyncio.StreamWriter,
+                          drain_cb) -> None:
+        """Second half of the hello: wait for the client's control-socket
+        connect, ship the eventfd pair (SCM_RIGHTS), unlink the
+        filesystem artifacts, then register the server doorbell with the
+        event loop. A client that never connects forfeits the lane."""
+        loop = asyncio.get_running_loop()
+        try:
+            conn, _ = await asyncio.wait_for(
+                loop.sock_accept(lane.ctrl_sock), timeout=10.0)
+            lane.complete_handshake(conn)
+        except Exception as exc:
+            log.warning("shm handshake failed: %s", exc)
+            lane.close()
+            return
+        if not lane.closed and not writer.is_closing():
+            loop.add_reader(lane.efd_server, drain_cb)
 
     # ---------------------------------------------------------- connection
 
@@ -136,6 +273,16 @@ class RateLimitServer:
         task = asyncio.current_task()
         if task is not None:
             self._conn_tasks.add(task)
+        sock = writer.get_extra_info("socket")
+        transport_kind = ("uds" if sock is not None
+                          and sock.family == socket.AF_UNIX else "tcp")
+        self._transport_conns[transport_kind] += 1
+        # Shared-memory lane state (ADR-025): populated by the
+        # T_SHM_HELLO upgrade; the socket this coroutine reads stays
+        # open as the control/liveness channel, so this coroutine's
+        # finally block IS the deterministic ring reclaim.
+        lane_box: list = []
+        lane_tasks: set = set()
 
         def _check_backpressure() -> None:
             transport = writer.transport
@@ -197,6 +344,204 @@ class RateLimitServer:
                     rec.record("encode", t0, tracing.now(),
                                trace_id=trace_id, batch=len(res))
 
+        # ------------------------------------------ shm lane (ADR-025)
+
+        def shm_abort(reason: str) -> None:
+            log.warning("dropping shm connection: %s", reason)
+            if lane_box:
+                try:
+                    asyncio.get_running_loop().remove_reader(
+                        lane_box[0].efd_server)
+                except (OSError, RuntimeError):
+                    pass
+            tr = writer.transport
+            if tr is not None:
+                tr.abort()
+
+        def shm_send(frame: bytes) -> None:
+            # All replies on an upgraded connection — including rid=0
+            # lease revocation pushes — ride the reply ring. A peer that
+            # stops draining gets the same slow-reader cut as the socket
+            # path's WRITE_BUFFER_LIMIT.
+            if not lane_box[0].send(frame):
+                shm_abort("shm reply overflow (slow reader)")
+
+        def complete_allow_shm(req_id: int, trace_id: int,
+                               fut: asyncio.Future) -> None:
+            exc = fut.exception()
+            if exc is not None:
+                shm_send(p.encode_error(req_id, p.code_for(exc), str(exc)))
+            else:
+                rec = tracing.RECORDER
+                t0 = tracing.now() if rec is not None else 0
+                shm_send(p.encode_result(req_id, fut.result()))
+                if rec is not None:
+                    rec.record("encode", t0, tracing.now(),
+                               trace_id=trace_id)
+
+        def complete_hashed_shm(req_id: int, trace_id: int,
+                                fut: asyncio.Future) -> None:
+            exc = fut.exception()
+            if exc is not None:
+                shm_send(p.encode_error(req_id, p.code_for(exc), str(exc)))
+            else:
+                rec = tracing.RECORDER
+                t0 = tracing.now() if rec is not None else 0
+                res = fut.result()
+                # The ring record must be one contiguous frame; joining
+                # the columnar views here is the lane's single reply
+                # copy (the native door packs straight into the ring).
+                shm_send(b"".join(
+                    bytes(v) for v in
+                    p.encode_result_hashed_views(req_id, res)))
+                if rec is not None:
+                    rec.record("encode", t0, tracing.now(),
+                               trace_id=trace_id, batch=len(res))
+
+        def shm_dispatch(frame: bytes) -> None:
+            # One committed ring record = one wire frame, byte-identical
+            # to what the socket loop below would have read; the dispatch
+            # mirrors its fast paths, replies via the ring.
+            try:
+                length, rtype, req_id = p.parse_header(
+                    frame, allow_dcn=self.dcn)
+                if len(frame) != length + 4:
+                    raise p.ProtocolError("ring record length mismatch")
+                body = frame[p.HEADER_SIZE:]
+                if rtype == p.T_SHM_HELLO:
+                    shm_send(p.encode_error(
+                        req_id, p.E_INVALID_CONFIG,
+                        "shm lane already active"))
+                    return
+                type_, trace_id, budget, body = p.split_request(
+                    rtype, body)
+                type_, fwd_hint = p.split_forward(type_)
+            except p.ProtocolError as exc:
+                shm_abort(f"shm protocol error: {exc}")
+                return
+            deadline = (time.monotonic() + budget
+                        if budget is not None else 0.0)
+            rec = tracing.RECORDER
+            t_io = tracing.now() if rec is not None else 0
+            redirect = (self.fleet is not None
+                        and not self.fleet.forward_enabled)
+            if type_ == p.T_ALLOW_N:
+                try:
+                    key, n = p.parse_allow_n(body)
+                    if redirect:
+                        self.fleet.check_frame_owned(
+                            self.fleet.hash_keys([key]))
+                    fut = self.batcher.submit_nowait(key, n, trace_id,
+                                                     deadline)
+                except Exception as exc:
+                    shm_send(p.encode_error(req_id, p.code_for(exc),
+                                            str(exc)))
+                    return
+                if rec is not None:
+                    rec.record("io", t_io, tracing.now(),
+                               trace_id=trace_id)
+                fut.add_done_callback(
+                    partial(complete_allow_shm, req_id, trace_id))
+                return
+            if type_ == p.T_ALLOW_HASHED:
+                try:
+                    ids, ns = p.parse_allow_hashed(body)
+                    if redirect:
+                        from ratelimiter_tpu.ops.hashing import splitmix64
+
+                        self.fleet.check_frame_owned(splitmix64(ids))
+                    fut = self.batcher.submit_hashed_nowait(
+                        ids, ns, trace_id, deadline, standalone=fwd_hint)
+                except Exception as exc:
+                    shm_send(p.encode_error(req_id, p.code_for(exc),
+                                            str(exc)))
+                    return
+                if rec is not None:
+                    rec.record("io", t_io, tracing.now(),
+                               trace_id=trace_id, batch=int(ids.shape[0]))
+                fut.add_done_callback(
+                    partial(complete_hashed_shm, req_id, trace_id))
+                return
+            if type_ == p.T_ALLOW_BATCH:
+                try:
+                    keys, ns = p.parse_allow_batch(body)
+                    if redirect:
+                        self.fleet.check_frame_owned(
+                            self.fleet.hash_keys(keys))
+                    futs = self.batcher.submit_many_nowait(
+                        zip(keys, ns), trace_id, deadline)
+                except Exception as exc:
+                    shm_send(p.encode_error(req_id, p.code_for(exc),
+                                            str(exc)))
+                    return
+                if rec is not None:
+                    rec.record("io", t_io, tracing.now(),
+                               trace_id=trace_id, batch=len(keys))
+
+                def complete_batch_shm(agg: asyncio.Future) -> None:
+                    exc = agg.exception()
+                    if exc is not None:
+                        shm_send(p.encode_error(req_id, p.code_for(exc),
+                                                str(exc)))
+                    else:
+                        results = agg.result()
+                        shm_send(p.encode_result_batch(
+                            req_id, self.limiter.config.limit, results))
+
+                agg = asyncio.gather(*futs)
+                agg.add_done_callback(complete_batch_shm)
+                return
+            t = asyncio.ensure_future(self._handle_frame(
+                type_, req_id, body, writer, write_lock,
+                out_fn=shm_send))
+            req_tasks.add(t)
+            t.add_done_callback(req_tasks.discard)
+
+        def shm_drain() -> None:
+            try:
+                lane_box[0].drain(shm_dispatch)
+            except shm_lane.ShmProtocolError as exc:
+                # Torn/poisoned record: stop trusting the mapping and
+                # reclaim through the liveness socket (kill -9 chaos
+                # path — the server never stalls on a corrupt ring).
+                shm_abort(f"shm lane poisoned: {exc}")
+
+        def shm_hello(req_id: int, body: bytes) -> None:
+            if not self.shm:
+                write_out(p.encode_error(
+                    req_id, p.E_INVALID_CONFIG,
+                    "shm lane not enabled on this server (--shm)"))
+                return
+            if lane_box:
+                write_out(p.encode_error(
+                    req_id, p.E_INVALID_CONFIG,
+                    "shm lane already active on this connection"))
+                return
+            try:
+                _ver, req_bytes, rep_bytes = p.parse_shm_hello(body)
+                req_cap = shm_lane.clamp_ring_bytes(
+                    req_bytes or self.shm_ring_bytes)
+                rep_cap = shm_lane.clamp_ring_bytes(
+                    rep_bytes or self.shm_ring_bytes)
+                self._lane_ctr += 1
+                lane = shm_lane.ServerLane(
+                    self.shm_dir, req_cap, rep_cap,
+                    tag="a%d-" % self._lane_ctr)
+            except Exception as exc:
+                write_out(p.encode_error(req_id, p.code_for(exc),
+                                         str(exc)))
+                return
+            lane_box.append(lane)
+            self._shm_lanes.add(lane)
+            self._transport_conns["shm"] += 1
+            t = asyncio.ensure_future(
+                self._shm_accept(lane, writer, shm_drain))
+            lane_tasks.add(t)
+            t.add_done_callback(lane_tasks.discard)
+            write_out(p.encode_shm_hello_r(
+                req_id, lane.req_cap, lane.rep_cap, lane.path,
+                lane.ctrl_path))
+
         try:
             while True:
                 try:
@@ -207,6 +552,12 @@ class RateLimitServer:
                     length, type_, req_id = p.parse_header(
                         hdr, allow_dcn=self.dcn)
                     body = await reader.readexactly(length - 9)
+                    # Shm lane upgrade (ADR-025): EXACT match on the
+                    # raw type byte before any flag stripping — 16
+                    # aliases FORWARD_FLAG | 0 (see protocol.py).
+                    if type_ == p.T_SHM_HELLO:
+                        shm_hello(req_id, body)
+                        continue
                     # Frame extensions: trace context (ADR-014) and the
                     # request deadline (ADR-015). The deadline budget is
                     # RELATIVE; anchor it to arrival on the local
@@ -320,6 +671,25 @@ class RateLimitServer:
                 req_tasks.add(t)
                 t.add_done_callback(req_tasks.discard)
         finally:
+            for t in list(lane_tasks):
+                t.cancel()
+            if lane_tasks:
+                await asyncio.gather(*list(lane_tasks),
+                                     return_exceptions=True)
+            if lane_box:
+                # Deterministic reclaim: the liveness socket closed (or
+                # the lane poisoned), so unmap, close the eventfds and
+                # drop any leftover /dev/shm artifacts NOW.
+                lane = lane_box[0]
+                try:
+                    asyncio.get_running_loop().remove_reader(
+                        lane.efd_server)
+                except (OSError, RuntimeError):
+                    pass
+                for k in self._shm_totals:
+                    self._shm_totals[k] += getattr(lane.stats, k)
+                self._shm_lanes.discard(lane)
+                lane.close()
             if req_tasks:
                 await asyncio.gather(*list(req_tasks), return_exceptions=True)
             writer.close()
@@ -405,7 +775,8 @@ class RateLimitServer:
 
     async def _handle_frame(self, type_: int, req_id: int, body: bytes,
                             writer: asyncio.StreamWriter,
-                            write_lock: asyncio.Lock) -> None:
+                            write_lock: asyncio.Lock,
+                            out_fn=None) -> None:
         try:
             if type_ == p.T_RESET:
                 key = p.parse_reset(body)
@@ -487,17 +858,21 @@ class RateLimitServer:
 
                     loop = asyncio.get_running_loop()
 
-                    def push(frame: bytes, _loop=loop,
-                             _writer=writer) -> None:
+                    def push(frame: bytes, _loop=loop, _writer=writer,
+                             _out=out_fn) -> None:
                         # Revocation push, called from arbitrary
                         # threads: marshal onto the connection's loop.
                         # A closed conn/loop raises here and the
                         # manager counts the failed push (the holder's
-                        # TTL still bounds the stale window).
+                        # TTL still bounds the stale window). On an
+                        # shm-upgraded connection the push rides the
+                        # reply ring like every other rid-0 frame.
                         if _writer.is_closing():
                             raise ConnectionError(
                                 "lease push: connection closed")
-                        _loop.call_soon_threadsafe(_writer.write, frame)
+                        _loop.call_soon_threadsafe(
+                            _out if _out is not None else _writer.write,
+                            frame)
 
                     try:
                         out = await loop.run_in_executor(
@@ -511,6 +886,11 @@ class RateLimitServer:
                                      f"unknown request type {type_}")
         except (p.ProtocolError, UnicodeDecodeError) as exc:
             out = p.encode_error(req_id, p.code_for(exc), str(exc))
+        if out_fn is not None:
+            # Ring writer (already on the loop thread; the lane handles
+            # its own backpressure).
+            out_fn(out)
+            return
         async with write_lock:
             try:
                 writer.write(out)

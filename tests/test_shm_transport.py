@@ -1,0 +1,754 @@
+"""Zero-syscall shared-memory wire lane (ADR-025): transport ladder tests.
+
+Four tiers, mirroring the ISSUE's acceptance bars:
+
+* **UDS listener** — both doors accept ``unix:/path`` binds and the
+  binary clients dial them.
+* **Shm lane** — T_SHM_HELLO upgrade end-to-end through both doors and
+  both Python clients, every request lane, plus the off-by-default pin
+  (``--shm`` off answers E_INVALID_CONFIG and the socket wire stays
+  byte-identical).
+* **Bit-identical pins** — the SAME request frames (trace + deadline
+  extensions, batch, hashed, leases) against fresh identical limiters
+  over tcp, uds and shm must produce byte-identical reply frames, on
+  the asyncio door and the native door. The lane carries the existing
+  framing verbatim; nothing re-encodes.
+* **Crash safety** — kill -9 mid-record never stalls or corrupts the
+  server; ring-full surfaces as the typed RingFullError; record-header
+  fuzz (truncate every byte, flip every bit) either raises
+  ShmProtocolError or yields bytes — never a hang, never an OOB read;
+  lease revocation pushes ride the reply ring.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from ratelimiter_tpu import (
+    Algorithm,
+    Config,
+    InvalidConfigError,
+    ManualClock,
+    SketchParams,
+    create_limiter,
+)
+from ratelimiter_tpu.leases import LeaseManager
+from ratelimiter_tpu.observability import Registry
+from ratelimiter_tpu.serving import AsyncClient, Client, RateLimitServer
+from ratelimiter_tpu.serving import protocol as p
+from ratelimiter_tpu.serving import shm as shm_lane
+from ratelimiter_tpu.serving.native_server import (
+    NativeRateLimitServer,
+    native_server_available,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs_native = pytest.mark.skipif(
+    not native_server_available(), reason="needs g++ for the native server")
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _mk_limiter(limit=100, window=60.0, backend="exact", **kw):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=limit,
+                 window=window, **kw)
+    return create_limiter(cfg, backend=backend, clock=clock), clock
+
+
+def _mk_sketch_limiter(limit=1000):
+    clock = ManualClock(1_700_000_000.0)
+    cfg = Config(algorithm=Algorithm.SLIDING_WINDOW, limit=limit,
+                 window=60.0,
+                 sketch=SketchParams(depth=3, width=256, sub_windows=5))
+    return create_limiter(cfg, backend="sketch", clock=clock), clock
+
+
+@contextmanager
+def running_server(limiter, host="127.0.0.1", **kw):
+    """Asyncio door on a background event loop; yields (server, loop)."""
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    server = RateLimitServer(limiter, host, 0, **kw)
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=10)
+    try:
+        yield server, loop
+    finally:
+        asyncio.run_coroutine_threadsafe(
+            server.shutdown(), loop).result(timeout=10)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        loop.close()
+
+
+@contextmanager
+def running_native(limiter, host="127.0.0.1", **kw):
+    srv = NativeRateLimitServer(limiter, host, 0, **kw)
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _shm_leftovers():
+    try:
+        return [f for f in os.listdir("/dev/shm") if f.startswith("rltpu-")]
+    except FileNotFoundError:
+        return []
+
+
+# -------------------------------------------------------------- uds rung
+
+class TestUdsListener:
+    def test_asyncio_door_unix_bind(self, tmp_path):
+        lim, _ = _mk_limiter(limit=5)
+        path = str(tmp_path / "rl.sock")
+        with running_server(lim, host=f"unix:{path}") as (_, _loop):
+            with Client(host=f"unix:{path}", transport="uds") as c:
+                for i in range(5):
+                    assert c.allow("u").allowed
+                assert not c.allow("u").allowed
+            # Bare path (no "unix:" prefix) also dials.
+            with Client(host=path, transport="uds") as c:
+                assert c.health()[0]  # serving
+        assert not os.path.exists(path)
+        lim.close()
+
+    @needs_native
+    def test_native_door_unix_bind(self, tmp_path):
+        lim, _ = _mk_limiter(limit=5)
+        path = str(tmp_path / "rl-native.sock")
+        with running_native(lim, host=f"unix:{path}"):
+            with Client(host=f"unix:{path}", transport="uds") as c:
+                assert c.allow("u").allowed
+                res = c.allow_batch(["a", "b", "a"])
+                assert [r.allowed for r in res] == [True, True, True]
+        assert not os.path.exists(path)
+        lim.close()
+
+
+# -------------------------------------------------------------- shm rung
+
+class TestShmLane:
+    def test_asyncio_door_all_request_lanes(self):
+        lim, _ = _mk_limiter(limit=10)
+        with running_server(lim, shm=True) as (server, _loop):
+            with Client(port=server.port, transport="shm") as c:
+                assert c.allow("k").allowed
+                assert c.allow_n("k", 4).allowed
+                res = c.allow_batch(["a", "b", "a"], [1, 1, 1])
+                assert [r.allowed for r in res] == [True, True, True]
+                # Frame extensions ride the ring unchanged.
+                assert c.allow("k", trace_id=0xAB12, deadline=5.0).allowed
+                c.reset("k")
+                assert c.allow_n("k", 10).allowed
+                serving, _uptime, decisions = c.health()
+                assert serving and decisions > 0
+                assert "rate_limiter" in c.metrics()
+            _wait_until(
+                lambda: server.transport_stats()["shm"]["lanes_active"] == 0,
+                what="lane teardown")
+        assert not _shm_leftovers()
+        lim.close()
+
+    def test_asyncio_door_async_client_burst(self):
+        lim, _ = _mk_limiter(limit=100000)
+        with running_server(lim, shm=True) as (server, _loop):
+            async def go():
+                c = await AsyncClient.connect(
+                    port=server.port, transport="shm")
+                try:
+                    res = await asyncio.gather(
+                        *(c.allow(f"k{i % 7}") for i in range(64)))
+                    assert all(r.allowed for r in res)
+                finally:
+                    await c.close()
+
+            asyncio.run(go())
+        assert not _shm_leftovers()
+        lim.close()
+
+    def test_hashed_lane_over_shm(self):
+        lim, _ = _mk_sketch_limiter(limit=1000)
+        with running_server(lim, shm=True) as (server, _loop):
+            with Client(port=server.port, transport="shm") as c:
+                ids = np.arange(1, 9, dtype=np.uint64)
+                res = c.allow_hashed(ids)
+                assert res.allowed.shape == (8,) and res.allowed.all()
+        lim.close()
+
+    def test_shm_off_is_typed_error_and_plain_wire_unchanged(self):
+        lim, _ = _mk_limiter(limit=10)
+        with running_server(lim) as (server, _loop):  # shm OFF (default)
+            with pytest.raises(InvalidConfigError, match="--shm"):
+                Client(port=server.port, transport="shm")
+            # The rejected hello leaves the plain wire fully usable and
+            # byte-identical: a raw allow_n gets the exact encode_result
+            # bytes a pre-ADR-025 server would send.
+            with socket.create_connection(("127.0.0.1", server.port)) as s:
+                s.sendall(p.encode_allow_n(7, "k", 1))
+                raw = _recv_frame(s)
+            length, type_, rid = p.parse_header(raw[:p.HEADER_SIZE])
+            assert (type_, rid) == (p.T_RESULT, 7)
+            res = p.parse_result(raw[p.HEADER_SIZE:])
+            assert res.allowed and res.limit == 10
+            assert raw == p.encode_result(7, res)
+        lim.close()
+
+    def test_duplicate_hello_rejected(self):
+        lim, _ = _mk_limiter()
+        with running_server(lim, shm=True) as (server, _loop):
+            with Client(port=server.port, transport="shm") as c:
+                # Second hello on the SAME (already upgraded) socket.
+                with c._lock:
+                    c._sock.sendall(p.encode_shm_hello(99, 0, 0))
+                    raw = _recv_frame(c._sock)
+                _len, type_, _rid = p.parse_header(raw[:p.HEADER_SIZE])
+                assert type_ == p.T_ERROR
+                code, msg = p.parse_error(raw[p.HEADER_SIZE:])
+                assert code == p.E_INVALID_CONFIG and "already" in msg
+        lim.close()
+
+    @needs_native
+    def test_native_door_shm_roundtrip(self):
+        lim, _ = _mk_limiter(limit=10)
+        with running_native(lim, shm=True) as srv:
+            with Client(port=srv.port, transport="shm") as c:
+                for i in range(10):
+                    assert c.allow("k").allowed
+                assert not c.allow("k").allowed
+                assert c.allow("k", trace_id=0x77, deadline=5.0) is not None
+                res = c.allow_batch(["x", "y"], [2, 3])
+                assert all(r.allowed for r in res)
+            st = srv.transport_stats()
+            assert st["connections"]["shm"] == 1
+            assert st["shm"]["records_in"] >= 12
+        assert not _shm_leftovers()
+        lim.close()
+
+    @needs_native
+    def test_native_door_shm_off_typed_error(self):
+        lim, _ = _mk_limiter()
+        with running_native(lim) as srv:
+            with pytest.raises(InvalidConfigError, match="--shm"):
+                Client(port=srv.port, transport="shm")
+            with Client(port=srv.port) as c:  # plain tcp still fine
+                assert c.allow("k").allowed
+        lim.close()
+
+
+# ------------------------------------------------- transport observability
+
+class TestTransportObservability:
+    def test_stats_and_gauges_track_lanes(self):
+        lim, _ = _mk_limiter(limit=100000)
+        reg = Registry()
+        with running_server(lim, shm=True, registry=reg) as (server, _loop):
+            with Client(port=server.port, transport="shm") as c:
+                for _ in range(32):
+                    assert c.allow("k").allowed
+                st = server.transport_stats()
+                assert st["connections"]["shm"] == 1
+                assert st["shm"]["lanes_active"] == 1
+                assert st["shm"]["records_in"] >= 32
+                assert st["shm"]["records_out"] >= 32
+                assert st["shm"]["rep_ring_highwater_bytes"] > 0
+                # A consumer either spun or took the doorbell for every
+                # record it claimed; both paths are counted.
+                assert (st["shm"]["spin_hits"]
+                        + st["shm"]["doorbell_wakes"]) > 0
+                text = reg.render()
+                for fam in ("rate_limiter_transport_connections",
+                            "rate_limiter_shm_lanes_active",
+                            "rate_limiter_shm_doorbell_wakes",
+                            "rate_limiter_shm_spin_hits",
+                            "rate_limiter_shm_ring_full_stalls",
+                            "rate_limiter_shm_records",
+                            "rate_limiter_shm_ring_used_bytes",
+                            "rate_limiter_shm_ring_highwater_bytes"):
+                    assert fam in text, fam
+            # Counters survive lane retirement (monotonic across
+            # disconnects), and the lane gauge returns to zero.
+            _wait_until(
+                lambda: server.transport_stats()["shm"]["lanes_active"] == 0,
+                what="lane retirement")
+            assert server.transport_stats()["shm"]["records_in"] >= 32
+        lim.close()
+
+    def test_tcp_and_uds_connections_counted(self, tmp_path):
+        lim, _ = _mk_limiter()
+        with running_server(lim, shm=True) as (server, _loop):
+            with Client(port=server.port) as c:
+                assert c.allow("k").allowed
+            st = server.transport_stats()
+            assert st["connections"]["tcp"] >= 1
+        path = str(tmp_path / "obs.sock")
+        lim2, _ = _mk_limiter()
+        with running_server(lim2, host=f"unix:{path}") as (server, _loop):
+            with Client(host=f"unix:{path}", transport="uds") as c:
+                assert c.allow("k").allowed
+            assert server.transport_stats()["connections"]["uds"] >= 1
+        lim.close()
+        lim2.close()
+
+
+# ------------------------------------------------------ bit-identical pins
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    buf = b""
+    while len(buf) < p.HEADER_SIZE:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    length = struct.unpack_from("<I", buf)[0]
+    want = 4 + length
+    while len(buf) < want:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    assert len(buf) == want, "unexpected trailing bytes"
+    return buf
+
+
+def _pin_frames(leases: bool = True) -> list[bytes]:
+    """The pinned request sequence: every decision lane plus the trace
+    and deadline extensions and (asyncio door only — the native door
+    serves leases via the sidecar listener, ADR-022) a lease grant.
+    rids are fixed so reply frames compare byte-for-byte across
+    transports."""
+    ids = np.arange(11, 19, dtype=np.uint64)
+    frames = [
+        p.encode_allow_n(10, "pin:a", 1),
+        p.with_trace(p.encode_allow_n(11, "pin:a", 2), 0xDECAF123),
+        p.with_deadline(p.encode_allow_n(12, "pin:b", 1), 5.0),
+        p.with_trace(
+            p.with_deadline(p.encode_allow_n(13, "pin:b", 1), 2.5),
+            0xABCD),
+        p.encode_allow_batch(14, ["x", "y", "x"], [1, 2, 3]),
+        p.encode_allow_hashed(15, ids),
+        p.with_trace(p.encode_allow_hashed(16, ids), 0x5150),
+        p.encode_reset(18, "pin:a"),
+        p.encode_allow_n(19, "pin:a", 1),
+    ]
+    if leases:
+        frames.insert(7, p.encode_lease_grant(17, 42, "pin:hot", 8, 0))
+    return frames
+
+
+def _roundtrip_socket(sock: socket.socket, frames) -> list[bytes]:
+    out = []
+    for f in frames:
+        sock.sendall(f)
+        out.append(_recv_frame(sock))
+    return out
+
+
+def _roundtrip_shm(host: str, port: int, frames) -> list[bytes]:
+    """Speak the hello by hand and drive the ClientLane directly so the
+    captured replies are the raw ring records, no client post-processing."""
+    if host.startswith("unix:"):
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(host[len("unix:"):])
+    else:
+        sock = socket.create_connection((host, port))
+    try:
+        sock.sendall(p.encode_shm_hello(1, 0, 0))
+        raw = _recv_frame(sock)
+        _len, type_, _rid = p.parse_header(raw[:p.HEADER_SIZE])
+        assert type_ == p.T_SHM_HELLO_R, "hello refused"
+        _rq, _rp, shm_path, ctrl_path = p.parse_shm_hello_r(
+            raw[p.HEADER_SIZE:])
+        lane = shm_lane.ClientLane(shm_path, ctrl_path)
+        try:
+            out = []
+            for f in frames:
+                lane.send_frame(f)
+                got = lane.recv_frame(timeout=10.0)
+                assert got is not None, "shm reply timeout"
+                out.append(got)
+            return out
+        finally:
+            lane.close()
+    finally:
+        sock.close()
+
+
+def _fresh_pin_fixture():
+    """Identical-state limiter + lease manager for one transport run."""
+    lim, _ = _mk_sketch_limiter(limit=1000)
+    mgr = LeaseManager(lim, ttl=30.0, default_budget=64,
+                       registry=Registry(), clock=FakeClock(100.0))
+    return lim, mgr
+
+
+class TestBitIdenticalPins:
+    """The lane carries the EXISTING framing byte-for-byte: the same
+    requests against identically-seeded limiters must return identical
+    reply bytes whichever rung of the transport ladder carried them."""
+
+    @staticmethod
+    def _asyncio_run(transport: str, tmp_path) -> list[bytes]:
+        """One capture = one fresh fixture + fresh server, so every
+        transport sees IDENTICAL limiter/lease state."""
+        frames = _pin_frames()
+        lim, mgr = _fresh_pin_fixture()
+        host = "127.0.0.1"
+        if transport.startswith("uds"):
+            host = f"unix:{tmp_path / ('pin-' + transport + '.sock')}"
+        try:
+            with running_server(lim, host=host, shm=True,
+                                leases=mgr) as (server, _loop):
+                if transport == "tcp":
+                    with socket.create_connection(
+                            ("127.0.0.1", server.port)) as s:
+                        return _roundtrip_socket(s, frames)
+                if transport == "uds":
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(host[len("unix:"):])
+                    try:
+                        return _roundtrip_socket(s, frames)
+                    finally:
+                        s.close()
+                return _roundtrip_shm(host, server.port, frames)
+        finally:
+            lim.close()
+
+    def test_asyncio_door_tcp_uds_shm_identical(self, tmp_path):
+        tcp = self._asyncio_run("tcp", tmp_path)
+        uds = self._asyncio_run("uds", tmp_path)
+        shm = self._asyncio_run("shm", tmp_path)
+        uds_shm = self._asyncio_run("uds+shm", tmp_path)
+        assert len(tcp) == len(_pin_frames())
+        assert tcp == uds
+        assert tcp == shm
+        assert tcp == uds_shm
+
+    @needs_native
+    def test_native_door_tcp_uds_shm_identical(self, tmp_path):
+        frames = _pin_frames(leases=False)
+
+        def native_run(transport, host="127.0.0.1"):
+            lim, _mgr = _fresh_pin_fixture()
+            try:
+                with running_native(lim, host=host, shm=True) as srv:
+                    if transport == "tcp":
+                        with socket.create_connection(
+                                ("127.0.0.1", srv.port)) as s:
+                            return _roundtrip_socket(s, frames)
+                    if transport == "uds":
+                        s = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+                        s.connect(host[len("unix:"):])
+                        try:
+                            return _roundtrip_socket(s, frames)
+                        finally:
+                            s.close()
+                    return _roundtrip_shm(host, srv.port, frames)
+            finally:
+                lim.close()
+
+        tcp = native_run("tcp")
+        shm = native_run("shm")
+        upath = str(tmp_path / "npin.sock")
+        uds = native_run("uds", host=f"unix:{upath}")
+        assert tcp == shm
+        assert tcp == uds
+
+    @needs_native
+    def test_doors_agree_with_each_other(self):
+        # Cross-door: the native door's bytes == the asyncio door's
+        # bytes for the pinned sequence, both over shm (lease frames
+        # excluded — the native door hands those to the sidecar).
+        frames = _pin_frames(leases=False)
+        lim, _mgr = _fresh_pin_fixture()
+        with running_server(lim, shm=True) as (server, _loop):
+            a = _roundtrip_shm("127.0.0.1", server.port, frames)
+        lim.close()
+        lim2, _mgr2 = _fresh_pin_fixture()
+        with running_native(lim2, shm=True) as srv:
+            n = _roundtrip_shm("127.0.0.1", srv.port, frames)
+        lim2.close()
+        assert a == n
+
+
+# ------------------------------------------------------------ crash tests
+
+class TestCrashSafety:
+    def test_ring_full_is_typed_backpressure(self):
+        """Block the server loop, flood a deliberately tiny ring: the
+        producer must surface RingFullError (typed, catchable as
+        StorageUnavailableError) — never silently drop or deadlock."""
+        lim, _ = _mk_limiter(limit=10**9)
+        with running_server(lim, shm=True) as (server, loop):
+            with Client(port=server.port, transport="shm",
+                        shm_ring_bytes=shm_lane.MIN_RING) as c:
+                assert c.allow("warm").allowed
+                # Wedge the event loop so nothing drains the request
+                # ring, then flood it.
+                loop.call_soon_threadsafe(time.sleep, 1.5)
+                time.sleep(0.05)
+                frame = p.encode_allow_n(12345, "x" * 200, 1)
+                with pytest.raises(shm_lane.RingFullError):
+                    for _ in range(shm_lane.MIN_RING // 64):
+                        c._lane.send_frame(frame, timeout=0.2)
+                assert c._lane.stats.ring_full_stalls > 0
+                # Once the loop resumes the queued frames drain; wait
+                # out the wedge, then swallow their replies so the lane
+                # is quiet again...
+                time.sleep(1.6)
+                while c._lane.recv_frame(timeout=0.5) is not None:
+                    pass
+                # ...and the SAME connection keeps working.
+                assert c.allow("after").allowed
+        lim.close()
+
+    def test_kill9_mid_write_never_stalls_server(self):
+        """A client SIGKILLed half-way through publishing a record (tail
+        advanced, commit word garbage) must poison only ITS lane: the
+        server drops that connection, keeps serving everyone else, and
+        leaves nothing in /dev/shm."""
+        lim, _ = _mk_limiter(limit=10**9)
+        with running_server(lim, shm=True) as (server, _loop):
+            script = textwrap.dedent(f"""
+                import os, struct, sys
+                sys.path.insert(0, {REPO!r})
+                from ratelimiter_tpu.serving.client import Client
+                from ratelimiter_tpu.serving import shm as shm_lane
+                c = Client("127.0.0.1", {server.port}, transport="shm")
+                assert c.allow("warm").allowed
+                ring = c._lane.outbound
+                tail = ring._tail()
+                base = ring._data + (tail & ring._mask)
+                # Torn publish: size says 64 bytes, commit word is junk,
+                # tail published — exactly what a crash mid-memcpy leaves.
+                struct.pack_into("<II", ring._mm, base, 64, 0xDEADBEEF)
+                ring._set_tail(tail + 8 + 64)
+                shm_lane._ding(c._lane.efd_server)
+                print("POISONED", flush=True)
+                os.kill(os.getpid(), 9)
+            """)
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen([sys.executable, "-c", script],
+                                    stdout=subprocess.PIPE, env=env,
+                                    stderr=subprocess.DEVNULL, text=True)
+            try:
+                line = proc.stdout.readline()
+                assert "POISONED" in line, "victim never armed the record"
+                proc.wait(timeout=20)
+                assert proc.returncode == -signal.SIGKILL
+                # Server survives and retires the poisoned lane...
+                _wait_until(
+                    lambda: server.transport_stats()["shm"][
+                        "lanes_active"] == 0,
+                    what="poisoned lane teardown")
+                # ...and keeps serving fresh clients on BOTH rungs.
+                with Client(port=server.port) as c:
+                    assert c.allow("alive").allowed
+                with Client(port=server.port, transport="shm") as c:
+                    assert c.allow("alive-shm").allowed
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+        assert not _shm_leftovers()
+        lim.close()
+
+    @needs_native
+    def test_kill9_mid_write_native_door(self):
+        lim, _ = _mk_limiter(limit=10**9)
+        with running_native(lim, shm=True) as srv:
+            script = textwrap.dedent(f"""
+                import os, struct, sys
+                sys.path.insert(0, {REPO!r})
+                from ratelimiter_tpu.serving.client import Client
+                from ratelimiter_tpu.serving import shm as shm_lane
+                c = Client("127.0.0.1", {srv.port}, transport="shm")
+                assert c.allow("warm").allowed
+                ring = c._lane.outbound
+                tail = ring._tail()
+                base = ring._data + (tail & ring._mask)
+                struct.pack_into("<II", ring._mm, base, 64, 0xDEADBEEF)
+                ring._set_tail(tail + 8 + 64)
+                shm_lane._ding(c._lane.efd_server)
+                print("POISONED", flush=True)
+                os.kill(os.getpid(), 9)
+            """)
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.Popen([sys.executable, "-c", script],
+                                    stdout=subprocess.PIPE, env=env,
+                                    stderr=subprocess.DEVNULL, text=True)
+            try:
+                assert "POISONED" in proc.stdout.readline()
+                proc.wait(timeout=20)
+                _wait_until(
+                    lambda: srv.transport_stats()["shm"][
+                        "lanes_active"] == 0,
+                    what="poisoned lane teardown (native)")
+                with Client(port=srv.port, transport="shm") as c:
+                    assert c.allow("alive").allowed
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+        assert not _shm_leftovers()
+        lim.close()
+
+    def test_revocation_push_rides_the_reply_ring(self):
+        """ADR-022 regression over the new wire: a lease granted over
+        shm is revoked by a policy mutation, and the rid-0
+        T_LEASE_REVOKE push arrives through the reply RING (the socket
+        is liveness-only once upgraded)."""
+        lim, _ = _mk_limiter(limit=100000)
+        mgr = LeaseManager(lim, ttl=2.0, default_budget=64,
+                           registry=Registry())
+        with running_server(lim, shm=True, leases=mgr) as (server, _loop):
+            with Client(port=server.port, transport="shm") as c:
+                cache = c.enable_leases(interval=0.02, hot_after=3,
+                                        hot_window=5.0)
+                _wait_until(
+                    lambda: (c.allow("hot").allowed
+                             and cache.status()["leased_keys"] > 0),
+                    what="lease grant over shm")
+                before = cache.status()["local_answers"]
+                for _ in range(16):
+                    assert c.allow("hot").allowed
+                assert cache.status()["local_answers"] > before
+                c.set_override("hot", 50000)
+                _wait_until(
+                    lambda: cache.status()["leased_keys"] == 0,
+                    what="revocation push over the shm reply ring")
+                assert c.allow("hot").allowed
+        lim.close()
+
+
+# ------------------------------------------------------- record-level fuzz
+
+def _fresh_ring():
+    """An anonymous mapping holding one lane; returns the request ring
+    viewed from both roles (same object — SPSC in one process)."""
+    cap = shm_lane.MIN_RING
+    mm = mmap.mmap(-1, shm_lane.total_bytes(cap, cap))
+    shm_lane.init_header(mm, cap, cap)
+    req, _rep = shm_lane.attach(mm, server=True)
+    return mm, req
+
+
+class TestRecordFuzz:
+    """The consumer's contract under arbitrary corruption: pop() either
+    returns bytes or raises ShmProtocolError — it never hangs, never
+    reads out of bounds, never silently spins."""
+
+    PAYLOAD = p.encode_allow_n(7, "fuzz-key", 3)
+
+    def test_clean_roundtrip_baseline(self):
+        mm, ring = _fresh_ring()
+        assert ring.try_push(self.PAYLOAD)
+        assert ring.pop() == self.PAYLOAD
+        assert ring.pop() is None
+        mm.close()
+
+    def test_truncated_publish_every_length(self):
+        """Simulate a producer dying after writing only the first i
+        bytes of the record region but with tail already published (the
+        worst reordering a crash can expose)."""
+        rec_len = 8 + shm_lane.align8(len(self.PAYLOAD))
+        for cut in range(rec_len):
+            mm, ring = _fresh_ring()
+            assert ring.try_push(self.PAYLOAD)
+            base = ring._data
+            keep = bytes(mm[base:base + cut])
+            mm[base:base + rec_len] = b"\x00" * rec_len
+            mm[base:base + cut] = keep
+            try:
+                got = ring.pop()
+                # A cut past the commit word leaves a committed record;
+                # payload bytes may be zeroed but framing never lies
+                # about its length.
+                if got is not None:
+                    assert len(got) == len(self.PAYLOAD)
+            except shm_lane.ShmProtocolError:
+                pass  # typed poison — the lane dies loudly, by design
+            mm.close()
+
+    def test_bitflip_every_header_bit(self):
+        for bit in range(64):  # the 8-byte [size|commit] record header
+            mm, ring = _fresh_ring()
+            assert ring.try_push(self.PAYLOAD)
+            off = ring._data + bit // 8
+            mm[off] ^= 1 << (bit % 8)
+            try:
+                got = ring.pop()
+                # Only a flip that keeps size^COMMIT_XOR == commit can
+                # survive; with both words covering each other that
+                # means the record must parse back intact.
+                assert got is not None
+            except shm_lane.ShmProtocolError:
+                pass
+            mm.close()
+
+    def test_bitflip_payload_is_framing_safe(self):
+        # Payload flips are NOT the ring's job (frames carry their own
+        # protocol-level validation) — but they must never break record
+        # framing or desync the ring.
+        for byte in range(len(self.PAYLOAD)):
+            mm, ring = _fresh_ring()
+            assert ring.try_push(self.PAYLOAD)
+            assert ring.try_push(self.PAYLOAD)  # a second, clean record
+            mm[ring._data + 8 + byte] ^= 0xFF
+            first = ring.pop()
+            assert first is not None and len(first) == len(self.PAYLOAD)
+            assert ring.pop() == self.PAYLOAD  # framing stays in step
+            mm.close()
+
+    def test_giant_size_rejected_not_overread(self):
+        mm, ring = _fresh_ring()
+        assert ring.try_push(self.PAYLOAD)
+        size = shm_lane.MAX_RING * 4
+        struct.pack_into("<II", mm, ring._data, size,
+                         size ^ shm_lane.COMMIT_XOR)
+        with pytest.raises(shm_lane.ShmProtocolError):
+            ring.pop()
+        mm.close()
+
+    def test_wrap_pad_fuzz(self):
+        # Corrupting a wrap marker's size beyond cap must raise, not
+        # send head past tail.
+        mm, ring = _fresh_ring()
+        assert ring.try_push(self.PAYLOAD)
+        struct.pack_into("<II", mm, ring._data, shm_lane.MAX_RING * 8,
+                         shm_lane.COMMIT_WRAP)
+        with pytest.raises(shm_lane.ShmProtocolError):
+            ring.pop()
+        mm.close()
