@@ -9,10 +9,11 @@ export PYTHONPATH := $(REPO):$(PYTHONPATH)
 .PHONY: help test test-all test-serving test-mesh test-collective test-tracing test-chaos \
         test-audit test-fleet test-fleet-forward test-fleet-obs \
         test-reshard test-hierarchy test-leases test-placement test-shm \
-        lint check \
+        test-neteng lint check \
         native bench bench-quick bench-audit bench-chaos bench-fleet \
         bench-fleet-obs bench-reshard bench-hierarchy bench-leases \
-        bench-rebalance bench-shm bench-matrix serve verify clean
+        bench-rebalance bench-shm bench-neteng bench-matrix serve verify \
+        clean
 
 help:            ## list targets
 	@grep -E '^[a-z-]+:.*##' $(MAKEFILE_LIST) | sed 's/:.*##/\t/'
@@ -71,6 +72,9 @@ test-placement:  ## load-aware placement (ADR-023): planner determinism, chaos r
 test-shm:        ## shared-memory wire lane (ADR-025): uds/shm both doors, bit-identical pins, kill -9, ring fuzz, revocation-over-shm
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_shm_transport.py -q
 
+test-neteng:     ## multi-ring network engine (ADR-026): epoll==uring byte parity, asserted probe downgrade, mid-frame death, slow-loris, fairness, shm-over-uring
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_net_engine.py -q
+
 bench-fleet:     ## fleet scale-out numbers (single vs 2/4-host affine/mixed sweep + failover JSON, ADR-019)
 	JAX_PLATFORMS=cpu $(PY) bench.py --fleet-hosts 4
 
@@ -97,6 +101,9 @@ bench-rebalance: ## load-aware placement numbers (skewed fleet convergence, move
 
 bench-shm:       ## transport ladder A/B (interleaved tcp/uds/shm paired rounds, wire-phase breakdown, SHM_r01 JSON, ADR-025)
 	$(PY) bench.py --shm
+
+bench-neteng:    ## network-engine conn sweep (baseline vs multi-ring paired rounds at 16..512 conns, syscalls/decision, NETENG_r01 JSON, ADR-026)
+	JAX_PLATFORMS=cpu $(PY) bench.py --conn-sweep
 
 lint:            ## in-repo linter (ruff config in pyproject.toml where available)
 	$(PY) tools/lint.py

@@ -1426,6 +1426,16 @@ def main() -> None:
                          "the single-device step rate so the "
                          "device-vs-e2e gap is tracked per transport "
                          "(published as SHM_r01.json)")
+    ap.add_argument("--conn-sweep", action="store_true",
+                    help="run ONLY the network-engine connection sweep "
+                         "(ISSUE-20, ADR-026) and emit the neteng JSON "
+                         "block: interleaved paired rounds of the "
+                         "pre-PR single-epoll write-per-frame baseline "
+                         "vs the multi-ring engine at 16..512 tcp "
+                         "connections through the C++ loadgen, per-row "
+                         "throughput, p99, and syscalls-per-decision "
+                         "from engine counter deltas (published as "
+                         "NETENG_r01.json)")
     ap.add_argument("--reshard", action="store_true",
                     help="run ONLY the elastic lifecycle bench "
                          "(ADR-018) over a 2-host fleet and emit the "
@@ -1466,6 +1476,27 @@ def main() -> None:
                 st["paired_best"]["shm"]["tcp_decisions_per_sec"])
             st["tcp_device_gap"] = round(dev / max(tcp_e2e, 1.0), 2)
         out_path = os.environ.get("BENCH_SHM_OUT", "SHM_r01.json")
+        with open(out_path, "w") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(json.dumps(payload))
+        return
+
+    if args.conn_sweep:
+        from benchmarks.e2e import run_conn_sweep
+
+        conns = tuple(int(x) for x in os.environ.get(
+            "BENCH_NETENG_CONNS", "16,64,256,512").split(","))
+        payload = {
+            "metric": "neteng_conn_sweep",
+            "platform": jax.devices()[0].platform,
+            "neteng": run_conn_sweep(
+                seconds=float(os.environ.get("BENCH_SECONDS", "2.5")),
+                pairs=int(os.environ.get("BENCH_NETENG_PAIRS", "2")),
+                conns=conns,
+                log=lambda *a: print(*a, file=sys.stderr, flush=True)),
+        }
+        out_path = os.environ.get("BENCH_NETENG_OUT", "NETENG_r01.json")
         with open(out_path, "w") as f:
             json.dump(payload, f, indent=1)
             f.write("\n")

@@ -434,6 +434,185 @@ def _build_loadgen(td: str) -> str:
     return binary
 
 
+def _net_counters(port: int):
+    """(net_syscalls_total, decisions_total) scraped over the wire.
+
+    Syscalls = the engine-maintained recv+writev+wait+wake counters
+    (rate_limiter_net_syscalls_total, summed over ``kind``) — the
+    numerator of the syscalls-per-decision figure NETENG_r01.json
+    reports. The scrape itself rides the same socket path, so its own
+    handful of syscalls lands in the delta; at bench volumes (1e5+
+    decisions/round) that noise is < 1e-4 of the figure."""
+    from ratelimiter_tpu.serving import Client
+
+    c = Client("127.0.0.1", port)
+    try:
+        text = c.metrics()
+        _, _, decisions = c.health()
+    finally:
+        c.close()
+    sys_total = 0.0
+    for line in text.splitlines():
+        if line.startswith("rate_limiter_net_syscalls_total{"):
+            sys_total += float(line.rsplit(" ", 1)[1])
+    return sys_total, float(decisions)
+
+
+def run_conn_sweep(*, seconds: float = 2.5, pairs: int = 2,
+                   conns=(16, 64, 256, 512), frame_keys: int = 8,
+                   inflight: int = 4, loadgen: Optional[str] = None,
+                   log=print) -> Dict:
+    """Connection-count sweep for the multi-ring network engine
+    (ISSUE-20, ADR-026): INTERLEAVED paired rounds of baseline vs new
+    engine at each connection count, C++ loadgen hashed lane, emitting
+    per-row throughput, p99, and syscalls-per-decision into
+    NETENG_r01.json.
+
+    Two ``--native`` servers stay up for the whole sweep:
+
+    * baseline — ``--net-engine epoll --io-rings 1`` plus
+      ``RL_NET_COALESCE=0``, the bench-honesty env knob that restores
+      the pre-ISSUE-20 write profile (one send syscall per reply frame,
+      one eventfd ding per queued reply) in the SAME binary, so the
+      pair measures the engine work and not build drift;
+    * engine — ``--net-engine auto`` (best available backend, auto ring
+      count), the shipped default.
+
+    ``frame_keys`` is deliberately tiny (8): per-frame wire cost is the
+    numerator under test, and jumbo frames would hide it behind the
+    device decide (same honesty note as run_shm_ab). Rounds alternate
+    baseline/engine back-to-back per connection count so machine drift
+    cancels in-pair; syscalls-per-decision is computed from counter
+    deltas around each round (engine-maintained counters, not strace)."""
+    import json
+    import shutil
+    import tempfile
+
+    if shutil.which("g++") is None:
+        return {"error": "no g++"}
+    td = None
+    rows: List[Dict] = []
+    try:
+        if loadgen is None:
+            td = tempfile.mkdtemp()
+            loadgen = _build_loadgen(td)
+        base_proc, base_port = _spawn_server(
+            "sketch", platform="cpu", native=True, max_batch=16384,
+            inflight=inflight,
+            extra_args=["--net-engine", "epoll", "--io-rings", "1",
+                        "--limit", "1000000"],
+            extra_env={"RL_NET_COALESCE": "0"})
+        eng_proc = None
+        try:
+            eng_proc, eng_port = _spawn_server(
+                "sketch", platform="cpu", native=True, max_batch=16384,
+                inflight=inflight,
+                extra_args=["--net-engine", "auto",
+                            "--limit", "1000000"])
+
+            def run(port: int, n_conns: int) -> Dict:
+                pre_sys, pre_dec = _net_counters(port)
+                out = subprocess.run(
+                    [loadgen, "127.0.0.1", str(port), str(seconds),
+                     str(n_conns), str(inflight), str(frame_keys),
+                     "100000", "hashed"],
+                    capture_output=True, text=True, timeout=seconds + 120)
+                row = json.loads(out.stdout.strip())
+                post_sys, post_dec = _net_counters(port)
+                d_dec = max(post_dec - pre_dec, 1.0)
+                row["syscalls_per_decision"] = round(
+                    (post_sys - pre_sys) / d_dec, 4)
+                return row
+
+            for n_conns in conns:
+                for i in range(max(1, pairs)):
+                    rd = {"conns": n_conns, "round": i,
+                          "baseline": run(base_port, n_conns),
+                          "engine": run(eng_port, n_conns)}
+                    rows.append(rd)
+                    log(f"conn-sweep {n_conns}c round {i + 1}: "
+                        f"base={rd['baseline']['decisions_per_sec']:.0f}/s"
+                        f"({rd['baseline']['syscalls_per_decision']:.3f} "
+                        "sys/dec) "
+                        f"engine={rd['engine']['decisions_per_sec']:.0f}/s"
+                        f"({rd['engine']['syscalls_per_decision']:.3f} "
+                        "sys/dec)")
+            eng_net = _engine_probe(eng_port)
+        finally:
+            for proc in (base_proc, eng_proc):
+                if proc is None:
+                    continue
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    finally:
+        if td is not None:
+            import shutil as _sh
+
+            _sh.rmtree(td, ignore_errors=True)
+
+    def best_pair(n_conns: int) -> Dict:
+        cand = [r for r in rows if r["conns"] == n_conns]
+        rd = max(cand, key=lambda r: (r["engine"]["decisions_per_sec"]
+                                      / max(r["baseline"]
+                                            ["decisions_per_sec"], 1e-9)))
+        b, e = rd["baseline"], rd["engine"]
+        return {
+            "baseline_decisions_per_sec": b["decisions_per_sec"],
+            "engine_decisions_per_sec": e["decisions_per_sec"],
+            "throughput_ratio": round(e["decisions_per_sec"]
+                                      / max(b["decisions_per_sec"], 1e-9),
+                                      3),
+            "baseline_syscalls_per_decision": b["syscalls_per_decision"],
+            "engine_syscalls_per_decision": e["syscalls_per_decision"],
+            "syscall_cut": round(b["syscalls_per_decision"]
+                                 / max(e["syscalls_per_decision"], 1e-9),
+                                 2),
+            "baseline_frame_p99_ms": b["frame_p99_ms"],
+            "engine_frame_p99_ms": e["frame_p99_ms"],
+        }
+
+    return {
+        "rows": rows,
+        "paired_best": {str(n): best_pair(n) for n in conns},
+        "engine": eng_net,
+        "harness": (
+            f"cpp_loadgen hashed lane, {frame_keys}-id frames x "
+            f"{inflight} pipelined, interleaved baseline/engine rounds "
+            "per connection count against two --native sketch-on-cpu "
+            "servers (baseline: --net-engine epoll --io-rings 1 + "
+            "RL_NET_COALESCE=0 = pre-ISSUE-20 write-per-frame profile; "
+            "engine: --net-engine auto); syscalls_per_decision from "
+            "engine counter deltas (rate_limiter_net_syscalls_total) "
+            "around each round; paired_best is the round with the best "
+            "engine/baseline throughput ratio (drift cancels in-pair)"),
+    }
+
+
+def _engine_probe(port: int) -> Dict:
+    """The engine/rings/probe identity of a live server, via /metrics
+    (rate_limiter_net_engine_info labels) — recorded in NETENG_r01.json
+    so the row says WHICH backend produced it."""
+    from ratelimiter_tpu.serving import Client
+
+    c = Client("127.0.0.1", port)
+    try:
+        text = c.metrics()
+    finally:
+        c.close()
+    for line in text.splitlines():
+        if line.startswith("rate_limiter_net_engine_info{"):
+            labels = line[line.index("{") + 1:line.index("}")]
+            out = {}
+            for part in labels.split(","):
+                k, _, v = part.partition("=")
+                out[k.strip()] = v.strip().strip('"')
+            return out
+    return {}
+
+
 def run_mesh_loadgen(n_devices: int, *, seconds: float = 4.0,
                      affine: bool = True, spread: Optional[int] = None,
                      loadgen: Optional[str] = None,

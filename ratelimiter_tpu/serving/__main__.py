@@ -248,6 +248,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="native front door dispatch shards: keys are "
                          "hash-routed, each shard decides on its own "
                          "limiter concurrently (per-key semantics exact)")
+    ap.add_argument("--net-engine", default="auto",
+                    choices=("auto", "epoll", "uring"),
+                    help="native door wire backend (ADR-026): auto probes "
+                         "io_uring at startup and falls back to epoll when "
+                         "the kernel or seccomp refuses; epoll forces the "
+                         "portable backend; uring requests io_uring but "
+                         "still downgrades (recorded in stats/healthz) "
+                         "rather than failing")
+    ap.add_argument("--io-rings", type=int, default=0,
+                    help="native door io ring shards: event-loop threads "
+                         "connections are pinned to by accept order; 0 = "
+                         "auto (min(4, cores))")
     ap.add_argument("--no-prewarm", action="store_true",
                     help="skip jit pre-warming of batch pad shapes at startup")
     ap.add_argument("--log-level", default="info")
@@ -1532,6 +1544,7 @@ async def amain(args) -> None:
             shards=(len(slices) if mesh_native else args.shards),
             # Fleet membership gossips over the DCN channel, so a fleet
             # member always listens for pushes.
+            net_engine=args.net_engine, io_rings=args.io_rings,
             dcn=bool(args.dcn_listen or args.dcn_peer or fleet_core),
             dcn_secret=dcn_secret,
             max_dcn_conns=args.dcn_max_transfers,
@@ -1697,10 +1710,14 @@ async def amain(args) -> None:
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
+        net_info = (server.transport_stats() or {}).get("net", {})
         print(f"serving(native) {args.algorithm}/{args.backend} "
               f"limit={args.limit}/{args.window:g}s on "
               + (args.listen if args.listen
                  else f"{args.host}:{server.port}")
+              + (f" net={net_info.get('engine', '?')}"
+                 f"x{net_info.get('rings', '?')}"
+                 f"(probe={net_info.get('uring_probe', '?')})")
               + (" shm" if args.shm else "")
               + (f" http:{gateway.port}" if gateway else "")
               + (f" grpc:{grpc_srv.port}" if grpc_srv else "")

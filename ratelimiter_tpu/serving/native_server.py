@@ -40,7 +40,7 @@ from ratelimiter_tpu.observability import metrics as m
 from ratelimiter_tpu.serving import protocol as p
 
 
-_ABI = 12
+_ABI = 13
 
 
 def _load_extension():
@@ -154,7 +154,8 @@ class NativeRateLimitServer:
                  shard_limiters: Optional[list] = None,
                  fleet=None, fleet_announce=None, leases=None,
                  shm: bool = False, shm_dir: str = "/dev/shm",
-                 shm_ring_bytes: int = 0):
+                 shm_ring_bytes: int = 0,
+                 net_engine: str = "auto", io_rings: int = 0):
         ext = _load_extension()
         if ext is None:
             raise RuntimeError(
@@ -317,7 +318,15 @@ class NativeRateLimitServer:
             # when on, T_SHM_HELLO upgrades a connection to SPSC ring
             # pairs in /dev/shm carrying the SAME wire frames.
             shm=bool(shm), shm_dir=str(shm_dir),
-            shm_ring_bytes=int(shm_ring_bytes))
+            shm_ring_bytes=int(shm_ring_bytes),
+            # Multi-ring network engine (ISSUE-20, ADR-026): backend
+            # request ("auto" probes io_uring at start and falls back to
+            # epoll with the reason recorded) + sharded io ring count
+            # (0 = auto: min(4, hardware threads); 1 + epoll reproduces
+            # the pre-ISSUE-20 single-loop behavior).
+            net_engine=str(net_engine), io_rings=int(io_rings))
+        self.net_engine = str(net_engine)
+        self.io_rings = int(io_rings)
         self.shm = bool(shm)
         self.shm_dir = str(shm_dir)
         self.shm_ring_bytes = int(shm_ring_bytes)
@@ -1027,7 +1036,11 @@ class NativeRateLimitServer:
         # thread owns the rings); report 0 so the gauge set is uniform.
         sh.setdefault("req_ring_used_bytes", 0)
         sh.setdefault("rep_ring_used_bytes", 0)
-        return {"connections": dict(st.get("transport", {})), "shm": sh}
+        # Network-engine ledger (ISSUE-20, ADR-026): selected backend,
+        # ring count, probe verdict and the syscall counters — rides
+        # transport_stats so /healthz carries the probe record.
+        return {"connections": dict(st.get("transport", {})), "shm": sh,
+                "net": dict(st.get("net", {}))}
 
     def _collect_transport_metrics(self) -> None:
         st = self.transport_stats()
@@ -1067,5 +1080,27 @@ class NativeRateLimitServer:
             "High-water shm ring occupancy across lanes")
         hg.set(sh["req_ring_highwater_bytes"], ring="req")
         hg.set(sh["rep_ring_highwater_bytes"], ring="rep")
+        net = st.get("net", {})
+        if net:
+            self.registry.gauge(
+                "rate_limiter_net_engine_info",
+                "Network engine identity (value 1): labels engine "
+                "(epoll/uring), rings, probe (pass/fail/off)").set(
+                    1, engine=net.get("engine", "epoll"),
+                    rings=str(net.get("rings", 0)),
+                    probe=net.get("uring_probe", "off"))
+            sg = self.registry.gauge(
+                "rate_limiter_net_syscalls_total",
+                "Wire-loop syscalls by kind (recv/writev/wait/wake) — "
+                "divide by decisions_total for syscalls per decision")
+            sg.set(net.get("recv_calls", 0), kind="recv")
+            sg.set(net.get("writev_calls", 0), kind="writev")
+            sg.set(net.get("wait_calls", 0), kind="wait")
+            sg.set(net.get("wake_calls", 0), kind="wake")
+            self.registry.gauge(
+                "rate_limiter_net_writev_frames",
+                "Reply frames flushed through vectored writes — over "
+                "net_syscalls_total{kind=\"writev\"} this is the "
+                "reply batch factor").set(net.get("writev_frames", 0))
 
 

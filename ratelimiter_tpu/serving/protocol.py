@@ -800,14 +800,28 @@ def parse_allow_batch(body: bytes):
     return keys, ns
 
 
-def encode_result_batch(req_id: int, limit: int, results) -> bytes:
-    parts = [_BATCH_RES_HEAD.pack(limit, len(results))]
+def encode_result_batch_views(req_id: int, limit: int, results) -> list:
+    """T_RESULT_BATCH frame as a writev-style buffer list (ISSUE-20
+    satellite, mirror of encode_result_hashed_views): frame header +
+    batch head as one small bytes object, then each 25-byte result
+    record as its own buffer. The SINGLE source of the batch framing —
+    encode_result_batch joins these parts for the one-buffer form, so
+    the scatter-gather path is byte-identical by construction. The
+    asyncio server hands the list to transport.writelines (a true
+    writev under uvloop); the encoder never joins the full body."""
+    n = len(results)
+    body_len = _BATCH_RES_HEAD.size + n * _BATCH_RES_ITEM.size
+    parts = [_HDR.pack(1 + 8 + body_len, T_RESULT_BATCH, req_id)
+             + _BATCH_RES_HEAD.pack(limit, n)]
     for r in results:
         flags = (1 if r.allowed else 0) | (2 if r.fail_open else 0)
         parts.append(_BATCH_RES_ITEM.pack(flags, r.remaining, r.retry_after,
                                           r.reset_at))
-    body = b"".join(parts)
-    return _HDR.pack(1 + 8 + len(body), T_RESULT_BATCH, req_id) + body
+    return parts
+
+
+def encode_result_batch(req_id: int, limit: int, results) -> bytes:
+    return b"".join(encode_result_batch_views(req_id, limit, results))
 
 
 def parse_result_batch(body: bytes):

@@ -114,6 +114,11 @@ class RateLimitServer:
         self._started_at = time.time()
         self._serving = False
         self._conn_tasks: set = set()
+        #: Frames flushed through the vectored write path (writelines —
+        #: hashed lane + T_RESULT_BATCH). Mirrors the native door's
+        #: rate_limiter_net_writev_frames so the batch factor is
+        #: observable on both doors (ISSUE-20).
+        self._writev_frames = 0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -245,6 +250,11 @@ class RateLimitServer:
             "High-water shm ring occupancy across lanes")
         hg.set(sh["req_ring_highwater_bytes"], ring="req")
         hg.set(sh["rep_ring_highwater_bytes"], ring="rep")
+        self.registry.gauge(
+            "rate_limiter_net_writev_frames",
+            "Reply frames flushed through a vectored write "
+            "(writev/writelines batch factor, ISSUE-20)").set(
+                self._writev_frames)
 
     async def _shm_accept(self, lane, writer: asyncio.StreamWriter,
                           drain_cb) -> None:
@@ -313,6 +323,7 @@ class RateLimitServer:
             # concatenate once at the socket layer.
             try:
                 writer.writelines(bufs)
+                self._writev_frames += 1
                 _check_backpressure()
             except (ConnectionResetError, BrokenPipeError, RuntimeError):
                 pass
@@ -653,7 +664,7 @@ class RateLimitServer:
                             rec = tracing.RECORDER
                             t0 = tracing.now() if rec is not None else 0
                             results = agg.result()
-                            write_out(p.encode_result_batch(
+                            write_vec(p.encode_result_batch_views(
                                 req_id, self.limiter.config.limit,
                                 results))
                             if rec is not None:

@@ -11,7 +11,9 @@ backend-conditional families:
   envelope and the ADR-022 lease families;
 * a mesh member with quarantine — the per-slice failure-domain
   families;
-* a token-bucket server — the debt-slab families.
+* a token-bucket server behind the NATIVE door — the debt-slab
+  families plus the multi-ring network-engine families (ADR-026:
+  engine info, syscall ledger, writev batch factor).
 
 Direction 1: every `rate_limiter_*` name written in OPERATIONS §3 must
 exist in the union scrape (a documented name may also be a PREFIX of a
@@ -101,9 +103,10 @@ class TestMetricNameDrift:
                     "--http-port", str(https[1])],
                    {"XLA_FLAGS":
                     "--xla_force_host_platform_device_count=2"}),
-            # 3: token bucket (debt-slab families).
+            # 3: token bucket (debt-slab families) behind the NATIVE
+            # door (multi-ring net engine families, ISSUE-20).
             _spawn(["--algorithm", "token_bucket", "--backend",
-                    "sketch", "--port", str(ports[2]),
+                    "sketch", "--native", "--port", str(ports[2]),
                     "--http-port", str(https[2])]),
         ]
         try:

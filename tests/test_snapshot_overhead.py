@@ -10,31 +10,64 @@ shared CPU makes even off-lock work steal cycles — but an on-lock
 serialization regression at this state size (~6 MB npz + fsync per
 snapshot, every 0.25 s) blocks dispatches for hundreds of ms and blows
 far past it.
+
+Deflaked for ISSUE-20: the latency assertions are gated on a
+LOAD-QUIET check (1-minute loadavg sampled before and after the
+measurement). A busy box — e.g. a concurrent bench run on the same CI
+host — turns a budget miss into a skip with the measured numbers in
+the reason, never a spurious red; the structural assertions (the
+snapshot thread ran, dispatches flowed) hold regardless. A budget miss
+on a QUIET box still fails loudly — that is the regression the test
+exists to catch.
 """
 
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _box_quiet() -> bool:
+    """True when the 1-minute loadavg leaves headroom for the bench:
+    concurrent load (another test lane, a bench run) shows up here and
+    makes tail-latency budgets meaningless."""
+    try:
+        la1 = os.getloadavg()[0]
+    except OSError:  # pragma: no cover - non-POSIX
+        return True
+    return la1 <= (os.cpu_count() or 1) + 0.5
 
 
 def test_p99_within_budget_of_baseline(tmp_path):
     from bench import measure_snapshot_overhead
 
+    quiet_before = _box_quiet()
     out = measure_snapshot_overhead(
         0.25, snapshot_dir=str(tmp_path), seconds=2.0,
         depth=3, width=1 << 14, sub_windows=60)
+    quiet_after = _box_quiet()
     base = out["baseline"]
     snap = out["with_snapshots"]
+    # Structural invariants hold on any box, loaded or not.
     assert snap["snapshots_taken"] >= 1, out     # the thread actually ran
     assert base["dispatches"] > 50 and snap["dispatches"] > 50, out
     budget_ms = max(5.0 * base["p99_ms"], base["p99_ms"] + 250.0)
-    assert snap["p99_ms"] <= budget_ms, (
+    p50_ok = snap["p50_ms"] <= 3.0 * base["p50_ms"] + 5.0
+    p99_ok = snap["p99_ms"] <= budget_ms
+    if not (p99_ok and p50_ok) and not (quiet_before and quiet_after):
+        pytest.skip(
+            f"latency budget not assertable under concurrent load "
+            f"(loadavg {os.getloadavg()[0]:.1f} on "
+            f"{os.cpu_count()} cpus): base p99={base['p99_ms']}ms "
+            f"snap p99={snap['p99_ms']}ms budget={budget_ms:.1f}ms")
+    assert p99_ok, (
         f"background snapshotting pushed p99 from {base['p99_ms']}ms to "
         f"{snap['p99_ms']}ms (budget {budget_ms:.1f}ms) — is "
         f"serialization running under the limiter lock? {out}")
     # The median must be essentially untouched: snapshots are rare
     # events, so any broad shift means constant overhead leaked into
     # the decision path.
-    assert snap["p50_ms"] <= 3.0 * base["p50_ms"] + 5.0, out
+    assert p50_ok, out
